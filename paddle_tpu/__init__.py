@@ -1,0 +1,88 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+A ground-up re-design of the capabilities of the reference framework
+(PaddlePaddle; see SURVEY.md) for TPU: eager define-by-run autograd recorded
+over XLA-traceable ops, whole-step program capture (``paddle_tpu.jit``),
+Pallas kernels for the fused hot set, and hybrid parallelism (DP/TP/SP/PP/
+ZeRO/EP + SPMD auto-parallel) expressed as shardings over a
+``jax.sharding.Mesh`` with XLA collectives over ICI/DCN.
+"""
+
+from __future__ import annotations
+
+from .core import (
+    OP_REGISTRY,
+    Parameter,
+    Tensor,
+    backward,
+    enable_grad,
+    get_flags,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    set_flags,
+    set_grad_enabled,
+)
+from .core.device import (
+    device_count,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    is_compiled_with_xpu,
+    set_device,
+)
+from .core.dtype import (
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+)
+from .core.random import seed
+from .ops import *  # noqa: F401,F403
+from .ops import sum, max, min, all, any, abs, pow, slice  # noqa: A004,F401
+
+from . import autograd, framework, version
+
+__version__ = version.__version__
+
+in_dynamic_mode = framework.in_dynamic_mode
+save = framework.save
+load = framework.load
+
+# Subpackages (nn, optimizer, amp, io, jit, distributed, ...) are imported
+# lazily on first attribute access to keep core import light.
+_LAZY_SUBMODULES = (
+    "nn",
+    "optimizer",
+    "amp",
+    "io",
+    "jit",
+    "metric",
+    "static",
+    "vision",
+    "distributed",
+    "incubate",
+    "profiler",
+    "distribution",
+    "sparse",
+    "device",
+    "models",
+)
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

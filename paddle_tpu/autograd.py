@@ -1,0 +1,177 @@
+"""User-facing autograd package (reference: python/paddle/autograd/).
+
+``backward``/``grad``/``no_grad`` re-export the engine; ``PyLayer`` provides
+custom forward/backward definitions recorded on the same tape
+(reference: python/paddle/autograd/py_layer.py:282).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .core import autograd as _engine
+from .core.autograd import backward, enable_grad, grad, is_grad_enabled, no_grad
+from .core.tensor import Tensor
+
+__all__ = [
+    "backward",
+    "grad",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "PyLayer",
+    "PyLayerContext",
+    "saved_tensors_hooks",
+]
+
+
+class PyLayerContext:
+    """Context passed to PyLayer.forward/backward for residual stashing.
+
+    If a :class:`saved_tensors_hooks` scope is active at forward time, its
+    pack hook is applied to every saved tensor and the matching unpack hook
+    at access time (activation-offload workflows).
+    """
+
+    def __init__(self):
+        self._saved: tuple = ()
+        self._unpack = None
+        self.materialize_grads = True
+        self._extra: dict[str, Any] = {}
+
+    def save_for_backward(self, *tensors):
+        scope = saved_tensors_hooks._active[-1] if saved_tensors_hooks._active else None
+        if scope is not None:
+            self._saved = tuple(scope.pack_hook(t) for t in tensors)
+            self._unpack = scope.unpack_hook
+        else:
+            self._saved = tuple(tensors)
+
+    @property
+    def saved_tensor(self):
+        if self._unpack is not None:
+            return tuple(self._unpack(t) for t in self._saved)
+        return self._saved
+
+    saved_tensors = saved_tensor
+
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+
+class _PyLayerNode(_engine.GradNode):
+    """GradNode whose pullback calls the user's backward()."""
+
+    def __init__(self, layer_cls, ctx, inputs, outs):
+        self.layer_cls = layer_cls
+        self.ctx = ctx
+        # Build base fields without a vjp_fn.
+        super().__init__(layer_cls.__name__, None, inputs, outs)
+
+    def apply(self, out_grads):
+        if self.released:
+            raise RuntimeError(
+                f"PyLayer {self.name} node released; use retain_graph=True"
+            )
+        cots = []
+        for g, s, d in zip(out_grads, self.out_shapes, self.out_dtypes):
+            if g is None:
+                g = jnp.zeros(s, d) if self.ctx.materialize_grads else None
+            cots.append(Tensor(g, stop_gradient=True) if g is not None else None)
+        with no_grad():
+            res = self.layer_cls.backward(
+                self.ctx, *(cots if len(cots) > 1 else [cots[0]])
+            )
+        if not isinstance(res, (tuple, list)):
+            res = (res,)
+        out = []
+        for r in res:
+            if r is None:
+                out.append(None)
+            else:
+                out.append(r._data if isinstance(r, Tensor) else jnp.asarray(r))
+        # Pad with Nones for inputs that get no grad.
+        while len(out) < len(self.inputs):
+            out.append(None)
+        return out
+
+    def release(self):
+        self.ctx = None
+        self.inputs = []
+        self.released = True
+
+
+class PyLayer:
+    """Custom op with user-defined forward and backward.
+
+    Usage matches the reference::
+
+        class Tanh(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                y = paddle_tpu.tanh(x)
+                ctx.save_for_backward(y)
+                return y
+
+            @staticmethod
+            def backward(ctx, dy):
+                (y,) = ctx.saved_tensor
+                return dy * (1 - y * y)
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)] + [
+            v for v in kwargs.values() if isinstance(v, Tensor)
+        ]
+        requires = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs
+        )
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        outs_t = (outs,) if single else tuple(outs)
+        if requires:
+            node = _PyLayerNode(
+                cls, ctx, tensor_inputs, tuple(o._data for o in outs_t)
+            )
+            node.multi_output = not single
+            for i, o in enumerate(outs_t):
+                o.stop_gradient = False
+                o._grad_node = node
+                o._out_slot = i
+        return outs if not single else outs_t[0]
+
+
+class saved_tensors_hooks:
+    """Pack/unpack hooks for activation offload-style workflows
+    (reference: python/paddle/autograd/saved_tensors_hooks.py). The eager
+    tape stores residuals inside jax vjp closures, so these hooks apply only
+    to PyLayer ``save_for_backward`` payloads.
+    """
+
+    _active: list = []
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        saved_tensors_hooks._active.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        saved_tensors_hooks._active.pop()
+        return False

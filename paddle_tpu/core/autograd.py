@@ -1,0 +1,414 @@
+"""Define-by-run autograd engine.
+
+TPU-native re-design of the reference's eager autograd
+(paddle/fluid/eager/backward.cc:105,439 ``RunBackward`` and
+paddle/fluid/eager/grad_node_info.h:197 ``GradNodeBase``): a tape of
+``GradNode``s is recorded as ops execute; ``backward`` walks it in
+topological order with an in-degree map and accumulates gradients.
+
+The key architectural change vs the reference: a GradNode does not re-dispatch
+a hand-written grad kernel. Each node holds the ``jax.vjp`` pullback of its
+op's XLA-traceable forward, so the backward computation is itself XLA-compiled
+(eagerly per-op, or fused into one program when the whole step is captured by
+``paddle_tpu.jit.to_static``).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "GradNode",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "backward",
+    "grad",
+]
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+def set_grad_enabled(mode: bool) -> None:
+    _state.enabled = bool(mode)
+
+
+class _GradModeGuard:
+    """Context manager / decorator toggling grad recording."""
+
+    def __init__(self, mode: bool):
+        self._mode = mode
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = self._mode
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*args, **kwargs):
+            with _GradModeGuard(self._mode):
+                return fn(*args, **kwargs)
+
+        wrapper.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapper
+
+
+def no_grad():
+    return _GradModeGuard(False)
+
+
+def enable_grad():
+    return _GradModeGuard(True)
+
+
+def _zero_cotangent(aval_shape, aval_dtype):
+    """Zero cotangent for an output slot that received no gradient."""
+    if jnp.issubdtype(aval_dtype, jnp.inexact):
+        return jnp.zeros(aval_shape, aval_dtype)
+    # Integer/bool outputs take float0 cotangents under jax.vjp.
+    return np.zeros(aval_shape, dtype=jax.dtypes.float0)
+
+
+class GradNode:
+    """One recorded op on the tape.
+
+    Holds the vjp pullback, references to the op's input tensors (the edges
+    of the graph — an input's own ``_grad_node`` is the upstream node), and
+    the output metadata needed to materialize zero cotangents.
+    """
+
+    __slots__ = (
+        "name",
+        "vjp_fn",
+        "inputs",
+        "out_shapes",
+        "out_dtypes",
+        "multi_output",
+        "released",
+    )
+
+    def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence, outs):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)
+        self.multi_output = isinstance(outs, (tuple, list))
+        outs_t = outs if self.multi_output else (outs,)
+        self.out_shapes = [o.shape for o in outs_t]
+        self.out_dtypes = [o.dtype for o in outs_t]
+        self.released = False
+
+    @property
+    def num_outputs(self) -> int:
+        return len(self.out_shapes)
+
+    def apply(self, out_grads: list):
+        """Run the pullback: per-output cotangents -> per-input gradients."""
+        if self.released:
+            raise RuntimeError(
+                f"GradNode<{self.name}> has been released; pass "
+                "retain_graph=True to backward() to backprop twice."
+            )
+        cotangents = [
+            g if g is not None else _zero_cotangent(s, d)
+            for g, s, d in zip(out_grads, self.out_shapes, self.out_dtypes)
+        ]
+        if self.multi_output:
+            in_grads = self.vjp_fn(tuple(cotangents))
+        else:
+            in_grads = self.vjp_fn(cotangents[0])
+        return in_grads
+
+    def release(self):
+        self.vjp_fn = None
+        self.inputs = []
+        self.released = True
+
+
+def _accumulate(slot_grads: dict, key, value):
+    prev = slot_grads.get(key)
+    if prev is None or (hasattr(value, "dtype") and value.dtype == jax.dtypes.float0):
+        slot_grads[key] = value if prev is None else prev
+    else:
+        slot_grads[key] = prev + value
+
+
+def _discover(seed_nodes):
+    """BFS the reachable tape; return (reachable set, in-degree per node).
+
+    In-degree counts edges from reachable consumer nodes into a node — the
+    same dependency-count scheme as the reference's RunBackward
+    (paddle/fluid/eager/backward.cc:23 ``getInDegreeMap``).
+    """
+    reachable = set()
+    indeg: dict[int, int] = {}
+    nodes: dict[int, GradNode] = {}
+    queue = deque(seed_nodes)
+    for n in seed_nodes:
+        nodes[id(n)] = n
+        reachable.add(id(n))
+        indeg.setdefault(id(n), 0)
+    while queue:
+        node = queue.popleft()
+        for t in node.inputs:
+            up = t._grad_node
+            if up is None:
+                continue
+            if id(up) not in reachable:
+                reachable.add(id(up))
+                nodes[id(up)] = up
+                indeg.setdefault(id(up), 0)
+                queue.append(up)
+            indeg[id(up)] = indeg.get(id(up), 0) + 1
+    return nodes, indeg
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False, _sink=None):
+    """Run reverse accumulation from ``tensors``.
+
+    Mirrors ``egr::Backward`` (paddle/fluid/eager/backward.cc:439): seeds the
+    queue with the output nodes, accumulates per-(node, slot) gradients in a
+    holder, and fires a node once all of its consumers have contributed.
+    Leaf tensors (``stop_gradient=False`` with no producing node) receive
+    accumulated ``.grad``.
+
+    Grad hooks fire exactly once per tensor, on the fully accumulated
+    gradient (matching the reference's hook semantics), which is why hook
+    application happens at node-fire time rather than per consumer edge.
+
+    ``_sink`` (internal, used by :func:`grad`): dict to receive leaf grads
+    keyed by id(tensor) instead of writing ``.grad`` — keeps the functional
+    API from polluting unrelated leaves.
+    """
+    from .tensor import Tensor  # local import; tensor.py imports this module
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # (id(node), slot) -> accumulated cotangent
+    holder: dict[tuple[int, int], Any] = {}
+    # id(tensor) -> [tensor, accumulated grad array] for leaves
+    leaf_acc: dict[int, list] = {}
+    seed_nodes = []
+
+    def leaf_route(t, g):
+        if (t.stop_gradient and not t._retain_grads) or g is None:
+            return
+        if hasattr(g, "dtype") and g.dtype == jax.dtypes.float0:
+            return
+        entry = leaf_acc.get(id(t))
+        if entry is None:
+            leaf_acc[id(t)] = [t, g]
+        else:
+            entry[1] = entry[1] + g
+
+    with no_grad():
+        for t, g in zip(tensors, grad_tensors):
+            if t.stop_gradient and t._grad_node is None:
+                continue
+            if g is None:
+                if t.size != 1:
+                    raise RuntimeError(
+                        "grad can be implicitly created only for scalar "
+                        f"outputs; got shape {t.shape}"
+                    )
+                g_arr = jnp.ones(t.shape, t.dtype)
+            else:
+                g_arr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+            node = t._grad_node
+            if node is None:
+                leaf_route(t, g_arr)
+                continue
+            if node not in seed_nodes:
+                seed_nodes.append(node)
+            _accumulate(holder, (id(node), t._out_slot), g_arr)
+
+        if seed_nodes:
+            nodes, indeg = _discover(seed_nodes)
+            # Map (producer node, slot) -> the produced tensor's hooks /
+            # retain flag, discovered from consumer edges and seeds.
+            slot_tensors: dict[tuple[int, int], Any] = {}
+
+            def note_tensor(t):
+                if t._grad_node is not None and (t._hooks or t._retain_grads):
+                    slot_tensors[(id(t._grad_node), t._out_slot)] = t
+
+            for t in tensors:
+                if isinstance(t, Tensor):
+                    note_tensor(t)
+            for n in nodes.values():
+                for t in n.inputs:
+                    note_tensor(t)
+
+            ready = deque(n for n in nodes.values() if indeg[id(n)] == 0)
+            while ready:
+                node = ready.popleft()
+                out_grads = []
+                for slot in range(node.num_outputs):
+                    g = holder.pop((id(node), slot), None)
+                    t = slot_tensors.get((id(node), slot))
+                    if t is not None and g is not None:
+                        for hook in t._hooks:
+                            g = hook_to_array(hook, g, t)
+                        if t._retain_grads:
+                            _write_grad(t, g, accumulate=True)
+                    out_grads.append(g)
+                inputs = list(node.inputs)
+                in_grads = node.apply(out_grads)
+                if not retain_graph:
+                    node.release()
+                for t, g in zip(inputs, in_grads):
+                    up = t._grad_node
+                    if up is not None:
+                        _accumulate(holder, (id(up), t._out_slot), g)
+                        indeg[id(up)] -= 1
+                        if indeg[id(up)] == 0:
+                            ready.append(up)
+                    else:
+                        leaf_route(t, g)
+
+        # Finalize leaves: apply hooks once on the accumulated grad.
+        for t, g in leaf_acc.values():
+            for hook in t._hooks:
+                g = hook_to_array(hook, g, t)
+            if _sink is not None:
+                _accumulate(_sink, id(t), g)
+            else:
+                _write_grad(t, g, accumulate=True)
+
+
+def _write_grad(t, g, accumulate: bool = False):
+    from .tensor import Tensor
+
+    if accumulate and t._grad is not None:
+        t._grad = Tensor(t._grad._data + g, stop_gradient=True)
+    else:
+        t._grad = Tensor(g, stop_gradient=True)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph: bool = False,
+    create_graph: bool = False,
+    allow_unused: bool = False,
+):
+    """Functional gradient API (reference: paddle.grad,
+    python/paddle/base/dygraph/base.py:656).
+
+    Returns gradients of ``outputs`` w.r.t. ``inputs`` without touching
+    ``.grad`` on any other tensor. ``create_graph`` is not yet supported on
+    the tape path (use jit-captured jax.grad for higher-order needs).
+    """
+    from .tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True is not supported on the eager tape; capture "
+            "the computation with paddle_tpu.jit and use functional grads."
+        )
+    from .tensor import Tensor as _T
+
+    # Route all leaf grads into a sink so no tensor's .grad is touched;
+    # temporarily mark the requested inputs as grad-receiving.
+    saved = [(t._retain_grads, t.stop_gradient) for t in inputs]
+    sink: dict[int, Any] = {}
+    intermediates = []
+    for t in inputs:
+        if t._grad_node is None:
+            t.stop_gradient = False
+        else:
+            # Intermediate target: capture via a one-shot hook on the slot.
+            t._retain_grads = False
+            intermediates.append(t)
+    hooks = []
+    for t in intermediates:
+        def make_hook(tid):
+            def h(g):
+                _accumulate(sink, tid, g._data)
+                return None
+
+            return h
+
+        hk = make_hook(id(t))
+        t._hooks.append(hk)
+        hooks.append((t, hk))
+    try:
+        backward(outputs, grad_outputs, retain_graph=retain_graph, _sink=sink)
+        results = []
+        for t in inputs:
+            g = sink.get(id(t))
+            if g is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "one of the input tensors received no gradient; pass "
+                        "allow_unused=True to get None instead"
+                    )
+                results.append(None)
+            else:
+                results.append(_T(g, stop_gradient=True))
+    finally:
+        for t, (old_retain, old_sg) in zip(inputs, saved):
+            t._retain_grads = old_retain
+            t.stop_gradient = old_sg
+        for t, hk in hooks:
+            if hk in t._hooks:
+                t._hooks.remove(hk)
+    return results
+
+
+def hook_to_array(hook, g, t):
+    """Apply a user hook (Tensor -> Tensor) to a raw grad array."""
+    from .tensor import Tensor
+
+    res = hook(Tensor(g, stop_gradient=True))
+    if res is None:
+        return g
+    return res._data if isinstance(res, Tensor) else jnp.asarray(res)
+
+
+def _leaf_receive(t, g, hooked: bool = False):
+    """Accumulate a gradient into a leaf (or retain_grads) tensor's .grad."""
+    from .tensor import Tensor
+
+    if t.stop_gradient and not t._retain_grads:
+        return
+    if not hooked:
+        for hook in t._hooks:
+            g = hook_to_array(hook, g, t)
+    if hasattr(g, "dtype") and g.dtype == jax.dtypes.float0:
+        return
+    if t._grad is None:
+        t._grad = Tensor(g, stop_gradient=True)
+    else:
+        t._grad = Tensor(t._grad._data + g, stop_gradient=True)

@@ -1,0 +1,97 @@
+"""Device management (reference: paddle/phi/backends device layer).
+
+XLA/PJRT owns device enumeration, streams and memory; this module provides
+the user-facing Place/set_device API surface (paddle.set_device,
+paddle.device.*) mapped onto jax devices, plus memory stats
+(reference: paddle/phi/core/memory/stats.h).
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = [
+    "set_device",
+    "get_device",
+    "device_count",
+    "is_compiled_with_cuda",
+    "is_compiled_with_xpu",
+    "is_compiled_with_tpu",
+    "get_all_devices",
+    "max_memory_allocated",
+    "memory_allocated",
+    "synchronize",
+]
+
+_current_device = None
+
+
+def get_all_devices():
+    return jax.devices()
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def set_device(device: str):
+    """Accepts 'tpu', 'tpu:0', 'cpu', 'gpu:0' style strings."""
+    global _current_device
+    if ":" in device:
+        platform, idx = device.split(":")
+        idx = int(idx)
+    else:
+        platform, idx = device, 0
+    platform = {"gpu": "cuda", "xpu": "tpu"}.get(platform, platform)
+    devs = [d for d in jax.devices() if d.platform.lower().startswith(platform[:3])]
+    if not devs:
+        devs = jax.devices()
+    _current_device = devs[min(idx, len(devs) - 1)]
+    jax.config.update("jax_default_device", _current_device)
+    return _current_device
+
+
+def get_device() -> str:
+    d = _current_device or jax.devices()[0]
+    return f"{d.platform}:{getattr(d, 'id', 0)}"
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform in ("tpu", "axon") for d in jax.devices())
+
+
+def synchronize():
+    """Block until all queued work on the default device is complete."""
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def _mem_stats(device=None):
+    d = device or _current_device or jax.devices()[0]
+    try:
+        return d.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None) -> int:
+    return int(_mem_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    return int(_mem_stats(device).get("peak_bytes_in_use", 0))
+
+
+def max_memory_reserved(device=None) -> int:
+    return int(_mem_stats(device).get("bytes_reserved", memory_allocated(device)))

@@ -1,0 +1,181 @@
+"""Op dispatch: the single funnel every framework op goes through.
+
+This replaces the reference's per-op chain of generated wrappers
+(_C_ops binding -> *_ad_func AMP/autotune/GradNode capture -> phi kernel
+selection; see paddle/fluid/eager/auto_code_generator/generator/eager_gen.py
+and paddle/phi/api/generator/api_base.py:1327). Here one generic function:
+
+  1. extracts jax arrays from Tensor arguments (nested one level),
+  2. applies the active AMP cast policy,
+  3. runs the op's jax implementation — under ``jax.vjp`` when grad is
+     required, recording a GradNode on the tape,
+  4. wraps outputs back into Tensors.
+
+Because the implementations are pure jax, the same dispatch path works both
+eagerly (per-op XLA executables, cached by jax) and under program capture
+(``paddle_tpu.jit``), where tracers flow through transparently.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .flags import GLOBAL_FLAGS
+from .tensor import Tensor
+
+
+class _Ph:
+    __slots__ = ("i",)
+
+    def __init__(self, i):
+        self.i = i
+
+
+def _extract(obj, leaves: list):
+    if isinstance(obj, Tensor):
+        leaves.append(obj)
+        return _Ph(len(leaves) - 1)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_extract(o, leaves) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _extract(v, leaves) for k, v in obj.items()}
+    return obj
+
+
+def _rebuild(obj, arrays):
+    if isinstance(obj, _Ph):
+        return arrays[obj.i]
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_rebuild(o, arrays) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _rebuild(v, arrays) for k, v in obj.items()}
+    return obj
+
+
+class OpDef(NamedTuple):
+    name: str
+    impl: Callable
+    differentiable: bool
+    amp_policy: str  # 'cast' (to low precision), 'keep_fp32', 'promote', 'none'
+
+
+OP_REGISTRY: dict[str, OpDef] = {}
+
+# Set by paddle_tpu.amp when an auto_cast scope is active:
+#   {"enable": bool, "dtype": jnp dtype, "level": "O1"|"O2"}
+AMP_STATE: dict | None = None
+
+# Profiler/tracing hooks: fn(op_name) called per dispatch.
+DISPATCH_HOOKS: list[Callable[[str], Any]] = []
+
+
+def _amp_cast_arrays(opdef: OpDef, arrays: list):
+    state = AMP_STATE
+    if state is None or not state.get("enable"):
+        return arrays
+    policy = opdef.amp_policy
+    target = state["dtype"]
+    if policy == "cast" or (state.get("level") == "O2" and policy != "keep_fp32"):
+        return [
+            a.astype(target)
+            if hasattr(a, "dtype") and a.dtype == jnp.float32
+            else a
+            for a in arrays
+        ]
+    if policy == "keep_fp32":
+        return [
+            a.astype(jnp.float32)
+            if hasattr(a, "dtype") and a.dtype in (jnp.float16, jnp.bfloat16)
+            else a
+            for a in arrays
+        ]
+    return arrays
+
+
+def _check_nan_inf(name: str, outs):
+    for o in outs if isinstance(outs, tuple) else (outs,):
+        if isinstance(o, jax.core.Tracer) or not jnp.issubdtype(
+            o.dtype, jnp.inexact
+        ):
+            continue
+        if not bool(jnp.isfinite(o).all()):
+            msg = f"NaN or Inf detected in output of op '{name}'"
+            if GLOBAL_FLAGS.get("check_nan_inf_level") > 0:
+                print("WARNING:", msg)
+            else:
+                raise FloatingPointError(msg)
+
+
+def op_call(opdef: OpDef, args, kwargs):
+    leaves: list[Tensor] = []
+    t_args = _extract(list(args), leaves)
+    t_kwargs = _extract(kwargs, leaves) if kwargs else {}
+    arrays = [t._data for t in leaves]
+    arrays = _amp_cast_arrays(opdef, arrays)
+
+    for hook in DISPATCH_HOOKS:
+        hook(opdef.name)
+
+    requires_grad = (
+        opdef.differentiable
+        and autograd.is_grad_enabled()
+        and any(not t.stop_gradient for t in leaves)
+    )
+
+    if requires_grad:
+        def primal(*arrs):
+            out = opdef.impl(
+                *_rebuild(t_args, arrs), **_rebuild(t_kwargs, arrs)
+            )
+            return tuple(out) if isinstance(out, list) else out
+
+        outs, vjp_fn = jax.vjp(primal, *arrays)
+        node = autograd.GradNode(opdef.name, vjp_fn, leaves, outs)
+    else:
+        outs = opdef.impl(*_rebuild(t_args, arrays), **_rebuild(t_kwargs, arrays))
+        if isinstance(outs, list):
+            outs = tuple(outs)
+        node = None
+
+    if GLOBAL_FLAGS.get("check_nan_inf"):
+        _check_nan_inf(opdef.name, outs)
+
+    def wrap(arr, slot):
+        t = Tensor(arr, stop_gradient=node is None)
+        if node is not None:
+            t._grad_node = node
+            t._out_slot = slot
+        return t
+
+    if isinstance(outs, tuple):
+        return tuple(wrap(o, i) for i, o in enumerate(outs))
+    return wrap(outs, 0)
+
+
+def op(name: str | None = None, differentiable: bool = True, amp: str = "none"):
+    """Register a framework op from a pure-jax implementation.
+
+    The analog of the reference's YAML op entry + PD_REGISTER_KERNEL
+    (paddle/phi/ops/yaml/ops.yaml; paddle/phi/core/kernel_registry.h:196):
+    the op's schema is the Python signature, its "kernel" the jax/XLA
+    lowering, its grad rule the jax vjp, its AMP list membership ``amp``.
+    """
+
+    def deco(impl):
+        op_name = name or impl.__name__
+        opdef = OpDef(op_name, impl, differentiable, amp)
+        OP_REGISTRY[op_name] = opdef
+
+        @functools.wraps(impl)
+        def wrapper(*args, **kwargs):
+            return op_call(opdef, args, kwargs)
+
+        wrapper.op_name = op_name
+        return wrapper
+
+    return deco

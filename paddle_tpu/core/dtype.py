@@ -1,0 +1,77 @@
+"""Dtype system mapped onto jax.numpy dtypes.
+
+Role of the reference's phi DataType (paddle/phi/common/data_type.h) and the
+python-visible ``paddle.float32`` style constants. On TPU the canonical
+compute dtypes are float32 / bfloat16; fp64 is supported on CPU meshes for
+numeric tests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical dtype aliases exposed at package top level (paddle.float32, ...).
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR_TO_DTYPE = {
+    "float16": float16,
+    "fp16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int": int32,
+    "int64": int64,
+    "long": int64,
+    "uint8": uint8,
+    "uint16": uint16,
+    "uint32": uint32,
+    "uint64": uint64,
+    "bool": bool_,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+FLOATING_DTYPES = (float16, bfloat16, float32, float64)
+INTEGER_DTYPES = (int8, int16, int32, int64, uint8, uint16, uint32, uint64)
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize a string / numpy / jnp dtype spec to a numpy dtype object."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _STR_TO_DTYPE:
+            raise ValueError(f"unknown dtype string: {dtype!r}")
+        dtype = _STR_TO_DTYPE[dtype]
+    return jnp.dtype(dtype)
+
+
+def is_floating_point(dtype) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), jnp.integer)

@@ -1,0 +1,98 @@
+"""Global runtime flag registry.
+
+TPU-native analog of the reference's gflags-like registry
+(paddle/common/flags.h:83 ``PD_DEFINE_VARIABLE`` and
+paddle/common/flags_native.cc): typed flags, env-var override via
+``FLAGS_<name>``, and a ``get_flags``/``set_flags`` API surface
+(python/paddle/base/framework.py:157,132 in the reference).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    value: Any
+    type: type
+    help: str
+
+
+class FlagRegistry:
+    """Process-global typed flag store with FLAGS_* env override."""
+
+    def __init__(self):
+        self._flags: dict[str, _Flag] = {}
+        self._lock = threading.RLock()
+
+    def define(self, name: str, default: Any, help: str = "") -> None:
+        with self._lock:
+            if name in self._flags:
+                raise ValueError(f"flag '{name}' already defined")
+            value = default
+            env = os.environ.get(f"FLAGS_{name}")
+            if env is not None:
+                value = self._parse(env, type(default))
+            self._flags[name] = _Flag(name, default, value, type(default), help)
+
+    @staticmethod
+    def _parse(text: str, ty: type) -> Any:
+        if ty is bool:
+            return text.lower() in ("1", "true", "yes", "on")
+        return ty(text)
+
+    def get(self, name: str) -> Any:
+        with self._lock:
+            return self._flags[name].value
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            flag = self._flags[name]
+            if not isinstance(value, flag.type):
+                value = self._parse(str(value), flag.type)
+            flag.value = value
+
+    def has(self, name: str) -> bool:
+        with self._lock:
+            return name in self._flags
+
+    def all(self) -> dict[str, Any]:
+        with self._lock:
+            return {k: f.value for k, f in self._flags.items()}
+
+
+GLOBAL_FLAGS = FlagRegistry()
+
+
+def define_flag(name: str, default: Any, help: str = "") -> None:
+    GLOBAL_FLAGS.define(name, default, help)
+
+
+def get_flags(flags) -> dict[str, Any]:
+    """Query one flag name or a list of names; returns a dict."""
+    if isinstance(flags, str):
+        flags = [flags]
+    return {name: GLOBAL_FLAGS.get(name) for name in flags}
+
+
+def set_flags(flags: dict[str, Any]) -> None:
+    for name, value in flags.items():
+        GLOBAL_FLAGS.set(name, value)
+
+
+# Core runtime flags (subset of the reference's 178 exported flags in
+# paddle/common/flags.cc that are meaningful on a trace/compile runtime).
+define_flag("check_nan_inf", False, "Check outputs of every eager op for NaN/Inf.")
+define_flag("check_nan_inf_level", 0, "0: error on nan/inf; >0: log only.")
+define_flag("benchmark", False, "Synchronize after each op for accurate timing.")
+define_flag("eager_op_cache", True, "Cache per-op compiled executables in eager mode.")
+define_flag("use_bf16_matmul", False, "Force bf16 accumulation inputs for matmul ops.")
+define_flag("log_compiles", False, "Log XLA compilations triggered by the runtime.")
+define_flag("deterministic", False, "Prefer deterministic kernel lowering.")
+define_flag("allocator_strategy", "auto_growth", "Kept for API parity; XLA owns HBM.")

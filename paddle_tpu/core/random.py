@@ -1,0 +1,65 @@
+"""Global RNG management over jax's functional PRNG.
+
+Role of the reference's phi::Generator (paddle/phi/core/generator.h): a
+process-global seeded generator from which ops draw. Here the generator is a
+splittable jax PRNG key; every draw splits the key so eager calls are
+reproducible from ``paddle_tpu.seed``. Named generator states support the
+TP RNG tracker (reference: fleet/layers/mpu/random.py:34).
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["seed", "next_key", "get_state", "set_state", "Generator"]
+
+
+class Generator:
+    """Key creation is lazy so importing the framework never touches devices."""
+
+    def __init__(self, seed_: int = 0):
+        self._key = None
+        self._seed = seed_
+
+    def manual_seed(self, seed_: int):
+        self._key = jax.random.PRNGKey(seed_)
+        self._seed = seed_
+        return self
+
+    def next_key(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
+        return self._key
+
+    def set_state(self, state):
+        self._key = state
+
+
+_default = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default
+
+
+def seed(s: int) -> Generator:
+    _default.manual_seed(s)
+    return _default
+
+
+def next_key():
+    return _default.next_key()
+
+
+def get_state():
+    return _default.get_state()
+
+
+def set_state(state):
+    _default.set_state(state)

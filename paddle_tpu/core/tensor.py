@@ -1,0 +1,227 @@
+"""Eager Tensor: a mutable handle over an immutable jax.Array.
+
+Re-design of the reference's ``phi::DenseTensor`` + eager ``AutogradMeta``
+(paddle/phi/core/dense_tensor.h:37; paddle/fluid/eager/autograd_meta.h:61).
+On TPU the buffer itself is an XLA-owned ``jax.Array`` (or a tracer during
+program capture); mutation semantics ("in-place" ops, optimizer updates) are
+provided by rebinding ``_data``. Autograd metadata (producing GradNode, output
+slot, accumulated ``.grad``) lives directly on the handle.
+
+Most operator methods are installed by ``paddle_tpu.ops`` at import time
+(the analog of the reference's monkey_patch of generated ``_C_ops`` methods).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd
+
+__all__ = ["Tensor", "Parameter"]
+
+
+class Tensor:
+    __slots__ = (
+        "_data",
+        "stop_gradient",
+        "_grad",
+        "_grad_node",
+        "_out_slot",
+        "_hooks",
+        "_retain_grads",
+        "name",
+        "persistable",
+        "_dist_spec",
+        "__weakref__",
+        "__dict__",
+    )
+
+    def __init__(self, data, stop_gradient: bool = True, name: str = ""):
+        if isinstance(data, Tensor):
+            data = data._data
+        elif not isinstance(data, jax.Array) and not isinstance(
+            data, jax.core.Tracer
+        ):
+            data = jnp.asarray(data)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self._grad: Optional[Tensor] = None
+        self._grad_node: Optional[autograd.GradNode] = None
+        self._out_slot: int = 0
+        self._hooks: list = []
+        self._retain_grads: bool = False
+        self.name = name
+        self.persistable = False
+        self._dist_spec = None  # jax.sharding.PartitionSpec for auto-parallel
+
+    # ---- metadata ----------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        try:
+            return str(next(iter(self._data.devices())))
+        except Exception:
+            return "traced"
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    # ---- grad plumbing -----------------------------------------------------
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        if value is not None and not isinstance(value, Tensor):
+            value = Tensor(value, stop_gradient=True)
+        self._grad = value
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self._grad is not None:
+            self._grad = Tensor(jnp.zeros_like(self._grad._data), stop_gradient=True)
+        else:
+            self._grad = None
+
+    clear_grad = clear_gradient
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def register_hook(self, hook):
+        """Register a grad hook (Tensor -> Tensor|None). Returns a remover."""
+        self._hooks.append(hook)
+
+        class _Remover:
+            def __init__(self, hooks, h):
+                self._hooks, self._h = hooks, h
+
+            def remove(self):
+                if self._h in self._hooks:
+                    self._hooks.remove(self._h)
+
+        return _Remover(self._hooks, hook)
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    # ---- conversion --------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._data)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __float__(self):
+        return float(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __hash__(self):
+        return id(self)
+
+    # ---- mutation ----------------------------------------------------------
+    def set_value(self, value):
+        """Rebind the buffer (in-place assignment semantics)."""
+        if isinstance(value, Tensor):
+            value = value._data
+        else:
+            value = jnp.asarray(value, dtype=self.dtype)
+        self._data = value
+        return self
+
+    def copy_(self, other, blocking: bool = True):
+        return self.set_value(other)
+
+    def _bump(self, new_data):
+        """Internal: rebind after a recorded in-place style op."""
+        self._data = new_data
+        return self
+
+    # ---- misc --------------------------------------------------------------
+    def block_until_ready(self):
+        if hasattr(self._data, "block_until_ready"):
+            self._data.block_until_ready()
+        return self
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        try:
+            data_repr = repr(np.asarray(self._data))
+        except Exception:
+            data_repr = f"<traced {self._data.shape} {self._data.dtype}>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype}{grad_info},\n"
+            f"       {data_repr})"
+        )
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: paddle.base.framework.EagerParamBase).
+
+    Registered in a process-global weak set so jit capture can discover
+    live model state without explicit registration.
+    """
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed")
+
+    def __init__(self, data, trainable: bool = True, name: str = ""):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        self.persistable = True
+        _LIVE_PARAMETERS.add(self)
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+import weakref
+
+_LIVE_PARAMETERS: "weakref.WeakSet[Parameter]" = weakref.WeakSet()
+
+
+def live_parameters():
+    return list(_LIVE_PARAMETERS)

@@ -1,0 +1,80 @@
+"""Framework-level helpers: dygraph/static mode switch, save/load.
+
+Reference: python/paddle/base/framework.py (mode flags) and
+python/paddle/framework/io.py:773,1020 (paddle.save / paddle.load).
+Serialization uses numpy-backed pickle so checkpoints are portable and
+device-independent (XLA arrays are rehydrated on load).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Parameter, Tensor
+
+_dygraph_mode = True
+
+
+def in_dynamic_mode() -> bool:
+    return _dygraph_mode
+
+
+def in_dygraph_mode() -> bool:
+    return _dygraph_mode
+
+
+def enable_static():
+    global _dygraph_mode
+    _dygraph_mode = False
+
+
+def disable_static():
+    global _dygraph_mode
+    _dygraph_mode = True
+
+
+def _to_serializable(obj: Any):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._data),
+                "stop_gradient": obj.stop_gradient,
+                "is_parameter": isinstance(obj, Parameter)}
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_serializable(v) for v in obj)
+    return obj
+
+
+def _from_serializable(obj: Any, return_numpy: bool = False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["data"]
+            cls = Parameter if obj.get("is_parameter") else Tensor
+            t = cls(jnp.asarray(obj["data"]))
+            if not obj.get("is_parameter"):
+                t.stop_gradient = obj.get("stop_gradient", True)
+            return t
+        return {k: _from_serializable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_serializable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False) -> Any:
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_serializable(obj, return_numpy)

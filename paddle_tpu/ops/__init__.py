@@ -1,0 +1,190 @@
+"""Op library: creation/math/manipulation/linalg + Tensor method install.
+
+The analog of the reference's generated ``_C_ops`` + tensor monkey-patching
+(python/paddle/tensor/__init__.py and
+paddle/fluid/pybind/eager_method.cc): every public op is exported here and a
+curated set is installed as ``Tensor`` methods and operator dunders.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import creation, linalg, manipulation, math
+
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+
+# Names that collide with builtins keep their module-level definitions.
+from .math import sum, max, min, all, any, abs, pow  # noqa: F401,A004
+from .manipulation import slice  # noqa: F401,A004
+
+
+def _install_tensor_methods():
+    T = Tensor
+
+    # -- operator dunders ---------------------------------------------------
+    T.__add__ = lambda s, o: math.add(s, o)
+    T.__radd__ = lambda s, o: math.add(o, s)
+    T.__sub__ = lambda s, o: math.subtract(s, o)
+    T.__rsub__ = lambda s, o: math.subtract(o, s)
+    T.__mul__ = lambda s, o: math.multiply(s, o)
+    T.__rmul__ = lambda s, o: math.multiply(o, s)
+    T.__truediv__ = lambda s, o: math.divide(s, o)
+    T.__rtruediv__ = lambda s, o: math.divide(o, s)
+    T.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    T.__rfloordiv__ = lambda s, o: math.floor_divide(o, s)
+    T.__mod__ = lambda s, o: math.mod(s, o)
+    T.__pow__ = lambda s, o: math.pow(s, o)
+    T.__rpow__ = lambda s, o: math.pow(o, s)
+    T.__matmul__ = lambda s, o: math.matmul(s, o)
+    T.__rmatmul__ = lambda s, o: math.matmul(o, s)
+    T.__neg__ = lambda s: math.neg(s)
+    T.__abs__ = lambda s: math.abs(s)
+    T.__invert__ = lambda s: math.logical_not(s)
+    T.__eq__ = lambda s, o: math.equal(s, o)
+    T.__ne__ = lambda s, o: math.not_equal(s, o)
+    T.__lt__ = lambda s, o: math.less_than(s, o)
+    T.__le__ = lambda s, o: math.less_equal(s, o)
+    T.__gt__ = lambda s, o: math.greater_than(s, o)
+    T.__ge__ = lambda s, o: math.greater_equal(s, o)
+    T.__and__ = lambda s, o: math.logical_and(s, o)
+    T.__or__ = lambda s, o: math.logical_or(s, o)
+    T.__xor__ = lambda s, o: math.logical_xor(s, o)
+    T.__getitem__ = lambda s, item: manipulation.getitem(s, item)
+
+    def _setitem(s, item, value):
+        # In-place write: functional scatter, then rebind the buffer.
+        if isinstance(value, Tensor):
+            value = value._data
+        item_u = manipulation._unwrap_index(item)
+        s._data = s._data.at[item_u].set(value)
+
+    T.__setitem__ = _setitem
+
+    # -- named methods ------------------------------------------------------
+    method_table = {
+        # math
+        "add": math.add, "subtract": math.subtract, "multiply": math.multiply,
+        "divide": math.divide, "pow": math.pow, "matmul": math.matmul,
+        "mm": math.matmul, "bmm": math.bmm, "dot": math.dot, "mv": math.mv,
+        "exp": math.exp, "log": math.log, "log2": math.log2, "sqrt": math.sqrt,
+        "rsqrt": math.rsqrt, "abs": math.abs, "floor": math.floor,
+        "ceil": math.ceil, "round": math.round, "sign": math.sign,
+        "sin": math.sin, "cos": math.cos, "tan": math.tan, "tanh": math.tanh,
+        "sigmoid": math.sigmoid, "square": math.square, "erf": math.erf,
+        "neg": math.neg, "reciprocal": math.reciprocal, "clip": math.clip,
+        "scale": math.scale, "lerp": math.lerp,
+        "sum": math.sum, "mean": math.mean, "prod": math.prod,
+        "max": math.max, "min": math.min, "amax": math.amax, "amin": math.amin,
+        "std": math.std, "var": math.var, "median": math.median,
+        "logsumexp": math.logsumexp, "all": math.all, "any": math.any,
+        "argmax": math.argmax, "argmin": math.argmin,
+        "cumsum": math.cumsum, "cumprod": math.cumprod,
+        "isnan": math.isnan, "isinf": math.isinf, "isfinite": math.isfinite,
+        "equal": math.equal, "not_equal": math.not_equal,
+        "less_than": math.less_than, "less_equal": math.less_equal,
+        "greater_than": math.greater_than, "greater_equal": math.greater_equal,
+        "logical_and": math.logical_and, "logical_or": math.logical_or,
+        "logical_not": math.logical_not, "logical_xor": math.logical_xor,
+        "maximum": math.maximum, "minimum": math.minimum,
+        "allclose": math.allclose, "isclose": math.isclose,
+        "equal_all": math.equal_all, "trace": math.trace, "kron": math.kron,
+        "mod": math.mod, "remainder": math.remainder,
+        "floor_divide": math.floor_divide,
+        # manipulation
+        "cast": manipulation.cast, "astype": manipulation.cast,
+        "reshape": None,  # special: accepts varargs
+        "transpose": manipulation.transpose, "t": manipulation.t,
+        "squeeze": manipulation.squeeze, "unsqueeze": manipulation.unsqueeze,
+        "flatten": manipulation.flatten, "tile": manipulation.tile,
+        "expand": manipulation.expand, "expand_as": manipulation.expand_as,
+        "broadcast_to": manipulation.broadcast_to, "flip": manipulation.flip,
+        "roll": manipulation.roll, "gather": manipulation.gather,
+        "gather_nd": manipulation.gather_nd, "scatter": manipulation.scatter,
+        "index_select": manipulation.index_select,
+        "index_sample": manipulation.index_sample,
+        "take_along_axis": manipulation.take_along_axis,
+        "put_along_axis": manipulation.put_along_axis,
+        "masked_select": manipulation.masked_select,
+        "masked_fill": manipulation.masked_fill,
+        "nonzero": manipulation.nonzero, "unique": manipulation.unique,
+        "sort": manipulation.sort, "argsort": manipulation.argsort,
+        "topk": manipulation.topk, "split": manipulation.split,
+        "chunk": manipulation.chunk, "unbind": manipulation.unbind,
+        "pad": manipulation.pad, "repeat_interleave": manipulation.repeat_interleave,
+        "tril": creation.tril, "triu": creation.triu,
+        "where": manipulation.where, "clone": creation.clone,
+        # linalg
+        "norm": linalg.norm, "inverse": linalg.inverse, "cholesky": linalg.cholesky,
+        "matrix_power": linalg.matrix_power, "det": linalg.det,
+    }
+    for name, fn in method_table.items():
+        if fn is not None:
+            setattr(T, name, fn)
+
+    def _reshape(s, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = shape[0]
+        return manipulation.reshape(s, list(shape))
+
+    T.reshape = _reshape
+    T.reshape_ = lambda s, *shape: s.set_value(_reshape(s, *shape)._data)
+
+    # In-place variants rebind the handle to the op result. To keep the tape
+    # acyclic, the op consumes an alias of the pre-mutation tensor (same
+    # buffer + same producing node), never the mutated handle itself.
+    def _make_inplace(fn):
+        def inplace(s, *a, **k):
+            from ..core import autograd as _ag
+
+            if (
+                s._grad_node is None
+                and not s.stop_gradient
+                and _ag.is_grad_enabled()
+            ):
+                raise RuntimeError(
+                    "in-place operation on a leaf tensor that requires grad "
+                    "is not allowed; wrap it in paddle_tpu.no_grad() or use "
+                    "the out-of-place op"
+                )
+            prev = Tensor(s._data, stop_gradient=s.stop_gradient)
+            prev._grad_node = s._grad_node
+            prev._out_slot = s._out_slot
+            out = fn(prev, *a, **k)
+            s._data = out._data
+            s._grad_node = out._grad_node
+            s._out_slot = out._out_slot
+            if out._grad_node is not None:
+                s.stop_gradient = False
+            return s
+
+        return inplace
+
+    for name in ("add", "subtract", "multiply", "scale", "clip"):
+        setattr(T, name + "_", _make_inplace(method_table[name]))
+    T.zero_ = lambda s: s.set_value(jnp.zeros_like(s._data))
+    T.fill_ = lambda s, v: s.set_value(jnp.full_like(s._data, v))
+
+    def _exponential(s, lam=1.0):
+        u = creation.uniform(s.shape, dtype="float32", min=0.0, max=1.0)._data
+        return s.set_value((-jnp.log1p(-u.clip(0.0, 1.0 - 1e-7)) / lam).astype(s.dtype))
+
+    T.exponential_ = _exponential
+
+    @property
+    def _T(s):
+        return manipulation.t(s) if s.ndim == 2 else manipulation.transpose(s)
+
+    T.T = _T
+
+    def _item(s, *args):
+        return s._data[args].item() if args else s._data.item()
+
+    T.item = _item
+
+
+_install_tensor_methods()

@@ -1,0 +1,265 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as prandom
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+__all__ = [
+    "to_tensor",
+    "tensor",
+    "zeros",
+    "ones",
+    "full",
+    "empty",
+    "zeros_like",
+    "ones_like",
+    "full_like",
+    "empty_like",
+    "arange",
+    "linspace",
+    "logspace",
+    "eye",
+    "diag",
+    "diagflat",
+    "tril",
+    "triu",
+    "meshgrid",
+    "rand",
+    "randn",
+    "randint",
+    "uniform",
+    "normal",
+    "standard_normal",
+    "randperm",
+    "bernoulli",
+    "multinomial",
+    "assign",
+    "clone",
+    "one_hot",
+    "tril_indices",
+    "triu_indices",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data if isinstance(s, Tensor) else s) for s in shape)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    if isinstance(data, Tensor):
+        arr = data._data
+    else:
+        arr = data
+    dtype = convert_dtype(dtype)
+    if dtype is None and not isinstance(arr, (jax.Array, jax.core.Tracer)):
+        # Match framework defaults: python floats -> float32, ints -> int64.
+        probe = np.asarray(arr)
+        if probe.dtype == np.float64:
+            dtype = jnp.float32
+    arr = jnp.asarray(arr, dtype=dtype)
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+tensor = to_tensor
+
+
+def zeros(shape, dtype="float32") -> Tensor:
+    return Tensor(jnp.zeros(_shape(shape), convert_dtype(dtype)))
+
+
+def ones(shape, dtype="float32") -> Tensor:
+    return Tensor(jnp.ones(_shape(shape), convert_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype="float32") -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value._data
+    return Tensor(jnp.full(_shape(shape), fill_value, convert_dtype(dtype)))
+
+
+def empty(shape, dtype="float32") -> Tensor:
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None) -> Tensor:
+    return Tensor(jnp.zeros_like(x._data, dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None) -> Tensor:
+    return Tensor(jnp.ones_like(x._data, dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None) -> Tensor:
+    return Tensor(jnp.full_like(x._data, fill_value, dtype=convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None) -> Tensor:
+    def val(v):
+        return v._data.item() if isinstance(v, Tensor) else v
+
+    start, end, step = val(start), val(end), val(step)
+    if end is None:
+        start, end = 0, start
+    dtype = convert_dtype(dtype)
+    if dtype is None:
+        dtype = (
+            jnp.int64
+            if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+            else jnp.float32
+        )
+    return Tensor(jnp.arange(start, end, step, dtype=dtype))
+
+
+def linspace(start, stop, num, dtype="float32") -> Tensor:
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=convert_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype="float32") -> Tensor:
+    return Tensor(
+        jnp.logspace(start, stop, int(num), base=base, dtype=convert_dtype(dtype))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype="float32") -> Tensor:
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=convert_dtype(dtype)))
+
+
+def diag(x, offset=0, padding_value=0) -> Tensor:
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    out = jnp.diag(arr, k=offset)
+    if padding_value != 0 and arr.ndim == 1:
+        mask = jnp.eye(out.shape[0], dtype=bool, k=offset)
+        out = jnp.where(mask, out, padding_value)
+    return Tensor(out)
+
+
+def diagflat(x, offset=0) -> Tensor:
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.diagflat(arr, k=offset))
+
+
+def tril(x, diagonal=0) -> Tensor:
+    from . import manipulation as _m  # tril is differentiable; route via op
+
+    return _m._tril(x, diagonal)
+
+
+def triu(x, diagonal=0) -> Tensor:
+    from . import manipulation as _m
+
+    return _m._triu(x, diagonal)
+
+
+def meshgrid(*args):
+    arrs = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    return [Tensor(o) for o in jnp.meshgrid(*arrs, indexing="ij")]
+
+
+def rand(shape, dtype="float32") -> Tensor:
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype="float32") -> Tensor:
+    key = prandom.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), convert_dtype(dtype)))
+
+
+standard_normal = randn
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64") -> Tensor:
+    if high is None:
+        low, high = 0, low
+    key = prandom.next_key()
+    return Tensor(
+        jax.random.randint(key, _shape(shape), low, high, dtype=convert_dtype(dtype))
+    )
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0) -> Tensor:
+    key = prandom.next_key() if seed == 0 else jax.random.PRNGKey(seed)
+    return Tensor(
+        jax.random.uniform(
+            key, _shape(shape), convert_dtype(dtype), minval=min, maxval=max
+        )
+    )
+
+
+def normal(mean=0.0, std=1.0, shape=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shape = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        key = prandom.next_key()
+        return Tensor(jax.random.normal(key, shape) * s + m)
+    key = prandom.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape)) * std + mean)
+
+
+def randperm(n, dtype="int64") -> Tensor:
+    key = prandom.next_key()
+    return Tensor(jax.random.permutation(key, n).astype(convert_dtype(dtype)))
+
+
+def bernoulli(x) -> Tensor:
+    key = prandom.next_key()
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(key, arr).astype(arr.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False) -> Tensor:
+    key = prandom.next_key()
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(arr, 1e-38))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1, shape=(
+            *arr.shape[:-1], num_samples
+        ) if arr.ndim > 1 else (num_samples,))
+    else:
+        # Gumbel top-k trick for sampling without replacement.
+        g = jax.random.gumbel(key, arr.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def assign(x, output=None) -> Tensor:
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        output.set_value(arr)
+        return output
+    return Tensor(arr)
+
+
+def clone(x) -> Tensor:
+    from . import manipulation as _m
+
+    return _m._clone(x)
+
+
+def one_hot(x, num_classes) -> Tensor:
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.nn.one_hot(arr, num_classes, dtype=jnp.float32))
+
+
+def tril_indices(row, col, offset=0) -> Tensor:
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=jnp.int64))
+
+
+def triu_indices(row, col=None, offset=0) -> Tensor:
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=jnp.int64))

@@ -1,0 +1,233 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py).
+
+Decompositions lower to XLA's native QR/SVD/Cholesky/Eigh; einsum rides
+jnp.einsum whose contractions map onto the MXU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import op
+
+
+@op("norm")
+def norm(x, p=None, axis=None, keepdim=False):
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+    if p == "fro":
+        return jnp.sqrt(
+            jnp.sum(
+                jnp.square(jnp.abs(x)),
+                axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis,
+                keepdims=keepdim,
+            )
+        )
+    if p == np.inf or p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == -np.inf or p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.sum(jnp.abs(x) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+
+@op("vector_norm")
+def vector_norm(x, p=2.0, axis=None, keepdim=False):
+    return jnp.linalg.vector_norm(
+        x, ord=p, axis=tuple(axis) if isinstance(axis, list) else axis, keepdims=keepdim
+    )
+
+
+@op("matrix_norm")
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    return jnp.linalg.matrix_norm(x, ord=p, keepdims=keepdim)
+
+
+@op("dist")
+def dist(x, y, p=2):
+    d = jnp.abs(x - y)
+    if p == 0:
+        return jnp.sum((d != 0).astype(x.dtype))
+    if p == float("inf"):
+        return jnp.max(d)
+    if p == float("-inf"):
+        return jnp.min(d)
+    return jnp.sum(d**p) ** (1.0 / p)
+
+
+@op("cholesky")
+def cholesky(x, upper=False):
+    l = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(l, -1, -2) if upper else l
+
+
+@op("cholesky_solve")
+def cholesky_solve(x, y, upper=False):
+    l = jnp.swapaxes(y, -1, -2) if upper else y
+    return jax.scipy.linalg.cho_solve((l, True), x)
+
+
+@op("qr")
+def qr(x, mode="reduced"):
+    return tuple(jnp.linalg.qr(x, mode=mode))
+
+
+@op("svd")
+def svd(x, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, jnp.swapaxes(vh, -1, -2).conj()
+
+
+@op("svdvals")
+def svdvals(x):
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+@op("eig")
+def eig(x):
+    # XLA eig is CPU-only; evaluate via host numpy for eager parity.
+    w, v = np.linalg.eig(np.asarray(x))
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+@op("eigh")
+def eigh(x, UPLO="L"):
+    return tuple(jnp.linalg.eigh(x, symmetrize_input=True))
+
+
+@op("eigvals")
+def eigvals(x):
+    return jnp.asarray(np.linalg.eigvals(np.asarray(x)))
+
+
+@op("eigvalsh")
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x)
+
+
+@op("inverse")
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+inv = inverse
+
+
+@op("pinv")
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@op("solve")
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@op("triangular_solve")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+    )
+
+
+@op("lstsq")
+def lstsq(x, y, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@op("lu")
+def lu(x, pivot=True):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_, piv.astype(jnp.int32) + 1  # paddle returns 1-based pivots
+
+
+@op("det")
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@op("slogdet")
+def slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+@op("matrix_power")
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@op("matrix_rank", differentiable=False)
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@op("cond")
+def cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@op("multi_dot", amp="cast")
+def multi_dot(xs):
+    return jnp.linalg.multi_dot(list(xs))
+
+
+@op("einsum", amp="cast")
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+@op("tensordot", amp="cast")
+def tensordot(x, y, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in axes)
+    return jnp.tensordot(x, y, axes=axes)
+
+
+@op("histogram", differentiable=False)
+def histogram(x, bins=100, min=0, max=0):  # noqa: A002
+    if min == 0 and max == 0:
+        r = None
+    else:
+        r = (min, max)
+    hist, _ = jnp.histogram(x, bins=bins, range=r)
+    return hist
+
+
+@op("bincount", differentiable=False)
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength, length=None)
+
+
+@op("corrcoef")
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@op("cov")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(
+        x, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fweights, aweights=aweights
+    )
+
+
+@op("householder_product")
+def householder_product(x, tau):
+    m, n = x.shape[-2], x.shape[-1]
+    q = jnp.eye(m, dtype=x.dtype)
+    q = jnp.broadcast_to(q, x.shape[:-2] + (m, m)).copy() if x.ndim > 2 else q
+
+    def apply_one(i, q):
+        v = jnp.where(jnp.arange(m) < i, 0.0, x[..., :, i].at[..., i].set(1.0))
+        v = v[..., :, None]
+        t = tau[..., i]
+        return q - t * (q @ v) @ jnp.swapaxes(v, -1, -2)
+
+    for i in range(n):
+        q = apply_one(i, q)
+    return q[..., :, :n]

@@ -1,0 +1,594 @@
+"""Shape / indexing / rearrangement ops.
+
+Reference surface: python/paddle/tensor/manipulation.py (7.5k LoC). Static
+shapes are preferred (XLA compiles per shape); the few inherently dynamic
+ops (masked_select, nonzero, unique) are eager-only and documented as such.
+"""
+
+from __future__ import annotations
+
+from builtins import slice as _pyslice
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import op
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+
+def _to_static_ints(v):
+    if isinstance(v, Tensor):
+        v = v.tolist()
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    return [int(x._data if isinstance(x, Tensor) else x) for x in v]
+
+
+@op("cast")
+def cast(x, dtype):
+    return x.astype(convert_dtype(dtype))
+
+
+@op("reshape")
+def reshape(x, shape):
+    return jnp.reshape(x, _to_static_ints(shape))
+
+
+@op("transpose")
+def transpose(x, perm=None):
+    return jnp.transpose(x, perm)
+
+
+@op("t")
+def t(x):
+    return x.T
+
+
+@op("moveaxis")
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@op("swapaxes")
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+@op("concat")
+def concat(x, axis=0):
+    return jnp.concatenate(list(x), axis=int(axis))
+
+
+@op("stack")
+def stack(x, axis=0):
+    return jnp.stack(list(x), axis=axis)
+
+
+@op("vstack")
+def vstack(x):
+    return jnp.vstack(list(x))
+
+
+@op("hstack")
+def hstack(x):
+    return jnp.hstack(list(x))
+
+
+@op("split")
+def split(x, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    sections = _to_static_ints(num_or_sections)
+    # Paddle allows one -1 section meaning "the rest".
+    total = x.shape[axis]
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections = [s if s != -1 else total - known for s in sections]
+    offsets = np.cumsum(sections)[:-1].tolist()
+    return tuple(jnp.split(x, offsets, axis=axis))
+
+
+@op("chunk")
+def chunk(x, chunks, axis=0):
+    return tuple(jnp.split(x, chunks, axis=int(axis)))
+
+
+@op("unbind")
+def unbind(x, axis=0):
+    n = x.shape[axis]
+    return tuple(jnp.take(x, i, axis=axis) for i in range(n))
+
+
+@op("squeeze")
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(a for a in axis if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=axis) if axis else x
+    return jnp.squeeze(x, axis=axis) if x.shape[axis] == 1 else x
+
+
+@op("unsqueeze")
+def unsqueeze(x, axis):
+    if isinstance(axis, (list, tuple)):
+        for a in sorted(axis):
+            x = jnp.expand_dims(x, a)
+        return x
+    return jnp.expand_dims(x, axis)
+
+
+@op("flatten")
+def flatten(x, start_axis=0, stop_axis=-1):
+    ndim = jnp.ndim(x)
+    if ndim == 0:
+        return jnp.reshape(x, (1,))
+    if start_axis < 0:
+        start_axis += ndim
+    if stop_axis < 0:
+        stop_axis += ndim
+    shape = x.shape
+    new_shape = (
+        shape[:start_axis]
+        + (int(np.prod(shape[start_axis : stop_axis + 1])),)
+        + shape[stop_axis + 1 :]
+    )
+    return jnp.reshape(x, new_shape)
+
+
+@op("tile")
+def tile(x, repeat_times):
+    return jnp.tile(x, _to_static_ints(repeat_times))
+
+
+@op("expand")
+def expand(x, shape):
+    shape = _to_static_ints(shape)
+    cur = list(x.shape)
+    # Paddle -1 means keep the original dim size.
+    pad = len(shape) - len(cur)
+    cur = [1] * pad + cur
+    tgt = [c if s == -1 else s for s, c in zip(shape, cur)]
+    return jnp.broadcast_to(jnp.reshape(x, cur), tgt)
+
+
+@op("expand_as")
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@op("broadcast_to")
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, _to_static_ints(shape))
+
+
+def broadcast_tensors(inputs):
+    arrs = [i._data if isinstance(i, Tensor) else jnp.asarray(i) for i in inputs]
+    shape = jnp.broadcast_shapes(*[a.shape for a in arrs])
+    return [Tensor(jnp.broadcast_to(a, shape)) for a in arrs]
+
+
+@op("flip")
+def flip(x, axis):
+    return jnp.flip(x, axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis)
+
+
+@op("roll")
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@op("rot90")
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@op("_clone")
+def _clone(x):
+    return x + 0 if jnp.issubdtype(x.dtype, jnp.number) else jnp.array(x)
+
+
+@op("_tril")
+def _tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@op("_triu")
+def _triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+# ---------------------------------------------------------------------------
+# Gather / scatter family
+# ---------------------------------------------------------------------------
+
+
+@op("gather")
+def gather(x, index, axis=0):
+    idx = index.reshape(-1) if jnp.ndim(index) > 1 else index
+    return jnp.take(x, idx, axis=axis)
+
+
+@op("gather_nd")
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@op("index_select")
+def index_select(x, index, axis=0):
+    return jnp.take(x, index.reshape(-1), axis=axis)
+
+
+@op("index_sample")
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=-1)
+
+
+@op("take_along_axis")
+def take_along_axis(arr, indices, axis, broadcast=True):
+    if broadcast:
+        shape = list(arr.shape)
+        shape[axis] = indices.shape[axis]
+        indices = jnp.broadcast_to(indices, shape)
+    return jnp.take_along_axis(arr, indices, axis=axis)
+
+
+@op("put_along_axis")
+def put_along_axis(arr, indices, values, axis, reduce="assign"):
+    values = jnp.broadcast_to(values, indices.shape)
+    mode = {"assign": "set", "add": "add", "multiply": "mul", "mul": "mul"}[reduce]
+    dims = jnp.ndim(arr)
+    idx = []
+    for d in range(dims):
+        if d == axis:
+            idx.append(indices)
+        else:
+            shape = [1] * dims
+            shape[d] = arr.shape[d]
+            idx.append(
+                jnp.broadcast_to(
+                    jnp.arange(arr.shape[d]).reshape(shape), indices.shape
+                )
+            )
+    at = arr.at[tuple(idx)]
+    return getattr(at, mode)(values)
+
+
+@op("scatter")
+def scatter(x, index, updates, overwrite=True):
+    index = index.reshape(-1)
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+@op("scatter_nd_add")
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+@op("scatter_nd")
+def scatter_nd(index, updates, shape):
+    zeros = jnp.zeros(_to_static_ints(shape), updates.dtype)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return zeros.at[idx].add(updates)
+
+
+@op("index_add")
+def index_add(x, index, axis, value):
+    moved = jnp.moveaxis(x, axis, 0)
+    out = moved.at[index].add(jnp.moveaxis(value, axis, 0))
+    return jnp.moveaxis(out, 0, axis)
+
+
+@op("index_put")
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(i for i in indices)
+    return x.at[idx].add(value) if accumulate else x.at[idx].set(value)
+
+
+@op("where")
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        raise ValueError("use nonzero() for single-arg where")
+    return jnp.where(condition, x, y)
+
+
+@op("masked_fill")
+def masked_fill(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+@op("fill_diagonal")
+def fill_diagonal(x, value, offset=0, wrap=False):
+    n = min(x.shape[-2], x.shape[-1])
+    i = jnp.arange(n - abs(offset))
+    rows = i + max(-offset, 0)
+    cols = i + max(offset, 0)
+    return x.at[..., rows, cols].set(value)
+
+
+# ---------------------------------------------------------------------------
+# Sorting / ranking
+# ---------------------------------------------------------------------------
+
+
+@op("sort")
+def sort(x, axis=-1, descending=False):
+    out = jnp.sort(x, axis=axis)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+@op("argsort", differentiable=False)
+def argsort(x, axis=-1, descending=False):
+    out = jnp.argsort(x, axis=axis)
+    if descending:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.int64)
+
+
+@op("topk")
+def topk(x, k, axis=-1, largest=True, sorted=True):  # noqa: A002
+    if isinstance(k, (jax.Array,)):
+        k = int(k)
+    if axis != -1 and axis != jnp.ndim(x) - 1:
+        xs = jnp.moveaxis(x, axis, -1)
+        vals, idx = jax.lax.top_k(xs if largest else -xs, k)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis).astype(
+            jnp.int64
+        )
+    vals, idx = jax.lax.top_k(x if largest else -x, k)
+    if not largest:
+        vals = -vals
+    return vals, idx.astype(jnp.int64)
+
+
+@op("kthvalue")
+def kthvalue(x, k, axis=-1, keepdim=False):
+    vals = jnp.sort(x, axis=axis)
+    idxs = jnp.argsort(x, axis=axis)
+    v = jnp.take(vals, k - 1, axis=axis)
+    i = jnp.take(idxs, k - 1, axis=axis)
+    if keepdim:
+        v, i = jnp.expand_dims(v, axis), jnp.expand_dims(i, axis)
+    return v, i.astype(jnp.int64)
+
+
+@op("mode")
+def mode(x, axis=-1, keepdim=False):
+    sorted_x = jnp.sort(x, axis=axis)
+    moved = jnp.moveaxis(sorted_x, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    # Count runs of equal values in the sorted array; pick the longest run.
+    eq = flat[:, 1:] == flat[:, :-1]
+    run_id = jnp.concatenate(
+        [jnp.zeros((flat.shape[0], 1), jnp.int32), jnp.cumsum(~eq, axis=1)], axis=1
+    )
+    one = jnp.ones_like(run_id)
+    counts = jax.vmap(lambda rid, o: jnp.zeros(flat.shape[1], jnp.int32).at[rid].add(o))(
+        run_id, one
+    )
+    best_run = jnp.argmax(counts, axis=1)
+    first_idx_of_run = jax.vmap(lambda rid, br: jnp.argmax(rid == br))(run_id, best_run)
+    values = jnp.take_along_axis(flat, first_idx_of_run[:, None], axis=1)[:, 0]
+    out_shape = moved.shape[:-1]
+    values = values.reshape(out_shape)
+    indices = jnp.zeros(out_shape, jnp.int64)
+    if keepdim:
+        values = jnp.expand_dims(values, axis)
+        indices = jnp.expand_dims(indices, axis)
+    return values, indices
+
+
+@op("searchsorted", differentiable=False)
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    if jnp.ndim(sorted_sequence) == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            sorted_sequence.reshape(-1, sorted_sequence.shape[-1]),
+            values.reshape(-1, values.shape[-1]),
+        ).reshape(values.shape)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@op("bucketize", differentiable=False)
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    out = jnp.searchsorted(sorted_sequence, x, side="right" if right else "left")
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+# ---------------------------------------------------------------------------
+# Dynamic-shape ops (eager-only; under jit these require static size hints)
+# ---------------------------------------------------------------------------
+
+
+def masked_select(x, mask):
+    """Eager-only: output size depends on data (forces host sync)."""
+    arr = np.asarray(x._data)
+    m = np.asarray(mask._data)
+    return Tensor(jnp.asarray(arr[m]))
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(x._data)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(n)) for n in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    arr = np.asarray(x._data)
+    res = np.unique(
+        arr,
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    arr = np.asarray(x._data)
+    flat = arr if axis is not None else arr.reshape(-1)
+    keep = np.ones(flat.shape[0] if axis is None else flat.shape[axis], dtype=bool)
+    cmp_axis = 0 if axis is None else axis
+    moved = np.moveaxis(flat, cmp_axis, 0) if axis is not None else flat
+    eq = (moved[1:] == moved[:-1])
+    if eq.ndim > 1:
+        eq = eq.reshape(eq.shape[0], -1).all(axis=1)
+    keep[1:] = ~eq
+    out = moved[keep] if axis is not None else flat[keep]
+    if axis is not None:
+        out = np.moveaxis(out, 0, cmp_axis)
+    results = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(~keep)
+        results.append(Tensor(jnp.asarray(np.cumsum(keep) - 1)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, keep.shape[0]))
+        results.append(Tensor(jnp.asarray(counts)))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+# ---------------------------------------------------------------------------
+# Padding / slicing
+# ---------------------------------------------------------------------------
+
+
+@op("pad")
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):  # noqa: A002
+    pad = _to_static_ints(pad)
+    ndim = jnp.ndim(x)
+    if len(pad) == 2 * ndim:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(ndim)]
+    else:
+        # Paddle convention: pad applies to the last len(pad)//2 spatial dims,
+        # ordered innermost-last, for NCHW/NCL/NCDHW layouts.
+        n_spatial = len(pad) // 2
+        width = [(0, 0)] * (ndim - n_spatial) + [
+            (pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)
+        ]
+        if data_format.endswith("C"):  # NHWC style: spatial dims before channel
+            width = (
+                [(0, 0)]
+                + width[ndim - n_spatial :]
+                + [(0, 0)] * (ndim - n_spatial - 1)
+            )
+    if mode == "constant":
+        return jnp.pad(x, width, constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, width, mode=jmode)
+
+
+@op("slice")
+def slice(x, axes, starts, ends):  # noqa: A001
+    idx = [_pyslice(None)] * jnp.ndim(x)
+    for ax, s, e in zip(axes, _to_static_ints(starts), _to_static_ints(ends)):
+        idx[ax] = _pyslice(s, e)
+    return x[tuple(idx)]
+
+
+@op("strided_slice")
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [_pyslice(None)] * jnp.ndim(x)
+    for ax, s, e, st in zip(
+        axes, _to_static_ints(starts), _to_static_ints(ends), _to_static_ints(strides)
+    ):
+        idx[ax] = _pyslice(s, e, st)
+    return x[tuple(idx)]
+
+
+@op("crop")
+def crop(x, shape=None, offsets=None):
+    shape = _to_static_ints(shape)
+    offsets = _to_static_ints(offsets) if offsets is not None else [0] * len(shape)
+    idx = tuple(
+        _pyslice(o, o + (s if s != -1 else x.shape[i] - o))
+        for i, (o, s) in enumerate(zip(offsets, shape))
+    )
+    return x[idx]
+
+
+@op("repeat_interleave")
+def repeat_interleave(x, repeats, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    if isinstance(repeats, int):
+        return jnp.repeat(x, repeats, axis=axis)
+    return jnp.repeat(x, repeats, axis=axis, total_repeat_length=int(jnp.sum(repeats)))
+
+
+@op("as_strided")
+def as_strided(x, shape, stride, offset=0):
+    flat = x.reshape(-1)
+    shape = _to_static_ints(shape)
+    stride = _to_static_ints(stride)
+    idx = np.zeros(shape, dtype=np.int64) + offset
+    for d, (s, st) in enumerate(zip(shape, stride)):
+        reshape = [1] * len(shape)
+        reshape[d] = s
+        idx = idx + (np.arange(s) * st).reshape(reshape)
+    return flat[jnp.asarray(idx)]
+
+
+# ---------------------------------------------------------------------------
+# getitem/setitem used by Tensor.__getitem__
+# ---------------------------------------------------------------------------
+
+
+def _unwrap_index(item):
+    if isinstance(item, Tensor):
+        return item._data
+    if isinstance(item, tuple):
+        return tuple(_unwrap_index(i) for i in item)
+    if isinstance(item, list):
+        return jnp.asarray(item)
+    return item
+
+
+@op("getitem")
+def _getitem(x, item):
+    return x[item]
+
+
+@op("tensor_getitem")
+def _tensor_getitem(x, *idx_arrays, template=None):
+    # Reassemble the index expression with tensor indices substituted.
+    it = iter(idx_arrays)
+    rebuilt = tuple(next(it) if e is None else e for e in template)
+    return x[rebuilt if len(rebuilt) > 1 else rebuilt[0]]
+
+
+def getitem(x, item):
+    """Differentiable __getitem__ supporting Tensor indices."""
+    if isinstance(item, Tensor):
+        return _getitem_with_tensors(x, (item,))
+    if isinstance(item, tuple) and any(isinstance(i, Tensor) for i in item):
+        return _getitem_with_tensors(x, item)
+    return _getitem(x, _unwrap_index(item))
+
+
+def _getitem_with_tensors(x, items):
+    tensor_idx = [i for i in items if isinstance(i, Tensor)]
+    template = tuple(None if isinstance(i, Tensor) else _unwrap_index(i) for i in items)
+    return _tensor_getitem(x, *tensor_idx, template=template)
+
+
+@op("setitem")
+def setitem(x, item, value):
+    return x.at[item].set(value)

@@ -1,0 +1,415 @@
+"""Elementwise / reduction / comparison math ops.
+
+Reference surface: python/paddle/tensor/math.py (8.5k LoC) — here each op is
+a thin pure-jax lowering registered through the dispatch funnel
+(paddle_tpu/core/dispatch.py), which supplies autograd, AMP and tracing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+# ---------------------------------------------------------------------------
+# Unary elementwise (differentiable)
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "log": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt,
+    "rsqrt": jax.lax.rsqrt,
+    "abs": jnp.abs,
+    "neg": jnp.negative,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "round": jnp.round,
+    "trunc": jnp.trunc,
+    "frac": lambda x: x - jnp.trunc(x),
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "asin": jnp.arcsin,
+    "acos": jnp.arccos,
+    "atan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh,
+    "acosh": jnp.arccosh,
+    "atanh": jnp.arctanh,
+    "sigmoid": jax.nn.sigmoid,
+    "reciprocal": lambda x: 1.0 / x,
+    "square": jnp.square,
+    "sign": jnp.sign,
+    "erf": jax.lax.erf,
+    "erfinv": jax.lax.erf_inv,
+    "lgamma": jax.lax.lgamma,
+    "digamma": jax.lax.digamma,
+    "i0": lambda x: jax.lax.bessel_i0e(x) * jnp.exp(jnp.abs(x)),
+    "i1": lambda x: jax.lax.bessel_i1e(x) * jnp.exp(jnp.abs(x)),
+    "angle": jnp.angle,
+    "conj": jnp.conj,
+    "real": jnp.real,
+    "imag": jnp.imag,
+    "deg2rad": jnp.deg2rad,
+    "rad2deg": jnp.rad2deg,
+    "logit": lambda x: jnp.log(x / (1.0 - x)),
+}
+
+for _name, _fn in _UNARY.items():
+    globals()[_name] = op(_name)(lambda x, _f=_fn: _f(x))
+
+# asinh etc. also under paddle names
+arcsin, arccos, arctan = asin, acos, atan  # noqa: F821
+arcsinh, arccosh, arctanh = asinh, acosh, atanh  # noqa: F821
+
+# Non-differentiable unary predicates
+_UNARY_PRED = {
+    "isnan": jnp.isnan,
+    "isinf": jnp.isinf,
+    "isfinite": jnp.isfinite,
+    "logical_not": jnp.logical_not,
+    "bitwise_not": jnp.bitwise_not,
+}
+for _name, _fn in _UNARY_PRED.items():
+    globals()[_name] = op(_name, differentiable=False)(lambda x, _f=_fn: _f(x))
+
+
+# ---------------------------------------------------------------------------
+# Binary elementwise
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "add": jnp.add,
+    "subtract": jnp.subtract,
+    "multiply": jnp.multiply,
+    "divide": jnp.divide,
+    "pow": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "fmax": jnp.fmax,
+    "fmin": jnp.fmin,
+    "atan2": jnp.arctan2,
+    "hypot": jnp.hypot,
+    "copysign": jnp.copysign,
+    "nextafter": jnp.nextafter,
+    "heaviside": jnp.heaviside,
+    "logaddexp": jnp.logaddexp,
+}
+for _name, _fn in _BINARY.items():
+    globals()[_name] = op(_name)(lambda x, y, _f=_fn: _f(x, y))
+
+_BINARY_NONDIFF = {
+    "floor_divide": jnp.floor_divide,
+    "mod": jnp.mod,
+    "remainder": jnp.remainder,
+    "floor_mod": jnp.mod,
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+    "less_than": jnp.less,
+    "less_equal": jnp.less_equal,
+    "greater_than": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "logical_and": jnp.logical_and,
+    "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+    "bitwise_and": jnp.bitwise_and,
+    "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "left_shift": jnp.left_shift,
+    "right_shift": jnp.right_shift,
+    "gcd": jnp.gcd,
+    "lcm": jnp.lcm,
+}
+for _name, _fn in _BINARY_NONDIFF.items():
+    globals()[_name] = op(_name, differentiable=False)(lambda x, y, _f=_fn: _f(x, y))
+
+
+@op("scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return out
+
+
+@op("clip")
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@op("lerp")
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@op("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@op("multiplex", differentiable=False)
+def multiplex(inputs, index):
+    stacked = jnp.stack(inputs, axis=0)
+    idx = index.reshape(-1)
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+@op("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+# ---------------------------------------------------------------------------
+# Reductions
+# ---------------------------------------------------------------------------
+
+
+def _norm_axis(axis):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis if axis is None else int(axis)
+
+
+@op("sum")
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    return jnp.sum(x, axis=_norm_axis(axis), dtype=convert_dtype(dtype), keepdims=keepdim)
+
+
+@op("mean")
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@op("prod")
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_norm_axis(axis), dtype=convert_dtype(dtype), keepdims=keepdim)
+
+
+@op("max")
+def max(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@op("min")
+def min(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+amax, amin = max, min
+
+
+@op("std")
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@op("var")
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@op("median")
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@op("quantile")
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, q, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@op("nanmean")
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@op("nansum")
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(x, axis=_norm_axis(axis), dtype=convert_dtype(dtype), keepdims=keepdim)
+
+
+@op("logsumexp")
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@op("all", differentiable=False)
+def all(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.all(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@op("any", differentiable=False)
+def any(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.any(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@op("argmax", differentiable=False)
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(convert_dtype(dtype))
+
+
+@op("argmin", differentiable=False)
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim)
+    return out.astype(convert_dtype(dtype))
+
+
+@op("count_nonzero", differentiable=False)
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+# ---------------------------------------------------------------------------
+# Scans
+# ---------------------------------------------------------------------------
+
+
+@op("cumsum")
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=convert_dtype(dtype))
+
+
+@op("cumprod")
+def cumprod(x, dim=None, dtype=None):
+    if dim is None:
+        x = x.reshape(-1)
+        dim = 0
+    return jnp.cumprod(x, axis=dim, dtype=convert_dtype(dtype))
+
+
+@op("cummax", differentiable=False)
+def cummax(x, axis=-1):
+    vals = jax.lax.associative_scan(jnp.maximum, x, axis=axis)
+    return vals
+
+
+@op("cummin", differentiable=False)
+def cummin(x, axis=-1):
+    return jax.lax.associative_scan(jnp.minimum, x, axis=axis)
+
+
+@op("logcumsumexp")
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+@op("diff")
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# Matrix products (hot path: these map onto the MXU)
+# ---------------------------------------------------------------------------
+
+
+@op("matmul", amp="cast")
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if jnp.ndim(x) > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if jnp.ndim(y) > 1 else y
+    return jnp.matmul(x, y)
+
+
+mm = matmul
+
+
+@op("dot", amp="cast")
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@op("inner", amp="cast")
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@op("outer", amp="cast")
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@op("addmm", amp="cast")
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@op("kron")
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@op("trace")
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@op("cross")
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+@op("bmm", amp="cast")
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@op("mv", amp="cast")
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+# ---------------------------------------------------------------------------
+# Comparison helpers returning python/bool tensors
+# ---------------------------------------------------------------------------
+
+
+@op("isclose", differentiable=False)
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@op("allclose", differentiable=False)
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@op("equal_all", differentiable=False)
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+# ---------------------------------------------------------------------------
+# Misc
+# ---------------------------------------------------------------------------
+
+
+@op("increment")
+def increment(x, value=1.0):
+    return x + value
+
+
+def accuracy(input, label, k=1):
+    """Top-k accuracy (reference: paddle.metric.accuracy)."""
+    topk_idx = jnp.argsort(-input._data, axis=-1)[..., :k]
+    lbl = label._data.reshape(-1, 1)
+    correct = jnp.any(topk_idx == lbl, axis=-1)
+    return Tensor(jnp.mean(correct.astype(jnp.float32)))

@@ -1,0 +1,31 @@
+"""Test harness config.
+
+Mirrors the reference's test strategy of running distributed logic without
+real accelerators (SURVEY.md §4): force an 8-device virtual CPU platform so
+mesh/sharding/collective tests exercise real XLA partitioning.
+
+Must run before jax initializes its backends, hence env vars set at import.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+import numpy as np
+import pytest
+
+# Numeric tests compare against NumPy in fp32; force exact fp32 contractions
+# (the TPU bench path keeps the backend default / bf16 AMP).
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    import paddle_tpu
+
+    paddle_tpu.seed(2024)
+    np.random.seed(2024)
+    yield

@@ -1,0 +1,79 @@
+"""Numeric op-test utilities.
+
+TPU-native analog of the reference's OpTest base
+(test/legacy_test/op_test.py:418): compare op outputs against a NumPy
+reference and check analytic gradients against central finite differences.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def check_output(op_fn, np_fn, inputs, atol=1e-5, rtol=1e-5, **kwargs):
+    """op_fn(*tensors, **kwargs) vs np_fn(*arrays, **kwargs)."""
+    tensors = [paddle.to_tensor(i) for i in inputs]
+    out = op_fn(*tensors, **kwargs)
+    ref = np_fn(*[np.asarray(i) for i in inputs], **kwargs)
+    if isinstance(out, (tuple, list)):
+        for o, r in zip(out, ref):
+            np.testing.assert_allclose(o.numpy(), r, atol=atol, rtol=rtol)
+    else:
+        np.testing.assert_allclose(out.numpy(), np.asarray(ref), atol=atol, rtol=rtol)
+    return out
+
+
+def check_grad(
+    op_fn,
+    inputs,
+    grad_input_idx=None,
+    eps=1e-3,
+    atol=1e-2,
+    rtol=1e-2,
+    reduce_fn=None,
+    **kwargs,
+):
+    """Finite-difference gradient check (reference: op_test.py:3114).
+
+    Computes d(sum(op(x)))/dx analytically via the tape and numerically via
+    central differences in float64-free (fp32) arithmetic.
+    """
+    inputs = [np.asarray(i, dtype=np.float32) for i in inputs]
+    grad_input_idx = grad_input_idx or list(range(len(inputs)))
+
+    def scalar_out(arrs):
+        tensors = [paddle.to_tensor(a, stop_gradient=False) for a in arrs]
+        out = op_fn(*tensors, **kwargs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        if reduce_fn is not None:
+            out = reduce_fn(out)
+        return out, tensors
+
+    out, tensors = scalar_out(inputs)
+    loss = out.sum()
+    loss.backward()
+
+    for idx in grad_input_idx:
+        analytic = tensors[idx].grad.numpy()
+        numeric = np.zeros_like(inputs[idx], dtype=np.float64)
+        flat = inputs[idx].reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            with paddle.no_grad():
+                o_plus, _ = scalar_out(inputs)
+                f_plus = float(o_plus.sum().numpy())
+            flat[i] = orig - eps
+            with paddle.no_grad():
+                o_minus, _ = scalar_out(inputs)
+                f_minus = float(o_minus.sum().numpy())
+            flat[i] = orig
+            num_flat[i] = (f_plus - f_minus) / (2 * eps)
+        np.testing.assert_allclose(
+            analytic, numeric.astype(np.float32), atol=atol, rtol=rtol,
+            err_msg=f"grad mismatch for input {idx}",
+        )

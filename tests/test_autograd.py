@@ -1,0 +1,179 @@
+"""Autograd engine tests (reference behaviors: paddle/fluid/eager/backward.cc)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+
+def test_chain_and_fanout():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    a = x * 3.0
+    b = a + x  # x used twice: fan-out accumulation
+    c = b * b
+    c.backward()
+    # c = (3x + x)^2 = 16x^2, dc/dx = 32x = 64
+    np.testing.assert_allclose(x.grad.numpy(), 64.0)
+
+
+def test_grad_accumulation_across_backwards():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+
+def test_clear_gradient():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    x.clear_gradient()
+    assert x.grad is None
+
+
+def test_no_grad_blocks_tape():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_stop_gradient_leaf_gets_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=True)
+    w = paddle.to_tensor([2.0], stop_gradient=False)
+    (x * w).sum().backward()
+    assert x.grad is None
+    np.testing.assert_allclose(w.grad.numpy(), [1.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    loss = y.sum()
+    loss.backward(retain_graph=True)
+    loss.backward(retain_graph=False)
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+    with pytest.raises(RuntimeError):
+        loss.backward()
+
+
+def test_backward_twice_without_retain_raises():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    loss = (x * x).sum()
+    loss.backward()
+    with pytest.raises(RuntimeError):
+        loss.backward()
+
+
+def test_non_scalar_backward_needs_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         stop_gradient=False)
+    a, b, c = paddle.split(x, 3, axis=1)
+    (a.sum() + 2 * b.sum()).backward()
+    expected = np.array([[1, 2, 0], [1, 2, 0]], dtype=np.float32)
+    np.testing.assert_allclose(x.grad.numpy(), expected)
+
+
+def test_matmul_grad():
+    a = paddle.to_tensor(np.random.randn(3, 4).astype(np.float32),
+                         stop_gradient=False)
+    b = paddle.to_tensor(np.random.randn(4, 5).astype(np.float32),
+                         stop_gradient=False)
+    out = paddle.matmul(a, b)
+    out.sum().backward()
+    ones = np.ones((3, 5), np.float32)
+    np.testing.assert_allclose(a.grad.numpy(), ones @ b.numpy().T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(), a.numpy().T @ ones, rtol=1e-5)
+
+
+def test_hooks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_functional_grad():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [12.0])
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_int_output_through_graph():
+    x = paddle.to_tensor([3.0, 1.0, 2.0], stop_gradient=False)
+    vals, idx = paddle.topk(x, 2)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])
+
+
+def test_pylayer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Cube(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x * x
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor
+            return dy * 3 * x * x
+
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = Cube.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).detach()
+    assert y.stop_gradient
+    z = x * 2
+    (z + y).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_grad_through_getitem():
+    x = paddle.to_tensor(np.ones((4, 4), np.float32), stop_gradient=False)
+    y = x[1:3, :2]
+    y.sum().backward()
+    expected = np.zeros((4, 4), np.float32)
+    expected[1:3, :2] = 1
+    np.testing.assert_allclose(x.grad.numpy(), expected)
+
+
+def test_grad_through_tensor_index():
+    x = paddle.to_tensor(np.eye(3, dtype=np.float32) * 5, stop_gradient=False)
+    idx = paddle.to_tensor([0, 2])
+    y = x[idx]
+    y.sum().backward()
+    expected = np.array([[1, 1, 1], [0, 0, 0], [1, 1, 1]], np.float32)
+    np.testing.assert_allclose(x.grad.numpy(), expected)

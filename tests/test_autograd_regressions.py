@@ -1,0 +1,122 @@
+"""Regression tests for review findings on the autograd/dispatch layer."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_inplace_op_keeps_gradient_flow():
+    # add_ on a non-leaf must keep the chain alive (no tape self-loop).
+    y = paddle.to_tensor([1.0], stop_gradient=False)
+    x = y * 1.0
+    x.add_(paddle.to_tensor([5.0]))
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(y.grad.numpy(), [3.0])
+
+
+def test_inplace_on_requires_grad_leaf_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with pytest.raises(RuntimeError):
+        x.add_(paddle.to_tensor([1.0]))
+    with paddle.no_grad():
+        x.add_(paddle.to_tensor([1.0]))  # allowed under no_grad
+    np.testing.assert_allclose(x.numpy(), [2.0])
+
+
+def test_tensor_kwarg_dispatch():
+    a = paddle.to_tensor([2.0], stop_gradient=False)
+    b = paddle.to_tensor([3.0], stop_gradient=False)
+    out = paddle.multiply(a, y=b)
+    out.sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), [3.0])
+    np.testing.assert_allclose(b.grad.numpy(), [2.0])
+
+
+def test_logcumsumexp_numerics():
+    x = np.array([0.0, 1000.0, 3.0], np.float32)
+    out = paddle.logcumsumexp(paddle.to_tensor(x))
+    ref = np.logaddexp.accumulate(x.astype(np.float64)).astype(np.float32)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+
+def test_grad_does_not_pollute_other_leaves():
+    w = paddle.to_tensor([3.0], stop_gradient=False)
+    x = paddle.to_tensor([4.0], stop_gradient=False)
+    (gx,) = paddle.grad((w * x).sum(), [x])
+    np.testing.assert_allclose(gx.numpy(), [3.0])
+    assert w.grad is None
+    assert x.grad is None
+
+
+def test_grad_of_intermediate_tensor():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * 3
+    z = (y * y).sum()
+    (gy,) = paddle.grad(z, [y])
+    np.testing.assert_allclose(gy.numpy(), [12.0])
+
+
+def test_hook_fires_once_on_accumulated_grad():
+    # x feeds two consumers; a clipping hook must see the accumulated grad.
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    h = x * 1.0
+    calls = []
+
+    def hook(g):
+        calls.append(g.numpy().copy())
+        return paddle.clip(g, -2.5, 2.5)
+
+    h.register_hook(hook)
+    (h * 2 + h * 3).sum().backward()
+    assert len(calls) == 1
+    np.testing.assert_allclose(calls[0], [5.0])
+    np.testing.assert_allclose(x.grad.numpy(), [2.5])
+
+
+def test_leaf_hook_fires_once_on_accumulated_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    calls = []
+    x.register_hook(lambda g: calls.append(1))
+    (x * 2 + x * 3).sum().backward()
+    assert len(calls) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_saved_tensors_hooks_pack_unpack():
+    from paddle_tpu.autograd import PyLayer, saved_tensors_hooks
+
+    packed, unpacked = [], []
+
+    class Sq(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor
+            return dy * 2 * x
+
+    def pack(t):
+        packed.append(t)
+        return t.numpy()
+
+    def unpack(a):
+        unpacked.append(a)
+        return paddle.to_tensor(a)
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    with saved_tensors_hooks(pack, unpack):
+        y = Sq.apply(x)
+    y.sum().backward()
+    assert len(packed) == 1 and len(unpacked) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_tensor_concat_free_function_only():
+    t = paddle.to_tensor([1.0])
+    assert not hasattr(paddle.Tensor, "concat") or callable(paddle.concat)
+    out = paddle.concat([t, t])
+    assert out.shape == [2]

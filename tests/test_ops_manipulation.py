@@ -1,0 +1,159 @@
+"""Shape/indexing op tests vs NumPy."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from op_test_base import check_grad, check_output
+
+RNG = np.random.RandomState(3)
+
+
+def rnd(*shape):
+    return RNG.randn(*shape).astype(np.float32)
+
+
+def test_reshape_transpose():
+    x = rnd(2, 3, 4)
+    check_output(lambda t: paddle.reshape(t, [4, 6]), lambda a: a.reshape(4, 6), [x])
+    check_output(
+        lambda t: paddle.transpose(t, [2, 0, 1]), lambda a: a.transpose(2, 0, 1), [x]
+    )
+    check_grad(lambda t: paddle.transpose(t, [1, 0, 2]), [rnd(2, 2, 2)])
+
+
+def test_concat_stack_split():
+    a, b = rnd(2, 3), rnd(2, 3)
+    out = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=1)
+    np.testing.assert_allclose(out.numpy(), np.concatenate([a, b], axis=1))
+    out = paddle.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+    np.testing.assert_allclose(out.numpy(), np.stack([a, b]))
+    parts = paddle.split(paddle.to_tensor(a), [1, 2], axis=1)
+    assert [p.shape for p in parts] == [[2, 1], [2, 2]]
+    parts = paddle.split(paddle.to_tensor(a), [1, -1], axis=1)
+    assert parts[1].shape == [2, 2]
+
+
+def test_concat_grad():
+    a = paddle.to_tensor(rnd(2, 2), stop_gradient=False)
+    b = paddle.to_tensor(rnd(2, 2), stop_gradient=False)
+    (paddle.concat([a, b], axis=0).sum() * 2).backward()
+    np.testing.assert_allclose(a.grad.numpy(), np.full((2, 2), 2.0))
+    np.testing.assert_allclose(b.grad.numpy(), np.full((2, 2), 2.0))
+
+
+def test_squeeze_unsqueeze_flatten():
+    x = rnd(2, 1, 3)
+    check_output(lambda t: paddle.squeeze(t, 1), lambda a: a.squeeze(1), [x])
+    check_output(
+        lambda t: paddle.unsqueeze(t, [0, 2]),
+        lambda a: np.expand_dims(np.expand_dims(a, 0), 2),
+        [x],
+    )
+    check_output(
+        lambda t: paddle.flatten(t, 1, 2), lambda a: a.reshape(2, 3), [x]
+    )
+
+
+def test_gather_scatter():
+    x = rnd(5, 3)
+    idx = np.array([0, 2, 4])
+    out = paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx))
+    np.testing.assert_allclose(out.numpy(), x[idx])
+
+    updates = rnd(2, 3)
+    out = paddle.scatter(
+        paddle.to_tensor(x), paddle.to_tensor(np.array([1, 3])),
+        paddle.to_tensor(updates),
+    )
+    expected = x.copy()
+    expected[[1, 3]] = updates
+    np.testing.assert_allclose(out.numpy(), expected)
+
+
+def test_gather_nd():
+    x = rnd(3, 4, 5)
+    idx = np.array([[0, 1], [2, 3]])
+    out = paddle.gather_nd(paddle.to_tensor(x), paddle.to_tensor(idx))
+    np.testing.assert_allclose(out.numpy(), x[[0, 2], [1, 3]])
+
+
+def test_where_masked():
+    x, y = rnd(3, 3), rnd(3, 3)
+    cond = x > 0
+    out = paddle.where(paddle.to_tensor(cond), paddle.to_tensor(x), paddle.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), np.where(cond, x, y))
+
+    out = paddle.masked_select(paddle.to_tensor(x), paddle.to_tensor(cond))
+    np.testing.assert_allclose(out.numpy(), x[cond])
+
+
+def test_topk_sort():
+    x = rnd(4, 6)
+    vals, idx = paddle.topk(paddle.to_tensor(x), 3)
+    ref = np.sort(x, axis=-1)[:, ::-1][:, :3]
+    np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+    out = paddle.sort(paddle.to_tensor(x), descending=True)
+    np.testing.assert_allclose(out.numpy(), np.sort(x)[:, ::-1], rtol=1e-6)
+
+
+def test_pad():
+    x = rnd(2, 3)
+    out = paddle.pad(paddle.to_tensor(x), [1, 1, 2, 0], value=9.0)
+    assert out.shape == [4, 5]
+    np.testing.assert_allclose(out.numpy()[0], np.full(5, 9.0))
+
+    # NCHW spatial padding
+    x4 = rnd(1, 2, 3, 3)
+    out = paddle.pad(paddle.to_tensor(x4), [1, 1, 1, 1])
+    assert out.shape == [1, 2, 5, 5]
+
+
+def test_tile_expand():
+    x = rnd(2, 3)
+    check_output(lambda t: paddle.tile(t, [2, 1]), lambda a: np.tile(a, (2, 1)), [x])
+    out = paddle.expand(paddle.to_tensor(rnd(1, 3)), [4, 3])
+    assert out.shape == [4, 3]
+    out = paddle.expand(paddle.to_tensor(rnd(1, 3)), [2, -1, -1])
+    assert out.shape == [2, 1, 3]
+
+
+def test_unique_nonzero():
+    x = np.array([1, 3, 1, 2, 3], np.int32)
+    out = paddle.unique(paddle.to_tensor(x))
+    np.testing.assert_array_equal(out.numpy(), [1, 2, 3])
+    nz = paddle.nonzero(paddle.to_tensor(np.array([0, 5, 0, 7])))
+    np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
+
+
+def test_cast():
+    x = paddle.to_tensor([1.7, 2.3])
+    assert paddle.cast(x, "int32").numpy().dtype == np.int32
+    y = paddle.cast(x, "bfloat16")
+    assert str(y.dtype) == "bfloat16"
+
+
+def test_take_put_along_axis():
+    x = rnd(3, 4)
+    idx = np.array([[0], [2], [1]])
+    out = paddle.take_along_axis(paddle.to_tensor(x), paddle.to_tensor(idx), 1)
+    np.testing.assert_allclose(out.numpy(), np.take_along_axis(x, idx, 1))
+
+    out = paddle.put_along_axis(
+        paddle.to_tensor(x), paddle.to_tensor(idx), 0.0, 1
+    )
+    ref = x.copy()
+    np.put_along_axis(ref, idx, 0.0, 1)
+    np.testing.assert_allclose(out.numpy(), ref)
+
+
+def test_tril_triu():
+    x = rnd(4, 4)
+    check_output(paddle.tril, np.tril, [x])
+    check_output(paddle.triu, np.triu, [x])
+    check_grad(lambda t: paddle.tril(t), [x])
+
+
+def test_flip_roll():
+    x = rnd(3, 4)
+    check_output(lambda t: paddle.flip(t, [0]), lambda a: np.flip(a, 0), [x])
+    check_output(lambda t: paddle.roll(t, 2, 1), lambda a: np.roll(a, 2, 1), [x])

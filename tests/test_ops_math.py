@@ -1,0 +1,163 @@
+"""Numeric op tests vs NumPy with finite-difference grad checks
+(reference strategy: test/legacy_test/op_test.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test_base import check_grad, check_output
+
+
+RNG = np.random.RandomState(7)
+
+
+def rnd(*shape):
+    return RNG.randn(*shape).astype(np.float32)
+
+
+def pos(*shape):
+    return (RNG.rand(*shape).astype(np.float32) + 0.5)
+
+
+class TestUnary:
+    @pytest.mark.parametrize(
+        "name",
+        ["exp", "log", "sqrt", "abs", "sin", "cos", "tanh", "sigmoid",
+         "square", "erf", "log1p", "rsqrt", "reciprocal"],
+    )
+    def test_forward(self, name):
+        x = pos(3, 4)
+        np_map = {
+            "sigmoid": lambda a: 1 / (1 + np.exp(-a)),
+            "square": np.square,
+            "rsqrt": lambda a: 1 / np.sqrt(a),
+            "reciprocal": lambda a: 1 / a,
+            "erf": None,
+            "log1p": np.log1p,
+        }
+        np_fn = np_map.get(name, getattr(np, name, None))
+        if np_fn is None:
+            import scipy.special  # available via jax's scipy dep? fall back
+
+            np_fn = getattr(scipy.special, name)
+        # XLA's vectorized transcendental approximations differ from NumPy's
+        # libm at the ~1e-4 relative level on CPU.
+        check_output(getattr(paddle, name), np_fn, [x], atol=1e-4, rtol=1e-3)
+
+    @pytest.mark.parametrize("name", ["exp", "tanh", "sigmoid", "sqrt", "log"])
+    def test_grad(self, name):
+        x = pos(2, 3)
+        check_grad(getattr(paddle, name), [x])
+
+
+class TestBinary:
+    @pytest.mark.parametrize(
+        "name,np_fn",
+        [("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+         ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum)],
+    )
+    def test_forward(self, name, np_fn):
+        check_output(getattr(paddle, name), np_fn, [rnd(3, 4), pos(3, 4)])
+
+    def test_broadcast_grad(self):
+        # Broadcasting must reduce grads back to input shapes.
+        check_grad(paddle.add, [rnd(3, 4), rnd(4)])
+        check_grad(paddle.multiply, [rnd(2, 1, 3), rnd(4, 1)])
+
+
+class TestReductions:
+    def test_sum_axes(self):
+        x = rnd(2, 3, 4)
+        check_output(paddle.sum, np.sum, [x])
+        check_output(lambda t: paddle.sum(t, axis=1), lambda a: a.sum(axis=1), [x])
+        check_output(
+            lambda t: paddle.sum(t, axis=[0, 2], keepdim=True),
+            lambda a: a.sum(axis=(0, 2), keepdims=True),
+            [x],
+        )
+
+    def test_mean_grad(self):
+        check_grad(lambda t: paddle.mean(t, axis=1), [rnd(3, 4)])
+
+    def test_max_grad(self):
+        x = np.array([[1.0, 5.0], [7.0, 2.0]], np.float32)
+        check_grad(lambda t: paddle.max(t, axis=1), [x])
+
+    def test_std_var(self):
+        x = rnd(5, 6)
+        check_output(
+            lambda t: paddle.std(t, axis=0),
+            lambda a: a.std(axis=0, ddof=1),
+            [x],
+            atol=1e-4,
+        )
+        check_output(
+            lambda t: paddle.var(t, axis=1, unbiased=False),
+            lambda a: a.var(axis=1),
+            [x],
+            atol=1e-4,
+        )
+
+    def test_logsumexp(self):
+        x = rnd(3, 4)
+        ref = np.log(np.exp(x).sum(axis=-1))
+        check_output(lambda t: paddle.logsumexp(t, axis=-1), lambda a: ref, [x])
+        check_grad(lambda t: paddle.logsumexp(t, axis=-1), [x])
+
+
+class TestMatmul:
+    def test_shapes(self):
+        a, b = rnd(3, 4), rnd(4, 5)
+        check_output(paddle.matmul, np.matmul, [a, b])
+        # batched
+        a, b = rnd(2, 3, 4), rnd(2, 4, 5)
+        check_output(paddle.matmul, np.matmul, [a, b])
+
+    def test_transpose_flags(self):
+        a, b = rnd(4, 3), rnd(4, 5)
+        out = paddle.matmul(
+            paddle.to_tensor(a), paddle.to_tensor(b), transpose_x=True
+        )
+        np.testing.assert_allclose(out.numpy(), a.T @ b, rtol=1e-5)
+
+    def test_grad(self):
+        check_grad(paddle.matmul, [rnd(3, 4), rnd(4, 2)])
+
+    def test_einsum(self):
+        a, b = rnd(2, 3, 4), rnd(2, 4, 5)
+        out = paddle.einsum("bij,bjk->bik", paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), np.einsum("bij,bjk->bik", a, b),
+                                   rtol=1e-5)
+
+
+class TestCumulative:
+    def test_cumsum(self):
+        x = rnd(3, 4)
+        check_output(lambda t: paddle.cumsum(t, axis=1),
+                     lambda a: np.cumsum(a, axis=1), [x])
+        check_grad(lambda t: paddle.cumsum(t, axis=0), [x])
+
+    def test_clip_grad(self):
+        x = np.array([-2.0, 0.5, 3.0], np.float32)
+        check_grad(lambda t: paddle.clip(t, -1.0, 1.0), [x])
+
+
+class TestComparison:
+    def test_equal_family(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([1.0, 5.0, 2.0], np.float32)
+        check_output(paddle.equal, np.equal, [a, b])
+        check_output(paddle.less_than, np.less, [a, b])
+        assert bool(paddle.allclose(paddle.to_tensor(a), paddle.to_tensor(a)))
+
+
+class TestInplace:
+    def test_add_(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        x.add_(paddle.to_tensor([1.0, 1.0]))
+        np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
+
+    def test_setitem(self):
+        x = paddle.zeros([3, 3])
+        x[1, :] = 5.0
+        np.testing.assert_allclose(x.numpy()[1], [5.0, 5.0, 5.0])
