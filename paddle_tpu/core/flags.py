@@ -96,3 +96,8 @@ define_flag("use_bf16_matmul", False, "Force bf16 accumulation inputs for matmul
 define_flag("log_compiles", False, "Log XLA compilations triggered by the runtime.")
 define_flag("deterministic", False, "Prefer deterministic kernel lowering.")
 define_flag("allocator_strategy", "auto_growth", "Kept for API parity; XLA owns HBM.")
+define_flag(
+    "use_pallas_attention",
+    True,
+    "Route scaled_dot_product_attention to the Pallas flash kernel on TPU.",
+)

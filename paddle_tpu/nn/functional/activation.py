@@ -1,0 +1,234 @@
+"""Activation functionals.
+
+Reference surface: python/paddle/nn/functional/activation.py — each op here is
+a pure-jax lowering registered through the dispatch funnel so XLA fuses it
+into neighboring matmuls (the TPU replacement for Phi's hand-fused
+activation CUDA kernels, paddle/phi/kernels/fusion/gpu/fused_bias_act*).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+from ...core.tensor import Tensor
+
+__all__ = [
+    "celu", "elu", "gelu", "glu", "gumbel_softmax", "hardshrink",
+    "hardsigmoid", "hardswish", "hardtanh", "leaky_relu", "log_sigmoid",
+    "log_softmax", "maxout", "mish", "prelu", "relu", "relu6", "relu_",
+    "rrelu", "selu", "sigmoid", "silu", "softmax", "softmax_",
+    "softplus", "softshrink", "softsign", "swish", "swiglu",
+    "tanhshrink", "thresholded_relu", "tanh",
+]
+
+
+@op("relu", amp="cast")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu_(x):
+    return x.set_value(relu(x)._data)
+
+
+@op("relu6")
+def relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+@op("gelu", amp="cast")
+def gelu(x, approximate: bool = False):
+    return jax.nn.gelu(x, approximate=bool(approximate))
+
+
+@op("silu", amp="cast")
+def silu(x):
+    return jax.nn.silu(x)
+
+
+@op("swish")
+def swish(x):
+    return jax.nn.silu(x)
+
+
+@op("swiglu", amp="cast")
+def swiglu(x, y=None):
+    # reference: python/paddle/incubate/nn/functional/swiglu.py — if y is
+    # None the last dim of x is split in half.
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+@op("leaky_relu")
+def leaky_relu(x, negative_slope: float = 0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+@op("elu")
+def elu(x, alpha: float = 1.0):
+    return jax.nn.elu(x, alpha)
+
+
+@op("celu")
+def celu(x, alpha: float = 1.0):
+    return jax.nn.celu(x, alpha)
+
+
+@op("selu")
+def selu(
+    x,
+    scale: float = 1.0507009873554804934193349852946,
+    alpha: float = 1.6732632423543772848170429916717,
+):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@op("nn_sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@op("nn_tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@op("hardshrink")
+def hardshrink(x, threshold: float = 0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@op("hardsigmoid")
+def hardsigmoid(x, slope: float = 1.0 / 6.0, offset: float = 0.5):
+    return jnp.clip(x * slope + offset, 0.0, 1.0)
+
+
+@op("hardswish")
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+@op("hardtanh")
+def hardtanh(x, min: float = -1.0, max: float = 1.0):
+    return jnp.clip(x, min, max)
+
+
+@op("log_sigmoid")
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@op("log_softmax", amp="keep_fp32")
+def log_softmax(x, axis: int = -1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@op("softmax", amp="keep_fp32")
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax_(x, axis: int = -1):
+    return x.set_value(softmax(x, axis)._data)
+
+
+@op("softplus")
+def softplus(x, beta: float = 1.0, threshold: float = 20.0):
+    return jnp.where(
+        x * beta > threshold, x, (1.0 / beta) * jnp.log1p(jnp.exp(beta * x))
+    )
+
+
+@op("softshrink")
+def softshrink(x, threshold: float = 0.5):
+    return jnp.where(
+        x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0)
+    )
+
+
+@op("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@op("tanhshrink")
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+@op("thresholded_relu")
+def thresholded_relu(x, threshold: float = 1.0, value: float = 0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+@op("mish")
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@op("prelu")
+def prelu(x, weight, data_format: str = "NCHW"):
+    w = weight
+    if w.ndim == 1 and w.shape[0] != 1 and x.ndim > 1:
+        ch_axis = 1 if data_format[1] == "C" else x.ndim - 1
+        shape = [1] * x.ndim
+        shape[ch_axis] = w.shape[0]
+        w = w.reshape(shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+@op("glu")
+def glu(x, axis: int = -1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@op("maxout")
+def maxout(x, groups: int, axis: int = 1):
+    # NCHW: channels split into (C//groups, groups), max over groups.
+    shape = list(x.shape)
+    if axis < 0:
+        axis += x.ndim
+    c = shape[axis]
+    new_shape = shape[:axis] + [c // groups, groups] + shape[axis + 1 :]
+    return jnp.max(jnp.reshape(x, new_shape), axis=axis + 1)
+
+
+def rrelu(x, lower: float = 1.0 / 8.0, upper: float = 1.0 / 3.0, training: bool = True):
+    from ...core import random as prandom
+
+    if not training:
+        return leaky_relu(x, (lower + upper) / 2.0)
+    key = prandom.next_key()
+
+    @op("rrelu_train")
+    def _rrelu(xx):
+        slope = jax.random.uniform(
+            key, jnp.shape(xx), dtype=jnp.result_type(float), minval=lower, maxval=upper
+        )
+        return jnp.where(xx >= 0, xx, slope * xx)
+
+    return _rrelu(x)
+
+
+def gumbel_softmax(x, temperature: float = 1.0, hard: bool = False, axis: int = -1):
+    from ...core import random as prandom
+
+    key = prandom.next_key()
+
+    @op("gumbel_softmax")
+    def _gumbel(xx):
+        g = -jnp.log(-jnp.log(jax.random.uniform(key, jnp.shape(xx)) + 1e-20) + 1e-20)
+        y = jax.nn.softmax((xx + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            # straight-through estimator
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+
+    return _gumbel(x)

@@ -1,0 +1,153 @@
+"""Attention functionals.
+
+Reference surface: python/paddle/nn/functional/flash_attention.py (flash_attn
+binding, kernels/gpu/flash_attn_kernel.cu:132) and
+scaled_dot_product_attention. On TPU the hot path is a Pallas flash-attention
+kernel (paddle_tpu/ops/pallas/flash_attention.py); this module routes to it
+when shapes/backend allow and otherwise falls back to the XLA-fused
+reference expression.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+from ...core.flags import GLOBAL_FLAGS
+
+__all__ = [
+    "scaled_dot_product_attention",
+    "flash_attention",
+    "flash_attn_unpadded",
+    "sdp_kernel",
+]
+
+
+def _sdpa_ref(q, k, v, mask, dropout_p, is_causal, scale, training, key=None):
+    # q,k,v: [B, S, H, D] (reference layout, flash_attention.py docstring)
+    qh = jnp.swapaxes(q, 1, 2)  # [B, H, S, D]
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / jnp.sqrt(jnp.float32(d))
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", qh, kh, preferred_element_type=jnp.float32
+    ) * s
+    if is_causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((ql, kl), dtype=bool), kl - ql)
+        logits = jnp.where(causal, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training and key is not None:
+        keep = 1.0 - dropout_p
+        dmask = jax.random.bernoulli(key, keep, probs.shape)
+        probs = jnp.where(dmask, probs / keep, 0.0).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)  # back to [B, S, H, D]
+
+
+def scaled_dot_product_attention(
+    query,
+    key,
+    value,
+    attn_mask=None,
+    dropout_p: float = 0.0,
+    is_causal: bool = False,
+    training: bool = True,
+    name=None,
+):
+    """q/k/v: [batch, seq, num_heads, head_dim] (reference layout)."""
+    rng_key = None
+    if dropout_p > 0.0 and training:
+        from ...core import random as prandom
+
+        rng_key = prandom.next_key()
+
+    use_pallas = (
+        GLOBAL_FLAGS.get("use_pallas_attention")
+        and attn_mask is None
+        and dropout_p == 0.0
+    )
+    if use_pallas:
+        from ...ops.pallas import flash_attention as _fa
+
+        if _fa.supported(query.shape, query.dtype):
+            return _fa.flash_attention(query, key, value, causal=is_causal)
+
+    @op("scaled_dot_product_attention", amp="cast")
+    def _impl(q, k, v, m):
+        return _sdpa_ref(q, k, v, m, dropout_p, is_causal, None, training, rng_key)
+
+    return _impl(query, key, value, attn_mask)
+
+
+def flash_attention(
+    query, key, value, dropout: float = 0.0, causal: bool = False,
+    return_softmax: bool = False, fixed_seed_offset=None, rng_name="",
+    training: bool = True, name=None,
+):
+    """reference: python/paddle/nn/functional/flash_attention.py:248."""
+    out = scaled_dot_product_attention(
+        query, key, value, None, dropout, causal, training
+    )
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def flash_attn_unpadded(
+    query, key, value, cu_seqlens_q, cu_seqlens_k, max_seqlen_q, max_seqlen_k,
+    scale, dropout=0.0, causal=False, return_softmax=False,
+    fixed_seed_offset=None, rng_name="", training=True, name=None,
+):
+    """Varlen flash attention over packed sequences.
+
+    reference: flash_attn_varlen_fwd (backends/dynload/flashattn.h). Lowered
+    here as a segment-masked SDPA over the packed [total_tokens, H, D] batch.
+    """
+    @op("flash_attn_unpadded", amp="cast")
+    def _impl(q, k, v, cu_q, cu_k):
+        total_q = q.shape[0]
+        total_k = k.shape[0]
+        pos_q = jnp.arange(total_q)
+        pos_k = jnp.arange(total_k)
+        seg_q = jnp.searchsorted(cu_q, pos_q, side="right") - 1
+        seg_k = jnp.searchsorted(cu_k, pos_k, side="right") - 1
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            off_q = pos_q - jnp.take(cu_q, seg_q)
+            off_k = pos_k - jnp.take(cu_k, seg_k)
+            mask = mask & (off_q[:, None] >= off_k[None, :])
+        logits = jnp.einsum(
+            "qhd,khd->hqk", q, k, preferred_element_type=jnp.float32
+        ) * scale
+        logits = jnp.where(mask[None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("hqk,khd->qhd", probs, v)
+
+    out = _impl(query, key, value, cu_seqlens_q, cu_seqlens_k)
+    return out, None
+
+
+class sdp_kernel:
+    """Context manager selecting attention backends (torch-compat shim)."""
+
+    def __init__(self, enable_flash=True, enable_math=True,
+                 enable_mem_efficient=True):
+        self._enable_flash = enable_flash
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = GLOBAL_FLAGS.get("use_pallas_attention")
+        GLOBAL_FLAGS.set("use_pallas_attention", bool(self._enable_flash))
+        return self
+
+    def __exit__(self, *exc):
+        GLOBAL_FLAGS.set("use_pallas_attention", self._prev)
+        return False

@@ -1,0 +1,370 @@
+"""Common functionals: linear, dropout, padding, interpolate, similarity.
+
+Reference surface: python/paddle/nn/functional/common.py (linear at :2170,
+dropout at :1017, interpolate at :214). Dropout draws its key from the
+global generator at call time and threads it through the op as an array so
+the mask computation is XLA-traced (and reproducible from paddle_tpu.seed).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import random as prandom
+from ...core.dispatch import op
+from ...core.tensor import Tensor
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "pad", "zeropad2d", "interpolate", "upsample", "cosine_similarity",
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "label_smooth",
+    "bilinear", "unfold", "fold", "class_center_sample",
+]
+
+
+@op("linear", amp="cast")
+def linear(x, weight, bias=None):
+    # reference keeps weight [in, out] (transposed vs torch):
+    # python/paddle/nn/functional/common.py:2170
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@op("dropout_impl")
+def _dropout_impl(x, key, p: float, upscale: bool):
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, jnp.shape(x))
+    if upscale:
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+def dropout(
+    x,
+    p: float = 0.5,
+    axis=None,
+    training: bool = True,
+    mode: str = "upscale_in_train",
+    name=None,
+):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x * (1.0 - p)
+        return x
+    if p == 1.0:
+        return x * 0.0
+    key = prandom.next_key()
+    upscale = mode == "upscale_in_train"
+    if axis is None:
+        return _dropout_impl(x, key, float(p), upscale)
+
+    # axis-wise mask broadcast (reference dropout(axis=...) semantics)
+    axes = [axis] if isinstance(axis, int) else list(axis)
+    shape = [d if i in axes else 1 for i, d in enumerate(x.shape)]
+
+    @op("dropout_axis")
+    def _dropout_axis(xx, kk):
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(kk, keep, tuple(shape))
+        if upscale:
+            return jnp.where(mask, xx / keep, 0.0).astype(xx.dtype)
+        return jnp.where(mask, xx, 0.0).astype(xx.dtype)
+
+    return _dropout_axis(x, key)
+
+
+def _feature_dropout(x, p, training, data_format, spatial_ndim):
+    if not training or p == 0.0:
+        return x
+    key = prandom.next_key()
+    cf = data_format.startswith("NC")
+
+    @op("feature_dropout")
+    def _impl(xx, kk):
+        shp = jnp.shape(xx)
+        if cf:
+            mask_shape = shp[:2] + (1,) * spatial_ndim
+        else:
+            mask_shape = (shp[0],) + (1,) * spatial_ndim + (shp[-1],)
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(kk, keep, mask_shape)
+        return jnp.where(mask, xx / keep, 0.0).astype(xx.dtype)
+
+    return _impl(x, key)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    return _feature_dropout(x, p, training, data_format, 2)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    return _feature_dropout(x, p, training, data_format, 3)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = prandom.next_key()
+
+    @op("alpha_dropout")
+    def _impl(xx, kk):
+        alpha = 1.6732632423543772848170429916717
+        scale = 1.0507009873554804934193349852946
+        alpha_p = -alpha * scale
+        keep = 1.0 - p
+        a = (keep + alpha_p**2 * keep * (1 - keep)) ** -0.5
+        b = -a * alpha_p * (1 - keep)
+        mask = jax.random.bernoulli(kk, keep, jnp.shape(xx))
+        return (a * jnp.where(mask, xx, alpha_p) + b).astype(xx.dtype)
+
+    return _impl(x, key)
+
+
+def _to_pairs(pad_arg, n):
+    p = list(pad_arg)
+    if len(p) == 2 * n:
+        # paddle order: last-dim-first pairs [l_dimk, r_dimk, ..., l_dim1, r_dim1]
+        pairs = [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(n)]
+        return pairs
+    raise ValueError(f"bad pad length {len(p)} for {n} spatial dims")
+
+
+@op("pad")
+def pad(x, pad, mode: str = "constant", value: float = 0.0, data_format: str = "NCHW"):
+    # reference: python/paddle/nn/functional/common.py:519 — `pad` applies to
+    # the trailing spatial dims in reverse order when len(pad) < 2*ndim.
+    nd = jnp.ndim(x)
+    if isinstance(pad, (list, tuple)) and len(pad) == 2 * nd:
+        cfg = [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(nd)]
+    else:
+        n_spatial = len(pad) // 2
+        pairs = _to_pairs(pad, n_spatial)
+        cfg = [(0, 0)] * nd
+        if data_format.startswith("NC"):
+            spatial_dims = list(range(2, 2 + n_spatial))
+        else:
+            spatial_dims = list(range(1, 1 + n_spatial))
+        for i, d in enumerate(spatial_dims):
+            cfg[d] = pairs[i]
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, cfg, mode="constant", constant_values=value)
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+@op("cosine_similarity")
+def cosine_similarity(x1, x2, axis: int = 1, eps: float = 1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@op("pixel_shuffle")
+def pixel_shuffle(x, upscale_factor: int, data_format: str = "NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = jnp.reshape(x, (n, c // (r * r), r, r, h, w))
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return jnp.reshape(x, (n, c // (r * r), h * r, w * r))
+    n, h, w, c = x.shape
+    x = jnp.reshape(x, (n, h, w, r, r, c // (r * r)))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return jnp.reshape(x, (n, h * r, w * r, c // (r * r)))
+
+
+@op("pixel_unshuffle")
+def pixel_unshuffle(x, downscale_factor: int, data_format: str = "NCHW"):
+    r = downscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = jnp.reshape(x, (n, c, h // r, r, w // r, r))
+        x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+        return jnp.reshape(x, (n, c * r * r, h // r, w // r))
+    n, h, w, c = x.shape
+    x = jnp.reshape(x, (n, h // r, r, w // r, r, c))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return jnp.reshape(x, (n, h // r, w // r, c * r * r))
+
+
+@op("channel_shuffle")
+def channel_shuffle(x, groups: int, data_format: str = "NCHW"):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = jnp.reshape(x, (n, groups, c // groups, h, w))
+        x = jnp.transpose(x, (0, 2, 1, 3, 4))
+        return jnp.reshape(x, (n, c, h, w))
+    n, h, w, c = x.shape
+    x = jnp.reshape(x, (n, h, w, groups, c // groups))
+    x = jnp.transpose(x, (0, 1, 2, 4, 3))
+    return jnp.reshape(x, (n, h, w, c))
+
+
+@op("label_smooth")
+def label_smooth(label, prior_dist=None, epsilon: float = 0.1):
+    n_classes = jnp.shape(label)[-1]
+    if prior_dist is not None:
+        return (1.0 - epsilon) * label + epsilon * prior_dist
+    return (1.0 - epsilon) * label + epsilon / n_classes
+
+
+@op("bilinear")
+def bilinear(x1, x2, weight, bias=None):
+    # weight: [out_features, in1, in2]
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@op("interpolate")
+def interpolate(
+    x,
+    size=None,
+    scale_factor=None,
+    mode: str = "nearest",
+    align_corners: bool = False,
+    align_mode: int = 0,
+    data_format: str = "NCHW",
+):
+    channel_first = data_format.startswith("NC")
+    if channel_first:
+        spatial = x.shape[2:]
+    else:
+        spatial = x.shape[1:-1]
+    n_sp = len(spatial)
+    if size is None:
+        if scale_factor is None:
+            raise ValueError("one of size / scale_factor must be set")
+        sf = (
+            [scale_factor] * n_sp
+            if not isinstance(scale_factor, (list, tuple))
+            else list(scale_factor)
+        )
+        size = [int(np.floor(s * f)) for s, f in zip(spatial, sf)]
+    size = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+
+    method = {
+        "nearest": "nearest",
+        "bilinear": "linear",
+        "trilinear": "linear",
+        "linear": "linear",
+        "bicubic": "cubic",
+        "area": "linear",
+    }[mode.lower()]
+
+    if channel_first:
+        out_shape = list(x.shape[:2]) + size
+    else:
+        out_shape = [x.shape[0]] + size + [x.shape[-1]]
+
+    if method == "nearest" or not align_corners:
+        return jax.image.resize(x, out_shape, method=method).astype(x.dtype)
+
+    # align_corners=True path: explicit coordinate map + linear gather
+    def resize_axis(arr, axis, new_len):
+        old_len = arr.shape[axis]
+        if new_len == old_len:
+            return arr
+        if new_len == 1 or old_len == 1:
+            idx = jnp.zeros((new_len,), dtype=jnp.int32)
+            return jnp.take(arr, idx, axis=axis)
+        pos = jnp.linspace(0.0, old_len - 1.0, new_len)
+        lo = jnp.floor(pos).astype(jnp.int32)
+        hi = jnp.clip(lo + 1, 0, old_len - 1)
+        w = (pos - lo).astype(arr.dtype)
+        shape = [1] * arr.ndim
+        shape[axis] = new_len
+        w = jnp.reshape(w, shape)
+        return jnp.take(arr, lo, axis=axis) * (1 - w) + jnp.take(arr, hi, axis=axis) * w
+
+    out = x
+    sp_axes = range(2, 2 + n_sp) if channel_first else range(1, 1 + n_sp)
+    for ax, s in zip(sp_axes, size):
+        out = resize_axis(out, ax, s)
+    return out.astype(x.dtype)
+
+
+def upsample(
+    x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+    align_mode=0, data_format="NCHW", name=None,
+):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+@op("unfold")
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    # im2col: x [N, C, H, W] -> [N, C*kh*kw, L]
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    p = paddings
+    if isinstance(p, int):
+        pt = pb = pl = pr = p
+    elif len(p) == 2:
+        pt = pb = p[0]
+        pl = pr = p[1]
+    else:
+        pt, pl, pb, pr = p
+    n, c, h, w = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+    oh = (h + pt + pb - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + pl + pr - dw * (kw - 1) - 1) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            sl = x[:, :, i * dh : i * dh + sh * (oh - 1) + 1 : sh,
+                   j * dw : j * dw + sw * (ow - 1) + 1 : sw]
+            patches.append(sl)
+    out = jnp.stack(patches, axis=2)  # [N, C, kh*kw, oh, ow]
+    return jnp.reshape(out, (n, c * kh * kw, oh * ow))
+
+
+@op("fold")
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    oh_out, ow_out = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    p = paddings
+    if isinstance(p, int):
+        pt = pb = pl = pr = p
+    elif len(p) == 2:
+        pt = pb = p[0]
+        pl = pr = p[1]
+    else:
+        pt, pl, pb, pr = p
+    n, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    oh = (oh_out + pt + pb - dh * (kh - 1) - 1) // sh + 1
+    ow = (ow_out + pl + pr - dw * (kw - 1) - 1) // sw + 1
+    x = jnp.reshape(x, (n, c, kh, kw, oh, ow))
+    out = jnp.zeros((n, c, oh_out + pt + pb, ow_out + pl + pr), dtype=x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            out = out.at[:, :, i * dh : i * dh + sh * (oh - 1) + 1 : sh,
+                         j * dw : j * dw + sw * (ow - 1) + 1 : sw].add(x[:, :, i, j])
+    return out[:, :, pt : pt + oh_out, pl : pl + ow_out]
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError(
+        "class_center_sample requires distributed negative sampling; "
+        "planned with the EP/MoE utilities"
+    )

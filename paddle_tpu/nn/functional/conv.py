@@ -1,0 +1,188 @@
+"""Convolution functionals lowered to lax.conv_general_dilated.
+
+Reference surface: python/paddle/nn/functional/conv.py (conv2d at :572). On
+TPU a convolution is a single XLA op that tiles directly onto the MXU — the
+replacement for Phi's cuDNN kernel selection + autotuning
+(paddle/phi/kernels/gpudnn/conv_kernel.cu).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+
+__all__ = [
+    "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+]
+
+
+def _tuple_n(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(x) for x in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _padding_n(padding, n):
+    """Normalize paddle padding (int | str | list) to lax [(lo, hi)] * n."""
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' / 'VALID'
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        # [before1, after1, before2, after2, ...]
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    if len(padding) == n and all(isinstance(p, (list, tuple)) for p in padding):
+        return [tuple(p) for p in padding]
+    raise ValueError(f"unsupported padding {padding!r}")
+
+
+def _dimension_numbers(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv_impl(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channel_last = data_format in ("NLC", "NHWC", "NDHWC")
+    dn = _dimension_numbers(n, channel_last)
+    # weight layout follows the reference: [out_c, in_c/groups, *k]
+    if channel_last:
+        # lax wants [*k, in_c/groups, out_c] for the channel-last spec above
+        perm = tuple(range(2, 2 + n)) + (1, 0)
+        w = jnp.transpose(weight, perm)
+    else:
+        w = weight
+    out = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=_tuple_n(stride, n),
+        padding=_padding_n(padding, n),
+        rhs_dilation=_tuple_n(dilation, n),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        if channel_last:
+            out = out + jnp.reshape(bias, (1,) * (n + 1) + (-1,))
+        else:
+            out = out + jnp.reshape(bias, (1, -1) + (1,) * n)
+    return out
+
+
+@op("conv1d", amp="cast")
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    df = "NLC" if data_format == "NLC" else "NCW"
+    return _conv_impl(x, weight, bias, stride, padding, dilation, groups, 1,
+                      "NLC" if df == "NLC" else "NCW")
+
+
+@op("conv2d", amp="cast")
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    return _conv_impl(x, weight, bias, stride, padding, dilation, groups, 2,
+                      data_format)
+
+
+@op("conv3d", amp="cast")
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    return _conv_impl(x, weight, bias, stride, padding, dilation, groups, 3,
+                      data_format)
+
+
+def _conv_transpose_impl(
+    x, weight, bias, stride, padding, output_padding, dilation, groups, n,
+    data_format, output_size=None,
+):
+    channel_last = data_format in ("NLC", "NHWC", "NDHWC")
+    dn = _dimension_numbers(n, channel_last)
+    strides = _tuple_n(stride, n)
+    dil = _tuple_n(dilation, n)
+    opad = _tuple_n(output_padding, n)
+    pad = _padding_n(padding, n)
+    if isinstance(pad, str):
+        pad_pairs = None
+    else:
+        pad_pairs = pad
+
+    # Gradient-of-conv formulation: lhs_dilation implements the stride.
+    # weight layout [in_c, out_c/groups, *k] (reference conv_transpose layout).
+    k = weight.shape[2:]
+    eff_k = [dil[i] * (k[i] - 1) + 1 for i in range(n)]
+    if pad_pairs is None:
+        if pad == "VALID":
+            pad_pairs = [(0, 0)] * n
+        else:  # SAME
+            pad_pairs = [(eff_k[i] // 2, eff_k[i] // 2) for i in range(n)]
+    trans_pad = [
+        (eff_k[i] - 1 - pad_pairs[i][0], eff_k[i] - 1 - pad_pairs[i][1] + opad[i])
+        for i in range(n)
+    ]
+    # flip spatial dims, swap io: [in, out/groups, *k] -> [out, in/groups, *k]
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + n)))
+    if groups > 1:
+        ic, ocg = w.shape[0], w.shape[1]
+        w = jnp.reshape(w, (groups, ic // groups, ocg) + k)
+        w = jnp.swapaxes(w, 1, 2)
+        w = jnp.reshape(w, (groups * ocg, ic // groups) + k)
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    if channel_last:
+        perm = tuple(range(2, 2 + n)) + (1, 0)
+        w = jnp.transpose(w, perm)
+    out = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(1,) * n,
+        padding=trans_pad,
+        lhs_dilation=strides,
+        rhs_dilation=dil,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if output_size is not None:
+        sizes = _tuple_n(output_size, n)
+        sl = [slice(None)] * out.ndim
+        sp_axes = range(2, 2 + n) if not channel_last else range(1, 1 + n)
+        for ax, s in zip(sp_axes, sizes):
+            sl[ax] = slice(0, s)
+        out = out[tuple(sl)]
+    if bias is not None:
+        if channel_last:
+            out = out + jnp.reshape(bias, (1,) * (n + 1) + (-1,))
+        else:
+            out = out + jnp.reshape(bias, (1, -1) + (1,) * n)
+    return out
+
+
+@op("conv1d_transpose", amp="cast")
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL"):
+    return _conv_transpose_impl(x, weight, bias, stride, padding, output_padding,
+                                dilation, groups, 1, data_format, output_size)
+
+
+@op("conv2d_transpose", amp="cast")
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW"):
+    return _conv_transpose_impl(x, weight, bias, stride, padding, output_padding,
+                                dilation, groups, 2, data_format, output_size)
+
+
+@op("conv3d_transpose", amp="cast")
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW"):
+    return _conv_transpose_impl(x, weight, bias, stride, padding, output_padding,
+                                dilation, groups, 3, data_format, output_size)

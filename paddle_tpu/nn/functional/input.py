@@ -1,0 +1,35 @@
+"""Input functionals: one_hot, embedding.
+
+Reference surface: python/paddle/nn/functional/input.py (embedding :178).
+Embedding is a gather; its backward is a scatter-add XLA emits natively —
+the reference's sparse-grad path (SelectedRows) is unnecessary on TPU where
+the full dense scatter rides HBM bandwidth.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+
+__all__ = ["one_hot", "embedding", "embedding_renorm_"]
+
+
+@op("one_hot", differentiable=False)
+def one_hot(x, num_classes: int):
+    return jax.nn.one_hot(x.astype(jnp.int32), num_classes, dtype=jnp.float32)
+
+
+@op("embedding", amp="cast")
+def embedding(x, weight, padding_idx=None, sparse: bool = False):
+    idx = x.astype(jnp.int32)
+    out = jnp.take(weight, idx, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (idx != padding_idx)[..., None]
+        out = jnp.where(mask, out, 0.0)
+    return out
+
+
+def embedding_renorm_(weight, x, max_norm, norm_type=2.0):
+    raise NotImplementedError("embedding max_norm renorm not yet implemented")

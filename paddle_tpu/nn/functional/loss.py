@@ -1,0 +1,413 @@
+"""Loss functionals.
+
+Reference surface: python/paddle/nn/functional/loss.py (cross_entropy :2458,
+~4k LoC). Cross-entropy here is one fused traced expression
+(logsumexp-stable, fp32 accumulation) rather than the reference's
+softmax_with_cross_entropy CUDA kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+from ...core.tensor import Tensor
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss",
+    "smooth_l1_loss", "nll_loss", "kl_div", "log_loss",
+    "margin_ranking_loss", "cosine_embedding_loss", "square_error_cost",
+    "sigmoid_focal_loss", "hinge_embedding_loss", "triplet_margin_loss",
+    "triplet_margin_with_distance_loss", "soft_margin_loss",
+    "multi_label_soft_margin_loss", "poisson_nll_loss", "gaussian_nll_loss",
+    "dice_loss", "npair_loss", "ctc_loss", "rnnt_loss",
+]
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@op("cross_entropy", amp="keep_fp32")
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index: int = -100,
+    reduction: str = "mean",
+    soft_label: bool = False,
+    axis: int = -1,
+    use_softmax: bool = True,
+    label_smoothing: float = 0.0,
+):
+    logits = input.astype(jnp.float32)
+    if use_softmax:
+        log_probs = jax.nn.log_softmax(logits, axis=axis)
+    else:
+        log_probs = jnp.log(jnp.clip(logits, 1e-15, 1.0))
+    n_classes = log_probs.shape[axis]
+
+    if soft_label or (label.ndim == log_probs.ndim and label.shape == log_probs.shape):
+        lbl = label.astype(jnp.float32)
+        if label_smoothing > 0.0:
+            lbl = (1.0 - label_smoothing) * lbl + label_smoothing / n_classes
+        loss = -jnp.sum(lbl * log_probs, axis=axis)
+        if weight is not None:
+            w = jnp.sum(lbl * weight.astype(jnp.float32), axis=axis)
+            loss = loss * w
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+        return _reduce(loss, reduction)
+
+    lbl = label
+    if lbl.ndim == log_probs.ndim and lbl.shape[axis] == 1:
+        lbl = jnp.squeeze(lbl, axis=axis)
+    lbl = lbl.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe_lbl = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(
+        log_probs, jnp.expand_dims(safe_lbl, axis), axis=axis
+    )
+    loss = -jnp.squeeze(picked, axis=axis)
+    if label_smoothing > 0.0:
+        smooth = -jnp.mean(log_probs, axis=axis)
+        loss = (1.0 - label_smoothing) * loss + label_smoothing * smooth
+    if weight is not None:
+        w = jnp.take(weight.astype(jnp.float32), safe_lbl) * valid
+        loss = loss * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+        loss = jnp.where(valid, loss, 0.0)
+        return _reduce(loss, reduction)
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return _reduce(loss, reduction)
+
+
+def softmax_with_cross_entropy(
+    logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True,
+    return_softmax=False, axis=-1,
+):
+    loss = cross_entropy(
+        logits, label, soft_label=soft_label, ignore_index=ignore_index,
+        reduction="none", axis=axis,
+    )
+    loss = loss.unsqueeze(axis) if loss.ndim < logits.ndim else loss
+    if return_softmax:
+        from .activation import softmax as _softmax
+
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+@op("binary_cross_entropy", amp="keep_fp32")
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    x = jnp.clip(input.astype(jnp.float32), 1e-12, 1.0 - 1e-12)
+    loss = -(label * jnp.log(x) + (1.0 - label) * jnp.log1p(-x))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@op("binary_cross_entropy_with_logits", amp="keep_fp32")
+def binary_cross_entropy_with_logits(
+    logit, label, weight=None, reduction="mean", pos_weight=None
+):
+    x = logit.astype(jnp.float32)
+    lbl = label.astype(jnp.float32)
+    max_val = jnp.clip(-x, 0.0, None)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * lbl + 1.0
+        loss = (1.0 - lbl) * x + log_w * (
+            jnp.log1p(jnp.exp(-jnp.abs(x))) + max_val
+        )
+    else:
+        loss = (1.0 - lbl) * x + jnp.log1p(jnp.exp(-jnp.abs(x))) + max_val
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@op("mse_loss")
+def mse_loss(input, label, reduction="mean"):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+@op("l1_loss")
+def l1_loss(input, label, reduction="mean"):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+@op("smooth_l1_loss")
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    diff = jnp.abs(input - label)
+    loss = jnp.where(
+        diff < delta, 0.5 * jnp.square(diff) / delta, diff - 0.5 * delta
+    )
+    return _reduce(loss, reduction)
+
+
+@op("nll_loss", amp="keep_fp32")
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    lbl = label.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(input, jnp.expand_dims(safe, 1), axis=1)
+    loss = -jnp.squeeze(picked, axis=1)
+    if weight is not None:
+        w = jnp.take(weight, safe) * valid
+        loss = loss * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+        return _reduce(jnp.where(valid, loss, 0.0), reduction)
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return _reduce(loss, reduction)
+
+
+@op("kl_div")
+def kl_div(input, label, reduction="mean", log_target=False):
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        safe_label = jnp.clip(label, 1e-12, None)
+        loss = label * (jnp.log(safe_label) - input)
+        loss = jnp.where(label > 0, loss, 0.0)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+@op("log_loss")
+def log_loss(input, label, epsilon=1e-4):
+    return -label * jnp.log(input + epsilon) - (1.0 - label) * jnp.log(
+        1.0 - input + epsilon
+    )
+
+
+@op("margin_ranking_loss")
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    loss = jnp.clip(-label * (input - other) + margin, 0.0, None)
+    return _reduce(loss, reduction)
+
+
+@op("cosine_embedding_loss")
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean"):
+    dot = jnp.sum(input1 * input2, axis=-1)
+    n1 = jnp.linalg.norm(input1, axis=-1)
+    n2 = jnp.linalg.norm(input2, axis=-1)
+    cos = dot / jnp.maximum(n1 * n2, 1e-12)
+    loss = jnp.where(label == 1, 1.0 - cos, jnp.clip(cos - margin, 0.0, None))
+    return _reduce(loss, reduction)
+
+
+@op("square_error_cost")
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+@op("sigmoid_focal_loss", amp="keep_fp32")
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    x = logit.astype(jnp.float32)
+    p = jax.nn.sigmoid(x)
+    ce = jnp.clip(x, 0.0, None) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * label + (1.0 - p) * (1.0 - label)
+    loss = ce * jnp.power(1.0 - p_t, gamma)
+    if alpha >= 0:
+        alpha_t = alpha * label + (1.0 - alpha) * (1.0 - label)
+        loss = alpha_t * loss
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+@op("hinge_embedding_loss")
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(
+        label == 1.0, input, jnp.clip(margin - input, 0.0, None)
+    )
+    return _reduce(loss, reduction)
+
+
+@op("triplet_margin_loss")
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    def dist(a, b):
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p), axis=-1), 1.0 / p
+        )
+
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    loss = jnp.clip(d_pos - d_neg + margin, 0.0, None)
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    d_pos = distance_function(input, positive)
+    d_neg = distance_function(input, negative)
+    if swap:
+        d_swap = distance_function(positive, negative)
+        d_neg = d_neg.minimum(d_swap)
+    from ...ops import math as _m
+
+    loss = (d_pos - d_neg + margin).clip(0.0)
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+@op("soft_margin_loss")
+def soft_margin_loss(input, label, reduction="mean"):
+    loss = jnp.log1p(jnp.exp(-label * input))
+    return _reduce(loss, reduction)
+
+
+@op("multi_label_soft_margin_loss")
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean"):
+    loss = -(
+        label * jax.nn.log_sigmoid(input)
+        + (1.0 - label) * jax.nn.log_sigmoid(-input)
+    )
+    loss = jnp.mean(loss, axis=-1)
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@op("poisson_nll_loss")
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = label * jnp.log(label + epsilon) - label + 0.5 * jnp.log(
+            2.0 * jnp.pi * (label + epsilon)
+        )
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+@op("gaussian_nll_loss")
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    var = jnp.clip(variance, epsilon, None)
+    loss = 0.5 * (jnp.log(var) + jnp.square(input - label) / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(2.0 * jnp.pi)
+    return _reduce(loss, reduction)
+
+
+@op("dice_loss")
+def dice_loss(input, label, epsilon=1e-5):
+    lbl = jnp.squeeze(label, axis=-1)
+    n_classes = input.shape[-1]
+    one_hot = jax.nn.one_hot(lbl, n_classes, dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inse = jnp.sum(input * one_hot, axis=reduce_dims)
+    dice_denom = jnp.sum(input, axis=reduce_dims) + jnp.sum(one_hot, axis=reduce_dims)
+    return jnp.mean(1.0 - 2.0 * inse / (dice_denom + epsilon))
+
+
+@op("npair_loss")
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    reg = l2_reg * (
+        jnp.mean(jnp.sum(jnp.square(anchor), axis=1))
+        + jnp.mean(jnp.sum(jnp.square(positive), axis=1))
+    ) * 0.25
+    sim = jnp.matmul(anchor, positive.T)
+    lbl = jnp.reshape(labels, (-1, 1))
+    target = (lbl == lbl.T).astype(jnp.float32)
+    target = target / jnp.sum(target, axis=1, keepdims=True)
+    ce = jnp.mean(
+        jnp.sum(-target * jax.nn.log_softmax(sim, axis=1), axis=1)
+    )
+    return ce + reg
+
+
+@op("ctc_loss", amp="keep_fp32")
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard alpha recursion as a lax.scan over time.
+
+    Reference: warpctc binding (python/paddle/nn/functional/loss.py:1492).
+    log_probs: [T, B, C] logits (softmax applied internally, as reference).
+    labels: [B, L] int labels (padded).
+    """
+    logp = jax.nn.log_softmax(log_probs.astype(jnp.float32), axis=-1)
+    T, B, C = logp.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    labels = labels.astype(jnp.int32)
+
+    # extended label sequence: blank l1 blank l2 ... blank
+    ext = jnp.full((B, S), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    # allow skip transition where ext[s] != ext[s-2] and ext[s] != blank
+    ext_prev2 = jnp.concatenate(
+        [jnp.full((B, 2), -1, dtype=jnp.int32), ext[:, :-2]], axis=1
+    )
+    can_skip = (ext != blank) & (ext != ext_prev2)
+
+    neg_inf = jnp.float32(-1e30)
+    alpha0 = jnp.full((B, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(logp[0, jnp.arange(B), ext[:, 0]])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(L > 0, logp[0, jnp.arange(B), ext[:, 1]], neg_inf)
+    )
+
+    def step(alpha, logp_t):
+        a_shift1 = jnp.concatenate(
+            [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1
+        )
+        a_shift2 = jnp.concatenate(
+            [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1
+        )
+        a_shift2 = jnp.where(can_skip, a_shift2, neg_inf)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a_shift2)
+        emit = jnp.take_along_axis(logp_t, ext, axis=1)
+        return merged + emit, merged + emit
+
+    _, alphas = jax.lax.scan(step, alpha0, logp[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, S]
+
+    t_idx = jnp.clip(input_lengths.astype(jnp.int32) - 1, 0, T - 1)
+    s_last = 2 * label_lengths.astype(jnp.int32)  # blank after last label
+    s_prev = jnp.clip(s_last - 1, 0, S - 1)
+    batch_idx = jnp.arange(B)
+    a_end1 = alphas[t_idx, batch_idx, s_last]
+    a_end2 = alphas[t_idx, batch_idx, s_prev]
+    ll = jnp.logaddexp(a_end1, a_end2)
+    loss = -ll
+    if norm_by_times:
+        loss = loss / jnp.maximum(input_lengths.astype(jnp.float32), 1.0)
+    if reduction == "mean":
+        return jnp.mean(loss / jnp.maximum(label_lengths.astype(jnp.float32), 1.0))
+    return _reduce(loss, reduction)
+
+
+def rnnt_loss(*args, **kwargs):
+    raise NotImplementedError(
+        "rnnt_loss: transducer loss planned; reference binds warprnnt "
+        "(python/paddle/nn/functional/loss.py 'rnnt_loss')"
+    )

@@ -1,0 +1,200 @@
+"""Normalization functionals.
+
+Reference surface: python/paddle/nn/functional/norm.py (batch_norm :186,
+layer_norm :325) + the fused Phi kernels they replace on TPU
+(paddle/phi/kernels/fusion/gpu/fused_layernorm_kernel.cu, rms_norm_kernel).
+Here each is one traced expression XLA fuses; stats math runs in fp32
+regardless of input dtype (matching the fused kernels' accumulation dtype).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+
+__all__ = [
+    "batch_norm", "layer_norm", "instance_norm", "group_norm",
+    "local_response_norm", "normalize", "rms_norm",
+]
+
+
+@op("layer_norm", amp="keep_fp32")
+def layer_norm(x, normalized_shape=None, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(normalized_shape) if normalized_shape is not None else 1
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+@op("rms_norm", amp="keep_fp32")
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1):
+    # reference: python/paddle/incubate/nn/functional/fused_rms_norm.py
+    axes = (
+        tuple(range(begin_norm_axis, x.ndim))
+        if begin_norm_axis >= 0
+        else (x.ndim - 1,)
+    )
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=axes, keepdims=True)
+    out = xf * jax.lax.rsqrt(ms + epsilon)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training: bool = False,
+    momentum: float = 0.9,
+    epsilon: float = 1e-5,
+    data_format: str = "NCHW",
+    use_global_stats=None,
+):
+    """Eager batch norm. In training mode the running stats Tensors are
+    updated in place (handle rebind), mirroring the reference's mutable
+    mean/variance outputs (paddle/phi/kernels/gpu/batch_norm_kernel.cu).
+    """
+    channel_axis = 1 if data_format.startswith("NC") and x.ndim > 1 else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    use_batch_stats = training and not (use_global_stats is True)
+
+    if use_batch_stats:
+        out, batch_mean, batch_var = _batch_norm_train(
+            x, weight, bias, channel_axis, reduce_axes, epsilon
+        )
+        # update running stats out-of-graph (stop_gradient buffers)
+        if running_mean is not None:
+            m = momentum
+            running_mean.set_value(
+                m * running_mean._data + (1 - m) * batch_mean._data
+            )
+            running_var.set_value(
+                m * running_var._data + (1 - m) * batch_var._data
+            )
+        return out
+    return _batch_norm_infer(
+        x, running_mean, running_var, weight, bias, channel_axis, epsilon
+    )
+
+
+@op("batch_norm_train", amp="keep_fp32")
+def _batch_norm_train(x, weight, bias, channel_axis, reduce_axes, epsilon):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=reduce_axes)
+    var = jnp.mean(jnp.square(xf), axis=reduce_axes) - jnp.square(mean)
+    shape = [1] * x.ndim
+    shape[channel_axis] = x.shape[channel_axis]
+    out = (xf - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32).reshape(shape)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32).reshape(shape)
+    return out.astype(x.dtype), mean, var
+
+
+@op("batch_norm_infer", amp="keep_fp32")
+def _batch_norm_infer(x, mean, var, weight, bias, channel_axis, epsilon):
+    shape = [1] * x.ndim
+    shape[channel_axis] = x.shape[channel_axis]
+    xf = x.astype(jnp.float32)
+    out = (xf - mean.astype(jnp.float32).reshape(shape)) * jax.lax.rsqrt(
+        var.astype(jnp.float32).reshape(shape) + epsilon
+    )
+    if weight is not None:
+        out = out * weight.astype(jnp.float32).reshape(shape)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32).reshape(shape)
+    return out.astype(x.dtype)
+
+
+@op("instance_norm", amp="keep_fp32")
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW"):
+    channel_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(
+        i for i in range(x.ndim) if i not in (0, channel_axis)
+    )
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=reduce_axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=reduce_axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        shape = [1] * x.ndim
+        shape[channel_axis] = x.shape[channel_axis]
+        out = out * weight.astype(jnp.float32).reshape(shape)
+        if bias is not None:
+            out = out + bias.astype(jnp.float32).reshape(shape)
+    return out.astype(x.dtype)
+
+
+@op("group_norm", amp="keep_fp32")
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW"):
+    channel_last = not data_format.startswith("NC")
+    if channel_last:
+        x_cf = jnp.moveaxis(x, -1, 1)
+    else:
+        x_cf = x
+    n, c = x_cf.shape[:2]
+    spatial = x_cf.shape[2:]
+    xf = x_cf.astype(jnp.float32).reshape((n, num_groups, c // num_groups) + spatial)
+    axes = tuple(range(2, xf.ndim))
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    out = ((xf - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x_cf.shape)
+    shape = (1, c) + (1,) * len(spatial)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32).reshape(shape)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32).reshape(shape)
+    if channel_last:
+        out = jnp.moveaxis(out, 1, -1)
+    return out.astype(x.dtype)
+
+
+@op("local_response_norm")
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    channel_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    sq = jnp.square(x)
+    c = x.shape[channel_axis]
+    half = size // 2
+    pads = [(0, 0)] * x.ndim
+    pads[channel_axis] = (half, size - half - 1)
+    sq = jnp.pad(sq, pads)
+    window = [1] * x.ndim
+    window[channel_axis] = size
+    ssum = jax.lax.reduce_window(
+        sq, 0.0, jax.lax.add, tuple(window), (1,) * x.ndim, [(0, 0)] * x.ndim
+    )
+    div = jnp.power(k + alpha * ssum / size, beta)
+    return x / div
+
+
+@op("normalize")
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    if p == 2:
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    else:
+        n = jnp.power(
+            jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=True), 1.0 / p
+        )
+    return x / jnp.maximum(n, epsilon)

@@ -1,0 +1,244 @@
+"""Pooling functionals lowered to lax.reduce_window.
+
+Reference surface: python/paddle/nn/functional/pooling.py. XLA lowers
+reduce_window to vectorized VPU code; no hand-written pooling kernels needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import op
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d",
+    "max_pool1d", "max_pool2d", "max_pool3d",
+    "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+]
+
+
+def _tuple_n(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(x) for x in v)
+    return v * n if len(v) == 1 else v
+
+
+def _pad_pairs(padding, n):
+    if isinstance(padding, str):
+        raise ValueError("string padding resolved by caller")
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _pool(x, kernel, stride, padding, n, channel_last, kind, ceil_mode=False,
+          exclusive=True):
+    k = _tuple_n(kernel, n)
+    s = _tuple_n(stride if stride is not None else kernel, n)
+    p = _pad_pairs(padding, n)
+    if channel_last:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = [(0, 0)] + p + [(0, 0)]
+    else:
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pads = [(0, 0), (0, 0)] + p
+    if ceil_mode:
+        # extend the right pad so the last partial window is included
+        sp_axes = range(1, 1 + n) if channel_last else range(2, 2 + n)
+        pads = list(pads)
+        for i, ax in enumerate(sp_axes):
+            size = x.shape[ax] + p[i][0] + p[i][1]
+            rem = (size - k[i]) % s[i]
+            if rem != 0:
+                lo, hi = pads[ax]
+                pads[ax] = (lo, hi + (s[i] - rem))
+    if kind == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pads)
+    # avg
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+    if exclusive:
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+        return summed / counts
+    return summed / float(np.prod(k))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, data_format, "max",
+                    ceil_mode, return_mask=return_mask)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, data_format, "max",
+                    ceil_mode, return_mask=return_mask)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, data_format, "max",
+                    ceil_mode, return_mask=return_mask)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, data_format, "avg",
+                    ceil_mode, exclusive=exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, data_format, "avg",
+                    ceil_mode, exclusive=exclusive,
+                    divisor_override=divisor_override)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, data_format, "avg",
+                    ceil_mode, exclusive=exclusive,
+                    divisor_override=divisor_override)
+
+
+def _pool_nd(x, kernel, stride, padding, n, data_format, kind, ceil_mode,
+             exclusive=True, return_mask=False, divisor_override=None):
+    channel_last = data_format in ("NLC", "NHWC", "NDHWC")
+
+    opname = f"{kind}_pool{n}d"
+
+    @op(opname)
+    def _impl(xx):
+        out = _pool(xx, kernel, stride, padding, n, channel_last, kind,
+                    ceil_mode, exclusive)
+        if kind == "avg" and divisor_override is not None:
+            k = _tuple_n(kernel, n)
+            out = out * (float(np.prod(k)) / float(divisor_override)) if exclusive \
+                else out * (float(np.prod(k)) / float(divisor_override))
+        return out.astype(xx.dtype)
+
+    out = _impl(x)
+    if return_mask:
+        idx = _pool_argmax(x, kernel, stride, padding, n, channel_last, ceil_mode)
+        return out, idx
+    return out
+
+
+def _pool_argmax(x, kernel, stride, padding, n, channel_last, ceil_mode):
+    @op("max_pool_mask", differentiable=False)
+    def _impl(xx):
+        # argmax over flattened spatial window, matching reference mask output
+        k = _tuple_n(kernel, n)
+        s = _tuple_n(stride if stride is not None else kernel, n)
+        p = _pad_pairs(padding, n)
+        sp_shape = xx.shape[2:] if not channel_last else xx.shape[1:-1]
+        flat_idx = jnp.arange(int(np.prod(sp_shape))).reshape(sp_shape)
+        bshape = (1, 1) + sp_shape if not channel_last else (1,) + sp_shape + (1,)
+        flat_idx = jnp.broadcast_to(flat_idx.reshape(bshape), xx.shape)
+        if channel_last:
+            window = (1,) + k + (1,)
+            strides = (1,) + s + (1,)
+            pads = [(0, 0)] + p + [(0, 0)]
+        else:
+            window = (1, 1) + k
+            strides = (1, 1) + s
+            pads = [(0, 0), (0, 0)] + p
+        init_v = -jnp.inf
+        init_i = jnp.array(-1, dtype=flat_idx.dtype)
+
+        def reducer(a, b):
+            av, ai = a
+            bv, bi = b
+            take_b = bv > av
+            return (
+                jnp.where(take_b, bv, av),
+                jnp.where(take_b, bi, ai),
+            )
+
+        _, idx = jax.lax.reduce_window(
+            (xx.astype(jnp.float32), flat_idx),
+            (jnp.array(init_v, jnp.float32), init_i),
+            reducer,
+            window,
+            strides,
+            pads,
+        )
+        return idx
+
+    return _impl(x)
+
+
+def _adaptive_pool(x, output_size, n, data_format, kind):
+    channel_last = data_format in ("NLC", "NHWC", "NDHWC")
+    out_sizes = _tuple_n(output_size, n)
+    sp_axes = list(range(1, 1 + n)) if channel_last else list(range(2, 2 + n))
+
+    @op(f"adaptive_{kind}_pool{n}d")
+    def _impl(xx):
+        out = xx
+        for i, ax in enumerate(sp_axes):
+            out = _adaptive_axis(out, ax, out_sizes[i], kind)
+        return out
+
+    return _impl(x)
+
+
+def _adaptive_axis(x, axis, out_size, kind):
+    in_size = x.shape[axis]
+    if out_size is None or out_size == in_size:
+        return x
+    if in_size % out_size == 0:
+        # uniform windows: reshape + reduce
+        k = in_size // out_size
+        new_shape = x.shape[:axis] + (out_size, k) + x.shape[axis + 1 :]
+        xr = jnp.reshape(x, new_shape)
+        return jnp.max(xr, axis=axis + 1) if kind == "max" else jnp.mean(xr, axis=axis + 1)
+    # non-uniform: per-output-window slices (out_size is static)
+    starts = [int(np.floor(i * in_size / out_size)) for i in range(out_size)]
+    ends = [int(np.ceil((i + 1) * in_size / out_size)) for i in range(out_size)]
+    pieces = []
+    for s, e in zip(starts, ends):
+        sl = [slice(None)] * x.ndim
+        sl[axis] = slice(s, e)
+        seg = x[tuple(sl)]
+        red = jnp.max(seg, axis=axis) if kind == "max" else jnp.mean(seg, axis=axis)
+        pieces.append(red)
+    return jnp.stack(pieces, axis=axis)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCL", "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCL", "max")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "NCHW", "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "NCDHW", "max")

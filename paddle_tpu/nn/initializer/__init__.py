@@ -1,0 +1,220 @@
+"""Weight initializers.
+
+Reference surface: python/paddle/nn/initializer/ (XavierInitializer at
+xavier.py, KaimingInitializer at kaiming.py, etc.). An initializer is a
+callable ``(shape, dtype) -> jax.Array`` drawing from the global generator;
+applied at Parameter creation by ``Layer.create_parameter``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import random as prandom
+from ...core.dtype import convert_dtype
+from ...core.tensor import Tensor
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Dirac", "Orthogonal", "calculate_gain", "set_global_initializer",
+]
+
+
+def _fans(shape, fan_in=None, fan_out=None):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # linear weight [in, out] (reference layout)
+        f_in, f_out = shape[0], shape[1]
+    else:
+        # conv weight [out_c, in_c/groups, *k]
+        receptive = int(np.prod(shape[2:]))
+        f_in = shape[1] * receptive
+        f_out = shape[0] * receptive
+    return fan_in or f_in, fan_out or f_out
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    gains = {
+        "sigmoid": 1.0,
+        "linear": 1.0,
+        "conv1d": 1.0,
+        "conv2d": 1.0,
+        "conv3d": 1.0,
+        "tanh": 5.0 / 3.0,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4.0,
+    }
+    if nonlinearity not in gains:
+        raise ValueError(f"unsupported nonlinearity {nonlinearity}")
+    return gains[nonlinearity]
+
+
+class Initializer:
+    def __call__(self, shape, dtype="float32"):
+        raise NotImplementedError
+
+    def _key(self):
+        return prandom.next_key()
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        return jnp.full(tuple(shape), self.value, dtype=convert_dtype(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype="float32"):
+        dt = convert_dtype(dtype)
+        sample_dt = dt if jnp.issubdtype(dt, jnp.floating) else jnp.float32
+        out = jax.random.normal(self._key(), tuple(shape), sample_dt)
+        return (out * self.std + self.mean).astype(dt)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0, a: float = -2.0,
+                 b: float = 2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype="float32"):
+        dt = convert_dtype(dtype)
+        out = jax.random.truncated_normal(
+            self._key(), self.a, self.b, tuple(shape), jnp.float32
+        )
+        return (out * self.std + self.mean).astype(dt)
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype="float32"):
+        dt = convert_dtype(dtype)
+        out = jax.random.uniform(
+            self._key(), tuple(shape), jnp.float32, self.low, self.high
+        )
+        return out.astype(dt)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype="float32"):
+        f_in, f_out = _fans(shape, self.fan_in, self.fan_out)
+        std = self.gain * math.sqrt(2.0 / (f_in + f_out))
+        return Normal(0.0, std)(shape, dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype="float32"):
+        f_in, f_out = _fans(shape, self.fan_in, self.fan_out)
+        limit = self.gain * math.sqrt(6.0 / (f_in + f_out))
+        return Uniform(-limit, limit)(shape, dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope: float = 0.0,
+                 nonlinearity: str = "relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype="float32"):
+        f_in, _ = _fans(shape, self.fan_in)
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(f_in)
+        return Normal(0.0, std)(shape, dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope: float = 0.0,
+                 nonlinearity: str = "relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype="float32"):
+        f_in, _ = _fans(shape, self.fan_in)
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / f_in)
+        return Uniform(-limit, limit)(shape, dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        self.value = jnp.asarray(value)
+
+    def __call__(self, shape, dtype="float32"):
+        out = self.value.astype(convert_dtype(dtype))
+        if tuple(out.shape) != tuple(shape):
+            out = jnp.reshape(out, tuple(shape))
+        return out
+
+
+class Dirac(Initializer):
+    def __init__(self, groups: int = 1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype="float32"):
+        # conv identity kernel: preserves channels through the conv
+        out = np.zeros(shape, dtype="float32")
+        out_c, in_c = shape[0], shape[1]
+        min_c = min(out_c // self.groups, in_c)
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for ch in range(min_c):
+                idx = (g * (out_c // self.groups) + ch, ch) + tuple(centers)
+                out[idx] = 1.0
+        return jnp.asarray(out, dtype=convert_dtype(dtype))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype="float32"):
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        flat = jax.random.normal(self._key(), (max(rows, cols), min(rows, cols)))
+        q, r = jnp.linalg.qr(flat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols].reshape(shape)).astype(
+            convert_dtype(dtype)
+        )
+
+
+_global_param_init: Initializer | None = None
+_global_bias_init: Initializer | None = None
+
+
+def set_global_initializer(weight_init=None, bias_init=None):
+    global _global_param_init, _global_bias_init
+    _global_param_init = weight_init
+    _global_bias_init = bias_init
+
+
+def global_initializer(is_bias: bool):
+    return _global_bias_init if is_bias else _global_param_init

@@ -1,0 +1,310 @@
+"""Normalization layers.
+
+Reference surface: python/paddle/nn/layer/norm.py (BatchNorm2D, LayerNorm
+:398, GroupNorm, SyncBatchNorm :1200). SyncBatchNorm on TPU: under pjit the
+batch axis is globally reduced by XLA when sharded, so SyncBatchNorm ==
+BatchNorm inside a compiled mesh program; the eager subclass allreduces
+stats over the data-parallel group explicitly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+from ...core.tensor import Tensor
+
+__all__ = [
+    "BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
+    "LayerNorm", "GroupNorm", "InstanceNorm1D", "InstanceNorm2D",
+    "InstanceNorm3D", "LocalResponseNorm", "SpectralNorm", "SyncBatchNorm",
+    "RMSNorm",
+]
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        if weight_attr is False:
+            self.weight = self.create_parameter(
+                [num_features], default_initializer=I.Constant(1.0))
+            self.weight.stop_gradient = True
+        else:
+            self.weight = self.create_parameter(
+                [num_features], attr=weight_attr,
+                default_initializer=I.Constant(1.0))
+        if bias_attr is False:
+            self.bias = self.create_parameter(
+                [num_features], is_bias=True,
+                default_initializer=I.Constant(0.0))
+            self.bias.stop_gradient = True
+        else:
+            self.bias = self.create_parameter(
+                [num_features], attr=bias_attr, is_bias=True,
+                default_initializer=I.Constant(0.0))
+        self.register_buffer("_mean", Tensor(jnp.zeros([num_features])))
+        self.register_buffer("_variance", Tensor(jnp.ones([num_features])))
+
+    def forward(self, input):
+        return F.batch_norm(
+            input, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self._momentum,
+            epsilon=self._epsilon, data_format=self._data_format,
+            use_global_stats=self._use_global_stats,
+        )
+
+    def extra_repr(self):
+        return (f"num_features={self._num_features}, "
+                f"momentum={self._momentum}, epsilon={self._epsilon}")
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, "NCL", use_global_stats, name)
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, "NCDHW", use_global_stats, name)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica batch norm.
+
+    reference: python/paddle/nn/layer/norm.py:1200. Under paddle_tpu.jit +
+    mesh sharding the global reduction happens inside XLA; in eager DP mode
+    stats are allreduced over the data-parallel group.
+    """
+
+    def forward(self, input):
+        if self.training:
+            from ... import distributed as dist
+
+            if dist.is_initialized() and dist.get_world_size() > 1:
+                return self._sync_forward(input)
+        return super().forward(input)
+
+    def _sync_forward(self, input):
+        from ... import distributed as dist
+
+        channel_axis = 1 if self._data_format.startswith("NC") else input.ndim - 1
+        reduce_axes = tuple(i for i in range(input.ndim) if i != channel_axis)
+        mean = input.mean(axis=list(reduce_axes))
+        sq_mean = (input * input).mean(axis=list(reduce_axes))
+        dist.all_reduce(mean, op=dist.ReduceOp.AVG)
+        dist.all_reduce(sq_mean, op=dist.ReduceOp.AVG)
+        var = sq_mean - mean * mean
+        m = self._momentum
+        self._mean.set_value(m * self._mean._data + (1 - m) * mean._data)
+        self._variance.set_value(m * self._variance._data + (1 - m) * var._data)
+        shape = [1] * input.ndim
+        shape[channel_axis] = self._num_features
+        out = (input - mean.reshape(shape)) / (
+            (var.reshape(shape) + self._epsilon).sqrt()
+        )
+        return out * self.weight.reshape(shape) + self.bias.reshape(shape)
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        converted = layer
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, cls):
+            converted = cls(layer._num_features, layer._momentum,
+                            layer._epsilon, data_format=layer._data_format)
+            converted.weight = layer.weight
+            converted.bias = layer.bias
+            converted._mean = layer._mean
+            converted._variance = layer._variance
+        for name, sub in list(layer._sub_layers.items()):
+            converted._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return converted
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                self._normalized_shape, attr=weight_attr,
+                default_initializer=I.Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                self._normalized_shape, attr=bias_attr, is_bias=True,
+                default_initializer=I.Constant(0.0))
+
+    def forward(self, input):
+        return F.layer_norm(input, self._normalized_shape, self.weight,
+                            self.bias, self._epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self._normalized_shape}, epsilon={self._epsilon}"
+
+
+class RMSNorm(Layer):
+    """TPU-first: pairs with the Pallas fused rmsnorm kernel.
+
+    reference: python/paddle/incubate/nn/functional/fused_rms_norm.py.
+    """
+
+    def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None, name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            [hidden_size], attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+
+    def forward(self, input):
+        return F.rms_norm(input, self.weight, epsilon=self._epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._num_channels = num_channels
+        self._epsilon = epsilon
+        self._data_format = data_format
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                [num_channels], attr=weight_attr,
+                default_initializer=I.Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                [num_channels], attr=bias_attr, is_bias=True,
+                default_initializer=I.Constant(0.0))
+
+    def forward(self, input):
+        return F.group_norm(input, self._num_groups, self._epsilon,
+                            self.weight, self.bias, self._data_format)
+
+
+class _InstanceNormBase(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._epsilon = epsilon
+        self._data_format = data_format
+        if weight_attr is False or bias_attr is False:
+            self.weight = None
+            self.bias = None
+        else:
+            self.weight = self.create_parameter(
+                [num_features], attr=weight_attr,
+                default_initializer=I.Constant(1.0))
+            self.bias = self.create_parameter(
+                [num_features], attr=bias_attr, is_bias=True,
+                default_initializer=I.Constant(0.0))
+
+    def forward(self, input):
+        return F.instance_norm(input, weight=self.weight, bias=self.bias,
+                               eps=self._epsilon,
+                               data_format=self._data_format)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+        self._data_format = data_format
+
+    def forward(self, input):
+        return F.local_response_norm(input, self.size, self.alpha, self.beta,
+                                     self.k, self._data_format)
+
+
+class SpectralNorm(Layer):
+    """Power-iteration spectral normalization of a weight tensor.
+
+    reference: python/paddle/nn/layer/norm.py SpectralNorm.
+    """
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        self.weight_u = self.create_parameter(
+            [h], default_initializer=I.Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v = self.create_parameter(
+            [w], default_initializer=I.Normal(0.0, 1.0))
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        from ...core.dispatch import op as _op
+
+        dim, power_iters, eps = self._dim, self._power_iters, self._eps
+
+        @_op("spectral_norm")
+        def _impl(w, u, v):
+            w_mat = jnp.moveaxis(w, dim, 0)
+            shape = w_mat.shape
+            w_mat = w_mat.reshape(shape[0], -1)
+            for _ in range(power_iters):
+                v = w_mat.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = w_mat @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ w_mat @ v
+            return jnp.moveaxis((w_mat / sigma).reshape(shape), 0, dim)
+
+        return _impl(weight, self.weight_u, self.weight_v)

@@ -1,0 +1,337 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU + cells.
+
+Reference surface: python/paddle/nn/layer/rnn.py (RNNBase :1300, LSTM :1633)
+whose CUDA path is cuDNN RNN. TPU-native design: the time loop is a
+``lax.scan`` inside one traced op — XLA compiles the whole unrolled-in-IR
+recurrence with the gate matmuls batched onto the MXU; no per-step Python.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = ["SimpleRNN", "LSTM", "GRU", "RNN", "BiRNN",
+           "SimpleRNNCell", "LSTMCell", "GRUCell"]
+
+
+def _cell_step(mode, x_t, h, c, w_ih, w_hh, b_ih, b_hh):
+    """One recurrence step. x_t: [B, I]; returns (h_new, c_new)."""
+    gates_x = x_t @ w_ih.T + (b_ih if b_ih is not None else 0.0)
+    if mode == "LSTM":
+        gates = gates_x + h @ w_hh.T + (b_hh if b_hh is not None else 0.0)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+    if mode == "GRU":
+        gates_h = h @ w_hh.T + (b_hh if b_hh is not None else 0.0)
+        xr, xz, xn = jnp.split(gates_x, 3, axis=-1)
+        hr, hz, hn = jnp.split(gates_h, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        h_new = (1.0 - z) * n + z * h
+        return h_new, c
+    # SimpleRNN
+    act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+    h_new = act(gates_x + h @ w_hh.T + (b_hh if b_hh is not None else 0.0))
+    return h_new, c
+
+
+def _scan_layer(mode, x, h0, c0, w_ih, w_hh, b_ih, b_hh, reverse=False):
+    """x: [T, B, I] time-major. Returns (outputs [T, B, H], h_T, c_T)."""
+
+    def step(carry, x_t):
+        h, c = carry
+        h, c = _cell_step(mode, x_t, h, c, w_ih, w_hh, b_ih, b_hh)
+        return (h, c), h
+
+    (h_f, c_f), out = jax.lax.scan(step, (h0, c0), x, reverse=reverse)
+    return out, h_f, c_f
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        if direction in ("bidirect", "bidirectional"):
+            self.num_directions = 2
+        else:
+            self.num_directions = 1
+        self.direction = direction
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_size = (
+                    input_size if layer == 0
+                    else hidden_size * self.num_directions
+                )
+                suffix = "_reverse" if d == 1 else ""
+                w_ih = self.create_parameter(
+                    [gate_mult * hidden_size, in_size], attr=weight_ih_attr,
+                    default_initializer=init)
+                w_hh = self.create_parameter(
+                    [gate_mult * hidden_size, hidden_size],
+                    attr=weight_hh_attr, default_initializer=init)
+                b_ih = self.create_parameter(
+                    [gate_mult * hidden_size], attr=bias_ih_attr, is_bias=True,
+                    default_initializer=init)
+                b_hh = self.create_parameter(
+                    [gate_mult * hidden_size], attr=bias_hh_attr, is_bias=True,
+                    default_initializer=init)
+                names = [f"weight_ih_l{layer}{suffix}",
+                         f"weight_hh_l{layer}{suffix}",
+                         f"bias_ih_l{layer}{suffix}",
+                         f"bias_hh_l{layer}{suffix}"]
+                for n, p in zip(names, [w_ih, w_hh, b_ih, b_hh]):
+                    self.add_parameter(n, p)
+                self._all_weights.append(names)
+
+    def _weights_flat(self):
+        flat = []
+        for names in self._all_weights:
+            flat.extend(self._parameters[n] for n in names)
+        return flat
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = self.mode
+        num_layers = self.num_layers
+        num_dirs = self.num_directions
+        hidden = self.hidden_size
+        time_major = self.time_major
+        dropout = self.dropout if self.training else 0.0
+        weights = self._weights_flat()
+
+        rng_key = None
+        if dropout > 0.0 and num_layers > 1:
+            from ...core import random as prandom
+
+            rng_key = prandom.next_key()
+
+        has_init = initial_states is not None
+        init_list = []
+        if has_init:
+            if mode == "LSTM":
+                init_list = [initial_states[0], initial_states[1]]
+            else:
+                init_list = [initial_states]
+
+        @op(f"rnn_{mode.lower()}")
+        def _impl(x, *flat):
+            n_w = 4 * num_layers * num_dirs
+            ws = flat[:n_w]
+            inits = flat[n_w:]
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # [T, B, I]
+            B = x.shape[1]
+            if inits:
+                if mode == "LSTM":
+                    h0_all, c0_all = inits
+                else:
+                    h0_all = inits[0]
+                    c0_all = jnp.zeros_like(h0_all)
+            else:
+                h0_all = jnp.zeros((num_layers * num_dirs, B, hidden), x.dtype)
+                c0_all = jnp.zeros_like(h0_all)
+
+            layer_in = x
+            h_finals, c_finals = [], []
+            idx = 0
+            for layer in range(num_layers):
+                outs = []
+                for d in range(num_dirs):
+                    w_ih, w_hh, b_ih, b_hh = ws[4 * idx : 4 * idx + 4]
+                    state_i = layer * num_dirs + d
+                    out, h_f, c_f = _scan_layer(
+                        mode, layer_in, h0_all[state_i], c0_all[state_i],
+                        w_ih, w_hh, b_ih, b_hh, reverse=(d == 1))
+                    outs.append(out)
+                    h_finals.append(h_f)
+                    c_finals.append(c_f)
+                    idx += 1
+                layer_in = outs[0] if num_dirs == 1 else jnp.concatenate(
+                    outs, axis=-1)
+                if dropout > 0.0 and layer < num_layers - 1 and rng_key is not None:
+                    k = jax.random.fold_in(rng_key, layer)
+                    keep = 1.0 - dropout
+                    mask = jax.random.bernoulli(k, keep, layer_in.shape)
+                    layer_in = jnp.where(mask, layer_in / keep, 0.0).astype(
+                        layer_in.dtype)
+            out = layer_in
+            if not time_major:
+                out = jnp.swapaxes(out, 0, 1)
+            h_n = jnp.stack(h_finals)
+            c_n = jnp.stack(c_finals)
+            return out, h_n, c_n
+
+        out, h_n, c_n = _impl(inputs, *weights, *init_list)
+        if mode == "LSTM":
+            return out, (h_n, c_n)
+        return out, h_n
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class _CellBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [gate_mult * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [gate_mult * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [gate_mult * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [gate_mult * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def _run(self, inputs, h, c):
+        mode = self.mode
+
+        @op(f"rnn_cell_{mode.lower()}")
+        def _impl(x, hh, cc, w_ih, w_hh, b_ih, b_hh):
+            return _cell_step(mode, x, hh, cc, w_ih, w_hh, b_ih, b_hh)
+
+        return _impl(inputs, h, c, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh)
+
+
+class SimpleRNNCell(_CellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, **kwargs)
+
+    def forward(self, inputs, states=None):
+        from ...ops import creation as C
+
+        if states is None:
+            states = C.zeros([inputs.shape[0], self.hidden_size],
+                             dtype=str(inputs.dtype))
+        h, _ = self._run(inputs, states, states)
+        return h, h
+
+
+class LSTMCell(_CellBase):
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, **kwargs)
+
+    def forward(self, inputs, states=None):
+        from ...ops import creation as C
+
+        if states is None:
+            z = C.zeros([inputs.shape[0], self.hidden_size],
+                        dtype=str(inputs.dtype))
+            states = (z, z)
+        h, c = self._run(inputs, states[0], states[1])
+        return h, (h, c)
+
+
+class GRUCell(_CellBase):
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, **kwargs)
+
+    def forward(self, inputs, states=None):
+        from ...ops import creation as C
+
+        if states is None:
+            states = C.zeros([inputs.shape[0], self.hidden_size],
+                             dtype=str(inputs.dtype))
+        h, _ = self._run(inputs, states, states)
+        return h, h
+
+
+class RNN(Layer):
+    """Wraps a cell into a time loop (reference: nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # python-loop fallback over the cell (cells are arbitrary user Layers)
+        from ...ops import manipulation as M
+
+        time_axis = 0 if self.time_major else 1
+        T = inputs.shape[time_axis]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = []
+        for t in steps:
+            x_t = inputs[:, t] if time_axis == 1 else inputs[t]
+            out, states = self.cell(x_t, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        outputs = M.stack(outs, axis=time_axis)
+        return outputs, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation as M
+
+        states_fw, states_bw = (initial_states if initial_states is not None
+                                else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        return M.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

@@ -1,0 +1,154 @@
+"""nn.utils (reference: python/paddle/nn/utils/)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters", "weight_norm", "remove_weight_norm",
+           "spectral_norm"]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros([]))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._data)) for g in grads]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(g._data.astype(jnp.float32)),
+                                  norm_type)) for g in grads),
+            1.0 / norm_type,
+        )
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("non-finite grad norm")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad.set_value((p.grad._data * scale).astype(p.grad.dtype))
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad.set_value(jnp.clip(p.grad._data, -clip_value, clip_value))
+
+
+def parameters_to_vector(parameters, name=None):
+    return Tensor(
+        jnp.concatenate([jnp.ravel(p._data) for p in parameters])
+    )
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    data = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    for p in parameters:
+        n = 1
+        for d in p._data.shape:
+            n *= d
+        p.set_value(jnp.reshape(data[offset : offset + n], p._data.shape))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize ``weight`` as g * v / ||v|| (reference: nn/utils/weight_norm_hook.py)."""
+    import jax.numpy as jnp
+
+    from ...core.tensor import Parameter
+
+    weight = getattr(layer, name)
+    w = weight._data
+
+    if dim is None:
+        norm = jnp.sqrt(jnp.sum(jnp.square(w)))
+    else:
+        axes = tuple(i for i in range(w.ndim) if i != dim)
+        norm = jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=False))
+    g = Parameter(norm)
+    v = Parameter(w)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    layer._weight_norm_name = name
+    layer._weight_norm_dim = dim
+
+    def hook(l, inputs):
+        vv = l._parameters[name + "_v"]
+        gg = l._parameters[name + "_g"]
+        from ...core.dispatch import op as _op
+
+        @_op("weight_norm_recompute")
+        def _compute(v_arr, g_arr):
+            if dim is None:
+                n = jnp.sqrt(jnp.sum(jnp.square(v_arr)))
+                return v_arr * (g_arr / n)
+            axes = tuple(i for i in range(v_arr.ndim) if i != dim)
+            n = jnp.sqrt(jnp.sum(jnp.square(v_arr), axis=axes, keepdims=True))
+            shape = [1] * v_arr.ndim
+            shape[dim] = -1
+            return v_arr * (jnp.reshape(g_arr, shape) / n)
+
+        w_t = _compute(vv, gg)
+        object.__setattr__(l, name, w_t)
+
+    layer._weight_norm_hook = layer.register_forward_pre_hook(hook)
+    hook(layer, ())
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    from ...core.tensor import Parameter
+
+    g = layer._parameters.pop(name + "_g")
+    v = layer._parameters.pop(name + "_v")
+    layer._weight_norm_hook.remove()
+    if hasattr(layer, name):
+        try:
+            object.__delattr__(layer, name)
+        except AttributeError:
+            pass
+    dim = layer._weight_norm_dim
+    if dim is None:
+        norm = jnp.sqrt(jnp.sum(jnp.square(v._data)))
+        w = v._data * (g._data / norm)
+    else:
+        axes = tuple(i for i in range(v._data.ndim) if i != dim)
+        norm = jnp.sqrt(jnp.sum(jnp.square(v._data), axis=axes, keepdims=True))
+        shape = [1] * v._data.ndim
+        shape[dim] = -1
+        w = v._data * (jnp.reshape(g._data, shape) / norm)
+    layer.add_parameter(name, Parameter(w))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Attach spectral normalization to a layer's weight."""
+    from ..layer.norm import SpectralNorm
+
+    weight = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = SpectralNorm(list(weight._data.shape), dim=dim,
+                      power_iters=n_power_iterations, eps=eps)
+    layer.add_sublayer(name + "_sn", sn)
+    orig = layer._parameters.pop(name)
+    layer.add_parameter(name + "_orig", orig)
+
+    def hook(l, inputs):
+        w = sn(l._parameters[name + "_orig"])
+        object.__setattr__(l, name, w)
+
+    layer._spectral_norm_hook = layer.register_forward_pre_hook(hook)
+    hook(layer, ())
+    return layer

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for the fused hot set.
+
+The TPU-native replacement for the reference's hand-written fused CUDA
+kernels (paddle/phi/kernels/fusion/gpu/ and fusion/cutlass/): flash
+attention, fused rms/layer norm, rotary embedding. Each module exposes
+``supported(...)`` so callers can fall back to the XLA-fused reference
+expression on unsupported shapes/backends.
+"""

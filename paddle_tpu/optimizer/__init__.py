@@ -1,0 +1,16 @@
+"""paddle_tpu.optimizer (reference: python/paddle/optimizer/__init__.py)."""
+
+from . import lr  # noqa: F401
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD,
+    AdaDelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    LBFGS,
+    Momentum,
+    RMSProp,
+)

@@ -1,0 +1,201 @@
+"""Optimizer base + the main update rules.
+
+Reference surface: python/paddle/optimizer/optimizer.py (Optimizer base),
+adam.py, adamw.py, sgd.py, momentum.py... TPU-native design: each
+optimizer's update is a **pure jax function over (param, grad, state)
+pytrees, jitted once and cached** — one fused XLA program updates every
+parameter (the analog of the reference's fused/multi-tensor optimizer
+kernels, e.g. fused_adam / multi_tensor_momentum in phi/kernels/fusion/),
+instead of per-parameter kernel launches.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    """Base class. Subclasses define ``_init_slot(p)`` → state pytree and
+    ``_update(grad, param, state, lr, ctx)`` → (new_param, new_state).
+    """
+
+    _slot_names: tuple[str, ...] = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        if parameters is None:
+            from ..core.tensor import live_parameters
+
+            parameters = live_parameters()
+        self._parameter_list = list(parameters)
+        # support param groups: list of dicts with 'params' key
+        self._param_groups = None
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            self._param_groups = self._parameter_list
+            flat = []
+            for g in self._param_groups:
+                flat.extend(g["params"])
+            self._parameter_list = flat
+        self._learning_rate = learning_rate
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._accumulators: dict[int, dict[str, Any]] = {}
+        self._master_weights: dict[int, jnp.ndarray] = {}
+        self._step_count = 0
+        self._update_jit = None
+
+    # -- lr -----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when learning rate is a scheduler")
+        self._learning_rate = value
+
+    def set_lr_scheduler(self, scheduler: LRScheduler):
+        self._learning_rate = scheduler
+
+    # -- state --------------------------------------------------------------
+    def _init_slot(self, p: Parameter) -> dict:
+        return {}
+
+    def _get_state(self, p: Parameter) -> dict:
+        key = id(p)
+        if key not in self._accumulators:
+            self._accumulators[key] = self._init_slot(p)
+        return self._accumulators[key]
+
+    def _param_lr(self, p: Parameter) -> float:
+        return p.optimize_attr.get("learning_rate", 1.0) if hasattr(
+            p, "optimize_attr") else 1.0
+
+    # -- step ---------------------------------------------------------------
+    def _collect(self):
+        params_grads = []
+        for p in self._parameter_list:
+            if p.stop_gradient or p.grad is None:
+                continue
+            params_grads.append((p, p.grad))
+        return params_grads
+
+    def _update(self, grad, param, state, lr, ctx):
+        raise NotImplementedError
+
+    def _ctx(self) -> dict:
+        """Per-step scalars shared across params (e.g. beta powers)."""
+        return {}
+
+    def step(self):
+        params_grads = self._collect()
+        if not params_grads:
+            return
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+
+        lr = self.get_lr()
+        self._step_count += 1
+        ctx = self._ctx()
+
+        # Bucket the whole update into one jitted call over stacked pytrees.
+        params = [p for p, _ in params_grads]
+        grads = [g._data for _, g in params_grads]
+        datas = [p._data for p in params]
+        states = [self._get_state(p) for p in params]
+        lrs = [lr * self._param_lr(p) for p in params]
+        wds = [self._effective_wd(p) for p in params]
+
+        update = self._jitted_update()
+        new_datas, new_states = update(datas, grads, states, lrs, wds, ctx)
+        for p, nd, ns in zip(params, new_datas, new_states):
+            p._bump(nd)
+            self._accumulators[id(p)] = ns
+
+    def _effective_wd(self, p) -> float:
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0
+        if not getattr(p, "regularizer", None) is None:
+            pass  # per-param regularizer overrides handled by subclasses
+        if hasattr(wd, "_coeff"):  # L2Decay object
+            return float(wd._coeff)
+        if isinstance(wd, (int, float)):
+            return float(wd)
+        return 0.0
+
+    def _jitted_update(self):
+        if self._update_jit is None:
+            upd = self._update
+
+            @functools.partial(jax.jit, donate_argnums=(0, 2))
+            def run(datas, grads, states, lrs, wds, ctx):
+                outs = [
+                    upd(g, d, s, l, dict(ctx, wd=w))
+                    for d, g, s, l, w in zip(datas, grads, states, lrs, wds)
+                ]
+                return [o[0] for o in outs], [o[1] for o in outs]
+
+            self._update_jit = run
+        return self._update_jit
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    # -- serialization ------------------------------------------------------
+    def state_dict(self) -> dict:
+        sd: dict[str, Any] = {"step_count": self._step_count}
+        named = {}
+        for i, p in enumerate(self._parameter_list):
+            key = p.name or f"param_{i}"
+            if id(p) in self._accumulators:
+                named[key] = {
+                    k: (v if not hasattr(v, "shape") else v)
+                    for k, v in self._accumulators[id(p)].items()
+                }
+        sd["state"] = named
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict: dict):
+        self._step_count = state_dict.get("step_count", 0)
+        named = state_dict.get("state", {})
+        for i, p in enumerate(self._parameter_list):
+            key = p.name or f"param_{i}"
+            if key in named:
+                # copy: the jitted step donates state buffers, so shared
+                # references with the source optimizer would be invalidated
+                self._accumulators[id(p)] = {
+                    k: jnp.array(v, copy=True) for k, v in named[key].items()
+                }
+        if "LR_Scheduler" in state_dict and isinstance(
+            self._learning_rate, LRScheduler
+        ):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+
+    @property
+    def _parameter_names(self):
+        return [p.name for p in self._parameter_list]

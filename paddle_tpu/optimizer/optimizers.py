@@ -1,0 +1,378 @@
+"""Concrete optimizers: SGD/Momentum/Adagrad/Adam/AdamW/Adamax/AdaDelta/
+RMSProp/Lamb/LBFGS.
+
+Reference surface: python/paddle/optimizer/{sgd,momentum,adam,adamw,...}.py.
+Update math matches the reference kernels (e.g. adam_kernel:
+phi/kernels/gpu/adam_kernel.cu); all updates run inside one jitted program
+(see optimizer.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum", "Adagrad", "Adam", "AdamW", "Adamax",
+           "AdaDelta", "RMSProp", "Lamb", "LBFGS"]
+
+
+def _f32(x):
+    return x.astype(jnp.float32)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _update(self, g, p, state, lr, ctx):
+        g = _f32(g)
+        g = g + ctx["wd"] * _f32(p)
+        return (p - (lr * g).astype(p.dtype)), state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_slot(self, p):
+        return {"velocity": jnp.zeros_like(_f32(p._data))}
+
+    def _update(self, g, p, state, lr, ctx):
+        g = _f32(g)
+        g = g + ctx["wd"] * _f32(p)
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            step = g + self._momentum * v
+        else:
+            step = v
+        return (p - (lr * step).astype(p.dtype)), {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._epsilon = epsilon
+        self._init_val = initial_accumulator_value
+
+    def _init_slot(self, p):
+        return {"moment": jnp.full_like(_f32(p._data), self._init_val)}
+
+    def _update(self, g, p, state, lr, ctx):
+        g = _f32(g)
+        g = g + ctx["wd"] * _f32(p)
+        m = state["moment"] + jnp.square(g)
+        step = g / (jnp.sqrt(m) + self._epsilon)
+        return (p - (lr * step).astype(p.dtype)), {"moment": m}
+
+
+class Adam(Optimizer):
+    """reference: python/paddle/optimizer/adam.py; kernel math
+    phi/kernels/funcs/adam_functors.h."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+
+    def _init_slot(self, p):
+        def z():
+            # distinct buffers: the jitted step donates state, and XLA
+            # rejects donating one buffer through two arguments
+            return jnp.zeros_like(_f32(p._data))
+
+        slot = {"moment1": z(), "moment2": z()}
+        if self._amsgrad:
+            slot["moment2_max"] = z()
+        return slot
+
+    def _ctx(self):
+        t = self._step_count
+        return {
+            "bias1": 1.0 - self._beta1**t,
+            "bias2": 1.0 - self._beta2**t,
+        }
+
+    def _decoupled_wd(self) -> bool:
+        return False
+
+    def _update(self, g, p, state, lr, ctx):
+        g = _f32(g)
+        pf = _f32(p)
+        wd = ctx["wd"]
+        if not self._decoupled_wd():
+            g = g + wd * pf  # L2-regularization form (Adam)
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
+        m1_hat = m1 / ctx["bias1"]
+        if self._amsgrad:
+            m2_max = jnp.maximum(state.get("moment2_max", m2), m2)
+            m2_hat = m2_max / ctx["bias2"]
+        else:
+            m2_hat = m2 / ctx["bias2"]
+        new_p = pf - lr * m1_hat / (jnp.sqrt(m2_hat) + self._epsilon)
+        if self._decoupled_wd():
+            new_p = new_p - lr * wd * pf  # decoupled decay (AdamW)
+        new_state = {"moment1": m1, "moment2": m2}
+        if self._amsgrad:
+            new_state["moment2_max"] = m2_max
+        return new_p.astype(p.dtype), new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         False, amsgrad, name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _decoupled_wd(self):
+        return True
+
+    def _effective_wd(self, p):
+        if (
+            self._apply_decay_param_fun is not None
+            and not self._apply_decay_param_fun(p.name)
+        ):
+            return 0.0
+        return super()._effective_wd(p)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_slot(self, p):
+        return {"moment": jnp.zeros_like(_f32(p._data)),
+                "inf_norm": jnp.zeros_like(_f32(p._data))}
+
+    def _ctx(self):
+        return {"bias1": 1.0 - self._beta1**self._step_count}
+
+    def _update(self, g, p, state, lr, ctx):
+        g = _f32(g)
+        g = g + ctx["wd"] * _f32(p)
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        step = m / (ctx["bias1"] * (u + self._epsilon))
+        return (p - (lr * step).astype(p.dtype)), {"moment": m, "inf_norm": u}
+
+
+class AdaDelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _init_slot(self, p):
+        return {"avg_squared_grad": jnp.zeros_like(_f32(p._data)),
+                "avg_squared_update": jnp.zeros_like(_f32(p._data))}
+
+    def _update(self, g, p, state, lr, ctx):
+        g = _f32(g)
+        g = g + ctx["wd"] * _f32(p)
+        asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * jnp.square(g)
+        update = (
+            jnp.sqrt(state["avg_squared_update"] + self._epsilon)
+            / jnp.sqrt(asg + self._epsilon)
+        ) * g
+        asu = self._rho * state["avg_squared_update"] + (1 - self._rho) * jnp.square(update)
+        return (p - (lr * update).astype(p.dtype)), {
+            "avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _init_slot(self, p):
+        slot = {"mean_square": jnp.zeros_like(_f32(p._data)),
+                "momentum": jnp.zeros_like(_f32(p._data))}
+        if self._centered:
+            slot["mean_grad"] = jnp.zeros_like(_f32(p._data))
+        return slot
+
+    def _update(self, g, p, state, lr, ctx):
+        g = _f32(g)
+        g = g + ctx["wd"] * _f32(p)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * jnp.square(g)
+        new_state = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+            new_state["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state["momentum"] + lr * g / denom
+        new_state["momentum"] = mom
+        return (p - mom.astype(p.dtype)), new_state
+
+
+class Lamb(Optimizer):
+    """reference: python/paddle/optimizer/lamb.py (+ the distributed fused
+    variant incubate/optimizer/distributed_fused_lamb.py)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 always_adapt=False, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_slot(self, p):
+        return {"moment1": jnp.zeros_like(_f32(p._data)),
+                "moment2": jnp.zeros_like(_f32(p._data))}
+
+    def _ctx(self):
+        t = self._step_count
+        return {"bias1": 1.0 - self._beta1**t, "bias2": 1.0 - self._beta2**t}
+
+    def _effective_wd(self, p):
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            return 0.0
+        return super()._effective_wd(p)
+
+    def _update(self, g, p, state, lr, ctx):
+        g = _f32(g)
+        pf = _f32(p)
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
+        m1_hat = m1 / ctx["bias1"]
+        m2_hat = m2 / ctx["bias2"]
+        r = m1_hat / (jnp.sqrt(m2_hat) + self._epsilon) + ctx.get("wd", 0.0) * pf
+        w_norm = jnp.linalg.norm(pf)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where(
+            (w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0
+        )
+        return (pf - lr * trust * r).astype(p.dtype), {
+            "moment1": m1, "moment2": m2}
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with strong-Wolfe line search (host loop).
+
+    reference: python/paddle/optimizer/lbfgs.py. The closure re-evaluates
+    loss+grads; history stays on device.
+    """
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._max_iter = max_iter
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history = history_size
+        self._line_search = line_search_fn
+        self._s_hist: list = []
+        self._y_hist: list = []
+        self._prev_flat_grad = None
+
+    def _flat_params(self):
+        return jnp.concatenate(
+            [jnp.ravel(_f32(p._data)) for p in self._parameter_list])
+
+    def _flat_grads(self):
+        return jnp.concatenate([
+            jnp.ravel(_f32(p.grad._data)) if p.grad is not None
+            else jnp.zeros(p._data.size, jnp.float32)
+            for p in self._parameter_list
+        ])
+
+    def _assign_flat(self, flat):
+        offset = 0
+        for p in self._parameter_list:
+            n = int(jnp.size(p._data))
+            p._bump(
+                jnp.reshape(flat[offset : offset + n], p._data.shape).astype(
+                    p.dtype))
+            offset += n
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure")
+        loss = closure()
+        flat_grad = self._flat_grads()
+        if float(jnp.max(jnp.abs(flat_grad))) <= self._tol_grad:
+            return loss
+        lr = self.get_lr()
+        for _ in range(self._max_iter):
+            q = flat_grad
+            alphas = []
+            for s, y in zip(reversed(self._s_hist), reversed(self._y_hist)):
+                rho = 1.0 / (jnp.dot(y, s) + 1e-10)
+                a = rho * jnp.dot(s, q)
+                q = q - a * y
+                alphas.append((a, rho, s, y))
+            if self._y_hist:
+                y_last, s_last = self._y_hist[-1], self._s_hist[-1]
+                gamma = jnp.dot(s_last, y_last) / (jnp.dot(y_last, y_last) + 1e-10)
+                q = gamma * q
+            for a, rho, s, y in reversed(alphas):
+                b = rho * jnp.dot(y, q)
+                q = q + (a - b) * s
+            direction = -q
+            x0 = self._flat_params()
+            self._assign_flat(x0 + lr * direction)
+            new_loss = closure()
+            new_grad = self._flat_grads()
+            s = lr * direction
+            y = new_grad - flat_grad
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._s_hist.append(s)
+                self._y_hist.append(y)
+                if len(self._s_hist) > self._history:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+            if float(jnp.abs(new_loss._data - loss._data)) < self._tol_change:
+                return new_loss
+            loss, flat_grad = new_loss, new_grad
+        return loss

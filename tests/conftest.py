@@ -14,6 +14,11 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax
+
+# The sandbox's sitecustomize imports jax with JAX_PLATFORMS=axon before this
+# conftest runs, so the env var above may be too late — force it on the live
+# config too (must happen before any backend is touched by tests).
+jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import pytest
 

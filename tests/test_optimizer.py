@@ -1,0 +1,162 @@
+"""Optimizer update-rule tests vs NumPy references."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def make_param(val):
+    p = paddle.Parameter(np.asarray(val, dtype="float32"))
+    return p
+
+
+def set_grad(p, g):
+    p.grad = paddle.to_tensor(np.asarray(g, dtype="float32"))
+
+
+class TestSGD:
+    def test_basic(self):
+        p = make_param([1.0, 2.0])
+        o = opt.SGD(learning_rate=0.1, parameters=[p])
+        set_grad(p, [1.0, -1.0])
+        o.step()
+        np.testing.assert_allclose(p.numpy(), [0.9, 2.1], rtol=1e-6)
+
+    def test_weight_decay(self):
+        p = make_param([1.0])
+        o = opt.SGD(learning_rate=0.1, parameters=[p], weight_decay=0.5)
+        set_grad(p, [0.0])
+        o.step()
+        np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 0.5], rtol=1e-6)
+
+
+class TestMomentum:
+    def test_two_steps(self):
+        p = make_param([0.0])
+        o = opt.Momentum(learning_rate=0.1, momentum=0.9, parameters=[p])
+        set_grad(p, [1.0])
+        o.step()
+        np.testing.assert_allclose(p.numpy(), [-0.1], rtol=1e-6)
+        set_grad(p, [1.0])
+        o.step()
+        # v = 0.9*1 + 1 = 1.9
+        np.testing.assert_allclose(p.numpy(), [-0.1 - 0.19], rtol=1e-6)
+
+
+class TestAdam:
+    def test_matches_numpy(self):
+        np.random.seed(0)
+        w0 = np.random.randn(4).astype("float32")
+        p = make_param(w0)
+        o = opt.Adam(learning_rate=0.01, parameters=[p])
+        m = np.zeros(4)
+        v = np.zeros(4)
+        w = w0.copy().astype("float64")
+        for t in range(1, 4):
+            g = np.random.randn(4).astype("float32")
+            set_grad(p, g)
+            o.step()
+            m = 0.9 * m + 0.1 * g
+            v = 0.999 * v + 0.001 * g * g
+            mh = m / (1 - 0.9**t)
+            vh = v / (1 - 0.999**t)
+            w = w - 0.01 * mh / (np.sqrt(vh) + 1e-8)
+        np.testing.assert_allclose(p.numpy(), w, rtol=1e-4)
+
+    def test_adamw_decoupled(self):
+        w0 = np.array([1.0], "float32")
+        p = make_param(w0)
+        o = opt.AdamW(learning_rate=0.1, parameters=[p], weight_decay=0.5)
+        set_grad(p, [0.0])
+        o.step()
+        # grad=0 -> adam step 0; only decay: w - lr*wd*w
+        np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 0.5 * 1.0],
+                                   rtol=1e-5)
+
+
+class TestSchedulers:
+    def test_step_decay(self):
+        s = opt.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(s())
+            s.step()
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025],
+                                   rtol=1e-6)
+
+    def test_warmup(self):
+        s = opt.lr.LinearWarmup(0.1, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+        vals = []
+        for _ in range(6):
+            vals.append(s())
+            s.step()
+        np.testing.assert_allclose(vals[:4], [0.0, 0.025, 0.05, 0.075],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(vals[4:], [0.1, 0.1], rtol=1e-6)
+
+    def test_cosine(self):
+        s = opt.lr.CosineAnnealingDecay(1.0, T_max=10)
+        s.step(5)
+        np.testing.assert_allclose(s(), 0.5, rtol=1e-6)
+
+    def test_optimizer_uses_scheduler(self):
+        p = make_param([1.0])
+        sched = opt.lr.StepDecay(0.1, step_size=1, gamma=0.1)
+        o = opt.SGD(learning_rate=sched, parameters=[p])
+        set_grad(p, [1.0])
+        o.step()
+        np.testing.assert_allclose(p.numpy(), [0.9], rtol=1e-6)
+        sched.step()
+        set_grad(p, [1.0])
+        o.step()
+        np.testing.assert_allclose(p.numpy(), [0.9 - 0.01], rtol=1e-5)
+
+
+class TestEndToEnd:
+    def test_linear_regression_converges(self):
+        np.random.seed(0)
+        x = np.random.randn(64, 3).astype("float32")
+        true_w = np.array([[1.0], [-2.0], [0.5]], "float32")
+        y = x @ true_w
+        lin = nn.Linear(3, 1)
+        o = opt.Adam(learning_rate=0.1, parameters=lin.parameters())
+        xt = paddle.to_tensor(x)
+        yt = paddle.to_tensor(y)
+        for _ in range(150):
+            loss = nn.functional.mse_loss(lin(xt), yt)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        assert loss.item() < 1e-3
+        np.testing.assert_allclose(lin.weight.numpy(), true_w, atol=0.05)
+
+    def test_state_dict_roundtrip(self):
+        p = make_param([1.0, 2.0])
+        p.name = "w"
+        o = opt.Adam(learning_rate=0.01, parameters=[p])
+        set_grad(p, [0.1, 0.2])
+        o.step()
+        sd = o.state_dict()
+        p2 = make_param(p.numpy())
+        p2.name = "w"
+        o2 = opt.Adam(learning_rate=0.01, parameters=[p2])
+        o2.set_state_dict(sd)
+        assert o2._step_count == 1
+        set_grad(p, [0.3, 0.1])
+        set_grad(p2, [0.3, 0.1])
+        o.step()
+        o2.step()
+        np.testing.assert_allclose(p.numpy(), p2.numpy(), rtol=1e-6)
+
+    def test_grad_clip_global_norm(self):
+        from paddle_tpu.nn import ClipGradByGlobalNorm
+
+        p = make_param(np.zeros(2))
+        o = opt.SGD(learning_rate=1.0, parameters=[p],
+                    grad_clip=ClipGradByGlobalNorm(1.0))
+        set_grad(p, [3.0, 4.0])
+        o.step()
+        np.testing.assert_allclose(np.linalg.norm(p.numpy()), 1.0, rtol=1e-5)
