@@ -162,11 +162,25 @@ class Tensor:
 
     # ---- mutation ----------------------------------------------------------
     def set_value(self, value):
-        """Rebind the buffer (in-place assignment semantics)."""
+        """Rebind the buffer (in-place assignment semantics).
+
+        Keeps the destination's placement: like the reference's set_value
+        (which writes into the existing DenseTensor allocation), assigning
+        new values must not move a sharded/stage-placed parameter back to
+        the default device.
+        """
         if isinstance(value, Tensor):
             value = value._data
         else:
             value = jnp.asarray(value, dtype=self.dtype)
+        old_sharding = getattr(self._data, "sharding", None)
+        if (
+            old_sharding is not None
+            and not isinstance(self._data, jax.core.Tracer)
+            and not isinstance(value, jax.core.Tracer)
+            and value.shape == self._data.shape
+        ):
+            value = jax.device_put(value, old_sharding)
         self._data = value
         return self
 

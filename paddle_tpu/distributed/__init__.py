@@ -1,0 +1,88 @@
+"""paddle_tpu.distributed: hybrid + auto parallelism over device meshes.
+
+Surface parity with python/paddle/distributed (SURVEY.md §1 L8): collective
+API, fleet hybrid-parallel stack, semi-auto shard_tensor/reshard, launch.
+The design translation is SURVEY.md §5's: process groups → mesh axes,
+NCCL collectives → XLA collectives on ICI, reducer/bucketing → sharding
+propagation, TCPStore → jax coordination service.
+"""
+
+from .collective import (
+    Group,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    broadcast,
+    get_group,
+    irecv,
+    isend,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+from .parallel import (
+    DataParallel,
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+)
+from .placement import Partial, Placement, Replicate, Shard
+from .process_mesh import ProcessMesh, get_mesh, set_mesh
+from .auto_parallel import (
+    ShardingStage1,
+    ShardingStage2,
+    ShardingStage3,
+    dtensor_from_fn,
+    dtensor_from_local,
+    get_placements,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    sharding_constraint,
+    unshard_dtensor,
+)
+from .sharding import group_sharded_parallel
+from . import collective, fleet, topology
+
+__all__ = [
+    # collectives
+    "Group", "ReduceOp", "new_group", "get_group", "all_reduce", "all_gather",
+    "all_gather_object", "reduce_scatter", "reduce", "broadcast", "scatter",
+    "alltoall", "alltoall_single", "send", "recv", "isend", "irecv", "barrier",
+    # env
+    "init_parallel_env", "get_rank", "get_world_size", "is_initialized",
+    "ParallelEnv", "DataParallel", "spawn", "launch",
+    # auto parallel
+    "ProcessMesh", "get_mesh", "set_mesh", "Shard", "Replicate", "Partial",
+    "Placement", "shard_tensor", "dtensor_from_local", "dtensor_from_fn",
+    "reshard", "shard_layer", "shard_optimizer", "unshard_dtensor",
+    "get_placements", "sharding_constraint",
+    "ShardingStage1", "ShardingStage2", "ShardingStage3",
+    "group_sharded_parallel",
+    "fleet",
+]
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """reference: python/paddle/distributed/spawn.py:463. Single-controller
+    SPMD needs no per-rank processes on one host: run the function once; it
+    sees the whole mesh. Multi-host launching is `paddle_tpu.distributed.launch`.
+    """
+    init_parallel_env()
+    return func(*args)
+
+
+def launch():
+    from .launch import main
+
+    return main()

@@ -1,0 +1,244 @@
+"""Semi-auto parallel API: shard_tensor / reshard / shard_layer / shard_optimizer.
+
+Re-design of python/paddle/distributed/auto_parallel/api.py
+(shard_tensor:204, reshard:726, shard_layer:827, shard_optimizer:1596).
+
+Architectural translation (SURVEY.md §7): the reference implements
+InferSpmd → reshard-collectives → local kernel per op in generated C++
+(phi/api/generator/dist_api_gen.py:76-137) plus a C++ reshard function
+library (p↔r↔s pairwise, reshard_function_registry.cc). On TPU the whole
+pipeline *is* GSPMD: ``shard_tensor`` = device_put with a NamedSharding,
+``reshard`` = resharding device_put (eager) / sharding constraint (traced),
+and SPMD rule inference + collective insertion happen inside XLA. The 53
+hand-written SPMD rules collapse into GSPMD propagation; explicit placement
+control remains available through this API for the cases where propagation
+picks wrong (same role as the reference's user annotations).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor, Parameter
+from .placement import Partial, Placement, Replicate, Shard, to_partition_spec
+from .process_mesh import ProcessMesh, get_mesh, set_mesh
+
+__all__ = [
+    "shard_tensor",
+    "dtensor_from_local",
+    "dtensor_from_fn",
+    "reshard",
+    "shard_layer",
+    "shard_optimizer",
+    "unshard_dtensor",
+    "get_placements",
+    "sharding_constraint",
+]
+
+
+def _as_jax_mesh(mesh) -> Mesh:
+    if isinstance(mesh, ProcessMesh):
+        return mesh.jax_mesh
+    if isinstance(mesh, Mesh):
+        return mesh
+    raise TypeError(f"expected ProcessMesh or jax Mesh, got {type(mesh)}")
+
+
+def _named_sharding(mesh, placements, ndim, shape=None) -> NamedSharding:
+    jmesh = _as_jax_mesh(mesh)
+    spec = to_partition_spec(placements, jmesh.axis_names, ndim)
+    if shape is not None:
+        spec = _sanitize_spec(spec, shape, jmesh)
+    return NamedSharding(jmesh, spec)
+
+
+def _sanitize_spec(spec, shape, jmesh):
+    """Drop shard entries whose dim isn't divisible by the axis product.
+
+    The reference pads uneven shards inside its reshard functions
+    (s_to_r_reshard_function.cc padding-aware path); GSPMD requires even
+    tiles for device_put, so non-divisible dims stay replicated — same
+    numerics, costs a broadcast.
+    """
+    entries = []
+    for d, entry in enumerate(spec):
+        if entry is None:
+            entries.append(None)
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        prod = int(np.prod([jmesh.shape[n] for n in names]))
+        entries.append(entry if shape[d] % prod == 0 else None)
+    return P(*entries)
+
+
+def shard_tensor(data, mesh, placements: Sequence[Placement],
+                 dtype=None, place=None, stop_gradient=None) -> Tensor:
+    """Create a distributed tensor from data + mesh + placements.
+
+    reference: auto_parallel/api.py:204. Partial placements are materialised
+    as zeros-except-one-shard in the reference (dist_tensor construction);
+    here a Partial input keeps full values (single-controller holds the
+    global value already) — Partial only arises transiently inside traces.
+    """
+    if isinstance(data, Tensor):
+        src = data
+        arr = data._data
+    else:
+        src = None
+        arr = jnp.asarray(data, dtype=dtype)
+    sharding = _named_sharding(mesh, placements, arr.ndim, arr.shape)
+    out_arr = jax.device_put(arr, sharding)
+    sg = stop_gradient if stop_gradient is not None else (
+        src.stop_gradient if src is not None else True)
+    if isinstance(src, Parameter):
+        # Keep parameter identity: reshard in place so optimizers keep working.
+        src._bump(out_arr)
+        src._dist_spec = sharding.spec
+        return src
+    out = Tensor(out_arr, stop_gradient=sg)
+    out._dist_spec = sharding.spec
+    return out
+
+
+def dtensor_from_local(local_tensor, mesh, placements) -> Tensor:
+    """reference api.py dtensor_from_local: per-rank locals → global. In
+    single-controller SPMD the "local" is already a shard view; treat the
+    given tensor as the global value and apply placements."""
+    return shard_tensor(local_tensor, mesh, placements)
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs) -> Tensor:
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(dist_tensor, mesh, placements) -> Tensor:
+    """Transform placements (reference api.py:726 → C++ reshard function
+    library p2r/s2r/r2s/s2s/x2r, reshard_function_registry.cc). Eagerly a
+    single resharding device_put; XLA chooses all-gather / slice /
+    collective-permute; cross-mesh reshard = device_put to the new mesh."""
+    t = dist_tensor if isinstance(dist_tensor, Tensor) else Tensor(dist_tensor)
+    sharding = _named_sharding(mesh, placements, t._data.ndim, t._data.shape)
+    # Pending-partial reduction requested to replicate: placements carry no
+    # partial axes eagerly (see shard_tensor); nothing to reduce.
+    out_arr = jax.device_put(t._data, sharding)
+    out = Tensor(out_arr, stop_gradient=t.stop_gradient)
+    out._dist_spec = sharding.spec
+    return out
+
+
+def sharding_constraint(x, mesh, placements):
+    """In-trace resharding (lax.with_sharding_constraint) — what ``reshard``
+    means under program capture."""
+    arr = x._data if isinstance(x, Tensor) else x
+    sharding = _named_sharding(mesh, placements, arr.ndim, arr.shape)
+    out = jax.lax.with_sharding_constraint(arr, sharding)
+    return Tensor(out, stop_gradient=getattr(x, "stop_gradient", True)) \
+        if isinstance(x, Tensor) else out
+
+
+def unshard_dtensor(dist_tensor) -> Tensor:
+    """Gather to replicated (reference api.py unshard_dtensor)."""
+    t = dist_tensor
+    jmesh = None
+    sh = getattr(t._data, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        jmesh = sh.mesh
+    if jmesh is None:
+        return t
+    out = jax.device_put(t._data, NamedSharding(jmesh, P()))
+    return Tensor(out, stop_gradient=t.stop_gradient)
+
+
+def get_placements(t) -> Optional[list]:
+    """Recover per-axis placements from the live sharding."""
+    sh = getattr(t._data if isinstance(t, Tensor) else t, "sharding", None)
+    if not isinstance(sh, NamedSharding):
+        return None
+    placements = []
+    for axis in sh.mesh.axis_names:
+        found = None
+        for d, entry in enumerate(sh.spec):
+            if entry is None:
+                continue
+            names = entry if isinstance(entry, tuple) else (entry,)
+            if axis in names:
+                found = Shard(d)
+                break
+        placements.append(found if found is not None else Replicate())
+    return placements
+
+
+def shard_layer(layer, process_mesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """Shard a layer's parameters over a mesh (reference api.py:827).
+
+    ``shard_fn(name, layer, mesh)`` may call shard_tensor on parameters;
+    default replicates every parameter (the reference default).
+    """
+    jmesh = _as_jax_mesh(process_mesh)
+    if shard_fn is None:
+        for p in layer.parameters():
+            p._bump(jax.device_put(p._data, NamedSharding(jmesh, P())))
+    else:
+        for name, sub in layer.named_sublayers(include_self=True):
+            shard_fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda lyr, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda lyr, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer
+
+
+class _ShardingStage:
+    def __init__(self, axis: str):
+        self.axis = axis
+
+
+class ShardingStage1(_ShardingStage):
+    """Optimizer-state sharding marker (reference api.py:1306)."""
+
+    def __init__(self, axis: str = "dp", mesh=None):
+        super().__init__(axis)
+        self.mesh = mesh
+
+
+class ShardingStage2(_ShardingStage):
+    def __init__(self, axis: str = "dp", mesh=None):
+        super().__init__(axis)
+        self.mesh = mesh
+
+
+class ShardingStage3(_ShardingStage):
+    def __init__(self, axis: str = "dp", mesh=None):
+        super().__init__(axis)
+        self.mesh = mesh
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Wrap an optimizer so its states follow parameter shardings, optionally
+    ZeRO-sharded over an axis (reference api.py:1596 + ShardingStage1/2/3).
+
+    TPU translation: optimizer state arrays are created lazily by our
+    optimizers; we install a state-spec policy on the optimizer telling it to
+    device_put each moment with the parameter's sharding (stage 0) or
+    sharded over the given axis (ZeRO, see distributed/sharding.py).
+    """
+    if shard_fn is not None and isinstance(shard_fn, _ShardingStage):
+        from .sharding import apply_zero_sharding
+
+        apply_zero_sharding(optimizer, shard_fn)
+        return optimizer
+    optimizer._follow_param_sharding = True
+    return optimizer
+
+
+__all__ += ["ShardingStage1", "ShardingStage2", "ShardingStage3"]

@@ -1,0 +1,52 @@
+"""Differentiable resharding ops, recorded on the autograd tape.
+
+This is the TPU analog of the reference's collective PyLayers
+(fleet/layers/mpu/mp_ops.py identity/allreduce pairs;
+fleet/utils/sequence_parallel_utils.py:85-140 ScatterOp/AllGatherOp/
+ReduceScatterOp): forward reshards, backward reshards the cotangent the
+opposite way. Here both directions are a single primitive — ``device_put``
+to a NamedSharding — whose jax vjp is exactly the reverse reshard, so one
+registered op covers the whole PyLayer family and XLA picks the collective
+(all-gather / reduce-scatter / all-to-all / slice) for each direction.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor
+
+__all__ = ["reshard_op", "scatter_axis", "gather_axis"]
+
+
+@op("reshard", amp="none")
+def _reshard(x, *, sharding):
+    # Works eagerly (resharding copy over ICI) and under trace (lowered to a
+    # sharding constraint); linear, so jax.vjp gives the reverse reshard.
+    return jax.device_put(x, sharding)
+
+
+def reshard_op(t: Tensor, mesh: Mesh, spec: P) -> Tensor:
+    return _reshard(t, sharding=NamedSharding(mesh, spec))
+
+
+def scatter_axis(t: Tensor, mesh: Mesh, dim: int, axis: str) -> Tensor:
+    """Shard tensor dim over a mesh axis (reference ScatterOp: split seq dim
+    across the mp group, sequence_parallel_utils.py:85)."""
+    entries = [None] * t.ndim
+    entries[dim] = axis
+    return reshard_op(t, mesh, P(*entries))
+
+
+def gather_axis(t: Tensor, mesh: Mesh, dim: int) -> Tensor:
+    """Replicate a previously sharded dim (reference AllGatherOp), keeping
+    shardings on every other dim (e.g. the dp-sharded batch dim)."""
+    cur = getattr(t._data, "sharding", None)
+    entries = [None] * t.ndim
+    if isinstance(cur, NamedSharding) and cur.mesh == mesh:
+        for d, e in enumerate(cur.spec):
+            if d != dim:
+                entries[d] = e
+    return reshard_op(t, mesh, P(*entries))

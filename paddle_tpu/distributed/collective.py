@@ -1,0 +1,533 @@
+"""Collective communication over mesh axes.
+
+TPU-native re-design of the reference's ProcessGroup stack
+(paddle/phi/core/distributed/collective/process_group.h:48 abstract PG;
+paddle/fluid/distributed/collective/process_group_nccl.h:37 NCCL impl;
+python/paddle/distributed/communication/*). The architectural translation
+(SURVEY.md §5): a "process group" is a **named axis of the device mesh**;
+a collective is an XLA op riding ICI, not a host-driven NCCL call. There are
+no per-rank processes in single-controller SPMD, so the API has two layers:
+
+1. **In-trace primitives** (``psum``/``pgather``/… wrappers): used inside
+   ``jax.shard_map``-traced code where the per-device view is real. This is
+   what pipeline/MoE/TP internals use; they lower to AllReduce/AllGather/
+   ReduceScatter/AllToAll/CollectivePermute HLOs on the ICI.
+
+2. **Eager DTensor-style API** (``all_reduce``/``all_gather``/…): operates
+   on global Tensors; per-rank variation exists only through sharding, so
+   each collective is defined as a placement transform (e.g. all_gather =
+   Shard→Replicate, lowered by XLA to an ICI all-gather). The degenerate
+   replicated-input cases keep reference numerics (allreduce-sum of a
+   replicated tensor multiplies by group size, matching N identical
+   contributions).
+
+Async ``Task`` parity: XLA dispatch is already async on TPU; ``Task.wait``
+maps to ``block_until_ready``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Group",
+    "ReduceOp",
+    "new_group",
+    "get_group",
+    "all_reduce",
+    "all_gather",
+    "all_gather_object",
+    "reduce_scatter",
+    "reduce",
+    "broadcast",
+    "scatter",
+    "alltoall",
+    "alltoall_single",
+    "send",
+    "recv",
+    "isend",
+    "irecv",
+    "barrier",
+    "Task",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Task:
+    """Async collective handle (reference: ProcessGroup::Task,
+    process_group.h:50). XLA launches are already async; wait = block."""
+
+    def __init__(self, result):
+        self._result = result
+
+    def wait(self):
+        r = self._result
+        if isinstance(r, Tensor):
+            r.block_until_ready()
+        return r
+
+    def is_completed(self):
+        return True
+
+
+class Group:
+    """A communicator = one (or more) named mesh axes.
+
+    Reference parity: python/paddle/distributed/communication/group.py.
+    ``axis_names`` index into the global hybrid topology mesh (topology.py);
+    ``nranks`` is the product of those axis sizes.
+    """
+
+    def __init__(self, mesh: Mesh, axis_names: Sequence[str], gid: int = 0,
+                 name: str = ""):
+        self.mesh = mesh
+        self.axis_names = tuple(axis_names)
+        self.id = gid
+        self.name = name or f"group_{'_'.join(self.axis_names)}"
+
+    @property
+    def nranks(self) -> int:
+        return int(np.prod([self.mesh.shape[a] for a in self.axis_names]))
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    @property
+    def rank(self) -> int:
+        # Single-controller SPMD: the host drives all devices; rank queries
+        # are only meaningful in-trace (lax.axis_index) or multi-host.
+        return jax.process_index()
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, rank):
+        return rank
+
+    def __repr__(self):
+        return f"Group(axes={self.axis_names}, nranks={self.nranks})"
+
+
+_GROUPS: dict[int, Group] = {}
+_NEXT_GID = [1]
+
+
+def _default_group() -> Group:
+    from . import parallel
+
+    return parallel._ensure_default_group()
+
+
+def _resolve(group: Optional[Group]) -> Group:
+    return group if group is not None else _default_group()
+
+
+def new_group(ranks=None, backend=None, axis_names=None, mesh=None) -> Group:
+    """Create a communicator. TPU-native signature: name mesh axes.
+
+    The rank-list form of the reference (communication/group.py) cannot map
+    to arbitrary device subsets on a fixed ICI topology; groups here are
+    mesh-axis aligned (which is also the only layout that performs on ICI).
+    """
+    g = _resolve(None) if (axis_names is None and mesh is None) else None
+    if g is None:
+        mesh = mesh if mesh is not None else _default_group().mesh
+        axis_names = tuple(axis_names) if axis_names else tuple(mesh.axis_names)
+        g = Group(mesh, axis_names, gid=_NEXT_GID[0])
+    _NEXT_GID[0] += 1
+    _GROUPS[g.id] = g
+    return g
+
+
+def get_group(gid: int) -> Optional[Group]:
+    return _GROUPS.get(gid)
+
+
+# ---------------------------------------------------------------------------
+# In-trace primitives (inside shard_map over the topology mesh)
+# ---------------------------------------------------------------------------
+
+def psum(x, axis):
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis):
+    return lax.pmean(x, axis)
+
+def pmax(x, axis):
+    return lax.pmax(x, axis)
+
+
+def pgather(x, axis, concat_dim=0, tiled=True):
+    return lax.all_gather(x, axis, axis=concat_dim, tiled=tiled)
+
+
+def pscatter_sum(x, axis, scatter_dim=0):
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
+
+
+def pall_to_all(x, axis, split_dim, concat_dim):
+    return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim,
+                          tiled=True)
+
+
+def ppermute(x, axis, perm):
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis):
+    return lax.axis_index(axis)
+
+
+# ---------------------------------------------------------------------------
+# Eager helpers
+# ---------------------------------------------------------------------------
+
+def _data(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _wrap_like(t, data):
+    out = Tensor(data, stop_gradient=True)
+    if isinstance(t, Tensor):
+        out.stop_gradient = t.stop_gradient
+    return out
+
+
+_SHARD_MAP_CACHE: dict = {}
+
+
+def _shard_map_jit(mesh, fn, in_spec, out_spec, cache_key):
+    """Build (once) a jitted shard_map program. Keyed explicitly: callers
+    pass closures/partials which would defeat hashing by identity."""
+    key = (id(mesh), cache_key, str(in_spec), str(out_spec))
+    prog = _SHARD_MAP_CACHE.get(key)
+    if prog is None:
+        f = jax.shard_map(fn, mesh=mesh, in_specs=(in_spec,),
+                          out_specs=out_spec)
+        prog = jax.jit(f)
+        _SHARD_MAP_CACHE[key] = prog
+    return prog
+
+
+def _current_spec(arr, mesh) -> P:
+    sh = getattr(arr, "sharding", None)
+    if isinstance(sh, NamedSharding) and sh.mesh == mesh:
+        return sh.spec
+    return P()
+
+
+def _sharded_dim(spec: P, axis_names: tuple) -> Optional[int]:
+    """Find the tensor dim sharded over any of axis_names, if any."""
+    for d, entry in enumerate(spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        if any(a in names for a in axis_names):
+            return d
+    return None
+
+
+def _sharding_degree(spec: P, dim: int, axis_names: tuple, mesh) -> int:
+    """Number of actual per-rank contributions along `dim`: the product of
+    the *group* axes that shard it (not the whole group size — a dim
+    dp-sharded in a dp×mp mesh has dp contributions, not dp*mp)."""
+    entry = spec[dim] if dim < len(spec) else None
+    if entry is None:
+        return 1
+    names = entry if isinstance(entry, tuple) else (entry,)
+    return int(np.prod([mesh.shape[n] for n in names if n in axis_names]))
+
+
+def _replicate_over(t, group: Group) -> jax.Array:
+    """Reshard so the group's axes no longer shard any dim (XLA all-gather)."""
+    arr = _data(t)
+    mesh = group.mesh
+    spec = _current_spec(arr, mesh)
+    new_entries = []
+    for entry in spec:
+        if entry is None:
+            new_entries.append(None)
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        kept = tuple(n for n in names if n not in group.axis_names)
+        new_entries.append(kept if kept else None)
+    new_spec = P(*new_entries)
+    return jax.device_put(arr, NamedSharding(mesh, new_spec))
+
+
+# ---------------------------------------------------------------------------
+# Eager collective API (placement-transform semantics)
+# ---------------------------------------------------------------------------
+
+def all_reduce(tensor, op: str = ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op: bool = True) -> Task:
+    """AllReduce across the group (reference:
+    communication/all_reduce.py:29 → ProcessGroupNCCL::AllReduce).
+
+    Sharded-over-group input (Partial-style contributions held as shards of
+    a leading dim are not representable eagerly): for a replicated input,
+    every "rank" contributes an identical copy — sum multiplies by nranks,
+    max/min/avg are identity, exactly the reference numerics. In-place on
+    ``tensor`` like the reference.
+    """
+    arr = _data(tensor)
+    n = group.nranks if group is not None else _resolve(group).nranks
+    mesh = _resolve(group).mesh
+    spec = _current_spec(arr, mesh)
+    dim = _sharded_dim(spec, _resolve(group).axis_names)
+    if dim is not None:
+        # Shards are the per-rank contributions only when user stacked them;
+        # all_reduce over a sharded tensor reduces the stacked leading dim.
+        g = _resolve(group)
+        nparts = _sharding_degree(spec, dim, g.axis_names, g.mesh)
+        full = _replicate_over(tensor, g)
+        parts = jnp.split(full, nparts, axis=dim)
+        if op in (ReduceOp.SUM,):
+            red = functools.reduce(jnp.add, parts)
+        elif op == ReduceOp.AVG:
+            red = functools.reduce(jnp.add, parts) / len(parts)
+        elif op == ReduceOp.MAX:
+            red = functools.reduce(jnp.maximum, parts)
+        elif op == ReduceOp.MIN:
+            red = functools.reduce(jnp.minimum, parts)
+        else:
+            red = functools.reduce(jnp.multiply, parts)
+        data = jnp.concatenate([red] * nparts, axis=dim)
+    else:
+        if op == ReduceOp.SUM:
+            data = arr * n
+        elif op == ReduceOp.PROD:
+            data = arr ** n
+        else:  # max/min/avg of identical copies
+            data = arr
+    if isinstance(tensor, Tensor):
+        tensor._bump(data)
+        return Task(tensor)
+    return Task(Tensor(data))
+
+
+def all_gather(tensor_list, tensor=None, group: Optional[Group] = None,
+               sync_op: bool = True) -> Task:
+    """AllGather (reference: communication/all_gather.py).
+
+    Two call forms:
+    - ``all_gather(out_list, t)``: appends each rank's copy of ``t``; for a
+      tensor sharded over the group axis the per-rank pieces are its shards.
+    - ``all_gather(t)`` returns a new replicated Tensor (TPU-native form).
+    """
+    g = _resolve(group)
+    if tensor is None:
+        tensor = tensor_list
+        tensor_list = None
+    arr = _data(tensor)
+    spec = _current_spec(arr, g.mesh)
+    dim = _sharded_dim(spec, g.axis_names)
+    full = _replicate_over(tensor, g)
+    if tensor_list is not None:
+        if dim is not None:
+            nparts = _sharding_degree(spec, dim, g.axis_names, g.mesh)
+            parts = jnp.split(full, nparts, axis=dim)
+        else:
+            parts = [full] * g.nranks
+        tensor_list.extend(Tensor(p) for p in parts)
+        return Task(tensor_list)
+    return Task(_wrap_like(tensor, full))
+
+
+def all_gather_object(obj_list, obj, group=None):
+    obj_list.extend([obj] * _resolve(group).nranks)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list=None, op: str = ReduceOp.SUM,
+                   group: Optional[Group] = None, sync_op: bool = True) -> Task:
+    """ReduceScatter: reduce then shard dim 0 over the group axis.
+
+    Reference: communication/reduce_scatter.py. Eager semantics: input is
+    the global (or stacked) tensor; output is dim-0-sharded over the axis.
+    """
+    g = _resolve(group)
+    src = tensor_or_tensor_list if tensor_or_tensor_list is not None else tensor
+    if isinstance(src, (list, tuple)):
+        arr = jnp.concatenate([_data(t) for t in src], axis=0)
+    else:
+        arr = _data(src)
+    n = g.nranks
+    if op == ReduceOp.SUM:
+        arr = arr * n
+    elif op == ReduceOp.AVG:
+        pass
+    elif op == ReduceOp.PROD:
+        arr = arr ** n
+    elif op in (ReduceOp.MAX, ReduceOp.MIN):
+        pass  # n identical contributions
+    else:
+        raise ValueError(f"unknown reduce op {op!r}")
+    axis_entry = g.axis_names if len(g.axis_names) > 1 else g.axis_names[0]
+    sharded = jax.device_put(
+        arr, NamedSharding(g.mesh, P(axis_entry))
+    )
+    out = _wrap_like(tensor, sharded)
+    if tensor_or_tensor_list is not None and isinstance(tensor, Tensor):
+        tensor._bump(sharded)
+        return Task(tensor)
+    return Task(out)
+
+
+def reduce(tensor, dst=0, op: str = ReduceOp.SUM,
+           group: Optional[Group] = None, sync_op: bool = True) -> Task:
+    return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def broadcast(tensor, src=0, group: Optional[Group] = None,
+              sync_op: bool = True) -> Task:
+    """Broadcast src's copy. Replicated input → identity; sharded input →
+    replicate src's shard over the axis."""
+    g = _resolve(group)
+    arr = _data(tensor)
+    spec = _current_spec(arr, g.mesh)
+    dim = _sharded_dim(spec, g.axis_names)
+    if dim is None:
+        return Task(tensor if isinstance(tensor, Tensor) else Tensor(arr))
+    full = _replicate_over(tensor, g)
+    nparts = _sharding_degree(spec, dim, g.axis_names, g.mesh)
+    parts = jnp.split(full, nparts, axis=dim)
+    data = jnp.concatenate([parts[src]] * nparts, axis=dim)
+    if isinstance(tensor, Tensor):
+        tensor._bump(data)
+        return Task(tensor)
+    return Task(Tensor(data))
+
+
+def scatter(tensor, tensor_list=None, src=0, group: Optional[Group] = None,
+            sync_op: bool = True) -> Task:
+    """Scatter src's list across ranks → dim-0-sharded tensor."""
+    g = _resolve(group)
+    if tensor_list is not None:
+        arr = jnp.concatenate([_data(t) for t in tensor_list], axis=0)
+    else:
+        arr = _data(tensor)
+    axis_entry = g.axis_names if len(g.axis_names) > 1 else g.axis_names[0]
+    sharded = jax.device_put(arr, NamedSharding(g.mesh, P(axis_entry)))
+    if isinstance(tensor, Tensor):
+        tensor._bump(sharded)
+        return Task(tensor)
+    return Task(Tensor(sharded))
+
+
+def alltoall(out_tensor_list, in_tensor_list=None,
+             group: Optional[Group] = None, sync_op: bool = True) -> Task:
+    """AllToAll (reference: communication/all_to_all.py). Stacked form:
+    input [n, ...] sharded(0) — transpose ranks' chunks."""
+    g = _resolve(group)
+    if in_tensor_list is None:
+        in_tensor_list = out_tensor_list
+        out_tensor_list = None
+    if isinstance(in_tensor_list, (list, tuple)):
+        arr = jnp.stack([_data(t) for t in in_tensor_list], axis=0)
+        # rank r receives chunk r from every rank: with identical host-side
+        # lists this is the identity permutation of the stack.
+        outs = [Tensor(arr[i]) for i in range(arr.shape[0])]
+        if out_tensor_list is not None:
+            out_tensor_list.extend(outs)
+            return Task(out_tensor_list)
+        return Task(outs)
+    return alltoall_single(in_tensor_list, group=group)
+
+
+def alltoall_single(tensor, output=None, in_split_sizes=None,
+                    out_split_sizes=None, group: Optional[Group] = None,
+                    sync_op: bool = True) -> Task:
+    """All-to-all on a single tensor: dim0 chunks exchanged across the axis.
+
+    Eager lowering: shard_map + lax.all_to_all over the group axis
+    (reference kernel: alltoall via ncclSend/Recv loop,
+    fluid/operators/collective/alltoall_op.cu.cc).
+    """
+    g = _resolve(group)
+    arr = _data(tensor)
+    axis = g.axis_names[0]
+    spec = P(axis)
+    fn = _shard_map_jit(
+        g.mesh,
+        functools.partial(_a2a_local, axis=axis),
+        spec,
+        spec,
+        cache_key=("a2a", axis),
+    )
+    out = fn(jax.device_put(arr, NamedSharding(g.mesh, P(axis))))
+    if output is not None and isinstance(output, Tensor):
+        output._bump(out)
+        return Task(output)
+    return Task(_wrap_like(tensor, out))
+
+
+def _a2a_local(x, axis):
+    # tiled: split the local dim0 into n chunks, chunk j to rank j, concat
+    # received chunks — exactly alltoall_single (reference alltoall_op).
+    return lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
+
+
+def send(tensor, dst=0, group=None, sync_op: bool = True) -> Task:
+    """P2P send. On ICI there is no host-driven isend (SURVEY.md §7 hard
+    parts); pairwise transfer is a device_put to dst's device (host-mediated
+    on CPU mesh, direct on TPU). Used by the eager PP debug path only — the
+    performant pipeline uses ppermute inside the compiled step.
+
+    Matching model: one host issues both sides, so a send/recv pair is
+    matched by program order per (group, dst) channel; ``recv`` pops the
+    channel named by its ``src``'s outstanding destination. Out-of-order
+    multi-destination patterns must pass ``dst`` to recv (kw-only extension).
+    """
+    g = _resolve(group)
+    devs = g.mesh.devices.reshape(-1)
+    data = jax.device_put(_data(tensor), devs[dst])
+    _P2P_BUF.setdefault(g.id, []).append((dst, data))
+    return Task(tensor)
+
+
+def recv(tensor, src=0, group=None, sync_op: bool = True, dst=None) -> Task:
+    """Receive the oldest pending message (optionally filtered to messages
+    addressed to ``dst``). Strict FIFO keeps single-controller pairings
+    deterministic — matched in the order the sends were issued."""
+    g = _resolve(group)
+    chan = _P2P_BUF.get(g.id, [])
+    for i, (d, data) in enumerate(chan):
+        if dst is None or d == dst:
+            chan.pop(i)
+            if isinstance(tensor, Tensor):
+                tensor._bump(data)
+            return Task(tensor)
+    raise RuntimeError("recv with no matching outstanding send "
+                       f"(group={g.name}, src={src}, dst={dst})")
+
+
+_P2P_BUF: dict = {}
+
+isend = send
+irecv = recv
+
+
+def barrier(group: Optional[Group] = None):
+    """Barrier: block host until all outstanding device work completes."""
+    for d in jax.devices():
+        jax.device_put(jnp.zeros((), jnp.int32), d).block_until_ready()

@@ -1,0 +1,155 @@
+"""Fleet: the hybrid-parallel user facade.
+
+Re-design of python/paddle/distributed/fleet (fleet.py:151 ``init``,
+model.py:32 ``distributed_model``, base/distributed_strategy.py:284).
+``fleet.init(strategy)`` builds the 5-axis hybrid mesh; ``distributed_model``
+wraps by parallel mode (DataParallel / TensorParallel / PipelineParallel /
+ShardingParallel / SegmentParallel) exactly as model.py:142-180 dispatches —
+but each wrapper expresses its parallelism as mesh shardings instead of
+process-group collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..topology import (
+    HYBRID_AXES,
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    ParallelMode,
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+from .. import parallel as _parallel
+from ..parallel import DataParallel
+from .mp_layers import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .random import RNGStatesTracker, get_rng_state_tracker
+from . import meta_parallel
+
+__all__ = [
+    "init",
+    "DistributedStrategy",
+    "get_hybrid_communicate_group",
+    "distributed_model",
+    "distributed_optimizer",
+    "worker_index",
+    "worker_num",
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "VocabParallelEmbedding",
+    "ParallelCrossEntropy",
+    "get_rng_state_tracker",
+    "meta_parallel",
+]
+
+
+class DistributedStrategy:
+    """Distributed knobs (reference: protobuf-backed DistributedStrategy,
+    paddle/fluid/framework/distributed_strategy.proto:105 HybridConfig;
+    python wrapper fleet/base/distributed_strategy.py:284). Plain attrs here
+    — the protobuf indirection served cross-language plumbing we don't have.
+    """
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.pipeline_configs = {
+            "accumulate_steps": 1,
+            "micro_batch_size": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.tensor_parallel_configs = {}
+        self.find_unused_parameters = False
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+_FLEET_STATE = {"initialized": False, "strategy": None}
+
+
+def init(role_maker=None, is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None):
+    """Build the hybrid topology mesh (reference fleet.py:218
+    _init_hybrid_parallel_env → HybridCommunicateGroup)."""
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    dims = {
+        "data": int(hc.get("dp_degree", 1)),
+        "pipe": int(hc.get("pp_degree", 1)),
+        "sharding": int(hc.get("sharding_degree", 1)),
+        "sep": int(hc.get("sep_degree", 1)),
+        "model": int(hc.get("mp_degree", 1)),
+    }
+    topo = CommunicateTopology(HYBRID_AXES, [dims[n] for n in HYBRID_AXES])
+    hcg = HybridCommunicateGroup(topo)
+    set_hybrid_communicate_group(hcg)
+    # Default group spans the whole mesh.
+    from ..collective import Group
+
+    _parallel._DEFAULT_GROUP = Group(hcg.mesh, tuple(hcg.mesh.axis_names),
+                                     gid=0, name="default")
+    _FLEET_STATE["initialized"] = True
+    _FLEET_STATE["strategy"] = strategy
+    return hcg
+
+
+def worker_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def worker_num() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def distributed_model(model):
+    """Wrap by parallel mode (reference fleet/model.py:142-180)."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        init()
+        hcg = get_hybrid_communicate_group()
+    strategy = _FLEET_STATE["strategy"] or DistributedStrategy()
+    mode = hcg.get_parallel_mode()
+    if mode == ParallelMode.PIPELINE_PARALLEL:
+        from .meta_parallel.pipeline_parallel import PipelineParallel
+
+        return PipelineParallel(model, hcg, strategy)
+    if mode in (ParallelMode.TENSOR_PARALLEL, ParallelMode.SEGMENT_PARALLEL):
+        from .meta_parallel.tensor_parallel import TensorParallel
+
+        return TensorParallel(model, hcg, strategy)
+    return DataParallel(model)
+
+
+def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
+    """reference fleet.py distributed_optimizer → HybridParallelOptimizer."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return optimizer
+    from .hybrid_parallel_optimizer import HybridParallelOptimizer
+
+    return HybridParallelOptimizer(optimizer, hcg,
+                                   strategy or _FLEET_STATE["strategy"]
+                                   or DistributedStrategy())

@@ -1,0 +1,52 @@
+"""HybridParallelOptimizer: cross-group-correct optimizer wrapper.
+
+Re-design of fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py:266. The reference's job there is to make
+ClipGradByGlobalNorm and AMP found_inf *match serial semantics* when grads
+are scattered across mp/pp/sharding process groups: it partial-sums the
+grad norm per group and allreduces across groups (:103 _dygraph_clip).
+
+Single-controller translation: every gradient is a **global** array (sharded
+or replicated over the mesh), so a norm computed over it is already the
+global norm — the cross-group allreduce tree is inherent. What remains of
+the wrapper: applying the inner optimizer and keeping the API
+(inner_opt, step/clear_grad passthrough, pipeline hooks).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    @property
+    def inner_opt(self):
+        return self._inner_opt
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self._inner_opt.clear_grad(set_to_zero=set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self._inner_opt.get_lr()
+
+    def set_lr(self, v):
+        return self._inner_opt.set_lr(v)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)

@@ -1,0 +1,13 @@
+"""Meta-parallel model wrappers (reference: fleet/meta_parallel/)."""
+
+from .tensor_parallel import TensorParallel
+from .pipeline_parallel import PipelineParallel
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer
+
+__all__ = [
+    "TensorParallel",
+    "PipelineParallel",
+    "LayerDesc",
+    "SharedLayerDesc",
+    "PipelineLayer",
+]

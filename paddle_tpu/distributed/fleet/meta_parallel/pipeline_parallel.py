@@ -1,0 +1,183 @@
+"""Pipeline-parallel runtime: 1F1B schedule over stage submeshes.
+
+Re-design of fleet/meta_parallel/pipeline_parallel.py (PipelineParallel:245,
+forward_backward_pipeline:565 — warmup/steady/cooldown 1F1B — and
+train_batch:810).
+
+Architectural translation: the reference runs one process per stage with
+NCCL isend/irecv activation exchange (pp_utils/p2p_communication.py). On a
+single-controller TPU slice there are no per-stage processes; stages are
+submeshes and a P2P hop is a resharding transfer (device_put) between
+adjacent submeshes, which XLA routes over ICI neighbours. The host drives
+the same 1F1B order; because XLA dispatch is async, consecutive microbatch
+computations on different stage submeshes overlap in device time — the
+1F1B pipelining effect — while per-microbatch backward bounds live
+activation memory exactly as in the reference.
+
+The fully-compiled pipeline (whole schedule inside one XLA program via
+shard_map + ppermute over the "pp" axis) lives in
+paddle_tpu/parallel/pipeline.py and is what the flagship train step uses;
+this class is the eager/dygraph-parity runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....core.tensor import Tensor
+from ....core import autograd as _autograd
+from ..meta_parallel.pp_layers import PipelineLayer
+
+__all__ = ["PipelineParallel"]
+
+
+class PipelineParallel:
+    def __init__(self, layers: PipelineLayer, hcg, strategy):
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        pc = getattr(strategy, "pipeline_configs", {}) or {}
+        self.accumulate_steps = int(pc.get("accumulate_steps", 1))
+        self.micro_batch_size = int(pc.get("micro_batch_size", 1))
+        self.num_stages = layers.get_num_stages()
+        self.total_loss = None
+
+    # -- helpers ------------------------------------------------------------
+    def _to_stage(self, t: Tensor, s: int) -> Tensor:
+        """P2P hop: reshard activation onto stage s's submesh (the
+        translation of SendRecvMeta+isend/irecv, p2p_communication.py:51)."""
+        mesh = self._layers.stage_mesh(s)
+        if mesh is None:
+            return t
+        from ...autograd_collectives import reshard_op
+
+        spec = getattr(t._data, "sharding", None)
+        # keep dp sharding of the batch dim if present
+        entries = [None] * t.ndim
+        if isinstance(spec, NamedSharding):
+            for d, e in enumerate(spec.spec):
+                if e is not None and d < t.ndim:
+                    names = e if isinstance(e, tuple) else (e,)
+                    kept = tuple(n for n in names if n in mesh.axis_names)
+                    entries[d] = kept if kept else None
+        return reshard_op(t, mesh, P(*entries))
+
+    def _forward_step(self, micro_input, labels=None):
+        x = micro_input
+        for s in range(self.num_stages):
+            x = self._to_stage(x, s)
+            x = self._layers.forward_stage(x, s)
+        if self._layers._loss_fn is not None and labels is not None:
+            return self._layers._loss_fn(x, labels)
+        return x
+
+    def _split_micro(self, data):
+        if isinstance(data, (tuple, list)):
+            xs = [self._split_micro(d) for d in data]
+            return list(zip(*xs))
+        n = self.accumulate_steps
+        b = data.shape[0]
+        if b % n != 0:
+            raise ValueError(f"batch {b} not divisible by accumulate_steps {n}")
+        import jax.numpy as jnp
+
+        arr = data._data if isinstance(data, Tensor) else jnp.asarray(data)
+        sg = data.stop_gradient if isinstance(data, Tensor) else True
+        return [Tensor(arr[i * (b // n):(i + 1) * (b // n)], stop_gradient=sg)
+                for i in range(n)]
+
+    # -- the schedule --------------------------------------------------------
+    def forward_backward_pipeline(self, data, scaler=None):
+        """1F1B (reference :565): warmup forwards, steady 1F1B, cooldown
+        backwards. Host-side buffering mirrors the reference's input/output
+        queues; backward of microbatch k frees its activations."""
+        inputs, labels = data if isinstance(data, tuple) and len(data) == 2 \
+            else (data, None)
+        micro_inputs = self._split_micro(inputs)
+        micro_labels = self._split_micro(labels) if labels is not None else \
+            [None] * self.accumulate_steps
+
+        n = self.accumulate_steps
+        # On a single controller every "stage rank" is driven by one host;
+        # the interleave degree is the stage count.
+        warmup = min(self.num_stages, n)
+        pending = []  # losses awaiting backward
+        total = None
+        k_fwd = 0
+        for _ in range(warmup):
+            loss = self._forward_step(micro_inputs[k_fwd], micro_labels[k_fwd])
+            pending.append(loss)
+            k_fwd += 1
+        while k_fwd < n or pending:
+            if pending:
+                loss = pending.pop(0)
+                scaled = loss.scale(1.0 / n)
+                if scaler is not None:
+                    scaler.scale(scaled).backward()
+                else:
+                    scaled.backward()
+                total = loss.detach() if total is None else total + loss.detach()
+            if k_fwd < n:
+                loss = self._forward_step(micro_inputs[k_fwd], micro_labels[k_fwd])
+                pending.append(loss)
+                k_fwd += 1
+        self.total_loss = total.scale(1.0 / n) if total is not None else None
+        return self.total_loss
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """reference :810: run the schedule then a global optimizer step."""
+        loss = self.forward_backward_pipeline(data, scaler=scaler)
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        inputs, labels = data if isinstance(data, tuple) and len(data) == 2 \
+            else (data, None)
+        micro_inputs = self._split_micro(inputs)
+        micro_labels = self._split_micro(labels) if labels is not None else \
+            [None] * self.accumulate_steps
+        outs = []
+        with _autograd.no_grad():
+            for mi, ml in zip(micro_inputs, micro_labels):
+                outs.append(self._forward_step(mi, ml if compute_loss else None))
+        if compute_loss:
+            total = outs[0]
+            for o in outs[1:]:
+                total = total + o
+            return total.scale(1.0 / len(outs))
+        return outs
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._layers, name)

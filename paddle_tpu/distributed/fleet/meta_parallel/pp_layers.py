@@ -1,0 +1,272 @@
+"""Pipeline layer partitioning: LayerDesc / SegmentLayers / PipelineLayer.
+
+Re-design of fleet/meta_parallel/parallel_layers/pp_layers.py
+(LayerDesc:56, SegmentLayers:92, PipelineLayer:257). The reference builds
+only the local stage's layers per process. Single-controller TPU builds
+*all* stages, then pins each stage's parameters onto that stage's submesh
+(the pp slice of the hybrid mesh) — so stage i's compute and memory live on
+stage i's devices exactly as in the reference, but placement is data, not
+process identity.
+
+Shared layers (tied embeddings): the reference allreduces shared-weight
+grads across owning stages (pipeline_parallel.py:740). Here a shared weight
+is one logical array placed on the union submesh; XLA reduces its grads
+automatically.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ....nn.layer.layers import Layer
+from ...topology import get_hybrid_communicate_group
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer"]
+
+
+class LayerDesc:
+    """Deferred layer construction (reference pp_layers.py:56)."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer) and not callable(layer_func):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({getattr(self.layer_func, '__name__', self.layer_func)})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Layer shared between stages, e.g. tied embeddings
+    (reference pp_layers.py:77)."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Partition N layer descs into num_parts stages
+    (reference pp_layers.py:92): uniform by count, or by named-layer
+    boundaries, or a user-provided seg_method list."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform",
+                 num_virtual_pipeline_stage=None):
+        self._layers_desc = layers_desc
+        self.method = method
+        self.num_parts = num_parts
+        self.num_items = len(layers_desc)
+        if num_virtual_pipeline_stage:
+            self.total_parts = num_parts * num_virtual_pipeline_stage
+        else:
+            self.total_parts = num_parts
+        if self.num_items < self.total_parts:
+            raise ValueError("layer number should be greater than number of "
+                             "segments")
+
+    def do_segment(self) -> list[int]:
+        if isinstance(self.method, list):
+            seg = list(self.method)
+            if seg[0] != 0:
+                seg.insert(0, 0)
+            if seg[-1] != self.num_items:
+                seg.append(self.num_items)
+            return seg
+        if self.method == "uniform":
+            return self.uniform(self.num_items, self.total_parts)
+        if self.method.startswith("layer:"):
+            # Cut so each segment holds an equal share of the named layers
+            # (reference: seg by regex match on layer class name).
+            name = self.method.split(":", 1)[1]
+            weights = [0] * self.num_items
+            for i, d in enumerate(self._layers_desc):
+                layer_name = (d.layer_func.__name__
+                              if isinstance(d, LayerDesc) else
+                              d.__class__.__name__)
+                if re.search(name, layer_name):
+                    weights[i] = 1
+            total = sum(weights)
+            if total % self.total_parts != 0 and total < self.total_parts:
+                raise ValueError(f"only {total} '{name}' layers for "
+                                 f"{self.total_parts} segments")
+            result = [0] * (self.total_parts + 1)
+            memory_counter, seg_idx = 0, 1
+            target = total / self.total_parts
+            for i, w in enumerate(weights):
+                memory_counter += w
+                if memory_counter >= target * seg_idx - 1e-6 and w:
+                    result[seg_idx] = i + 1
+                    seg_idx += 1
+                    if seg_idx == self.total_parts:
+                        break
+            result[self.total_parts] = self.num_items
+            for i in range(1, self.total_parts + 1):
+                if result[i] == 0:
+                    result[i] = result[i - 1]
+            return result
+        raise ValueError(f"unknown seg method {self.method}")
+
+    @staticmethod
+    def uniform(num_items, num_parts) -> list[int]:
+        result = [0] * (num_parts + 1)
+        part_size = num_items // num_parts
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + part_size + (1 if i <= extra else 0)
+        return result
+
+
+class PipelineLayer(Layer):
+    """A model given as a LayerDesc list, partitioned into pp stages
+    (reference pp_layers.py:257).
+
+    Each stage's parameters are placed on the stage's pp-slice submesh;
+    ``stage_mesh(i)`` exposes it for the runtime's activation transfers.
+    ``loss_fn`` is applied by the pipeline runtime after the last stage.
+    """
+
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method="uniform",
+                 recompute_interval: int = 0, num_virtual_pipeline_stages=None,
+                 hcg=None):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._hcg = hcg or get_hybrid_communicate_group()
+        if num_stages is None:
+            if self._hcg is None:
+                raise ValueError("num_stages or an initialized hybrid "
+                                 "topology is required")
+            num_stages = self._hcg.get_pipe_parallel_world_size()
+        self._num_stages = int(num_stages)
+
+        seg = SegmentLayers(self._layers_desc, self._num_stages,
+                            method=seg_method)
+        self.segment_parts = seg.do_segment()
+
+        self._stage_meshes = self._build_stage_meshes()
+        self._stage_layers: list[list[Layer]] = []
+        self._shared_layers: dict[str, Layer] = {}
+        self.run_function: list = []
+        for s in range(self._num_stages):
+            built = []
+            for i in range(self.segment_parts[s], self.segment_parts[s + 1]):
+                desc = self._layers_desc[i]
+                if isinstance(desc, SharedLayerDesc):
+                    if desc.layer_name not in self._shared_layers:
+                        self._shared_layers[desc.layer_name] = desc.build_layer()
+                    lyr = self._shared_layers[desc.layer_name]
+                    fwd = desc.forward_func
+                    if fwd is not None:
+                        shared = lyr
+
+                        class _SharedFwd(Layer):
+                            def __init__(self, inner, fn):
+                                super().__init__()
+                                self.inner = inner
+                                self._fn = fn
+
+                            def forward(self, x):
+                                return self._fn(self.inner, x)
+
+                        lyr = _SharedFwd(shared, fwd)
+                elif isinstance(desc, LayerDesc):
+                    lyr = desc.build_layer()
+                elif isinstance(desc, Layer):
+                    lyr = desc
+                elif callable(desc):
+                    # plain functions (e.g. reshape lambdas) are allowed
+                    built.append(desc)
+                    self.run_function.append(desc)
+                    continue
+                else:
+                    raise TypeError(f"bad layer desc {desc!r}")
+                self.add_sublayer(f"stage{s}_{len(built)}", lyr)
+                built.append(lyr)
+                self.run_function.append(lyr)
+            self._stage_layers.append(built)
+            self._place_stage_params(s)
+
+    # -- placement ----------------------------------------------------------
+    def _build_stage_meshes(self) -> list[Mesh]:
+        if self._hcg is None:
+            return [None] * self._num_stages
+        mesh = self._hcg.mesh
+        pp_axis = mesh.axis_names.index("pp")
+        meshes = []
+        for s in range(self._num_stages):
+            devs = np.take(mesh.devices, s, axis=pp_axis)
+            names = tuple(n for n in mesh.axis_names if n != "pp")
+            meshes.append(Mesh(devs, names))
+        return meshes
+
+    def stage_mesh(self, s: int) -> Mesh:
+        return self._stage_meshes[s]
+
+    def _place_stage_params(self, s: int):
+        mesh = self._stage_meshes[s]
+        if mesh is None:
+            return
+        shared_ids = {id(p) for lyr in self._shared_layers.values()
+                      for p in lyr.parameters()}
+        for lyr in self._stage_layers[s]:
+            if not isinstance(lyr, Layer):
+                continue
+            for p in lyr.parameters():
+                if id(p) in shared_ids:
+                    continue  # shared weights stay on their union placement
+                spec = getattr(p, "_dist_spec", None)
+                if spec is None:
+                    spec = P()
+                else:
+                    # Drop pp references (the stage submesh has no pp axis);
+                    # keep mp/sharding entries.
+                    entries = []
+                    for e in spec:
+                        if e is None:
+                            entries.append(None)
+                            continue
+                        names = e if isinstance(e, tuple) else (e,)
+                        kept = tuple(n for n in names if n != "pp")
+                        entries.append(kept if kept else None)
+                    spec = P(*entries)
+                p._bump(jax.device_put(p._data, NamedSharding(mesh, spec)))
+
+    # -- info ---------------------------------------------------------------
+    def get_num_stages(self) -> int:
+        return self._num_stages
+
+    def stage_layers(self, s: int):
+        return self._stage_layers[s]
+
+    def get_stage_from_index(self, layer_idx: int) -> int:
+        for s in range(self._num_stages):
+            if self.segment_parts[s] <= layer_idx < self.segment_parts[s + 1]:
+                return s
+        raise ValueError(layer_idx)
+
+    def forward_stage(self, x, s: int):
+        for lyr in self._stage_layers[s]:
+            x = lyr(x)
+        return x
+
+    def forward(self, x):
+        """Full serial forward (debug / single-stage path)."""
+        for s in range(self._num_stages):
+            x = self.forward_stage(x, s)
+        return x

@@ -1,0 +1,50 @@
+"""TensorParallel model wrapper.
+
+Re-design of fleet/meta_parallel/tensor_parallel.py: the reference
+broadcasts parameters across the mp group at wrap time and syncs
+non-distributed params' grads. Here wrap time annotates every
+non-mp-sharded parameter as replicated over the mesh, which gives both
+behaviors for free (one logical copy; grads of replicated params are
+reduced by XLA's sharding propagation inside the step).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class TensorParallel:
+    def __init__(self, layers, hcg, strategy=None):
+        self._layers = layers
+        self._hcg = hcg
+        mesh = hcg.mesh
+        for p in layers.parameters():
+            sh = getattr(p._data, "sharding", None)
+            if not (isinstance(sh, NamedSharding) and sh.mesh == mesh):
+                p._bump(jax.device_put(p._data, NamedSharding(mesh, P())))
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    __call__ = forward
+
+    def __getattr__(self, name):
+        return getattr(self._layers, name)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self

@@ -1,0 +1,144 @@
+"""Tensor-parallel (model-parallel) layers.
+
+Re-design of python/paddle/distributed/fleet/layers/mpu/mp_layers.py
+(VocabParallelEmbedding:47, ColumnParallelLinear:334, RowParallelLinear:541,
+ParallelCrossEntropy:742).
+
+Architectural translation: the reference materialises *local* weight shards
+per process and calls explicit collectives (identity/allreduce PyLayers,
+mp_ops.py). On TPU each layer keeps the **global** parameter annotated with
+a NamedSharding over the "mp" mesh axis; XLA partitions the matmul onto the
+MXU per device and inserts the matching ICI collectives (all-reduce for the
+row-parallel contraction, all-gather only where gather_output asks for it).
+The math and comm volume match Megatron exactly; the code is ~10x smaller
+because partitioning is declarative.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer.layers import Layer
+from ..topology import get_hybrid_communicate_group
+
+__all__ = [
+    "VocabParallelEmbedding",
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "ParallelCrossEntropy",
+]
+
+
+def _mp_mesh():
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        raise RuntimeError("fleet.init(strategy) must run before building "
+                           "tensor-parallel layers")
+    return hcg.mesh
+
+
+def _shard_param(param, spec: P):
+    """Annotate a parameter with an mp sharding (in place)."""
+    mesh = _mp_mesh()
+    param._bump(jax.device_put(param._data, NamedSharding(mesh, spec)))
+    param.is_distributed = True
+    param._dist_spec = spec
+    return param
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over mp
+    (reference mp_layers.py:47: per-rank vocab range + mask + allreduce).
+    GSPMD partitions the gather and emits the same collective."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.XavierNormal(),
+        )
+        _shard_param(self.weight, P("mp", None))
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with out_features sharded over mp (reference mp_layers.py:334).
+
+    ``gather_output=True`` reshards the output to replicated (all-gather);
+    False leaves it mp-sharded for a following RowParallelLinear — zero
+    comm between the pair, as in Megatron.
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal(),
+        )
+        _shard_param(self.weight, P(None, "mp"))
+        if has_bias:
+            self.bias = self.create_parameter(
+                [out_features], attr=None, is_bias=True)
+            _shard_param(self.bias, P("mp"))
+        else:
+            self.bias = None
+        self.gather_output = gather_output
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            from ..autograd_collectives import gather_axis
+
+            y = gather_axis(y, _mp_mesh(), y.ndim - 1)
+        return y
+
+
+class RowParallelLinear(Layer):
+    """Linear with in_features sharded over mp (reference mp_layers.py:541).
+    The contraction over the sharded dim yields an XLA all-reduce —
+    the explicit allreduce PyLayer of the reference."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal(),
+        )
+        _shard_param(self.weight, P("mp", None))
+        if has_bias:
+            self.bias = self.create_parameter(
+                [out_features], attr=None, is_bias=True)
+        else:
+            self.bias = None
+        self.input_is_parallel = input_is_parallel
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax CE on class-dim-sharded logits (reference mp_layers.py:742 →
+    c_softmax_with_cross_entropy kernel: local max/sum + allreduce).
+    GSPMD derives the same pattern from the sharded reductions."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)

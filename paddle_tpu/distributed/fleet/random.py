@@ -1,0 +1,84 @@
+"""Model-parallel RNG state tracking.
+
+Re-design of python/paddle/distributed/fleet/layers/mpu/random.py:34
+(``RNGStatesTracker``): the reference must keep distinct per-rank seeds for
+dropout on sharded activations and identical seeds for replicated ones,
+switching via ``get_rng_state_tracker().rng_state("local_seed")``.
+
+On TPU there is one logical SPMD program: XLA generates random bits per
+*logical position*, so sharded activations automatically get distinct bits
+per shard and replicated ones identical bits — the exact invariant the
+tracker enforces by hand. The class is kept for ported-code parity and for
+deterministic named streams.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from ...core import random as _random
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed"]
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = int(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "model_parallel_rng"):
+        if name not in self.states_:
+            # Lazily derive a named stream from the name — stable hash so
+            # every run and every host derives the same seed (a randomized
+            # str hash would silently diverge multi-host SPMD programs).
+            import zlib
+
+            self.add(name, zlib.crc32(name.encode()) % (2**31))
+        state = self.states_[name]
+        if isinstance(state, int):
+            import jax
+
+            state = jax.random.PRNGKey(state)
+        orig = _random.get_state()
+        _random.set_state(state)
+        try:
+            yield
+        finally:
+            self.states_[name] = _random.get_state()
+            _random.set_state(orig)
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _TRACKER
+
+
+def model_parallel_random_seed(seed: int = 2024):
+    """reference random.py model_parallel_random_seed: derive
+    global/local/mp seeds. Single logical program → one base seed."""
+    _TRACKER.reset()
+    _random.seed(seed)
+    _TRACKER.add("global_seed", seed)
+    _TRACKER.add("local_seed", seed + 1024)

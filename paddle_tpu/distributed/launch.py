@@ -1,0 +1,75 @@
+"""Distributed launcher: ``python -m paddle_tpu.distributed.launch train.py``.
+
+Re-design of python/paddle/distributed/launch (main.py:23, collective
+controller launch/controllers/collective.py:75-236). The reference spawns
+one process per GPU and wires PADDLE_TRAINER_ENDPOINTS / PADDLE_MASTER env
+for NCCL rendezvous. On TPU one process drives all local chips, so the
+per-device process fan-out disappears; what remains is **multi-host**
+bring-up: initialise the jax coordination service (the TCPStore equivalent,
+phi/core/distributed/store/tcp_store.h:121) from the same env contract,
+then exec the training script.
+
+Env contract honored (reference collective.py:75-236):
+  PADDLE_MASTER / MASTER_ADDR:PORT → coordinator address
+  PADDLE_TRAINERS_NUM / NNODES     → num_processes
+  PADDLE_TRAINER_ID / NODE_RANK    → process_id
+"""
+
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+__all__ = ["main", "init_from_env"]
+
+
+def init_from_env() -> bool:
+    """Initialise jax.distributed from the launcher env. Returns True if a
+    multi-host setup was detected and initialised."""
+    master = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    nnodes = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                os.environ.get("NNODES", "1")))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID",
+                              os.environ.get("NODE_RANK", "0")))
+    if nnodes <= 1 or not master:
+        return False
+    if ":" not in master:
+        port = os.environ.get("MASTER_PORT", "8090")
+        master = f"{master}:{port}"
+    import jax
+
+    jax.distributed.initialize(coordinator_address=master,
+                               num_processes=nnodes, process_id=rank)
+    return True
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    args = list(argv)
+    if not args:
+        print("usage: python -m paddle_tpu.distributed.launch [--nnodes N] "
+              "[--master HOST:PORT] [--rank R] script.py [script args...]",
+              file=sys.stderr)
+        return 2
+    # minimal flag parsing: flags before the script path
+    while args and args[0].startswith("--"):
+        flag = args.pop(0).lstrip("-")
+        if "=" in flag:
+            flag, value = flag.split("=", 1)
+        else:
+            value = args.pop(0)
+        env_key = {"nnodes": "PADDLE_TRAINERS_NUM",
+                   "master": "PADDLE_MASTER",
+                   "rank": "PADDLE_TRAINER_ID"}.get(flag)
+        if env_key:
+            os.environ[env_key] = value
+    script, script_args = args[0], args[1:]
+    init_from_env()
+    sys.argv = [script] + script_args
+    runpy.run_path(script, run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,113 @@
+"""Placement algebra for distributed tensors.
+
+TPU-native re-design of the reference's auto-parallel placement types
+(paddle/phi/core/distributed/auto_parallel/placement_types.h and
+dist_attr.h:81 ``TensorDistAttr``): a tensor's distribution over a
+``ProcessMesh`` is one placement per mesh axis — ``Shard(dim)``,
+``Replicate()`` or ``Partial(op)``.
+
+On TPU, Shard/Replicate lower directly to a ``jax.sharding.PartitionSpec``;
+``Partial`` (a pending cross-device reduction) has no XLA array-level
+representation, so eager tensors carry it as an *unreduced leading stack
+axis* (see distributed/collective.py) while traced code keeps it implicit
+until a ``reshard``/collective materialises the reduction.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec
+
+__all__ = ["Placement", "Shard", "Replicate", "Partial", "to_partition_spec"]
+
+
+class Placement:
+    def is_shard(self, dim=None) -> bool:
+        return False
+
+    def is_replicate(self) -> bool:
+        return False
+
+    def is_partial(self) -> bool:
+        return False
+
+
+class Shard(Placement):
+    """Tensor dim ``dim`` is split across this mesh axis."""
+
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def get_dim(self) -> int:
+        return self.dim
+
+    def is_shard(self, dim=None) -> bool:
+        return dim is None or dim == self.dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate(Placement):
+    """Tensor is fully replicated across this mesh axis."""
+
+    def is_replicate(self) -> bool:
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    """Each device along this axis holds a partial reduction term.
+
+    ``reduce_type`` in {"sum", "avg", "max", "min"} (reference:
+    phi/core/distributed/auto_parallel/dist_attr.h partial_status).
+    """
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self) -> bool:
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("Partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+def to_partition_spec(placements, mesh_axis_names, ndim: int) -> PartitionSpec:
+    """Lower a per-mesh-axis placement list to a ``PartitionSpec``.
+
+    Mirrors the reference's dims_mapping computation
+    (auto_parallel/dist_attr: placements -> dims_mapping) but targets
+    GSPMD: spec entry per *tensor dim* naming the mesh axes it is split on.
+    Partial placements contribute nothing to the spec (caller handles them).
+    """
+    entries: list = [None] * ndim
+    for axis_name, p in zip(mesh_axis_names, placements):
+        if isinstance(p, Shard):
+            d = p.dim % ndim
+            if entries[d] is None:
+                entries[d] = [axis_name]
+            else:
+                entries[d].append(axis_name)
+    while entries and entries[-1] is None:
+        entries.pop()
+    return PartitionSpec(*(tuple(e) if e else None for e in entries))

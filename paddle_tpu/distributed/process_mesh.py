@@ -1,0 +1,140 @@
+"""ProcessMesh: the n-d logical device mesh.
+
+Re-design of the reference's ``ProcessMesh``
+(paddle/phi/core/distributed/auto_parallel/process_mesh.h:34 and
+python/paddle/distributed/auto_parallel/process_mesh.py:85). On TPU a
+process mesh *is* a ``jax.sharding.Mesh``: axes map to ICI dimensions, and
+collectives over an axis ride ICI links. Where the reference keeps a list of
+global ranks per mesh, here device ordering comes from
+``mesh_utils.create_device_mesh`` so that adjacent mesh coordinates are
+ICI neighbours.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+__all__ = ["ProcessMesh", "get_mesh", "set_mesh", "build_mesh"]
+
+_GLOBAL_MESH: Optional["ProcessMesh"] = None
+
+
+def build_mesh(shape: Sequence[int], axis_names: Sequence[str], devices=None) -> Mesh:
+    """Create a ``jax.sharding.Mesh`` with ICI-friendly device order."""
+    shape = tuple(int(s) for s in shape)
+    if devices is None:
+        n = int(np.prod(shape))
+        avail = jax.devices()
+        if n > len(avail):
+            raise ValueError(
+                f"mesh shape {shape} needs {n} devices, have {len(avail)}"
+            )
+        try:
+            dmesh = mesh_utils.create_device_mesh(shape, devices=avail[:n])
+        except Exception:
+            dmesh = np.array(avail[:n]).reshape(shape)
+    else:
+        dmesh = np.asarray(devices).reshape(shape)
+    return Mesh(dmesh, tuple(axis_names))
+
+
+class ProcessMesh:
+    """An n-d mesh of devices with named axes.
+
+    Unlike the reference (which identifies devices by global trainer rank,
+    process_mesh.py:85), devices here are jax device objects; ``process_ids``
+    is kept for API parity.
+    """
+
+    def __init__(
+        self,
+        mesh=None,
+        dim_names: Optional[Sequence[str]] = None,
+        shape: Optional[Sequence[int]] = None,
+    ):
+        if isinstance(mesh, Mesh):
+            self._jax_mesh = mesh
+            self._shape = tuple(mesh.devices.shape)
+            self._dim_names = tuple(mesh.axis_names)
+        else:
+            if mesh is not None:
+                arr = np.asarray(mesh)
+                shape = arr.shape
+            if shape is None:
+                raise ValueError("ProcessMesh needs `mesh` (array of ids) or `shape`")
+            shape = tuple(int(s) for s in shape)
+            if dim_names is None:
+                dim_names = [f"d{i}" for i in range(len(shape))]
+            self._shape = shape
+            self._dim_names = tuple(dim_names)
+            self._jax_mesh = build_mesh(shape, self._dim_names)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return list(range(int(np.prod(self._shape))))
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._shape[self._dim_names.index(dim_name)]
+
+    def get_mesh_with_dim(self, dim_name: str, index=None):
+        """Sub-mesh views; parity with reference process_mesh.py."""
+        axis = self._dim_names.index(dim_name)
+        names = [n for i, n in enumerate(self._dim_names) if i != axis]
+        devices = np.moveaxis(self._jax_mesh.devices, axis, 0)
+        if index is None:
+            # Reorder so dim_name is leading.
+            reordered = Mesh(
+                devices, (dim_name,) + tuple(names)
+            )
+            return ProcessMesh(reordered)
+        return ProcessMesh(Mesh(devices[index], tuple(names)))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and other._shape == self._shape
+            and other._dim_names == self._dim_names
+        )
+
+    def __hash__(self):
+        return hash((self._shape, self._dim_names))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={list(self._shape)}, dim_names={list(self._dim_names)})"
+
+
+def set_mesh(mesh) -> None:
+    global _GLOBAL_MESH
+    if isinstance(mesh, Mesh):
+        mesh = ProcessMesh(mesh)
+    _GLOBAL_MESH = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _GLOBAL_MESH

@@ -1,0 +1,179 @@
+"""Hybrid-parallel topology: the 5-D logical mesh.
+
+Re-design of the reference's ``CommunicateTopology``/``HybridCommunicateGroup``
+(python/paddle/distributed/fleet/base/topology.py:70,189): axis order
+outer→inner is data, pipe, sharding, sep, model — kept identical so
+DistributedStrategy configs port over. The NCCL-group construction
+(cartesian enumeration per axis, topology.py:346) disappears: each axis of a
+``jax.sharding.Mesh`` *is* the communicator, and XLA maps axis-neighbour
+collectives onto ICI rings. ``Group`` objects per axis are provided for API
+parity and eager collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from jax.sharding import Mesh
+
+from .collective import Group
+from .process_mesh import build_mesh
+
+__all__ = [
+    "CommunicateTopology",
+    "HybridCommunicateGroup",
+    "ParallelMode",
+    "get_hybrid_communicate_group",
+    "set_hybrid_communicate_group",
+]
+
+# Axis order must match reference fleet/base/topology.py:73-79.
+HYBRID_AXES = ("data", "pipe", "sharding", "sep", "model")
+# Short names used in sharding specs.
+AXIS_ALIAS = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+              "sep": "sep", "model": "mp"}
+
+
+class ParallelMode:
+    """reference: fleet/base/topology.py:40."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=HYBRID_AXES,
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(int(d) for d in dims)
+        self._world_size = int(np.prod(self._dims))
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    def world_size(self):
+        return self._world_size
+
+    def get_dim_size(self, axis_name):
+        return self.get_dim(axis_name)
+
+
+class HybridCommunicateGroup:
+    """Holds the global mesh and per-axis Groups.
+
+    Mesh axis names use the short aliases ("dp","pp","sharding","sep","mp")
+    — these are the names layer code writes in PartitionSpecs.
+    """
+
+    def __init__(self, topology: CommunicateTopology, devices=None):
+        self._topo = topology
+        dims = [topology.get_dim(n) for n in HYBRID_AXES]
+        self._dp_degree, self._pp_degree, self._sharding_degree, \
+            self._sep_degree, self._mp_degree = dims
+        axis_names = tuple(AXIS_ALIAS[n] for n in HYBRID_AXES)
+        self._mesh: Mesh = build_mesh(dims, axis_names, devices=devices)
+        self._groups = {
+            a: Group(self._mesh, (a,), gid=i, name=f"{a}_group")
+            for i, a in enumerate(axis_names)
+        }
+        # Check group spanning dp(+pp+sharding) for global grad-norm clip /
+        # AMP found_inf (reference topology.py:240 _set_check_group).
+        self._check_group = Group(
+            self._mesh, ("dp", "pp", "sharding"), gid=100, name="check_group")
+
+    # -- degrees ------------------------------------------------------------
+    def get_data_parallel_world_size(self):
+        return self._dp_degree * self._sharding_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # -- groups -------------------------------------------------------------
+    def get_data_parallel_group(self) -> Group:
+        return self._groups["dp"]
+
+    def get_model_parallel_group(self) -> Group:
+        return self._groups["mp"]
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._groups["pp"]
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._groups["sep"]
+
+    def get_check_parallel_group(self, sharding_new_group=False) -> Group:
+        return self._check_group
+
+    # -- ranks: single-controller SPMD has no per-process rank; expose 0 and
+    # keep the querying surface for ported user code. In-trace rank =
+    # lax.axis_index(axis).
+    def get_global_rank(self):
+        return 0
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    def get_parallel_mode(self):
+        if self._mp_degree == 1 and self._pp_degree == 1 \
+                and self._sharding_degree == 1:
+            return ParallelMode.DATA_PARALLEL
+        if self._sharding_degree > 1 and self._mp_degree == 1 \
+                and self._pp_degree == 1:
+            return ParallelMode.SHARDING_PARALLEL
+        if self._pp_degree > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        return ParallelMode.TENSOR_PARALLEL
+
+    def __repr__(self):
+        return (f"HybridCommunicateGroup(dp={self._dp_degree}, "
+                f"pp={self._pp_degree}, sharding={self._sharding_degree}, "
+                f"sep={self._sep_degree}, mp={self._mp_degree})")
+
+
+_HCG: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _HCG
+    _HCG = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _HCG

@@ -111,19 +111,29 @@ class Optimizer:
         self._step_count += 1
         ctx = self._ctx()
 
-        # Bucket the whole update into one jitted call over stacked pytrees.
-        params = [p for p, _ in params_grads]
-        grads = [g._data for _, g in params_grads]
-        datas = [p._data for p in params]
-        states = [self._get_state(p) for p in params]
-        lrs = [lr * self._param_lr(p) for p in params]
-        wds = [self._effective_wd(p) for p in params]
+        # One jitted call per device set: params on the same devices (e.g. a
+        # pipeline stage's submesh) update in one fused XLA program; a single
+        # program over all params would be rejected by jit when stages pin
+        # their params to disjoint submeshes.
+        buckets: dict = {}
+        for p, g in params_grads:
+            key = getattr(p._data, "sharding", None)
+            key = tuple(sorted(d.id for d in key.device_set)) if key is not None \
+                else None
+            buckets.setdefault(key, []).append((p, g))
 
         update = self._jitted_update()
-        new_datas, new_states = update(datas, grads, states, lrs, wds, ctx)
-        for p, nd, ns in zip(params, new_datas, new_states):
-            p._bump(nd)
-            self._accumulators[id(p)] = ns
+        for group in buckets.values():
+            params = [p for p, _ in group]
+            grads = [g._data for _, g in group]
+            datas = [p._data for p in params]
+            states = [self._get_state(p) for p in params]
+            lrs = [lr * self._param_lr(p) for p in params]
+            wds = [self._effective_wd(p) for p in params]
+            new_datas, new_states = update(datas, grads, states, lrs, wds, ctx)
+            for p, nd, ns in zip(params, new_datas, new_states):
+                p._bump(nd)
+                self._accumulators[id(p)] = ns
 
     def _effective_wd(self, p) -> float:
         wd = self._weight_decay
