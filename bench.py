@@ -1,0 +1,106 @@
+"""Benchmark: flagship GPT training throughput on the local chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md), so the north star is
+absolute: tokens/sec/chip and MFU on GPT-3-family configs, target >=50% MFU
+(BASELINE.json). ``vs_baseline`` reports MFU / 0.50 — progress toward that
+target; >1.0 beats it.
+
+MFU accounting (standard matmul-only): flops/token = 6*P_dense (+ causal
+attention term 6*L*S*H), peak from the device kind table.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# bf16 peak matmul TFLOPS per chip by device kind (public specs).
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,   # v6e (Trillium)
+    "TPU v6e": 918e12,
+    "cpu": 1e12,             # nominal, CI fallback
+}
+
+
+def _peak_flops() -> float:
+    kind = jax.devices()[0].device_kind
+    for k, v in PEAK_FLOPS.items():
+        if kind.lower().startswith(k.lower()):
+            return v
+    return PEAK_FLOPS.get(kind, 1e12)
+
+
+def main():
+    from paddle_tpu.models.gpt import GPTConfig, gpt_presets
+    from paddle_tpu.parallel import make_sharded_train_step
+    from paddle_tpu.distributed.process_mesh import build_mesh
+
+    on_tpu = "tpu" in jax.devices()[0].platform.lower() or \
+        "TPU" in jax.devices()[0].device_kind
+    if on_tpu:
+        cfg = gpt_presets("gpt3-350m")
+        batch, steps, warmup = 8, 20, 3
+    else:  # CI / CPU smoke: tiny model, still exercises the full path
+        cfg = GPTConfig(vocab_size=1024, hidden=256, n_layers=4, n_heads=4,
+                        seq_len=256)
+        batch, steps, warmup = 4, 5, 1
+
+    n_dev = len(jax.devices())
+    mesh = build_mesh((n_dev, 1, 1), ("dp", "pp", "mp"))
+    step, params, opt_state = make_sharded_train_step(
+        cfg, mesh, lr=1e-4, n_microbatches=1, zero1=n_dev > 1)
+
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, cfg.vocab_size, size=(batch, cfg.seq_len))
+    labs = rng.randint(0, cfg.vocab_size, size=(batch, cfg.seq_len))
+
+    for _ in range(warmup):
+        loss, params, opt_state = step(params, opt_state, toks, labs)
+    float(loss)  # full fetch: block_until_ready is unreliable over remote
+    # device tunnels, a value fetch is not
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, params, opt_state = step(params, opt_state, toks, labs)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = batch * cfg.seq_len * steps
+    tok_per_sec_chip = tokens / dt / n_dev
+
+    # dense params (matmul-visible): embeddings + blocks
+    H, L, S, V, F = (cfg.hidden, cfg.n_layers, cfg.seq_len, cfg.vocab_size,
+                     cfg.ffn_mult * cfg.hidden)
+    p_dense = V * H + L * (4 * H * H + 2 * H * F) + (0 if cfg.tie_embeddings
+                                                    else H * V)
+    flops_per_token = 6 * p_dense + 6 * L * S * H  # + causal attention
+    mfu = flops_per_token * tok_per_sec_chip / _peak_flops()
+
+    print(json.dumps({
+        "metric": "gpt3_350m_train_tokens_per_sec_per_chip" if on_tpu
+        else "gpt_tiny_cpu_tokens_per_sec",
+        "value": round(tok_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.50, 4),
+        "mfu": round(mfu, 4),
+        "step_ms": round(dt / steps * 1000, 2),
+        "loss": round(float(loss), 4),
+        "device": jax.devices()[0].device_kind,
+        "n_devices": n_dev,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -55,27 +55,10 @@ def _named_sharding(mesh, placements, ndim, shape=None) -> NamedSharding:
     jmesh = _as_jax_mesh(mesh)
     spec = to_partition_spec(placements, jmesh.axis_names, ndim)
     if shape is not None:
-        spec = _sanitize_spec(spec, shape, jmesh)
+        from .placement import sanitize_spec
+
+        spec = sanitize_spec(spec, shape, jmesh)
     return NamedSharding(jmesh, spec)
-
-
-def _sanitize_spec(spec, shape, jmesh):
-    """Drop shard entries whose dim isn't divisible by the axis product.
-
-    The reference pads uneven shards inside its reshard functions
-    (s_to_r_reshard_function.cc padding-aware path); GSPMD requires even
-    tiles for device_put, so non-divisible dims stay replicated — same
-    numerics, costs a broadcast.
-    """
-    entries = []
-    for d, entry in enumerate(spec):
-        if entry is None:
-            entries.append(None)
-            continue
-        names = entry if isinstance(entry, tuple) else (entry,)
-        prod = int(np.prod([jmesh.shape[n] for n in names]))
-        entries.append(entry if shape[d] % prod == 0 else None)
-    return P(*entries)
 
 
 def shard_tensor(data, mesh, placements: Sequence[Placement],
@@ -236,8 +219,8 @@ def shard_optimizer(optimizer, shard_fn=None):
         from .sharding import apply_zero_sharding
 
         apply_zero_sharding(optimizer, shard_fn)
-        return optimizer
-    optimizer._follow_param_sharding = True
+    # Stage 0 ("follow the parameter's sharding") is inherent: moments are
+    # created with jnp.zeros_like(param), which preserves the sharding.
     return optimizer
 
 

@@ -17,7 +17,34 @@ from __future__ import annotations
 
 from jax.sharding import PartitionSpec
 
-__all__ = ["Placement", "Shard", "Replicate", "Partial", "to_partition_spec"]
+__all__ = ["Placement", "Shard", "Replicate", "Partial", "to_partition_spec",
+           "sanitize_spec"]
+
+
+def sanitize_spec(spec: PartitionSpec, shape, mesh) -> PartitionSpec:
+    """Shared uneven-shard policy: drop spec entries whose dim is not
+    divisible by the product of its (present, non-degenerate) mesh axes.
+
+    The reference pads uneven shards inside its reshard functions
+    (s_to_r_reshard_function.cc padding-aware path); GSPMD requires even
+    tiles, so non-divisible dims stay replicated — same numerics, costs a
+    broadcast. Axes absent from the mesh or of size 1 are dropped too, so
+    one spec works across degenerate meshes.
+    """
+    import numpy as np
+
+    entries = []
+    for d in range(len(shape)):
+        e = spec[d] if d < len(spec) else None
+        if e is None:
+            entries.append(None)
+            continue
+        names = e if isinstance(e, tuple) else (e,)
+        names = tuple(n for n in names
+                      if n in mesh.axis_names and mesh.shape[n] > 1)
+        prod = int(np.prod([mesh.shape[n] for n in names])) if names else 1
+        entries.append(names if names and shape[d] % prod == 0 else None)
+    return PartitionSpec(*entries)
 
 
 class Placement:

@@ -57,6 +57,9 @@ def shard_array_over(arr: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
     if isinstance(cur, NamedSharding) and cur.mesh == mesh:
         for d, e in enumerate(cur.spec):
             entries[d] = e
+            names = e if isinstance(e, tuple) else (e,) if e else ()
+            if axis in names:
+                return arr  # already sharded over this axis
     # pick a dim not already sharded
     free_shape = [
         s if entries[d] is None else 0 for d, s in enumerate(arr.shape)

@@ -151,6 +151,11 @@ class HybridCommunicateGroup:
         return self._topo
 
     def get_parallel_mode(self):
+        # reference topology.py:40 order: sep counts as tensor-style
+        # parallelism (fleet/model.py wraps sep models like TP ones)
+        if self._sep_degree > 1 and self._mp_degree == 1 \
+                and self._pp_degree == 1:
+            return ParallelMode.SEGMENT_PARALLEL
         if self._mp_degree == 1 and self._pp_degree == 1 \
                 and self._sharding_degree == 1:
             return ParallelMode.DATA_PARALLEL

@@ -1,0 +1,54 @@
+"""paddle_tpu.jit: program capture, compiled execution, save/load.
+
+Reference surface: python/paddle/jit (api.py:195 to_static; SOT + dy2static
+frontends; save/load of TranslatedLayer). See capture.py for the design.
+"""
+
+from . import capture as _capture
+from .capture import (
+    StaticFunction,
+    live_optimizers,
+    not_to_static,
+    register_stateful,
+    to_static,
+)
+
+__all__ = ["to_static", "not_to_static", "StaticFunction",
+           "register_stateful", "live_optimizers", "save", "load",
+           "ignore_module", "enable_to_static"]
+
+def enable_to_static(flag: bool):
+    """reference: paddle.jit.enable_to_static — global capture kill-switch
+    (StaticFunction.__call__ falls back to the eager python function)."""
+    _capture.TO_STATIC_ENABLED[0] = bool(flag)
+
+
+def ignore_module(modules):
+    """Parity no-op: the capture frontend has no bytecode interpreter that
+    needs module skip lists (reference sot/skip_files)."""
+    return None
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Save a layer/function for deployment (reference jit/api.py save →
+    TranslatedLayer program + params). Serialises the state_dict plus the
+    layer class qualname; the program itself is re-traced at load (XLA
+    executables are not portable artifacts the way ProgramDesc is)."""
+    import pickle
+
+    state = {
+        "class": f"{type(layer).__module__}.{type(layer).__qualname__}",
+        "state_dict": {k: v.numpy() for k, v in layer.state_dict().items()},
+    }
+    with open(path + ".pdparams" if not path.endswith(".pdparams") else path,
+              "wb") as f:
+        pickle.dump(state, f)
+
+
+def load(path, **configs):
+    """Load a saved state dict (pair with jit.save)."""
+    import pickle
+
+    p = path + ".pdparams" if not path.endswith(".pdparams") else path
+    with open(p, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,251 @@
+"""Whole-program capture: eager train steps → single compiled XLA programs.
+
+This is the TPU answer to the reference's entire static-graph stack
+(SURVEY.md §3.4): dy2static AST transforms + SOT bytecode tracing
+(jit/sot/opcode_translator/executor/opcode_executor.py), ProgramDesc→PIR
+translation, pass pipeline, and PirInterpreter execution
+(new_executor/pir_interpreter.h:32). Because this framework's eager ops are
+pure jax underneath (core/dispatch.py), *running the user's Python step
+function under jax tracing* — autograd tape, optimizer update, RNG and all
+— yields one fused XLA program. No bytecode interpreter, no IR translator,
+no instruction-list executor: XLA is the IR, the pass pipeline and the
+runtime.
+
+Functionalization: XLA programs are pure, but an eager step mutates state
+(Parameter buffers, optimizer moments, the global PRNG key). The capture
+protocol snapshots every known state leaf before tracing, feeds them as
+inputs, rebinds the live objects to tracers, runs the function, then reads
+the (possibly grown) state set back as outputs. At execution the returned
+arrays are written back through recorded setters. State sources:
+
+- ``Parameter`` objects (process-global weak registry, core/tensor.py),
+- optimizer accumulators + master weights (optimizer registry below),
+- the global PRNG key (core/random.py) — so dropout masks advance across
+  calls instead of baking the trace-time mask in as a constant.
+
+Guard model (reference: SOT guards, jit/sot/.../guard.py:90 — stringified
+lambda conjunctions): here a guard key is the pytree structure + shape/dtype
+of Tensor args plus hashable non-tensor args, plus a fingerprint of the
+state structure; a mismatch re-traces, like the reference's per-input-spec
+program cache (program_translator.py:1598 _build_once).
+"""
+
+from __future__ import annotations
+
+import functools
+import weakref
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+from ..core.tensor import Parameter, Tensor, live_parameters
+
+__all__ = ["to_static", "StaticFunction", "register_stateful",
+           "live_optimizers", "not_to_static"]
+
+# Stateful objects beyond Parameters (optimizers register on construction).
+_STATEFUL: "weakref.WeakSet" = weakref.WeakSet()
+
+# Global capture kill-switch (paddle_tpu.jit.enable_to_static).
+TO_STATIC_ENABLED = [True]
+
+
+def register_stateful(obj) -> None:
+    """Register an object exposing ``_state_leaves() -> list[(get, set)]``
+    (pairs of zero-arg getter / one-arg setter over jax arrays)."""
+    _STATEFUL.add(obj)
+
+
+def live_optimizers():
+    return [o for o in _STATEFUL]
+
+
+def _snapshot():
+    """Collect (values, setters) for every known state leaf, in a stable
+    order: parameters, stateful objects, PRNG key."""
+    values, setters = [], []
+    params = sorted(live_parameters(), key=id)
+    for p in params:
+        values.append(p._data)
+        setters.append(p._bump)
+    for obj in sorted(_STATEFUL, key=id):
+        for get, set_ in obj._state_leaves():
+            values.append(get())
+            setters.append(set_)
+    values.append(_random.get_state())
+    setters.append(_random.set_state)
+    return values, setters
+
+
+class _TensorSpec:
+    __slots__ = ("shape", "dtype", "sharding")
+
+    def __init__(self, arr):
+        self.shape = tuple(arr.shape)
+        self.dtype = str(arr.dtype)
+        sh = getattr(arr, "sharding", None)
+        self.sharding = str(sh) if sh is not None else None
+
+    def __eq__(self, o):
+        return (isinstance(o, _TensorSpec) and o.shape == self.shape
+                and o.dtype == self.dtype and o.sharding == self.sharding)
+
+    def __hash__(self):
+        return hash((self.shape, self.dtype, self.sharding))
+
+    def __repr__(self):
+        return f"TensorSpec({self.shape}, {self.dtype})"
+
+
+def _guard_key(args, kwargs, n_state):
+    def spec(o):
+        if isinstance(o, Tensor):
+            return _TensorSpec(o._data)
+        if isinstance(o, (list, tuple)):
+            return tuple(spec(x) for x in o)
+        if isinstance(o, dict):
+            return tuple(sorted((k, spec(v)) for k, v in o.items()))
+        try:
+            hash(o)
+            return o
+        except TypeError:
+            return str(type(o))
+
+    return (spec(list(args)), spec(kwargs), n_state)
+
+
+def _extract_arrays(obj, out: list):
+    if isinstance(obj, Tensor):
+        out.append(obj._data)
+        return ("__tensor__", len(out) - 1, obj.stop_gradient)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_extract_arrays(o, out) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _extract_arrays(v, out) for k, v in obj.items()}
+    return obj
+
+
+def _rebuild_tensors(tpl, arrays):
+    if isinstance(tpl, tuple) and len(tpl) == 3 and tpl[0] == "__tensor__":
+        t = Tensor(arrays[tpl[1]], stop_gradient=tpl[2])
+        return t
+    if isinstance(tpl, (list, tuple)):
+        return type(tpl)(_rebuild_tensors(o, arrays) for o in tpl)
+    if isinstance(tpl, dict):
+        return {k: _rebuild_tensors(v, arrays) for k, v in tpl.items()}
+    return tpl
+
+
+class _Compiled:
+    __slots__ = ("jitted", "out_setters", "out_template", "n_state_out")
+
+    def __init__(self, jitted, out_setters, out_template, n_state_out):
+        self.jitted = jitted
+        self.out_setters = out_setters
+        self.out_template = out_template
+        self.n_state_out = n_state_out
+
+
+class StaticFunction:
+    """reference: jit/dy2static/program_translator.py:377. ``__call__``
+    looks up the (guard → compiled program) cache, tracing on miss."""
+
+    def __init__(self, fn: Callable, build_strategy=None, donate_states: bool = True):
+        self._fn = fn
+        self._cache: dict = {}
+        self._donate = donate_states
+        functools.update_wrapper(self, fn)
+
+    @property
+    def compile_count(self) -> int:
+        return len(self._cache)
+
+    def __call__(self, *args, **kwargs):
+        if not TO_STATIC_ENABLED[0]:
+            return self._fn(*args, **kwargs)
+        state_vals, state_setters = _snapshot()
+        key = _guard_key(args, kwargs, len(state_vals))
+        compiled: Optional[_Compiled] = self._cache.get(key)
+        if compiled is None:
+            compiled = self._compile(args, kwargs, state_vals)
+            self._cache[key] = compiled
+            # State created during the trace (e.g. optimizer moments) holds
+            # tracers until this first execution's out_setters overwrite it
+            # with real arrays; nothing reads it in between. The next call
+            # snapshots the grown state set → a second (final) compile.
+
+        arg_arrays: list = []
+        _extract_arrays((list(args), kwargs), arg_arrays)
+        outs_flat, state_out = compiled.jitted(state_vals, arg_arrays)
+        for setter, val in zip(compiled.out_setters, state_out):
+            setter(val)
+        return _rebuild_tensors(compiled.out_template, outs_flat)
+
+    def _compile(self, args, kwargs, state_vals_outer) -> _Compiled:
+        fn = self._fn
+        arg_template_holder = {}
+        result_holder = {}
+
+        def pure(state_in, arg_arrays):
+            # Bind state tracers into the live objects.
+            _, setters = _snapshot()
+            if len(setters) != len(state_in):
+                raise RuntimeError("state changed between snapshot and trace")
+            for s, v in zip(setters, state_in):
+                s(v)
+            template = arg_template_holder["t"]
+            a, k = _rebuild_tensors(template, arg_arrays)
+            out = fn(*a, **k)
+            # Read back all state (possibly grown during the trace).
+            out_vals, out_setters = _snapshot()
+            result_holder["setters"] = out_setters
+            outs_flat: list = []
+            out_template = _extract_arrays(out, outs_flat)
+            result_holder["template"] = out_template
+            return outs_flat, out_vals
+
+        arg_arrays: list = []
+        template = _extract_arrays((list(args), kwargs), arg_arrays)
+        arg_template_holder["t"] = template
+
+        jitted = jax.jit(pure, donate_argnums=(0,) if self._donate else ())
+        _, orig_setters = _snapshot()
+        try:
+            # AOT trace+compile; pure() runs once with tracers here.
+            lowered = jitted.lower(state_vals_outer, arg_arrays)
+            compiled_exe = lowered.compile()
+        finally:
+            # Tracing bound tracers into the live objects (params, RNG key);
+            # restore the real arrays for the pre-existing leaves.
+            for s, v in zip(orig_setters, state_vals_outer):
+                s(v)
+        out_setters = result_holder["setters"]
+        out_template = result_holder["template"]
+
+        def runner(state_vals, arg_arrays):
+            return compiled_exe(state_vals, arg_arrays)
+
+        return _Compiled(runner, out_setters, out_template,
+                         n_state_out=len(out_setters))
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """Decorator / wrapper (reference: python/paddle/jit/api.py:195)."""
+
+    def wrap(fn):
+        if isinstance(fn, StaticFunction):
+            return fn
+        return StaticFunction(fn, build_strategy=build_strategy)
+
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+def not_to_static(fn=None):
+    """Marker parity (reference api.py not_to_static): capture is opt-in
+    per-function here, so this is the identity."""
+    return fn

@@ -1,0 +1,7 @@
+"""Flagship model zoo (reference: python/paddle/vision/models + the GPT/
+BERT/LLaMA configs exercised by the fleet test-suite and BASELINE.md)."""
+
+from .gpt import GPT, GPTConfig, gpt_presets, init_params, model_apply, loss_fn
+
+__all__ = ["GPT", "GPTConfig", "gpt_presets", "init_params", "model_apply",
+           "loss_fn"]

@@ -1,0 +1,344 @@
+"""GPT: the flagship decoder-only LM, TPU-first.
+
+Capability parity targets (BASELINE.md configs 3-4): the reference trains
+GPT-class models through fleet hybrid parallel — VocabParallelEmbedding /
+Column/RowParallelLinear (fleet/layers/mpu/mp_layers.py:47,334,541),
+PipelineParallel 1F1B (fleet/meta_parallel/pipeline_parallel.py:245), fused
+attention kernels (phi/kernels/fusion/gpu/fused_attention*). Here the model
+is designed for XLA from the start:
+
+- **Functional core** (`init_params` / `model_apply`): pure jnp over a
+  params pytree; blocks are *stacked* ``[L, ...]`` and iterated with
+  ``lax.scan`` (constant compile time in depth, and the natural layout for
+  pipeline stacking), rematerialised per block (``jax.checkpoint``) like the
+  reference's recompute (fleet/recompute/recompute.py:124).
+- **Sharding by annotation**: tp = vocab/heads/ffn dims over "mp", dp/ep =
+  batch/experts over "dp", Megatron-SP = token dim over "mp" between blocks;
+  pipeline = stacked-layer axis over "pp" via parallel/pipeline.py.
+- **MXU discipline**: matmuls in bf16 with fp32 accumulation, fp32 master
+  params; attention through the Pallas flash kernel (ops/pallas).
+- Optional **MoE** FFN layers (GShard/switch top-1 with capacity, one-hot
+  einsum dispatch — static shapes, no host loops; reference:
+  incubate/distributed/models/moe/moe_layer.py:263 + global_scatter/gather).
+
+The eager ``GPT`` Layer wraps the same functional core through one
+registered op, so dygraph autograd, AMP and capture all apply.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["GPTConfig", "init_params", "model_apply", "loss_fn", "GPT",
+           "gpt_presets"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden: int = 1024
+    n_layers: int = 24
+    n_heads: int = 16
+    seq_len: int = 1024
+    ffn_mult: int = 4
+    # MoE: if n_experts > 0, `n_moe_layers` expert-FFN blocks run after the
+    # dense stack's midpoint (expert dim shards over dp = "ep").
+    n_experts: int = 0
+    n_moe_layers: int = 0
+    moe_capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16          # activation/compute dtype (MXU)
+    param_dtype: Any = jnp.float32     # master params
+    tie_embeddings: bool = True
+    use_flash: bool = True
+    remat: bool = True
+    eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.n_heads
+
+
+def gpt_presets(name: str) -> GPTConfig:
+    """Reference GPT-3 family sizes (BASELINE.md configs)."""
+    table = {
+        "gpt3-125m": dict(hidden=768, n_layers=12, n_heads=12),
+        "gpt3-350m": dict(hidden=1024, n_layers=24, n_heads=16),
+        "gpt3-760m": dict(hidden=1536, n_layers=24, n_heads=16),
+        "gpt3-1.3b": dict(hidden=2048, n_layers=24, n_heads=16),
+        "gpt3-2.7b": dict(hidden=2560, n_layers=32, n_heads=32),
+        "gpt3-6.7b": dict(hidden=4096, n_layers=32, n_heads=32),
+        "gpt3-13b": dict(hidden=5120, n_layers=40, n_heads=40),
+    }
+    return GPTConfig(**table[name])
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: GPTConfig, key) -> dict:
+    """Initialise the stacked-parameter pytree (normal(0.02), scaled
+    residual projections à la GPT-2)."""
+    k = iter(jax.random.split(key, 24))
+    H, L, F = cfg.hidden, cfg.n_layers, cfg.ffn_mult * cfg.hidden
+    std = 0.02
+    pstd = std / math.sqrt(2 * L)
+    pd = cfg.param_dtype
+
+    def nrm(kk, shape, s=std):
+        return (jax.random.normal(kk, shape, jnp.float32) * s).astype(pd)
+
+    params = {
+        "wte": nrm(next(k), (cfg.vocab_size, H)),
+        "wpe": nrm(next(k), (cfg.seq_len, H), 0.01),
+        "blocks": {
+            "ln1_g": jnp.ones((L, H), pd),
+            "ln1_b": jnp.zeros((L, H), pd),
+            "qkv_w": nrm(next(k), (L, H, 3 * H)),
+            "qkv_b": jnp.zeros((L, 3 * H), pd),
+            "proj_w": nrm(next(k), (L, H, H), pstd),
+            "proj_b": jnp.zeros((L, H), pd),
+            "ln2_g": jnp.ones((L, H), pd),
+            "ln2_b": jnp.zeros((L, H), pd),
+            "fc_w": nrm(next(k), (L, H, F)),
+            "fc_b": jnp.zeros((L, F), pd),
+            "fc2_w": nrm(next(k), (L, F, H), pstd),
+            "fc2_b": jnp.zeros((L, H), pd),
+        },
+        "lnf_g": jnp.ones((H,), pd),
+        "lnf_b": jnp.zeros((H,), pd),
+    }
+    if not cfg.tie_embeddings:
+        params["head_w"] = nrm(next(k), (H, cfg.vocab_size))
+    if cfg.n_experts > 0 and cfg.n_moe_layers > 0:
+        E, M = cfg.n_experts, cfg.n_moe_layers
+        params["moe"] = {
+            "ln_g": jnp.ones((M, H), pd),
+            "ln_b": jnp.zeros((M, H), pd),
+            "router_w": nrm(next(k), (M, H, E), 0.01),
+            "w1": nrm(next(k), (M, E, H, F)),
+            "b1": jnp.zeros((M, E, F), pd),
+            "w2": nrm(next(k), (M, E, F, H), pstd),
+            "b2": jnp.zeros((M, E, H), pd),
+        }
+    return params
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _layer_norm(x, g, b, eps):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    y = (x32 - mu) * lax.rsqrt(var + eps)
+    return (y * g.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+
+
+def _attention(q, k, v, cfg: GPTConfig):
+    # q,k,v: [B, T, nH, dH]
+    if cfg.use_flash:
+        from ..ops.pallas.flash_attention import flash_attention_raw, supported
+
+        # flash_attention takes [B, T, nH, dH] (it handles the head-major
+        # transpose internally, ops/pallas/flash_attention.py:_flash_fwd)
+        if supported(q.shape, q.dtype):
+            return flash_attention_raw(q, k, v, causal=True)
+    # XLA fallback: fp32 logits, causal mask
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    T = q.shape[1]
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def block_apply(bp: dict, x, cfg: GPTConfig, sp_constraint=None):
+    """One pre-LN transformer block. ``bp`` leaves have NO leading layer dim
+    (a single layer's slice). ``sp_constraint`` optionally reshards the
+    activation (Megatron-SP: token dim over 'mp') between sublayers."""
+    B, T, H = x.shape
+    h = _layer_norm(x, bp["ln1_g"], bp["ln1_b"], cfg.eps)
+    qkv = jnp.einsum("bth,hk->btk", h, bp["qkv_w"].astype(cfg.dtype),
+                     preferred_element_type=jnp.float32).astype(cfg.dtype)
+    qkv = qkv + bp["qkv_b"].astype(cfg.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(B, T, cfg.n_heads, cfg.head_dim)
+    v = v.reshape(B, T, cfg.n_heads, cfg.head_dim)
+    o = _attention(q, k, v, cfg).reshape(B, T, H)
+    o = jnp.einsum("bth,hk->btk", o, bp["proj_w"].astype(cfg.dtype),
+                   preferred_element_type=jnp.float32).astype(cfg.dtype)
+    x = x + o + bp["proj_b"].astype(cfg.dtype)
+    if sp_constraint is not None:
+        x = sp_constraint(x)
+    h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"], cfg.eps)
+    h = jnp.einsum("bth,hf->btf", h, bp["fc_w"].astype(cfg.dtype),
+                   preferred_element_type=jnp.float32).astype(cfg.dtype)
+    h = jax.nn.gelu(h + bp["fc_b"].astype(cfg.dtype), approximate=True)
+    h = jnp.einsum("btf,fh->bth", h, bp["fc2_w"].astype(cfg.dtype),
+                   preferred_element_type=jnp.float32).astype(cfg.dtype)
+    x = x + h + bp["fc2_b"].astype(cfg.dtype)
+    if sp_constraint is not None:
+        x = sp_constraint(x)
+    return x
+
+
+def moe_block_apply(mp: dict, x, cfg: GPTConfig):
+    """Switch-style top-1 MoE FFN (GShard dense-dispatch formulation).
+
+    The reference routes with variable-size all-to-all driven by count
+    tensors (moe_utils.py:20 global_scatter). XLA needs static shapes, so
+    dispatch is a one-hot capacity einsum: tokens beyond an expert's
+    capacity are dropped (their residual passes through), the standard
+    TPU MoE trade. Expert dim E shards over the dp axis ("ep").
+    Returns (y, aux_loss)."""
+    B, T, H = x.shape
+    E = mp["router_w"].shape[-1]
+    N = B * T
+    C = max(1, int(cfg.moe_capacity_factor * N / E))
+    h = _layer_norm(x, mp["ln_g"], mp["ln_b"], cfg.eps)
+    flat = h.reshape(N, H)
+    logits = jnp.einsum("nh,he->ne", flat.astype(jnp.float32),
+                        mp["router_w"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, -1)
+    gate, idx = probs.max(-1), probs.argmax(-1)  # [N]
+    # position of each token within its expert's queue
+    onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)          # [N, E]
+    pos = (jnp.cumsum(onehot, axis=0) - 1) * onehot            # [N, E]
+    pos_in_e = pos.sum(-1)                                     # [N]
+    keep = pos_in_e < C
+    # dispatch tensor [N, E, C]
+    disp = (jax.nn.one_hot(idx, E, dtype=cfg.dtype)[:, :, None]
+            * jax.nn.one_hot(jnp.where(keep, pos_in_e, C), C + 1,
+                             dtype=cfg.dtype)[:, None, :C])
+    xin = jnp.einsum("nec,nh->ech", disp, flat.astype(cfg.dtype))  # [E,C,H]
+    hmid = jnp.einsum("ech,ehf->ecf", xin, mp["w1"].astype(cfg.dtype),
+                      preferred_element_type=jnp.float32).astype(cfg.dtype)
+    hmid = jax.nn.gelu(hmid + mp["b1"].astype(cfg.dtype)[:, None, :],
+                       approximate=True)
+    hout = jnp.einsum("ecf,efh->ech", hmid, mp["w2"].astype(cfg.dtype),
+                      preferred_element_type=jnp.float32).astype(cfg.dtype)
+    hout = hout + mp["b2"].astype(cfg.dtype)[:, None, :]
+    combine = disp * gate.astype(cfg.dtype)[:, None, None]
+    y = jnp.einsum("nec,ech->nh", combine, hout).reshape(B, T, H)
+    # load-balance aux loss (Switch): E * sum_e f_e * P_e
+    f = onehot.astype(jnp.float32).mean(0)
+    P = probs.mean(0)
+    aux = E * jnp.sum(f * P)
+    return x + y, aux
+
+
+def model_apply(params: dict, tokens, cfg: GPTConfig, sp_constraint=None,
+                blocks_fn=None):
+    """Forward to logits. ``blocks_fn(params_blocks, x)`` overrides the
+    dense-stack execution (the pipeline path passes the shard_map'd stage
+    runner); default is a remat'd lax.scan over stacked layers."""
+    B, T = tokens.shape
+    x = params["wte"][tokens].astype(cfg.dtype) + \
+        params["wpe"][:T].astype(cfg.dtype)
+    if sp_constraint is not None:
+        x = sp_constraint(x)
+
+    if blocks_fn is not None:
+        x = blocks_fn(params["blocks"], x)
+    else:
+        fn = functools.partial(block_apply, cfg=cfg,
+                               sp_constraint=sp_constraint)
+        if cfg.remat:
+            fn = jax.checkpoint(fn)
+
+        def body(carry, bp):
+            return fn(bp, carry), None
+
+        x, _ = lax.scan(body, x, params["blocks"])
+
+    # MoE layers run after the dense stack in BOTH paths (so the pipeline
+    # blocks_fn override cannot silently drop expert compute).
+    aux = jnp.zeros((), jnp.float32)
+    if cfg.n_experts > 0 and cfg.n_moe_layers > 0:
+        def moe_body(carry, mp):
+            y, a = moe_block_apply(mp, carry[0], cfg)
+            return (y, carry[1] + a), None
+
+        (x, aux), _ = lax.scan(moe_body, (x, aux), params["moe"])
+
+    x = _layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.eps)
+    head = (params["wte"].T if cfg.tie_embeddings else params["head_w"])
+    logits = jnp.einsum("bth,hv->btv", x, head.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, aux
+
+
+def loss_fn(params, tokens, labels, cfg: GPTConfig, sp_constraint=None,
+            blocks_fn=None):
+    """Causal LM cross-entropy in fp32 (the reference's
+    ParallelCrossEntropy semantics for mp-sharded logits come from GSPMD
+    partitioning the log-sum-exp)."""
+    logits, aux = model_apply(params, tokens, cfg, sp_constraint, blocks_fn)
+    V = logits.shape[-1]
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    nll = (lse - gold).mean()
+    return nll + 0.01 * aux
+
+
+# ---------------------------------------------------------------------------
+# eager Layer wrapper
+# ---------------------------------------------------------------------------
+
+from ..core.dispatch import op
+from ..core.tensor import Parameter, Tensor
+from ..nn.layer.layers import Layer
+
+
+@op("gpt_forward")
+def _gpt_forward_op(params, tokens, *, cfg):
+    logits, aux = model_apply(params, tokens, cfg)
+    return logits
+
+
+@op("gpt_loss")
+def _gpt_loss_op(params, tokens, labels, *, cfg):
+    return loss_fn(params, tokens, labels, cfg)
+
+
+class GPT(Layer):
+    """Eager flagship model: owns the functional params as Parameters and
+    dispatches the whole forward as one op — so eager stepping costs one
+    XLA program instead of per-layer dispatch, and capture/AMP/autograd
+    compose through the standard funnel."""
+
+    def __init__(self, cfg: GPTConfig, seed: int = 0):
+        super().__init__()
+        self.cfg = cfg
+        raw = init_params(cfg, jax.random.PRNGKey(seed))
+        self._tree, leaves = self._register(raw)
+        for i, leaf in enumerate(leaves):
+            self.add_parameter(f"p{i}", leaf)
+
+    def _register(self, raw):
+        leaves, treedef = jax.tree.flatten(raw)
+        params = [Parameter(a) for a in leaves]
+        return treedef, params
+
+    def _params_pytree(self):
+        return jax.tree.unflatten(
+            self._tree, [p for p in self.parameters()])
+
+    def forward(self, tokens: Tensor) -> Tensor:
+        return _gpt_forward_op(self._params_pytree(), tokens, cfg=self.cfg)
+
+    def loss(self, tokens: Tensor, labels: Tensor) -> Tensor:
+        return _gpt_loss_op(self._params_pytree(), tokens, labels,
+                            cfg=self.cfg)

@@ -137,8 +137,7 @@ def _sdpa_fallback(q, k, v, causal, sm_scale):
     return jnp.swapaxes(o, 1, 2)
 
 
-@op("pallas_flash_attention", amp="cast")
-def flash_attention(q, k, v, causal: bool = False, sm_scale: float | None = None):
+def flash_attention_raw(q, k, v, causal: bool = False, sm_scale: float | None = None):
     """Differentiable flash attention: Pallas forward, XLA-expression VJP.
 
     The custom_vjp pairs the Pallas forward with a recompute-based backward
@@ -164,3 +163,8 @@ def flash_attention(q, k, v, causal: bool = False, sm_scale: float | None = None
 
     fa.defvjp(fwd, bwd)
     return fa(q, k, v)
+
+
+# Framework-op wrapper (Tensor in/out, tape-recorded); pure-jnp callers
+# (functional models, compiled train steps) use flash_attention_raw.
+flash_attention = op("pallas_flash_attention", amp="cast")(flash_attention_raw)

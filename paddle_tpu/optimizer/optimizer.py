@@ -54,6 +54,15 @@ class Optimizer:
         self._master_weights: dict[int, jnp.ndarray] = {}
         self._step_count = 0
         self._update_jit = None
+        # Functionalized scalars for whole-step capture (paddle_tpu.jit):
+        # bound to tracers while tracing so the compiled step reads the
+        # *current* lr / step each call instead of baking trace-time values.
+        self._lr_buffer = None
+        self._step_buffer = None
+        self._step_value: Any = 0
+        from ..jit.capture import register_stateful
+
+        register_stateful(self)
 
     # -- lr -----------------------------------------------------------------
     def get_lr(self) -> float:
@@ -107,8 +116,21 @@ class Optimizer:
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
 
-        lr = self.get_lr()
+        lr = self._lr_value()
+        if self._step_buffer is not None and not isinstance(
+            self._step_buffer, jax.core.Tracer
+        ):
+            # Re-sync after captured steps: the true count lives in the
+            # functionalized buffer (advanced inside the compiled program),
+            # not in the python counter (incremented once per trace).
+            self._step_count = int(self._step_buffer)
+            self._step_buffer = None
         self._step_count += 1
+        if self._step_buffer is not None:
+            self._step_buffer = self._step_buffer + 1
+            self._step_value = self._step_buffer
+        else:
+            self._step_value = self._step_count
         ctx = self._ctx()
 
         # One jitted call per device set: params on the same devices (e.g. a
@@ -134,6 +156,54 @@ class Optimizer:
             for p, nd, ns in zip(params, new_datas, new_states):
                 p._bump(nd)
                 self._accumulators[id(p)] = ns
+
+    def _lr_value(self):
+        """Current lr: the bound tracer during capture, else the live
+        python value (scheduler-aware)."""
+        if self._lr_buffer is not None and isinstance(
+            self._lr_buffer, jax.core.Tracer
+        ):
+            return self._lr_buffer
+        return self.get_lr()
+
+    def _state_leaves(self):
+        """Capture protocol (paddle_tpu.jit.capture): (getter, setter) pairs
+        for every mutable array this optimizer owns — moments, master
+        weights, the step counter, and the (scheduler-driven) lr."""
+        leaves = []
+        for pid in sorted(self._accumulators):
+            st = self._accumulators[pid]
+            for k in sorted(st):
+                leaves.append((
+                    lambda st=st, k=k: st[k],
+                    lambda v, st=st, k=k: st.__setitem__(k, v),
+                ))
+        for pid in sorted(self._master_weights):
+            mw = self._master_weights
+            leaves.append((
+                lambda mw=mw, pid=pid: mw[pid],
+                lambda v, mw=mw, pid=pid: mw.__setitem__(pid, v),
+            ))
+
+        def get_step():
+            # During a trace this returns the (advanced) tracer so the step
+            # count is a true state output, not a baked constant.
+            if self._step_buffer is not None:
+                return self._step_buffer
+            return jnp.asarray(self._step_count, jnp.int32)
+
+        def set_step(v):
+            self._step_buffer = v
+
+        def get_lr_leaf():
+            return jnp.asarray(self.get_lr(), jnp.float32)
+
+        def set_lr_leaf(v):
+            self._lr_buffer = v
+
+        leaves.append((get_step, set_step))
+        leaves.append((get_lr_leaf, set_lr_leaf))
+        return leaves
 
     def _effective_wd(self, p) -> float:
         wd = self._weight_decay
@@ -176,7 +246,12 @@ class Optimizer:
 
     # -- serialization ------------------------------------------------------
     def state_dict(self) -> dict:
-        sd: dict[str, Any] = {"step_count": self._step_count}
+        step = self._step_count
+        if self._step_buffer is not None and not isinstance(
+            self._step_buffer, jax.core.Tracer
+        ):
+            step = int(self._step_buffer)  # true count after captured steps
+        sd: dict[str, Any] = {"step_count": step}
         named = {}
         for i, p in enumerate(self._parameter_list):
             key = p.name or f"param_{i}"

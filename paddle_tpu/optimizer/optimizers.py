@@ -105,7 +105,7 @@ class Adam(Optimizer):
         return slot
 
     def _ctx(self):
-        t = self._step_count
+        t = self._step_value
         return {
             "bias1": 1.0 - self._beta1**t,
             "bias2": 1.0 - self._beta2**t,
@@ -178,7 +178,7 @@ class Adamax(Optimizer):
                 "inf_norm": jnp.zeros_like(_f32(p._data))}
 
     def _ctx(self):
-        return {"bias1": 1.0 - self._beta1**self._step_count}
+        return {"bias1": 1.0 - self._beta1**self._step_value}
 
     def _update(self, g, p, state, lr, ctx):
         g = _f32(g)
@@ -269,7 +269,7 @@ class Lamb(Optimizer):
                 "moment2": jnp.zeros_like(_f32(p._data))}
 
     def _ctx(self):
-        t = self._step_count
+        t = self._step_value
         return {"bias1": 1.0 - self._beta1**t, "bias2": 1.0 - self._beta2**t}
 
     def _effective_wd(self, p):

@@ -1,0 +1,12 @@
+"""Compiled SPMD parallel building blocks (pipeline, sharded train step).
+
+This package holds the *performance* path: whole-step XLA programs with
+explicit mesh shardings. The dygraph-parity wrappers live in
+paddle_tpu.distributed.fleet.
+"""
+
+from .pipeline import pipeline_blocks_fn
+from .train_step import make_sharded_train_step, shard_gpt_params
+
+__all__ = ["pipeline_blocks_fn", "make_sharded_train_step",
+           "shard_gpt_params"]

@@ -1,0 +1,107 @@
+"""Compiled SPMD pipeline: the whole schedule inside one XLA program.
+
+This is the performant pipeline the flagship train step uses — the TPU
+answer to the reference's host-driven 1F1B with NCCL isend/irecv
+(fleet/meta_parallel/pipeline_parallel.py:565, pp_utils/p2p_communication.py):
+stage parameters are a *stacked* leading axis sharded over the mesh's "pp"
+axis; `shard_map(axis_names={"pp"})` makes pp manual while dp/mp stay under
+GSPMD propagation inside the body; microbatches stream through a
+`lax.scan` whose per-tick neighbour transfer is a `lax.ppermute` riding ICI.
+Backward through the scan+ppermute (jax.grad) is automatically the reverse
+pipeline — the 1F1B memory profile is approximated by remat'ing stages
+rather than by schedule order (XLA owns the schedule; SURVEY.md §7 "hard
+parts": zero-bubble under a static program model trades as bubble vs remat
+here).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["pipeline_blocks_fn"]
+
+
+def pipeline_blocks_fn(stage_fn: Callable, mesh: Mesh, n_microbatches: int,
+                       pp_axis: str = "pp"):
+    """Build a ``blocks_fn(stacked_params, x)`` running the stacked layers
+    as a GPipe-style pipeline over ``pp_axis``.
+
+    ``stage_fn(stage_params, x) -> y`` applies one stage's slice of the
+    stack (itself typically a lax.scan over layers_per_stage).
+    ``stacked_params`` leaves are ``[L, ...]`` with L divisible by the pp
+    degree; x is the full activation ``[B, T, H]`` with B divisible by
+    ``n_microbatches``.
+    """
+    n_stages = mesh.shape[pp_axis]
+
+    def blocks_fn(stacked_params, x):
+        if n_stages == 1:
+            return stage_fn(stacked_params, x)
+        B = x.shape[0]
+        M = n_microbatches
+        assert B % M == 0, f"batch {B} % microbatches {M} != 0"
+        mb = B // M
+        xs = x.reshape((M, mb) + x.shape[1:])
+
+        in_specs = (jax.tree.map(lambda _: P(pp_axis), stacked_params),
+                    P())
+        # Partial-manual shard_map: mesh comes from the jax.sharding.set_mesh
+        # context (passing mesh= would make every axis manual); pp is manual,
+        # dp/mp stay under GSPMD propagation inside the body.
+        run = jax.shard_map(
+            functools.partial(_pipeline_local, stage_fn=stage_fn,
+                              n_stages=n_stages, n_micro=M,
+                              pp_axis=pp_axis),
+            in_specs=in_specs,
+            # each stage returns its output buffer stacked on a leading pp
+            # dim; only the last stage's slice is the real model output
+            out_specs=P(pp_axis),
+            axis_names={pp_axis},
+            check_vma=False,
+        )
+        # Partial-manual shard_map resolves the context mesh only under jit;
+        # callers outside jit must wrap in `jax.sharding.set_mesh(mesh)`.
+        ys = jax.jit(run)(stacked_params, xs)[-1]
+        return ys.reshape((B,) + x.shape[1:])
+
+    return blocks_fn
+
+
+def _pipeline_local(stage_params, xs, *, stage_fn, n_stages, n_micro,
+                    pp_axis):
+    """Per-pp-rank body. stage_params: this stage's [L/S, ...] slice
+    (leading stacked dim already divided by shard_map); xs: [M, mb, T, H]
+    microbatch queue, replicated over pp."""
+    stage = lax.axis_index(pp_axis)
+    total = n_micro + n_stages - 1
+    state = jnp.zeros(xs.shape[1:], xs.dtype)      # activation in flight
+    outputs = jnp.zeros_like(xs)                   # filled on last stage
+
+    fwd = jax.checkpoint(stage_fn)
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 injects microbatch t (clamped; invalid ticks are masked
+        # out when outputs are collected)
+        inject = xs[jnp.minimum(t, n_micro - 1)]
+        x_in = jnp.where(stage == 0, inject, state)
+        y = fwd(stage_params, x_in)
+        # shift to the next stage over ICI; last stage's y falls off the end
+        nxt = lax.ppermute(y, pp_axis,
+                           [(i, i + 1) for i in range(n_stages - 1)])
+        out_slot = t - (n_stages - 1)
+        valid = jnp.logical_and(stage == n_stages - 1, out_slot >= 0)
+        upd = lax.dynamic_update_index_in_dim(
+            outputs, y, jnp.maximum(out_slot, 0), 0)
+        outputs = jnp.where(valid, upd, outputs)
+        return (nxt, outputs), None
+
+    (_, outputs), _ = lax.scan(tick, (state, outputs), jnp.arange(total))
+    # stacked over pp by out_specs; caller keeps the last stage's slice
+    return outputs[None]
