@@ -75,6 +75,7 @@ _LAZY_SUBMODULES = (
     "sparse",
     "device",
     "models",
+    "hapi",
 )
 
 
@@ -85,4 +86,9 @@ def __getattr__(name):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod
+    if name == "Model":
+        from .hapi import Model
+
+        globals()["Model"] = Model
+        return Model
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

@@ -1,0 +1,230 @@
+"""paddle_tpu.amp: automatic mixed precision.
+
+Re-design of python/paddle/amp (auto_cast.py:1029 ``auto_cast``/``amp_guard``
+:462, grad_scaler.py:645 ``GradScaler``, amp_lists.py allow/deny lists).
+
+TPU translation: bf16 is the native MXU dtype, so O1 autocast = cast matmul
+/conv-class op inputs to bf16 at the dispatch funnel (core/dispatch.py
+_amp_cast_arrays — the per-op generated autocast of the reference's
+eager_gen.py collapses into that single funnel hook). fp16 is supported for
+parity; with bf16 the GradScaler's dynamic loss scaling is numerically
+unnecessary (bf16 shares fp32's exponent range) but fully implemented —
+enabled it behaves exactly like the reference's scaler (scale, unscale,
+found_inf skip, dynamic growth/backoff).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch as _dispatch
+from ..core.tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate",
+           "white_list", "black_list", "is_float16_supported",
+           "is_bfloat16_supported", "debugging"]
+
+# Default op lists (reference: python/paddle/amp/amp_lists.py). Ops with
+# amp_policy="cast" registered in OP_REGISTRY form the effective white list;
+# these names extend/override at runtime.
+WHITE_LIST = {"matmul", "mm", "bmm", "linear", "conv2d", "conv1d", "conv3d",
+              "conv2d_transpose", "einsum", "pallas_flash_attention"}
+BLACK_LIST = {"softmax", "log_softmax", "cross_entropy", "log", "exp",
+              "mean", "sum", "layer_norm", "batch_norm", "group_norm",
+              "rms_norm", "softmax_with_cross_entropy", "norm", "cumsum"}
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST)
+
+
+def is_float16_supported(device=None) -> bool:
+    return True
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    return True
+
+
+_DTYPE_MAP = {"float16": jnp.float16, "bfloat16": jnp.bfloat16,
+              "fp16": jnp.float16, "bf16": jnp.bfloat16}
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None,
+              custom_black_list=None, level: str = "O1",
+              dtype: str = "bfloat16", use_promote: bool = True):
+    """Autocast scope (reference auto_cast.py:1029).
+
+    O1: white-list ops run in low precision, black-list ops in fp32.
+    O2: everything except black-list runs in low precision (params stay
+    fp32 masters; see ``decorate`` for O2 param casting).
+    """
+    if level not in ("O0", "O1", "O2", "OD"):
+        raise ValueError(f"level must be O0/OD/O1/O2, got {level}")
+    prev = _dispatch.AMP_STATE
+    if enable and level != "O0":
+        _dispatch.AMP_STATE = {
+            "enable": True,
+            "dtype": _DTYPE_MAP.get(dtype, jnp.bfloat16),
+            "level": level,
+            "white": WHITE_LIST | set(custom_white_list or ()),
+            "black": BLACK_LIST | set(custom_black_list or ()),
+        }
+    else:
+        _dispatch.AMP_STATE = None
+    try:
+        yield
+    finally:
+        _dispatch.AMP_STATE = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level: str = "O2",
+             dtype: str = "bfloat16", master_weight=None,
+             save_dtype=None):
+    """O2 decoration (reference auto_cast.py amp_decorate): cast model
+    params to the low-precision dtype; optimizers keep fp32 master weights
+    (our optimizers always compute the update in fp32 and cast back, so
+    master_weight=True semantics hold by construction)."""
+    target = _DTYPE_MAP.get(dtype, jnp.bfloat16)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            for p in m.parameters():
+                if p.dtype == jnp.float32:
+                    p._bump(p._data.astype(target))
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaler (reference grad_scaler.py:645).
+
+    scale() multiplies the loss; step()/minimize() unscale grads, check
+    finiteness across all grads (the cross-group allreduce of found_inf in
+    the reference's HybridParallelGradScaler is inherent here — grads are
+    global arrays), skip the step on overflow, and update the scale."""
+
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.**15,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 1000,
+                 decr_every_n_nan_or_inf: int = 1, use_dynamic_loss_scaling:
+                 bool = True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled_opts: set = set()
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    is_use_dynamic_loss_scaling = lambda self: self._dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        return var.scale(self._scale)
+
+    def unscale_(self, optimizer):
+        if not self._enable or id(optimizer) in self._unscaled_opts:
+            return  # idempotent per step (reference tracks OptimizerState)
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is None:
+                continue
+            g = p.grad._data.astype(jnp.float32) * inv
+            if not bool(jnp.isfinite(g).all()):
+                found = True
+            p.grad = Tensor(g)
+        self._found_inf = found
+        self._unscaled_opts.add(id(optimizer))
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._unscaled_opts.discard(id(optimizer))
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n_nan_or_inf:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_count": self._good_steps,
+                "decr_count": self._bad_steps}
+
+    def set_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("incr_count", 0)
+        self._bad_steps = sd.get("decr_count", 0)
+
+
+class debugging:
+    """Namespace stub for paddle.amp.debugging (reference amp/debugging.py);
+    the eager check_nan_inf flag (core/flags.py) covers the main use."""
+
+    @staticmethod
+    def enable_operator_stats_collection():
+        from ..core.dispatch import DISPATCH_HOOKS
+        stats: dict = {}
+        hook = lambda name: stats.__setitem__(name, stats.get(name, 0) + 1)
+        DISPATCH_HOOKS.append(hook)
+        debugging._stats = stats
+        debugging._hook = hook
+
+    @staticmethod
+    def disable_operator_stats_collection():
+        from ..core.dispatch import DISPATCH_HOOKS
+        if getattr(debugging, "_hook", None) in DISPATCH_HOOKS:
+            DISPATCH_HOOKS.remove(debugging._hook)
+        for k, v in sorted(getattr(debugging, "_stats", {}).items()):
+            print(f"  {k}: {v}")

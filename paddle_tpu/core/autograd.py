@@ -134,6 +134,15 @@ class GradNode:
             g if g is not None else _zero_cotangent(s, d)
             for g, s, d in zip(out_grads, self.out_shapes, self.out_dtypes)
         ]
+        # AMP boundary: a downstream low-precision op hands back a bf16/fp16
+        # cotangent for an fp32 output (or vice versa) — jax.vjp requires
+        # exact aval match, so cast to the recorded output dtype (the
+        # reference casts in its generated GradNodes the same way).
+        cotangents = [
+            c.astype(d) if hasattr(c, "dtype") and c.dtype != d
+            and c.dtype != jax.dtypes.float0 else c
+            for c, d in zip(cotangents, self.out_dtypes)
+        ]
         if self.multi_output:
             in_grads = self.vjp_fn(tuple(cotangents))
         else:

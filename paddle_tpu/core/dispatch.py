@@ -79,6 +79,12 @@ def _amp_cast_arrays(opdef: OpDef, arrays: list):
     if state is None or not state.get("enable"):
         return arrays
     policy = opdef.amp_policy
+    # Runtime allow/deny lists override the registered per-op policy
+    # (reference: custom_white_list/custom_black_list, amp/auto_cast.py).
+    if opdef.name in state.get("black", ()):
+        policy = "keep_fp32"
+    elif opdef.name in state.get("white", ()):
+        policy = "cast"
     target = state["dtype"]
     if policy == "cast" or (state.get("level") == "O2" and policy != "keep_fp32"):
         return [

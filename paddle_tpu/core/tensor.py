@@ -160,6 +160,12 @@ class Tensor:
     def __hash__(self):
         return id(self)
 
+    def __reduce__(self):
+        # Pickle via host numpy (spawned DataLoader workers, checkpointing);
+        # device placement is not a portable property of a pickled tensor.
+        return (_unpickle_tensor,
+                (np.asarray(self._data), self.stop_gradient, self.name))
+
     # ---- mutation ----------------------------------------------------------
     def set_value(self, value):
         """Rebind the buffer (in-place assignment semantics).
@@ -208,6 +214,10 @@ class Tensor:
             f"Tensor(shape={self.shape}, dtype={self.dtype}{grad_info},\n"
             f"       {data_repr})"
         )
+
+
+def _unpickle_tensor(arr, stop_gradient, name):
+    return Tensor(arr, stop_gradient=stop_gradient, name=name)
 
 
 class Parameter(Tensor):

@@ -1,0 +1,8 @@
+"""High-level Model API (reference: python/paddle/hapi/model.py:1472
+``Model``, fit:2200; callbacks.py)."""
+
+from .model import Model
+from .callbacks import Callback, EarlyStopping, LRScheduler, ModelCheckpoint
+
+__all__ = ["Model", "Callback", "EarlyStopping", "LRScheduler",
+           "ModelCheckpoint"]

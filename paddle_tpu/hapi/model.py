@@ -1,0 +1,238 @@
+"""paddle.Model equivalent: prepare/fit/evaluate/predict/save/load.
+
+Re-design of python/paddle/hapi/model.py:1472 (fit:2200). The reference
+keeps separate dygraph/static adapters; here the train step is one eager
+function that `paddle_tpu.jit.to_static` captures on demand
+(prepare(jit_compile=True)), giving the static-graph speed path without an
+adapter split.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..metric import Metric
+from .callbacks import Callback, ProgBarLogger
+
+__all__ = ["Model"]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self.stop_training = False
+        self._optimizer = None
+        self._loss = None
+        self._metrics: list[Metric] = []
+        self._train_step = None
+
+    # -- setup --------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, jit_compile: bool = False):
+        self._optimizer = optimizer
+        self._loss = loss
+        ms = metrics if metrics is not None else []
+        self._metrics = list(ms) if isinstance(ms, (list, tuple)) else [ms]
+
+        def train_step(*data):
+            n_in = len(data) - 1 if len(data) > 1 else 1
+            inputs, labels = data[:-1], data[-1]
+            outputs = self.network(*inputs)
+            loss_v = self._loss(outputs, labels)
+            loss_v.backward()
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+            return loss_v, outputs
+
+        if jit_compile:
+            from .. import jit
+
+            train_step = jit.to_static(train_step)
+        self._train_step = train_step
+
+    # -- loops --------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size: int = 1,
+            epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
+            save_dir=None, save_freq: int = 1, verbose: int = 1,
+            drop_last: bool = False, shuffle: bool = True,
+            num_workers: int = 0, callbacks: Optional[Sequence[Callback]] = None,
+            accumulate_grad_batches: int = 1, num_iters=None):
+        loader = self._as_loader(train_data, batch_size, shuffle, drop_last,
+                                 num_workers)
+        cbs = list(callbacks or [])
+        if verbose:
+            cbs.append(ProgBarLogger(log_freq, verbose))
+        for cb in cbs:
+            cb.set_model(self)
+            cb.on_train_begin()
+        self.stop_training = False
+        it = 0
+        for epoch in range(epochs):
+            for cb in cbs:
+                cb.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                for cb in cbs:
+                    cb.on_train_batch_begin(step)
+                loss, outputs = self._train_step(*self._split(batch))
+                logs = {"loss": float(np.asarray(loss._data))}
+                labels = self._split(batch)[-1]
+                for m in self._metrics:
+                    c = m.compute(outputs, labels)
+                    res = m.update(*c) if isinstance(c, tuple) else m.update(c)
+                    names = m.name()
+                    names = [names] if isinstance(names, str) else names
+                    vals = res if isinstance(res, (list, tuple)) else [res]
+                    logs.update(dict(zip(names, vals)))
+                for cb in cbs:
+                    cb.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    self.stop_training = True
+                    break
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data, batch_size,
+                                          verbose=0, num_workers=num_workers)
+                for cb in cbs:
+                    cb.on_eval_end(eval_logs)
+            for cb in cbs:
+                cb.on_epoch_end(epoch, logs)
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+            if self.stop_training:
+                break
+        for cb in cbs:
+            cb.on_train_end()
+
+    def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,
+                 verbose: int = 1, num_workers: int = 0, callbacks=None,
+                 num_samples=None):
+        from ..core import autograd
+
+        loader = self._as_loader(eval_data, batch_size, False, False,
+                                 num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        with autograd.no_grad():
+            for batch in loader:
+                parts = self._split(batch)
+                inputs, labels = parts[:-1], parts[-1]
+                outputs = self.network(*inputs)
+                if self._loss is not None:
+                    losses.append(float(np.asarray(
+                        self._loss(outputs, labels)._data)))
+                for m in self._metrics:
+                    c = m.compute(outputs, labels)
+                    m.update(*c) if isinstance(c, tuple) else m.update(c)
+        logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            names = m.name()
+            names = [names] if isinstance(names, str) else names
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, (list, tuple)) else [vals]
+            logs.update(dict(zip(names, vals)))
+        return logs
+
+    def predict(self, test_data, batch_size: int = 1, num_workers: int = 0,
+                stack_outputs: bool = False, verbose: int = 1, callbacks=None):
+        from ..core import autograd
+
+        loader = self._as_loader(test_data, batch_size, False, False,
+                                 num_workers)
+        outs = []
+        with autograd.no_grad():
+            for batch in loader:
+                parts = self._split(batch)
+                inputs = parts if not isinstance(batch, (list, tuple)) or \
+                    len(parts) == 1 else parts[:-1]
+                outs.append(self.network(*inputs))
+        if stack_outputs:
+            import jax.numpy as jnp
+
+            return [Tensor(jnp.concatenate([o._data for o in outs], 0))]
+        return [outs]
+
+    def train_batch(self, inputs, labels=None):
+        loss, _ = self._train_step(*self._as_tensors(inputs, labels))
+        return [float(np.asarray(loss._data))]
+
+    def eval_batch(self, inputs, labels=None):
+        from ..core import autograd
+
+        with autograd.no_grad():
+            args = self._as_tensors(inputs, labels)
+            out = self.network(*args[:-1])
+            return [float(np.asarray(self._loss(out, args[-1])._data))]
+
+    def predict_batch(self, inputs):
+        from ..core import autograd
+
+        with autograd.no_grad():
+            return [self.network(*self._as_tensors(inputs, None)[:-1])]
+
+    # -- io -----------------------------------------------------------------
+    def save(self, path: str, training: bool = True):
+        from .. import framework
+
+        framework.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            framework.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer:
+             bool = False):
+        from .. import framework
+
+        self.network.set_state_dict(framework.load(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None:
+            import os
+
+            if os.path.exists(path + ".pdopt"):
+                self._optimizer.set_state_dict(framework.load(path + ".pdopt"))
+
+    def parameters(self, *a, **k):
+        return self.network.parameters(*a, **k)
+
+    def summary(self, input_size=None, dtype=None):
+        n = sum(p.size for p in self.network.parameters())
+        info = {"total_params": n}
+        print(f"Total params: {n:,}")
+        return info
+
+    # -- helpers ------------------------------------------------------------
+    @staticmethod
+    def _as_loader(data, batch_size, shuffle, drop_last, num_workers):
+        from ..io import DataLoader, Dataset
+
+        if data is None:
+            raise ValueError("data is required")
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              drop_last=drop_last, num_workers=num_workers)
+        return data  # generic iterable of batches
+
+    @staticmethod
+    def _split(batch):
+        if isinstance(batch, (list, tuple)):
+            return tuple(batch)
+        return (batch,)
+
+    def _as_tensors(self, inputs, labels):
+        def t(x):
+            return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+        ins = [t(i) for i in (inputs if isinstance(inputs, (list, tuple))
+                              else [inputs])]
+        if labels is not None:
+            labs = [t(l) for l in (labels if isinstance(labels, (list, tuple))
+                                   else [labels])]
+        else:
+            labs = []
+        return tuple(ins + labs)

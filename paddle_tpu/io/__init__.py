@@ -1,0 +1,20 @@
+"""paddle_tpu.io: datasets, samplers, DataLoader.
+
+Reference surface: python/paddle/io (reader.py:262 DataLoader, dataset.py,
+dataloader/batch_sampler.py incl. DistributedBatchSampler).
+"""
+
+from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,
+                      IterableDataset, Subset, TensorDataset, random_split)
+from .reader import DataLoader, default_collate_fn
+from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
+                      Sampler, SequenceSampler, SubsetRandomSampler,
+                      WeightedRandomSampler)
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "ChainDataset", "ConcatDataset", "Subset", "random_split",
+    "DataLoader", "default_collate_fn",
+    "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
+    "SubsetRandomSampler", "BatchSampler", "DistributedBatchSampler",
+]

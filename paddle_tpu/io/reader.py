@@ -1,0 +1,211 @@
+"""DataLoader: batched, shuffled, prefetching host-side input pipeline.
+
+Re-design of python/paddle/io/reader.py:262 ``DataLoader`` and the
+dataloader worker stack (io/dataloader/worker.py ``_worker_loop``,
+fetcher/collate, SURVEY.md §8.10: index queue → worker processes → shared
+blocking queue → device).
+
+TPU translation: batches are assembled on host as numpy (TPU input is
+host RAM → PCIe/ICI transfer at dispatch; there is no per-GPU pin-memory
+stage), so "move to device ahead of consumption" becomes an async
+``jax.device_put`` one batch ahead. num_workers>0 uses a process pool
+(spawn-safe) feeding an ordered prefetch window of ``prefetch_factor *
+num_workers`` like the reference's blocking-queue capacity.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler, RandomSampler, SequenceSampler
+
+__all__ = ["DataLoader", "default_collate_fn"]
+
+
+def default_collate_fn(batch):
+    """Stack samples (reference: io/dataloader/collate.py default_collate_fn)."""
+    sample = batch[0]
+    if isinstance(sample, (np.ndarray, np.generic)):
+        return np.stack(batch)
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._data) for s in batch])
+    if isinstance(sample, (int, float)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn(list(f)) for f in zip(*batch))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    return batch
+
+
+def _fetch(dataset, indices, collate_fn):
+    return collate_fn([dataset[i] for i in indices])
+
+
+# Worker-process globals, set once by the pool initializer so batch
+# submissions carry only index lists (the reference's index-queue protocol,
+# io/dataloader/worker.py) instead of re-pickling the dataset per batch.
+_WORKER_STATE: dict = {}
+
+
+def _init_worker(dataset, collate_fn, worker_init_fn, worker_id_counter):
+    _WORKER_STATE["dataset"] = dataset
+    _WORKER_STATE["collate_fn"] = collate_fn
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id_counter)
+
+
+def _fetch_in_worker(indices):
+    return _fetch(_WORKER_STATE["dataset"], indices,
+                  _WORKER_STATE["collate_fn"])
+
+
+class _MultiprocessIter:
+    """Ordered multiprocess fetcher: an index feeder keeps
+    prefetch_factor×workers tasks in flight; results are yielded in order
+    (the reference reorders via _rcvd_idx bookkeeping, worker.py).
+
+    Uses the spawn context: the parent holds a live multithreaded jax
+    runtime, and fork() from a multithreaded process deadlocks; the dataset
+    is shipped once per worker via the initializer."""
+
+    def __init__(self, loader):
+        import multiprocessing as mp
+
+        self._loader = loader
+        ctx = mp.get_context("spawn")
+        self._pool = ctx.Pool(
+            loader.num_workers,
+            initializer=_init_worker,
+            initargs=(loader.dataset, loader.collate_fn, None, 0),
+        )
+        self._batches = iter(loader.batch_sampler)
+        self._pending: "queue.Queue" = queue.Queue()
+        self._depth = loader.prefetch_factor * loader.num_workers
+        for _ in range(self._depth):
+            self._submit()
+
+    def _submit(self):
+        idxs = next(self._batches, None)
+        if idxs is None:
+            return
+        r = self._pool.apply_async(_fetch_in_worker, (list(idxs),))
+        self._pending.put(r)
+
+    def __next__(self):
+        if self._pending.empty():
+            self._pool.close()
+            raise StopIteration
+        r = self._pending.get()
+        self._submit()
+        out = r.get(timeout=self._loader.timeout or None)
+        return self._loader._to_tensor(out)
+
+    def __del__(self):
+        try:
+            self._pool.terminate()
+        except Exception:
+            pass
+
+
+class DataLoader:
+    """reference io/reader.py:262; iterates Tensors (or numpy with
+    return_numpy=True, a TPU-native extension for feeding jitted steps)."""
+
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list: bool = True, batch_sampler=None,
+                 batch_size: Optional[int] = 1, shuffle: bool = False,
+                 drop_last: bool = False, collate_fn: Optional[Callable] = None,
+                 num_workers: int = 0, use_buffer_reader: bool = True,
+                 prefetch_factor: int = 2, use_shared_memory: bool = True,
+                 timeout: int = 0, worker_init_fn=None,
+                 return_numpy: bool = False):
+        self.dataset = dataset
+        self.num_workers = max(0, int(num_workers))
+        self.prefetch_factor = prefetch_factor
+        self.timeout = timeout
+        self.collate_fn = collate_fn or default_collate_fn
+        self.return_numpy = return_numpy
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+
+        if batch_sampler is not None:
+            if batch_size != 1 and batch_size is not None or shuffle or drop_last:
+                pass  # mirror reference: batch_sampler is exclusive; ignore
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", None)
+        elif batch_size is None:
+            self.batch_sampler = None
+            self.batch_size = None
+        else:
+            self.batch_size = batch_size
+            if not self._iterable_mode:
+                sampler = (RandomSampler(dataset) if shuffle
+                           else SequenceSampler(dataset))
+                self.batch_sampler = BatchSampler(
+                    sampler=sampler, batch_size=batch_size,
+                    drop_last=drop_last)
+            else:
+                self.batch_sampler = None
+        self.drop_last = drop_last
+
+    def _to_tensor(self, out):
+        if self.return_numpy:
+            return out
+        if isinstance(out, (list, tuple)):
+            return type(out)(self._to_tensor(o) for o in out)
+        if isinstance(out, dict):
+            return {k: self._to_tensor(v) for k, v in out.items()}
+        if isinstance(out, np.ndarray):
+            return Tensor(out)
+        return out
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("length of IterableDataset is unknown")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self._iterable_mode:
+            return self._iter_iterable()
+        if self.batch_sampler is None:
+            return (self._to_tensor(self.collate_fn([self.dataset[i]]))
+                    for i in range(len(self.dataset)))
+        if self.num_workers > 0:
+            it = _MultiprocessIter(self)
+            return iter(lambda: _next_or_sentinel(it), _SENTINEL)
+        return self._iter_single()
+
+    def _iter_single(self):
+        for idxs in self.batch_sampler:
+            yield self._to_tensor(_fetch(self.dataset, idxs, self.collate_fn))
+
+    def _iter_iterable(self):
+        it = iter(self.dataset)
+        if self.batch_size is None:
+            for sample in it:
+                yield self._to_tensor(self.collate_fn([sample]))
+            return
+        while True:
+            chunk = list(itertools.islice(it, self.batch_size))
+            if not chunk or (self.drop_last and len(chunk) < self.batch_size):
+                return
+            yield self._to_tensor(self.collate_fn(chunk))
+
+
+_SENTINEL = object()
+
+
+def _next_or_sentinel(it):
+    try:
+        return next(it)
+    except StopIteration:
+        return _SENTINEL

@@ -40,7 +40,16 @@ def pipeline_blocks_fn(stage_fn: Callable, mesh: Mesh, n_microbatches: int,
     """
     n_stages = mesh.shape[pp_axis]
 
+    # Build the shard_map'd program ONCE (a fresh shard_map+jit per call
+    # would defeat the compile cache for eager callers). Partial-manual:
+    # mesh comes from the jax.sharding.set_mesh context (passing mesh=
+    # would make every axis manual); pp is manual, dp/mp stay under GSPMD
+    # propagation inside the body. The context mesh resolves only under
+    # jit; callers outside jit must wrap in `jax.sharding.set_mesh(mesh)`.
+    local = None
+
     def blocks_fn(stacked_params, x):
+        nonlocal local
         if n_stages == 1:
             return stage_fn(stacked_params, x)
         B = x.shape[0]
@@ -49,25 +58,22 @@ def pipeline_blocks_fn(stage_fn: Callable, mesh: Mesh, n_microbatches: int,
         mb = B // M
         xs = x.reshape((M, mb) + x.shape[1:])
 
-        in_specs = (jax.tree.map(lambda _: P(pp_axis), stacked_params),
-                    P())
-        # Partial-manual shard_map: mesh comes from the jax.sharding.set_mesh
-        # context (passing mesh= would make every axis manual); pp is manual,
-        # dp/mp stay under GSPMD propagation inside the body.
-        run = jax.shard_map(
-            functools.partial(_pipeline_local, stage_fn=stage_fn,
-                              n_stages=n_stages, n_micro=M,
-                              pp_axis=pp_axis),
-            in_specs=in_specs,
-            # each stage returns its output buffer stacked on a leading pp
-            # dim; only the last stage's slice is the real model output
-            out_specs=P(pp_axis),
-            axis_names={pp_axis},
-            check_vma=False,
-        )
-        # Partial-manual shard_map resolves the context mesh only under jit;
-        # callers outside jit must wrap in `jax.sharding.set_mesh(mesh)`.
-        ys = jax.jit(run)(stacked_params, xs)[-1]
+        if local is None:
+            in_specs = (jax.tree.map(lambda _: P(pp_axis), stacked_params),
+                        P())
+            run = jax.shard_map(
+                functools.partial(_pipeline_local, stage_fn=stage_fn,
+                                  n_stages=n_stages, n_micro=M,
+                                  pp_axis=pp_axis),
+                in_specs=in_specs,
+                # each stage returns its output buffer stacked on a leading
+                # pp dim; only the last stage's slice is the model output
+                out_specs=P(pp_axis),
+                axis_names={pp_axis},
+                check_vma=False,
+            )
+            local = jax.jit(run)
+        ys = local(stacked_params, xs)[-1]
         return ys.reshape((B,) + x.shape[1:])
 
     return blocks_fn

@@ -1,0 +1,123 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+Zero-egress build: dataset classes read local files when present (same
+formats as the reference) and raise a clear error otherwise; FakeData
+provides deterministic synthetic samples for tests/benchmarks.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic images (torchvision-style FakeData)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=10,
+                 transform=None):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = np.array(idx % self.num_classes, dtype=np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class MNIST(Dataset):
+    """IDX-format MNIST reader (reference vision/datasets/mnist.py — minus
+    the downloader: point image_path/label_path at local files)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        self.transform = transform
+        if image_path is None or label_path is None:
+            raise ValueError(
+                "zero-egress build: pass image_path=/label_path= to local "
+                "IDX files (idx3-ubyte[.gz] / idx1-ubyte[.gz])")
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path,
+                                                                       "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            assert magic == 2051, f"bad magic {magic}"
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            assert magic == 2049, f"bad magic {magic}"
+            return np.frombuffer(f.read(), dtype=np.uint8)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None] / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array(int(self.labels[idx]), dtype=np.int64)
+
+
+FashionMNIST = MNIST
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 python-pickle format reader (local file)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file is None:
+            raise ValueError("zero-egress build: pass data_file= pointing at "
+                             "the cifar-10 batches file")
+        import pickle
+        import tarfile
+
+        self.transform = transform
+        datas, labels = [], []
+        names = ([f"data_batch_{i}" for i in range(1, 6)]
+                 if mode == "train" else ["test_batch"])
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                if any(m.name.endswith(n) for n in names):
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    datas.append(d[b"data"])
+                    labels.extend(d[b"labels"])
+        self.data = np.concatenate(datas).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.data[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class Cifar100(Cifar10):
+    pass

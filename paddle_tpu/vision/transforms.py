@@ -1,0 +1,136 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/).
+
+Numpy-based host-side preprocessing (the TPU input pipeline keeps image
+decode/augment on host; see io/reader.py).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "Transpose",
+           "to_tensor", "normalize", "resize"]
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+def to_tensor(pic, data_format="CHW"):
+    raw = np.asarray(pic)
+    arr = raw.astype(np.float32)
+    if raw.dtype == np.uint8:
+        arr = arr / 255.0
+    if arr.ndim == 2:
+        arr = arr[None] if data_format == "CHW" else arr[..., None]
+    elif data_format == "CHW" and arr.shape[-1] in (1, 3, 4):
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr)
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, pic):
+        return to_tensor(pic, self.data_format)
+
+
+def normalize(img, mean, std, data_format="CHW"):
+    arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img,
+                                                                 np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        arr = (arr - mean[:, None, None]) / std[:, None, None]
+    else:
+        arr = (arr - mean) / std
+    return Tensor(arr) if isinstance(img, Tensor) else arr
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", **kw):
+        self.mean = [mean] * 3 if np.isscalar(mean) else mean
+        self.std = [std] * 3 if np.isscalar(std) else std
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+def _interp_resize(arr, h, w):
+    """Nearest-neighbour resize (host-side, dependency-free)."""
+    H, W = arr.shape[:2]
+    ys = (np.arange(h) * H / h).astype(int).clip(0, H - 1)
+    xs = (np.arange(w) * W / w).astype(int).clip(0, W - 1)
+    return arr[ys][:, xs]
+
+
+def resize(img, size, interpolation="nearest"):
+    arr = np.asarray(img)
+    if np.isscalar(size):
+        size = (int(size), int(size))
+    return _interp_resize(arr, size[0], size[1])
+
+
+class Resize:
+    def __init__(self, size, interpolation="nearest"):
+        self.size = size
+
+    def __call__(self, img):
+        return resize(img, self.size)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if np.isscalar(size) else tuple(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = self.size
+        H, W = arr.shape[:2]
+        top, left = (H - h) // 2, (W - w) // 2
+        return arr[top:top + h, left:left + w]
+
+
+class RandomCrop:
+    def __init__(self, size, **kw):
+        self.size = (size, size) if np.isscalar(size) else tuple(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = self.size
+        H, W = arr.shape[:2]
+        top = np.random.randint(0, max(1, H - h + 1))
+        left = np.random.randint(0, max(1, W - w + 1))
+        return arr[top:top + h, left:left + w]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if np.random.rand() < self.prob:
+            return arr[:, ::-1].copy()
+        return arr
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.asarray(img).transpose(self.order)

@@ -1,0 +1,128 @@
+"""AMP + DataLoader tests (reference: test/amp/, test/legacy_test dataloader
+suites)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu import amp
+from paddle_tpu.io import (BatchSampler, DataLoader, DistributedBatchSampler,
+                           TensorDataset)
+
+
+# ---------------------------------------------------------------------------
+# AMP
+# ---------------------------------------------------------------------------
+
+def test_autocast_o1_casts_matmul():
+    x = pt.randn([4, 8])
+    w = pt.randn([8, 8])
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        y = x.matmul(w)
+    assert y.dtype == jnp.bfloat16
+    y2 = x.matmul(w)
+    assert y2.dtype == jnp.float32
+
+
+def test_autocast_black_list_keeps_fp32():
+    x = pt.randn([4, 8])
+    with amp.auto_cast(level="O1"):
+        s = pt.nn.functional.softmax(x)
+    assert s.dtype == jnp.float32
+
+
+def test_autocast_custom_lists():
+    x = pt.randn([4, 8])
+    with amp.auto_cast(level="O1", custom_black_list={"matmul"}):
+        y = x.matmul(pt.randn([8, 8]))
+    assert y.dtype == jnp.float32
+
+
+def test_grad_scaler_dynamic():
+    m = nn.Linear(8, 4)
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=1024.0,
+                            incr_every_n_steps=2)
+    w0 = m.weight.numpy().copy()
+    x = pt.randn([4, 8])
+    loss = m(x).mean()
+    scaler.scale(loss).backward()
+    scaler.step(opt)
+    scaler.update()
+    assert not np.allclose(m.weight.numpy(), w0)
+    assert scaler.get_loss_scaling() == 1024.0  # not yet grown
+
+
+def test_grad_scaler_skips_on_inf():
+    m = nn.Linear(4, 2)
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=8.0)
+    w0 = m.weight.numpy().copy()
+    x = pt.to_tensor(np.full((2, 4), 1e38, np.float32))
+    loss = (m(x) * 1e38).mean()
+    scaler.scale(loss).backward()
+    scaler.step(opt)   # grads overflow -> step skipped
+    scaler.update()    # scale backs off
+    np.testing.assert_allclose(m.weight.numpy(), w0)
+    assert scaler.get_loss_scaling() < 8.0
+
+
+def test_decorate_o2_casts_params():
+    m = nn.Linear(8, 4)
+    amp.decorate(m, level="O2", dtype="bfloat16")
+    assert m.weight.dtype == jnp.bfloat16
+
+
+# ---------------------------------------------------------------------------
+# DataLoader
+# ---------------------------------------------------------------------------
+
+def _dataset(n=20):
+    xs = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+    ys = np.arange(n, dtype=np.int64)
+    return TensorDataset([pt.to_tensor(xs), pt.to_tensor(ys)])
+
+
+def test_dataloader_basic():
+    dl = DataLoader(_dataset(), batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 5
+    x, y = batches[0]
+    assert x.shape == [4, 3]
+    np.testing.assert_allclose(y.numpy(), [0, 1, 2, 3])
+
+
+def test_dataloader_shuffle_drop_last():
+    pt.seed(0)
+    dl = DataLoader(_dataset(10), batch_size=3, shuffle=True, drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 3
+    seen = np.concatenate([b[1].numpy() for b in batches])
+    assert len(set(seen.tolist())) == 9
+
+
+def test_dataloader_multiprocess_matches_serial():
+    ds = _dataset(16)
+    serial = [b[1].numpy() for b in DataLoader(ds, batch_size=4)]
+    mp = [b[1].numpy() for b in DataLoader(ds, batch_size=4, num_workers=2)]
+    np.testing.assert_array_equal(np.stack(serial), np.stack(mp))
+
+
+def test_distributed_batch_sampler_partitions():
+    ds = _dataset(16)
+    seen = []
+    for rank in range(4):
+        bs = DistributedBatchSampler(ds, batch_size=2, num_replicas=4,
+                                     rank=rank)
+        for idxs in bs:
+            seen.extend(idxs)
+    assert sorted(seen) == list(range(16))
+
+
+def test_dataloader_return_numpy():
+    dl = DataLoader(_dataset(), batch_size=4, return_numpy=True)
+    x, y = next(iter(dl))
+    assert isinstance(x, np.ndarray)

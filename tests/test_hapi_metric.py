@@ -1,0 +1,86 @@
+"""hapi Model.fit + metrics tests (reference: test/legacy_test/test_model.py)."""
+
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+
+
+def _cls_dataset(n=32, d=8, classes=4):
+    rng = np.random.RandomState(0)
+    xs = rng.randn(n, d).astype(np.float32)
+    ys = rng.randint(0, classes, size=(n,)).astype(np.int64)
+    return TensorDataset([pt.to_tensor(xs), pt.to_tensor(ys)])
+
+
+def test_model_fit_evaluate_predict():
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+    model = pt.Model(net)
+    model.prepare(
+        optimizer=pt.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy(),
+    )
+    ds = _cls_dataset()
+    model.fit(ds, epochs=2, batch_size=8, verbose=0)
+    logs = model.evaluate(ds, batch_size=8, verbose=0)
+    assert "loss" in logs and "acc" in logs
+    preds = model.predict(ds, batch_size=8, stack_outputs=True)
+    assert preds[0].shape == [32, 4]
+
+
+def test_model_fit_jit_compiled():
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model = pt.Model(net)
+    model.prepare(
+        optimizer=pt.optimizer.SGD(learning_rate=0.05,
+                                   parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        jit_compile=True,
+    )
+    ds = _cls_dataset()
+    model.fit(ds, epochs=2, batch_size=8, verbose=0)
+    logs = model.evaluate(ds, batch_size=8, verbose=0)
+    assert logs["loss"] < 1.6
+
+
+def test_accuracy_topk():
+    m = Accuracy(topk=(1, 2))
+    pred = pt.to_tensor(np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]],
+                                 np.float32))
+    label = pt.to_tensor(np.array([1, 2]))
+    m.update(m.compute(pred, label))
+    top1, top2 = m.accumulate()
+    assert top1 == 0.5 and top2 == 0.5
+
+
+def test_precision_recall_auc():
+    p, r, a = Precision(), Recall(), Auc()
+    preds = np.array([0.9, 0.8, 0.2, 0.1], np.float32)
+    labels = np.array([1, 0, 1, 0])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    a.update(preds, labels)
+    assert p.accumulate() == 0.5
+    assert r.accumulate() == 0.5
+    assert 0.4 < a.accumulate() <= 0.8
+
+
+def test_early_stopping():
+    from paddle_tpu.hapi.callbacks import EarlyStopping
+
+    net = nn.Linear(8, 4)
+    model = pt.Model(net)
+    model.prepare(
+        optimizer=pt.optimizer.SGD(learning_rate=0.0,
+                                   parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+    )
+    ds = _cls_dataset(16)
+    es = EarlyStopping(monitor="loss", patience=1, mode="min")
+    model.fit(ds, eval_data=ds, epochs=6, batch_size=8, verbose=0,
+              callbacks=[es])
+    assert model.stop_training  # lr=0 -> no improvement -> stopped early
