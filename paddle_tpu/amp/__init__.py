@@ -155,15 +155,22 @@ class GradScaler:
         if not self._enable or id(optimizer) in self._unscaled_opts:
             return  # idempotent per step (reference tracks OptimizerState)
         inv = 1.0 / self._scale
-        found = False
+        finite_flags = []
         for p in optimizer._parameter_list:
             if p.grad is None:
                 continue
             g = p.grad._data.astype(jnp.float32) * inv
-            if not bool(jnp.isfinite(g).all()):
-                found = True
+            finite_flags.append(jnp.isfinite(g).all())
             p.grad = Tensor(g)
-        self._found_inf = found
+        # single device->host sync for the whole found_inf check (the
+        # reference reduces found_inf across params in one kernel too)
+        if finite_flags:
+            all_finite = finite_flags[0]
+            for f in finite_flags[1:]:
+                all_finite = jnp.logical_and(all_finite, f)
+            self._found_inf = not bool(all_finite)
+        else:
+            self._found_inf = False
         self._unscaled_opts.add(id(optimizer))
 
     def step(self, optimizer):

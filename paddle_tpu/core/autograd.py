@@ -115,8 +115,9 @@ class GradNode:
         self.inputs = list(inputs)
         self.multi_output = isinstance(outs, (tuple, list))
         outs_t = outs if self.multi_output else (outs,)
-        self.out_shapes = [o.shape for o in outs_t]
-        self.out_dtypes = [o.dtype for o in outs_t]
+        # None entries = optional outputs the op didn't produce
+        self.out_shapes = [getattr(o, "shape", None) for o in outs_t]
+        self.out_dtypes = [getattr(o, "dtype", None) for o in outs_t]
         self.released = False
 
     @property
@@ -131,7 +132,8 @@ class GradNode:
                 "retain_graph=True to backward() to backprop twice."
             )
         cotangents = [
-            g if g is not None else _zero_cotangent(s, d)
+            g if g is not None else
+            (None if s is None else _zero_cotangent(s, d))
             for g, s, d in zip(out_grads, self.out_shapes, self.out_dtypes)
         ]
         # AMP boundary: a downstream low-precision op hands back a bf16/fp16
@@ -139,7 +141,8 @@ class GradNode:
         # exact aval match, so cast to the recorded output dtype (the
         # reference casts in its generated GradNodes the same way).
         cotangents = [
-            c.astype(d) if hasattr(c, "dtype") and c.dtype != d
+            c.astype(d) if c is not None and d is not None
+            and hasattr(c, "dtype") and c.dtype != d
             and c.dtype != jax.dtypes.float0 else c
             for c, d in zip(cotangents, self.out_dtypes)
         ]

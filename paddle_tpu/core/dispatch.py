@@ -152,6 +152,8 @@ def op_call(opdef: OpDef, args, kwargs):
         _check_nan_inf(opdef.name, outs)
 
     def wrap(arr, slot):
+        if arr is None:  # optional outputs (e.g. fused_rope's absent v)
+            return None
         t = Tensor(arr, stop_gradient=node is None)
         if node is not None:
             t._grad_node = node

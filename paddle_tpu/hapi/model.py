@@ -37,16 +37,22 @@ class Model:
         ms = metrics if metrics is not None else []
         self._metrics = list(ms) if isinstance(ms, (list, tuple)) else [ms]
 
+        self._accum = 1
+        self._accum_count = 0
+
         def train_step(*data):
-            n_in = len(data) - 1 if len(data) > 1 else 1
             inputs, labels = data[:-1], data[-1]
             outputs = self.network(*inputs)
             loss_v = self._loss(outputs, labels)
-            loss_v.backward()
-            self._optimizer.step()
-            self._optimizer.clear_grad()
+            (loss_v.scale(1.0 / self._accum) if self._accum > 1
+             else loss_v).backward()
+            self._accum_count += 1
+            if self._accum_count % self._accum == 0:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
             return loss_v, outputs
 
+        self._train_step_eager = train_step
         if jit_compile:
             from .. import jit
 
@@ -60,6 +66,12 @@ class Model:
             drop_last: bool = False, shuffle: bool = True,
             num_workers: int = 0, callbacks: Optional[Sequence[Callback]] = None,
             accumulate_grad_batches: int = 1, num_iters=None):
+        self._accum = max(1, int(accumulate_grad_batches))
+        self._accum_count = 0
+        # grad accumulation branches per-batch on host state, which a
+        # captured program would bake in — run the eager step in that case
+        step_fn = (self._train_step_eager if self._accum > 1
+                   else self._train_step)
         loader = self._as_loader(train_data, batch_size, shuffle, drop_last,
                                  num_workers)
         cbs = list(callbacks or [])
@@ -79,7 +91,7 @@ class Model:
             for step, batch in enumerate(loader):
                 for cb in cbs:
                     cb.on_train_batch_begin(step)
-                loss, outputs = self._train_step(*self._split(batch))
+                loss, outputs = step_fn(*self._split(batch))
                 logs = {"loss": float(np.asarray(loss._data))}
                 labels = self._split(batch)[-1]
                 for m in self._metrics:

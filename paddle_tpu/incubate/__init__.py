@@ -1,0 +1,6 @@
+"""paddle_tpu.incubate (reference: python/paddle/incubate)."""
+
+from . import nn
+from . import optimizer
+
+__all__ = ["nn", "optimizer"]

@@ -1,0 +1,5 @@
+"""incubate.nn (reference: python/paddle/incubate/nn)."""
+
+from . import functional
+
+__all__ = ["functional"]

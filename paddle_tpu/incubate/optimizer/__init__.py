@@ -1,0 +1,156 @@
+"""incubate optimizers (reference: python/paddle/incubate/optimizer —
+LookAhead, ModelAverage, DistributedFusedLamb, GradientMergeOptimizer)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...optimizer import Lamb
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage", "DistributedFusedLamb",
+           "GradientMergeOptimizer"]
+
+
+class LookAhead(Optimizer):
+    """k-step lookahead wrapper (reference incubate/optimizer/lookahead.py):
+    every k inner steps, slow weights pull toward fast weights by alpha."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._k_count = 0
+        self._parameter_list = inner_optimizer._parameter_list
+        # slow weights snapshot the PRE-window parameters (copies: the inner
+        # optimizer's jitted update donates param buffers, which would
+        # invalidate aliased references)
+        self._slow: dict[int, jnp.ndarray] = {
+            id(p): jnp.array(p._data, copy=True)
+            for p in self._parameter_list
+        }
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._k_count += 1
+        if self._k_count % self.k:
+            return
+        for p in self._parameter_list:
+            slow = self._slow[id(p)]
+            slow = slow + self.alpha * (p._data - slow)
+            self._slow[id(p)] = slow
+            # bump a copy: the next inner step donates p._data's buffer,
+            # which must not alias the retained slow weight
+            p._bump(jnp.array(slow, copy=True))
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        return self.inner_optimizer.state_dict()
+
+    def set_state_dict(self, sd):
+        return self.inner_optimizer.set_state_dict(sd)
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage(Optimizer):
+    """Running average of parameters (reference incubate/optimizer/
+    modelaverage.py): apply()/restore() swap averaged weights in and out."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters)
+        self._sum: dict[int, jnp.ndarray] = {}
+        self._cnt = 0
+        self._backup: dict[int, jnp.ndarray] = {}
+
+    def step(self):
+        self._cnt += 1
+        for p in self._parameter_list:
+            s = self._sum.get(id(p))
+            self._sum[id(p)] = (jnp.array(p._data, copy=True) if s is None
+                                else s + p._data)
+
+    def apply(self, executor=None, need_restore: bool = True):
+        import contextlib
+
+        for p in self._parameter_list:
+            if id(p) in self._sum and self._cnt:
+                self._backup[id(p)] = jnp.array(p._data, copy=True)
+                p._bump(self._sum[id(p)] / self._cnt)
+
+        @contextlib.contextmanager
+        def ctx():
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return ctx()
+
+    def restore(self, executor=None):
+        for p in self._parameter_list:
+            if id(p) in self._backup:
+                p._bump(self._backup.pop(id(p)))
+
+
+class DistributedFusedLamb(Lamb):
+    """reference: incubate/optimizer/distributed_fused_lamb.py (pairs with
+    the distributed_fused_lamb CUDA kernels). On TPU the fused multi-tensor
+    update already happens in one XLA program (optimizer.py step), and
+    gradient sharding rides ZeRO (distributed/sharding.py) — Lamb with the
+    same knobs."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 clip_after_allreduce=True, is_grad_scaled_by_nranks=True,
+                 use_master_param_norm=True, gradient_accumulation_steps=1,
+                 use_master_acc_grad=True, nproc_per_node=None,
+                 use_hierarchical_allreduce=False, name=None):
+        super().__init__(learning_rate=learning_rate,
+                         lamb_weight_decay=lamb_weight_decay, beta1=beta1,
+                         beta2=beta2, epsilon=epsilon, parameters=parameters,
+                         grad_clip=grad_clip,
+                         exclude_from_weight_decay_fn=exclude_from_weight_decay_fn)
+
+
+class GradientMergeOptimizer:
+    """k-step gradient accumulation wrapper (reference incubate/optimizer/
+    gradient_merge.py): inner step fires every k backwards."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+        self._count = 0
+
+    def step(self):
+        self._count += 1
+        if self._count % self.k_steps:
+            return
+        if self.avg:
+            for p in self.inner_optimizer._parameter_list:
+                if p.grad is not None:
+                    p.grad = Tensor(p.grad._data / self.k_steps)
+        self.inner_optimizer.step()
+        self.inner_optimizer.clear_grad()
+
+    def clear_grad(self, set_to_zero: bool = False):
+        # grads intentionally accumulate across the window
+        if self._count % self.k_steps == 0:
+            self.inner_optimizer.clear_grad(set_to_zero)
+
+    def __getattr__(self, name):
+        return getattr(self.inner_optimizer, name)

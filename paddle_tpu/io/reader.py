@@ -55,11 +55,15 @@ def _fetch(dataset, indices, collate_fn):
 _WORKER_STATE: dict = {}
 
 
-def _init_worker(dataset, collate_fn, worker_init_fn, worker_id_counter):
+def _init_worker(dataset, collate_fn, worker_init_fn, id_counter):
     _WORKER_STATE["dataset"] = dataset
     _WORKER_STATE["collate_fn"] = collate_fn
+    with id_counter.get_lock():
+        worker_id = id_counter.value
+        id_counter.value += 1
+    _WORKER_STATE["worker_id"] = worker_id
     if worker_init_fn is not None:
-        worker_init_fn(worker_id_counter)
+        worker_init_fn(worker_id)
 
 
 def _fetch_in_worker(indices):
@@ -81,10 +85,12 @@ class _MultiprocessIter:
 
         self._loader = loader
         ctx = mp.get_context("spawn")
+        counter = ctx.Value("i", 0)
         self._pool = ctx.Pool(
             loader.num_workers,
             initializer=_init_worker,
-            initargs=(loader.dataset, loader.collate_fn, None, 0),
+            initargs=(loader.dataset, loader.collate_fn,
+                      loader.worker_init_fn, counter),
         )
         self._batches = iter(loader.batch_sampler)
         self._pending: "queue.Queue" = queue.Queue()
@@ -132,6 +138,7 @@ class DataLoader:
         self.prefetch_factor = prefetch_factor
         self.timeout = timeout
         self.collate_fn = collate_fn or default_collate_fn
+        self.worker_init_fn = worker_init_fn
         self.return_numpy = return_numpy
         self._iterable_mode = isinstance(dataset, IterableDataset)
 

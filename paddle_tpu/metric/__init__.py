@@ -52,8 +52,10 @@ class Accuracy(Metric):
     def compute(self, pred, label):
         pred = _np(pred)
         label = _np(label)
-        if label.ndim == pred.ndim and label.shape[-1] > 1:
-            label = label.argmax(-1)
+        if label.ndim == pred.ndim:
+            # one-hot/soft labels -> class ids; [B, 1] index labels -> [B]
+            label = label.argmax(-1) if label.shape[-1] > 1 else \
+                label.squeeze(-1)
         order = np.argsort(-pred, axis=-1)[..., :self.maxk]
         correct = (order == label[..., None]).astype(np.float32)
         return correct

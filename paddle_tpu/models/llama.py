@@ -1,0 +1,427 @@
+"""LLaMA: decoder LM with RoPE/RMSNorm/SwiGLU/GQA + a compiled inference
+engine (BASELINE config 5: LLaMA-2 7B fused inference).
+
+The reference serves this with fused CUDA kernels — fused_multi_transformer
+(phi/kernels/fusion/gpu/fused_multi_transformer_kernel.cu), masked
+multihead attention for decode, fused_rope / fused_rms_norm, and weight-only
+quant gemm. TPU translation: prefill and decode are two jitted programs over
+a stacked-layer param pytree; decode attends against a static-shape KV cache
+updated with ``lax.dynamic_update_slice`` (the masked-MHA kernel becomes a
+batched dot against the cache, fused by XLA); rope/rmsnorm/swiglu fuse into
+the surrounding matmuls. Weight-only int8 keeps weights quantized in HBM
+and dequantizes in-register at each matmul (halves the HBM traffic that
+bounds decode).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["LlamaConfig", "llama_presets", "init_llama_params",
+           "llama_apply", "llama_loss", "LlamaForCausalLM",
+           "quantize_weights_int8"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32          # < n_heads => GQA/MQA
+    ffn_hidden: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.bfloat16   # inference default; fp32 for training
+    weight_only_int8: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.n_heads
+
+
+def llama_presets(name: str) -> LlamaConfig:
+    table = {
+        "llama2-7b": dict(hidden=4096, n_layers=32, n_heads=32,
+                          n_kv_heads=32, ffn_hidden=11008),
+        "llama2-13b": dict(hidden=5120, n_layers=40, n_heads=40,
+                           n_kv_heads=40, ffn_hidden=13824),
+        "llama3-8b": dict(hidden=4096, n_layers=32, n_heads=32,
+                          n_kv_heads=8, ffn_hidden=14336,
+                          vocab_size=128256, rope_theta=500000.0),
+        "tinyllama": dict(hidden=256, n_layers=4, n_heads=8, n_kv_heads=4,
+                          ffn_hidden=688, vocab_size=1024, max_seq_len=512),
+    }
+    return LlamaConfig(**table[name])
+
+
+def init_llama_params(cfg: LlamaConfig, key) -> dict:
+    ks = iter(jax.random.split(key, 16))
+    H, L = cfg.hidden, cfg.n_layers
+    dH, nKV = cfg.head_dim, cfg.n_kv_heads
+    F = cfg.ffn_hidden
+    pd = cfg.param_dtype
+    std = 0.02
+
+    def nrm(shape, s=std):
+        return (jax.random.normal(next(ks), shape, jnp.float32) * s).astype(pd)
+
+    return {
+        "wte": nrm((cfg.vocab_size, H)),
+        "blocks": {
+            "attn_norm": jnp.ones((L, H), pd),
+            "wq": nrm((L, H, cfg.n_heads * dH)),
+            "wk": nrm((L, H, nKV * dH)),
+            "wv": nrm((L, H, nKV * dH)),
+            "wo": nrm((L, cfg.n_heads * dH, H), std / math.sqrt(2 * L)),
+            "ffn_norm": jnp.ones((L, H), pd),
+            "w_gate": nrm((L, H, F)),
+            "w_up": nrm((L, H, F)),
+            "w_down": nrm((L, F, H), std / math.sqrt(2 * L)),
+        },
+        "final_norm": jnp.ones((H,), pd),
+        "head": nrm((H, cfg.vocab_size)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# building blocks (the reference's fused-kernel equivalents)
+# ---------------------------------------------------------------------------
+
+def rms_norm(x, g, eps):
+    """fused_rms_norm equivalent (XLA fuses the expression);
+    fp32 accumulation."""
+    x32 = x.astype(jnp.float32)
+    y = x32 * lax.rsqrt((x32 * x32).mean(-1, keepdims=True) + eps)
+    return (y * g.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_angles(cfg: LlamaConfig, positions):
+    """positions: [T] or [B] int; returns (cos, sin) [..., dH/2] fp32."""
+    dH = cfg.head_dim
+    inv = 1.0 / (cfg.rope_theta ** (jnp.arange(0, dH, 2,
+                                               dtype=jnp.float32) / dH))
+    ang = positions.astype(jnp.float32)[..., None] * inv
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x, cos, sin):
+    """fused_rotary_position_embedding equivalent. x: [..., nH, dH];
+    cos/sin broadcastable [..., 1, dH/2] (rotate-half convention)."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    o1 = x1 * cos - x2 * sin
+    o2 = x2 * cos + x1 * sin
+    return jnp.concatenate([o1, o2], -1).astype(x.dtype)
+
+
+def _deq(w, scale):
+    return w.astype(jnp.bfloat16) * scale
+
+
+def _mm(x, w, cfg):
+    """Matmul with optional weight-only int8 (reference: weight_only_linear,
+    incubate/nn/functional; scale per output column)."""
+    if isinstance(w, tuple):  # (int8 weights, scales)
+        wq, scale = w
+        return jnp.einsum("...h,hk->...k", x, _deq(wq, scale),
+                          preferred_element_type=jnp.float32).astype(cfg.dtype)
+    return jnp.einsum("...h,hk->...k", x, w.astype(cfg.dtype),
+                      preferred_element_type=jnp.float32).astype(cfg.dtype)
+
+
+def quantize_weights_int8(params: dict) -> dict:
+    """Weight-only int8: per-column absmax scales (reference:
+    weight_quantize op). Norm gains and embeddings stay high-precision."""
+    def q(path, a):
+        if a.ndim < 2 or "norm" in path or path == "wte":
+            return a
+        scale = jnp.abs(a).max(axis=-2, keepdims=True).astype(jnp.float32) \
+            / 127.0
+        scale = jnp.where(scale == 0, 1.0, scale)
+        wq = jnp.clip(jnp.round(a.astype(jnp.float32) / scale), -127, 127
+                      ).astype(jnp.int8)
+        return (wq, scale.astype(jnp.bfloat16))
+
+    out = {"wte": params["wte"], "final_norm": params["final_norm"],
+           "head": q("head", params["head"]), "blocks": {}}
+    for k, v in params["blocks"].items():
+        out["blocks"][k] = q(k, v)
+    return out
+
+
+def _repeat_kv(x, n_rep):
+    if n_rep == 1:
+        return x
+    B, T, nKV, dH = x.shape
+    return jnp.repeat(x, n_rep, axis=2)
+
+
+def block_apply(bp, x, cfg: LlamaConfig, cos, sin, use_flash=True):
+    """Training/prefill block: full-sequence causal attention."""
+    B, T, H = x.shape
+    nH, nKV, dH = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    h = rms_norm(x, bp["attn_norm"], cfg.rms_eps)
+    q = _mm(h, bp["wq"], cfg).reshape(B, T, nH, dH)
+    k = _mm(h, bp["wk"], cfg).reshape(B, T, nKV, dH)
+    v = _mm(h, bp["wv"], cfg).reshape(B, T, nKV, dH)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    kf = _repeat_kv(k, nH // nKV)
+    vf = _repeat_kv(v, nH // nKV)
+    if use_flash:
+        from ..ops.pallas.flash_attention import (flash_attention_raw,
+                                                  supported)
+
+        if supported(q.shape, q.dtype):
+            o = flash_attention_raw(q, kf, vf, causal=True)
+        else:
+            o = _sdpa(q, kf, vf)
+    else:
+        o = _sdpa(q, kf, vf)
+    x = x + _mm(o.reshape(B, T, nH * dH), bp["wo"], cfg)
+    h = rms_norm(x, bp["ffn_norm"], cfg.rms_eps)
+    gate = _mm(h, bp["w_gate"], cfg)
+    up = _mm(h, bp["w_up"], cfg)
+    x = x + _mm(jax.nn.silu(gate.astype(jnp.float32)).astype(cfg.dtype) * up,
+                bp["w_down"], cfg)
+    return x
+
+
+def _sdpa(q, k, v):
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    T = q.shape[1]
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, -1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def llama_apply(params, tokens, cfg: LlamaConfig, remat: bool = True):
+    B, T = tokens.shape
+    x = params["wte"][tokens].astype(cfg.dtype)
+    cos, sin = rope_angles(cfg, jnp.arange(T))
+    cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+
+    fn = functools.partial(block_apply, cfg=cfg, cos=cos, sin=sin)
+    if remat:
+        fn = jax.checkpoint(fn)
+
+    def body(carry, bp):
+        return fn(bp, carry), None
+
+    x, _ = lax.scan(body, x, params["blocks"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    return _mm(x, params["head"], cfg).astype(jnp.float32)
+
+
+def llama_loss(params, tokens, labels, cfg: LlamaConfig):
+    logits = llama_apply(params, tokens, cfg)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return (lse - gold).mean()
+
+
+# ---------------------------------------------------------------------------
+# inference engine
+# ---------------------------------------------------------------------------
+
+def _decode_block(bp, x, cache_k, cache_v, pos, cfg: LlamaConfig, cos, sin):
+    """One decode step for one block: x [B, 1, H]; cache [B, S, nKV, dH].
+    The reference's masked_multihead_attention kernel: q·cache dot with a
+    position mask, fused by XLA."""
+    B = x.shape[0]
+    nH, nKV, dH = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    h = rms_norm(x, bp["attn_norm"], cfg.rms_eps)
+    q = _mm(h, bp["wq"], cfg).reshape(B, 1, nH, dH)
+    k = _mm(h, bp["wk"], cfg).reshape(B, 1, nKV, dH)
+    v = _mm(h, bp["wv"], cfg).reshape(B, 1, nKV, dH)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype),
+                                       (0, pos, 0, 0))
+    cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype),
+                                       (0, pos, 0, 0))
+    S = cache_k.shape[1]
+    kf = _repeat_kv(cache_k, nH // nKV)     # [B, S, nH, dH]
+    vf = _repeat_kv(cache_v, nH // nKV)
+    logits = jnp.einsum("bqhd,bshd->bhqs", q, kf.astype(q.dtype),
+                        preferred_element_type=jnp.float32) / math.sqrt(dH)
+    mask = (jnp.arange(S) <= pos)[None, None, None, :]
+    logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, -1).astype(q.dtype)
+    o = jnp.einsum("bhqs,bshd->bqhd", p, vf.astype(q.dtype))
+    x = x + _mm(o.reshape(B, 1, nH * dH), bp["wo"], cfg)
+    h = rms_norm(x, bp["ffn_norm"], cfg.rms_eps)
+    x = x + _mm(jax.nn.silu(_mm(h, bp["w_gate"], cfg).astype(jnp.float32)
+                            ).astype(cfg.dtype) * _mm(h, bp["w_up"], cfg),
+                bp["w_down"], cfg)
+    return x, cache_k, cache_v
+
+
+class LlamaForCausalLM:
+    """Compiled prefill/decode inference engine.
+
+    ``generate`` runs one jitted prefill over the prompt, then a jitted
+    per-token decode loop against the static KV cache — the two-executable
+    serving pattern that replaces the reference's AnalysisPredictor +
+    fused_multi_transformer path.
+    """
+
+    def __init__(self, cfg: LlamaConfig, params: Optional[dict] = None,
+                 seed: int = 0, max_batch: int = 1,
+                 max_seq_len: Optional[int] = None):
+        self.cfg = cfg
+        self.params = params if params is not None else init_llama_params(
+            cfg, jax.random.PRNGKey(seed))
+        if cfg.weight_only_int8 and not isinstance(
+                self.params["blocks"]["wq"], tuple):
+            self.params = quantize_weights_int8(self.params)
+        self.max_batch = max_batch
+        self.max_seq = max_seq_len or cfg.max_seq_len
+        self._prefill = jax.jit(self._prefill_impl)
+        self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
+        self._decode_n = jax.jit(self._decode_n_impl, donate_argnums=(1,),
+                                 static_argnames=("n", "temperature",
+                                                 "top_p"))
+
+    def _empty_cache(self, B):
+        L, S = self.cfg.n_layers, self.max_seq
+        nKV, dH = self.cfg.n_kv_heads, self.cfg.head_dim
+        z = jnp.zeros((L, B, S, nKV, dH), self.cfg.dtype)
+        return {"k": z, "v": z}
+
+    def _prefill_impl(self, params, tokens, cache):
+        """Full-sequence forward that also fills the cache."""
+        cfg = self.cfg
+        B, T = tokens.shape
+        x = params["wte"][tokens].astype(cfg.dtype)
+        cos, sin = rope_angles(cfg, jnp.arange(T))
+        cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+        nH, nKV, dH = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+        def body(carry, inp):
+            x = carry
+            bp, ck, cv = inp
+            h = rms_norm(x, bp["attn_norm"], cfg.rms_eps)
+            q = _mm(h, bp["wq"], cfg).reshape(B, T, nH, dH)
+            k = _mm(h, bp["wk"], cfg).reshape(B, T, nKV, dH)
+            v = _mm(h, bp["wv"], cfg).reshape(B, T, nKV, dH)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
+            cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
+            o = _sdpa(q, _repeat_kv(k, nH // nKV), _repeat_kv(v, nH // nKV))
+            x = x + _mm(o.reshape(B, T, nH * dH), bp["wo"], cfg)
+            h = rms_norm(x, bp["ffn_norm"], cfg.rms_eps)
+            x = x + _mm(jax.nn.silu(_mm(h, bp["w_gate"], cfg).astype(
+                jnp.float32)).astype(cfg.dtype) * _mm(h, bp["w_up"], cfg),
+                bp["w_down"], cfg)
+            return x, (ck, cv)
+
+        x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"],
+                                         cache["v"]))
+        x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+        logits = _mm(x[:, -1:], params["head"], cfg).astype(jnp.float32)
+        return logits[:, 0], {"k": ks, "v": vs}
+
+    def _decode_impl(self, params, cache, token, pos):
+        cfg = self.cfg
+        B = token.shape[0]
+        x = params["wte"][token].astype(cfg.dtype).reshape(B, 1, cfg.hidden)
+        cos, sin = rope_angles(cfg, pos[None])
+        cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+
+        def body(carry, inp):
+            x = carry
+            bp, ck, cv = inp
+            x, ck, cv = _decode_block(bp, x, ck, cv, pos, cfg, cos, sin)
+            return x, (ck, cv)
+
+        x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"],
+                                         cache["v"]))
+        x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+        logits = _mm(x, params["head"], cfg).astype(jnp.float32)
+        return logits[:, 0], {"k": ks, "v": vs}
+
+    def _decode_n_impl(self, params, cache, first_token, start_pos, key, *,
+                       n, temperature, top_p):
+        """n decode steps in ONE program (lax.scan): kills the per-token
+        host/RPC dispatch that otherwise bounds serving latency — the
+        fused_multi_transformer decode loop of the reference, compiled."""
+
+        def tick(carry, _):
+            cache, tok, pos, key = carry
+            logits, cache = self._decode_impl(params, cache, tok, pos)
+            key, sub = jax.random.split(key)
+            nxt = self._sample(logits, sub, temperature, top_p)
+            return (cache, nxt, pos + 1, key), nxt
+
+        (cache, _, _, _), toks = lax.scan(
+            tick, (cache, first_token, start_pos, key), None, length=n)
+        return toks, cache
+
+    @staticmethod
+    def _sample(logits, key, temperature, top_p):
+        if temperature == 0.0:
+            return jnp.argmax(logits, -1)
+        logits = logits / temperature
+        if top_p < 1.0:
+            sorted_logits = jnp.sort(logits, -1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_logits, -1)
+            cum = jnp.cumsum(probs, -1)
+            cutoff_idx = jnp.sum(cum < top_p, -1, keepdims=True)
+            cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, -1)
+            logits = jnp.where(logits < cutoff, -1e30, logits)
+        return jax.random.categorical(key, logits, -1)
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None, seed: int = 0):
+        """Prefill + greedy/nucleus decode. input_ids: [B, T] numpy/array."""
+        tokens = jnp.asarray(input_ids)
+        B, T = tokens.shape
+        assert T + max_new_tokens <= self.max_seq, "exceeds KV cache length"
+        cache = self._empty_cache(B)
+        key = jax.random.PRNGKey(seed)
+        logits, cache = self._prefill(self.params, tokens, cache)
+        key, sub = jax.random.split(key)
+        first = self._sample(logits, sub, temperature, top_p)
+        if max_new_tokens == 1:
+            return np.asarray(first)[:, None]
+        if eos_token_id is None:
+            # whole decode loop fused into one program; the first decoded
+            # token is written at cache slot T (slots 0..T-1 hold the prompt)
+            toks, cache = self._decode_n(
+                self.params, cache, first, jnp.asarray(T, jnp.int32),
+                key, n=max_new_tokens - 1, temperature=temperature,
+                top_p=top_p)
+            return np.concatenate([np.asarray(first)[:, None],
+                                   np.asarray(toks).T.reshape(
+                                       B, max_new_tokens - 1)], axis=1)
+        # early-exit path: per-token dispatch so eos can stop the loop
+        out = [first]
+        nxt = first
+        pos = T - 1
+        for _ in range(max_new_tokens - 1):
+            pos += 1
+            logits, cache = self._decode(self.params, cache, nxt,
+                                         jnp.asarray(pos, jnp.int32))
+            key, sub = jax.random.split(key)
+            nxt = self._sample(logits, sub, temperature, top_p)
+            out.append(nxt)
+            if bool((nxt == eos_token_id).all()):
+                break
+        return np.stack([np.asarray(o) for o in out], axis=1)

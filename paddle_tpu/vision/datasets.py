@@ -98,16 +98,23 @@ class Cifar10(Dataset):
 
         self.transform = transform
         datas, labels = [], []
-        names = ([f"data_batch_{i}" for i in range(1, 6)]
-                 if mode == "train" else ["test_batch"])
         with tarfile.open(data_file) as tf:
             for m in tf.getmembers():
-                if any(m.name.endswith(n) for n in names):
+                if any(m.name.endswith(n) for n in self._member_names(mode)):
                     d = pickle.load(tf.extractfile(m), encoding="bytes")
                     datas.append(d[b"data"])
-                    labels.extend(d[b"labels"])
+                    # CIFAR-100 uses b"fine_labels" (reference
+                    # vision/datasets/cifar.py falls back the same way)
+                    labels.extend(d.get(b"labels", d.get(b"fine_labels")))
+        if not datas:
+            raise ValueError(f"no {mode} batches found in {data_file}")
         self.data = np.concatenate(datas).reshape(-1, 3, 32, 32)
         self.labels = np.asarray(labels, np.int64)
+
+    @staticmethod
+    def _member_names(mode):
+        return ([f"data_batch_{i}" for i in range(1, 6)]
+                if mode == "train" else ["test_batch"])
 
     def __len__(self):
         return len(self.labels)
@@ -120,4 +127,6 @@ class Cifar10(Dataset):
 
 
 class Cifar100(Cifar10):
-    pass
+    @staticmethod
+    def _member_names(mode):
+        return ["train"] if mode == "train" else ["test"]
