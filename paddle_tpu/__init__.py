@@ -76,6 +76,11 @@ _LAZY_SUBMODULES = (
     "device",
     "models",
     "hapi",
+    "text",
+    "audio",
+    "geometric",
+    "quantization",
+    "onnx",
 )
 
 

@@ -1,0 +1,125 @@
+"""paddle_tpu.audio: audio feature extraction (reference: python/paddle/
+audio — spectrogram/MelSpectrogram/MFCC functional + layers).
+
+Implemented as XLA expressions (rfft via jnp.fft), so features run on
+device and differentiate.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["features", "functional"]
+
+
+class functional:
+    @staticmethod
+    def hz_to_mel(f, htk: bool = False):
+        if htk:
+            return 2595.0 * np.log10(1.0 + np.asarray(f) / 700.0)
+        f = np.asarray(f, dtype=np.float64)
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        return np.where(f >= min_log_hz,
+                        min_log_mel + np.log(f / min_log_hz) / logstep, mels)
+
+    @staticmethod
+    def mel_to_hz(m, htk: bool = False):
+        if htk:
+            return 700.0 * (10.0 ** (np.asarray(m) / 2595.0) - 1.0)
+        m = np.asarray(m, dtype=np.float64)
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        return np.where(m >= min_log_mel,
+                        min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                        freqs)
+
+    @staticmethod
+    def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                             f_min: float = 0.0, f_max=None, htk=False,
+                             norm="slaney", dtype="float32"):
+        f_max = f_max or sr / 2
+        mels = np.linspace(functional.hz_to_mel(f_min, htk),
+                           functional.hz_to_mel(f_max, htk), n_mels + 2)
+        freqs = functional.mel_to_hz(mels, htk)
+        fft_freqs = np.linspace(0, sr / 2, n_fft // 2 + 1)
+        fb = np.zeros((n_mels, len(fft_freqs)))
+        for i in range(n_mels):
+            lo, ctr, hi = freqs[i], freqs[i + 1], freqs[i + 2]
+            up = (fft_freqs - lo) / max(ctr - lo, 1e-10)
+            down = (hi - fft_freqs) / max(hi - ctr, 1e-10)
+            fb[i] = np.maximum(0, np.minimum(up, down))
+        if norm == "slaney":
+            enorm = 2.0 / (freqs[2:] - freqs[:-2])
+            fb *= enorm[:, None]
+        return Tensor(fb.astype(dtype))
+
+
+class features:
+    class Spectrogram:
+        def __init__(self, n_fft: int = 512, hop_length=None,
+                     win_length=None, window: str = "hann", power: float = 2.0,
+                     center: bool = True, pad_mode: str = "reflect",
+                     dtype: str = "float32"):
+            self.n_fft = n_fft
+            self.hop = hop_length or n_fft // 4
+            self.win_length = win_length or n_fft
+            self.power = power
+            self.center = center
+            win = np.hanning(self.win_length + 1)[:-1] if window == "hann" \
+                else np.ones(self.win_length)
+            pad = (n_fft - self.win_length) // 2
+            self.window = np.pad(win, (pad, n_fft - self.win_length - pad))
+
+        def __call__(self, x: Tensor) -> Tensor:
+            arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+            if self.center:
+                arr = jnp.pad(arr, [(0, 0)] * (arr.ndim - 1)
+                              + [(self.n_fft // 2, self.n_fft // 2)],
+                              mode="reflect")
+            n_frames = 1 + (arr.shape[-1] - self.n_fft) // self.hop
+            idx = (jnp.arange(n_frames)[:, None] * self.hop
+                   + jnp.arange(self.n_fft)[None, :])
+            frames = arr[..., idx] * jnp.asarray(self.window, arr.dtype)
+            spec = jnp.abs(jnp.fft.rfft(frames, axis=-1)) ** self.power
+            return Tensor(jnp.swapaxes(spec, -1, -2))
+
+    class MelSpectrogram:
+        def __init__(self, sr: int = 22050, n_fft: int = 512,
+                     hop_length=None, n_mels: int = 64, f_min: float = 50.0,
+                     f_max=None, **kw):
+            self.spec = features.Spectrogram(n_fft, hop_length)
+            self.fbank = functional.compute_fbank_matrix(
+                sr, n_fft, n_mels, f_min, f_max)
+
+        def __call__(self, x):
+            s = self.spec(x)
+            return Tensor(jnp.einsum("mf,...ft->...mt",
+                                     self.fbank._data, s._data))
+
+    class MFCC:
+        def __init__(self, sr: int = 22050, n_mfcc: int = 40,
+                     n_fft: int = 512, n_mels: int = 64, **kw):
+            self.mel = features.MelSpectrogram(sr, n_fft, n_mels=n_mels, **kw)
+            n = n_mels
+            k = np.arange(n)
+            dct = np.cos(np.pi / n * (k[:, None] + 0.5) * np.arange(n_mfcc))
+            self.dct = Tensor((dct * math.sqrt(2.0 / n)).T.astype("float32"))
+
+        def __call__(self, x):
+            m = self.mel(x)
+            logm = jnp.log(jnp.clip(m._data, 1e-10))
+            return Tensor(jnp.einsum("cm,...mt->...ct",
+                                     self.dct._data, logm))

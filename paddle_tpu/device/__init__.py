@@ -1,0 +1,115 @@
+"""paddle_tpu.device: device query/control API.
+
+Reference surface: python/paddle/device (set_device/get_device, cuda
+namespace, synchronize, stream APIs). TPU translation: devices come from
+the PJRT runtime; streams don't exist at the API level (XLA orders
+execution), so stream functions are synchronization no-ops kept for
+ported-code compatibility.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..core.device import (device_count, get_device, is_compiled_with_cuda,
+                           is_compiled_with_tpu, is_compiled_with_xpu,
+                           set_device)
+
+__all__ = ["set_device", "get_device", "device_count",
+           "is_compiled_with_cuda", "is_compiled_with_xpu",
+           "is_compiled_with_tpu", "synchronize", "get_available_device",
+           "get_available_custom_device", "Stream", "Event",
+           "current_stream", "stream_guard", "cuda"]
+
+
+def synchronize(device=None):
+    """Block until all dispatched work completes."""
+    for d in jax.devices():
+        jax.device_put(0, d).block_until_ready()
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+class Stream:
+    """Streams are an XLA scheduling detail; API kept for parity."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, device=None, enable_timing=False, blocking=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+_CURRENT_STREAM = Stream()
+
+
+def current_stream(device=None):
+    return _CURRENT_STREAM
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def stream_guard(stream):
+    yield
+
+
+class cuda:
+    """paddle.device.cuda namespace (parity; TPU build has no CUDA)."""
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def is_available():
+        return False
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        return 0
+
+    Stream = Stream
+    Event = Event

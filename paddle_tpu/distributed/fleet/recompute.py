@@ -1,0 +1,90 @@
+"""Recompute: activation checkpointing for eager training.
+
+Re-design of python/paddle/distributed/fleet/recompute/recompute.py
+(RecomputeFunction:124 reentrant PyLayer; :319 non-reentrant saved-tensor
+hooks; RNG state restore :112).
+
+TPU translation: the reference's two mechanisms (re-running forward inside
+a custom PyLayer backward / swapping saved tensors for recompute closures)
+collapse into ``jax.checkpoint`` over the segment's pure function: the
+segment executes as ONE tape op whose jax vjp rematerialises internals, so
+backward memory is O(segment inputs) exactly like the reference, and the
+XLA scheduler overlaps the recompute. RNG: jax PRNG is functional — the
+recomputed forward sees the identical key, giving the reference's
+"restore RNG state before replay" semantics for free.
+
+The segment must expose its parameters: a Layer (parameters() walked
+automatically) or a pure function of its tensor args.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+
+from ...core import autograd
+from ...core.dispatch import op_call, OpDef
+from ...core.tensor import Parameter, Tensor
+from ...nn.layer.layers import Layer
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, **kwargs):
+    """Checkpointed call (reference recompute.py:124).
+
+    ``use_reentrant`` is accepted for parity; both reference modes map to
+    the same jax.checkpoint lowering here.
+    """
+    kwargs.pop("use_reentrant", None)
+    preserve = kwargs.pop("preserve_rng_state", True)  # inherent (functional PRNG)
+
+    if isinstance(function, Layer):
+        params = [p for p in function.parameters() if not p.stop_gradient]
+    else:
+        params = []
+
+    def impl(param_arrays, *arg_arrays, **kw):
+        # Bind param tracers into the live layer for the traced call, then
+        # restore (same functionalization move as jit/capture.py).
+        originals = [p._data for p in params]
+        for p, a in zip(params, param_arrays):
+            p._data = a
+        try:
+            wrapped = [Tensor(a, stop_gradient=True) for a in arg_arrays]
+            with autograd.no_grad():
+                out = function(*wrapped, **kw)
+        finally:
+            for p, o in zip(params, originals):
+                p._data = o
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+        return out._data if isinstance(out, Tensor) else out
+
+    ckpt_impl = jax.checkpoint(impl)
+    opdef = OpDef("recompute", ckpt_impl, True, "none")
+    return op_call(opdef, (params,) + args, kwargs)
+
+
+def recompute_sequential(ctx: dict, functions, *args, **kwargs):
+    """reference: recompute_sequential — checkpoint each chunk of a
+    Sequential. ctx: {"segments": n}."""
+    segments = int((ctx or {}).get("segments", 1))
+    if isinstance(functions, Layer):
+        layers = list(functions.children()) or [functions]
+    else:
+        layers = list(functions)
+    n = len(layers)
+    per = max(1, n // segments)
+    out = args
+    i = 0
+    while i < n:
+        chunk = layers[i:i + per]
+        from ...nn.layer.container import Sequential
+
+        seg = chunk[0] if len(chunk) == 1 else Sequential(*chunk)
+        out = (recompute(seg, *out, **kwargs),)
+        i += per
+    return out[0]

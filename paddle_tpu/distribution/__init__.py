@@ -1,0 +1,509 @@
+"""paddle_tpu.distribution: probability distributions.
+
+Re-design of python/paddle/distribution (12k LoC; Distribution base,
+Normal/Uniform/Categorical/..., kl_divergence registry, transforms).
+Implementations are jax-native (sampling via the global functional PRNG,
+log_probs as XLA expressions) so they compose with autograd and capture.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+from ..core.tensor import Tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Exponential", "Gamma", "Geometric",
+           "Laplace", "LogNormal", "Multinomial", "Poisson", "StudentT",
+           "kl_divergence", "register_kl"]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x, jnp.float32)
+
+
+def _wrap(x):
+    return Tensor(x)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _wrap(jnp.exp(_arr(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def _extend(self, shape):
+        return tuple(shape) + tuple(jnp.broadcast_shapes(
+            *(jnp.shape(a) for a in self._params())))
+
+    def _params(self):
+        return ()
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def _params(self):
+        return (self.loc, self.scale)
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        out = self.loc + self.scale * jax.random.normal(
+            key, tuple(shape) + self.batch_shape)
+        return _wrap(out)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale ** 2
+        return _wrap(-((v - self.loc) ** 2) / (2 * var)
+                     - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape))
+
+    def probs(self, value):
+        return self.prob(value)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    @property
+    def mean(self):
+        return _wrap((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return _wrap((self.high - self.low) ** 2 / 12)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        u = jax.random.uniform(key, tuple(shape) + self.batch_shape)
+        return _wrap(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = jnp.logical_and(v >= self.low, v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return _wrap(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return _wrap(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if logits is not None:
+            self.logits = _arr(logits)
+        else:
+            self.logits = jnp.log(jnp.clip(_arr(probs), 1e-30))
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs_array(self):
+        return jax.nn.softmax(self.logits, -1)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _wrap(jax.random.categorical(
+            key, self.logits, shape=tuple(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return _wrap(jnp.take_along_axis(logp, v[..., None], -1)[..., 0])
+
+    def probs(self, value):
+        return self.prob(value)
+
+    def entropy(self):
+        p = self.probs_array
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return _wrap(-(p * logp).sum(-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _arr(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _wrap(jax.random.bernoulli(
+            key, self.probs, tuple(shape) + self.batch_shape
+        ).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return _wrap(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return _wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _wrap(1 / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(1 / self.rate ** 2)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _wrap(jax.random.exponential(
+            key, tuple(shape) + self.batch_shape) / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return _wrap(1 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.concentration / self.rate ** 2)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        g = jax.random.gamma(key, self.concentration,
+                             tuple(shape) + self.batch_shape)
+        return _wrap(g / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a, b = self.concentration, self.rate
+        return _wrap(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                     - jax.scipy.special.gammaln(a))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        return _wrap(a - jnp.log(b) + jax.scipy.special.gammaln(a)
+                     + (1 - a) * jax.scipy.special.digamma(a))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.alpha / (self.alpha + self.beta))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _wrap(jax.random.beta(key, self.alpha, self.beta,
+                                     tuple(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a, b = self.alpha, self.beta
+        lbeta = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                 - jax.scipy.special.gammaln(a + b))
+        return _wrap((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        c = self.concentration
+        return _wrap(c / c.sum(-1, keepdims=True))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _wrap(jax.random.dirichlet(
+            key, self.concentration, tuple(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        c = self.concentration
+        lnorm = (jax.scipy.special.gammaln(c).sum(-1)
+                 - jax.scipy.special.gammaln(c.sum(-1)))
+        return _wrap(((c - 1) * jnp.log(v)).sum(-1) - lnorm)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.loc)
+
+    @property
+    def variance(self):
+        return _wrap(2 * self.scale ** 2)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _wrap(self.loc + self.scale * jax.random.laplace(
+            key, tuple(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(-jnp.abs(v - self.loc) / self.scale
+                     - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return _wrap(1 + jnp.log(2 * self.scale))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        self._normal = Normal(loc, scale)
+        super().__init__(self._normal.batch_shape)
+
+    @property
+    def mean(self):
+        return _wrap(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    def sample(self, shape=()):
+        return _wrap(jnp.exp(_arr(self._normal.sample(shape))))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(_arr(self._normal.log_prob(jnp.log(v))) - jnp.log(v))
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _arr(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return _wrap(1 / self.probs)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        u = jax.random.uniform(key, tuple(shape) + self.batch_shape)
+        return _wrap(jnp.floor(jnp.log1p(-u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(v * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _arr(probs)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        cat = jax.random.categorical(
+            key, jnp.log(jnp.clip(self.probs, 1e-30)),
+            shape=(self.total_count,) + tuple(shape) + self.batch_shape)
+        k = self.probs.shape[-1]
+        onehot = jax.nn.one_hot(cat, k)
+        return _wrap(onehot.sum(0))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        logp = jnp.log(jnp.clip(self.probs, 1e-30))
+        return _wrap((v * logp).sum(-1)
+                     + jax.scipy.special.gammaln(self.total_count + 1)
+                     - jax.scipy.special.gammaln(v + 1).sum(-1))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.rate)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _wrap(jax.random.poisson(
+            key, self.rate, tuple(shape) + self.batch_shape
+        ).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(v * jnp.log(self.rate) - self.rate
+                     - jax.scipy.special.gammaln(v + 1))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _arr(df)
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        t = jax.random.t(key, self.df, tuple(shape) + self.batch_shape)
+        return _wrap(self.loc + self.scale * t)
+
+    def log_prob(self, value):
+        v = (_arr(value) - self.loc) / self.scale
+        d = self.df
+        lg = jax.scipy.special.gammaln
+        return _wrap(lg((d + 1) / 2) - lg(d / 2)
+                     - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+                     - (d + 1) / 2 * jnp.log1p(v ** 2 / d))
+
+
+# -- KL registry -------------------------------------------------------------
+
+_KL_REGISTRY: dict = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p: Normal, q: Normal):
+    var_p, var_q = p.scale ** 2, q.scale ** 2
+    return _wrap(jnp.log(q.scale / p.scale)
+                 + (var_p + (p.loc - q.loc) ** 2) / (2 * var_q) - 0.5)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p: Categorical, q: Categorical):
+    pp = p.probs_array
+    return _wrap((pp * (jax.nn.log_softmax(p.logits, -1)
+                        - jax.nn.log_softmax(q.logits, -1))).sum(-1))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p: Uniform, q: Uniform):
+    return _wrap(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p: Bernoulli, q: Bernoulli):
+    a = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    b = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return _wrap(a * (jnp.log(a) - jnp.log(b))
+                 + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))

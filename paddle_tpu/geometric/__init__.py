@@ -1,0 +1,161 @@
+"""paddle_tpu.geometric: graph-NN message passing utilities.
+
+Re-design of python/paddle/geometric (message_passing/send_recv.py
+send_u_recv/send_ue_recv, math.py segment ops, sampling). TPU translation:
+gather + segment_sum (XLA scatter-add) replace the reference's CUDA
+graph_send_recv kernels; static shapes come from out_size.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min", "reindex_graph",
+           "sample_neighbors"]
+
+def _segment(data, ids, num, pool):
+    if pool == "sum":
+        return jax.ops.segment_sum(data, ids, num_segments=num)
+    if pool == "mean":
+        s = jax.ops.segment_sum(data, ids, num_segments=num)
+        c = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype), ids,
+                                num_segments=num)
+        return s / jnp.clip(c, 1).reshape((-1,) + (1,) * (data.ndim - 1))
+    if pool == "max":
+        return jax.ops.segment_max(data, ids, num_segments=num)
+    if pool == "min":
+        return jax.ops.segment_min(data, ids, num_segments=num)
+    raise ValueError(f"unknown reduce {pool}")
+
+
+@op("graph_send_u_recv")
+def _send_u_recv(x, src_index, dst_index, *, pool, out_size):
+    n = out_size if out_size is not None else x.shape[0]
+    return _segment(x[src_index], dst_index, n, pool)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size=None, name=None):
+    """Gather source features along edges, reduce at destinations
+    (reference message_passing/send_recv.py:send_u_recv)."""
+    return _send_u_recv(x, src_index, dst_index, pool=reduce_op,
+                        out_size=out_size)
+
+
+@op("graph_send_ue_recv")
+def _send_ue_recv(x, y, src_index, dst_index, *, message_op, pool, out_size):
+    msg = x[src_index]
+    if message_op == "add":
+        msg = msg + y
+    elif message_op == "mul":
+        msg = msg * y
+    elif message_op == "sub":
+        msg = msg - y
+    elif message_op == "div":
+        msg = msg / y
+    n = out_size if out_size is not None else x.shape[0]
+    return _segment(msg, dst_index, n, pool)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size=None, name=None):
+    return _send_ue_recv(x, y, src_index, dst_index, message_op=message_op,
+                         pool=reduce_op, out_size=out_size)
+
+
+@op("graph_send_uv")
+def _send_uv(x, y, src_index, dst_index, *, message_op):
+    a, b = x[src_index], y[dst_index]
+    if message_op == "add":
+        return a + b
+    if message_op == "mul":
+        return a * b
+    if message_op == "sub":
+        return a - b
+    return a / b
+
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add", name=None):
+    return _send_uv(x, y, src_index, dst_index, message_op=message_op)
+
+
+def _make_segment_api(pool):
+    @op(f"segment_{pool}")
+    def impl(data, segment_ids, *, _pool=pool):
+        if isinstance(segment_ids, jax.core.Tracer):
+            # The reference API derives the segment count from the data
+            # (max id + 1), which needs a concrete value; under capture
+            # the count must be static.
+            raise NotImplementedError(
+                f"segment_{pool} under program capture needs a static "
+                "segment count — compute it eagerly or use "
+                "send_u_recv(..., out_size=N)")
+        n = int(jnp.max(segment_ids)) + 1
+        return _segment(data, segment_ids, n, _pool)
+
+    def api(data, segment_ids, name=None):
+        return impl(data, segment_ids)
+
+    return api
+
+
+segment_sum = _make_segment_api("sum")
+segment_mean = _make_segment_api("mean")
+segment_max = _make_segment_api("max")
+segment_min = _make_segment_api("min")
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """reference geometric/reindex.py: compact global ids to local ids."""
+    import numpy as np
+
+    xs = np.asarray(x._data if isinstance(x, Tensor) else x)
+    nb = np.asarray(neighbors._data if isinstance(neighbors, Tensor)
+                    else neighbors)
+    uniq = list(dict.fromkeys(xs.tolist()))
+    mapping = {g: i for i, g in enumerate(uniq)}
+    next_id = len(uniq)
+    out_nodes = list(uniq)
+    reindexed = np.empty_like(nb)
+    for i, g in enumerate(nb.tolist()):
+        if g not in mapping:
+            mapping[g] = next_id
+            out_nodes.append(g)
+            next_id += 1
+        reindexed[i] = mapping[g]
+    return (Tensor(np.asarray(reindexed)), Tensor(np.asarray(out_nodes)),
+            Tensor(np.asarray(count._data if isinstance(count, Tensor)
+                              else count)))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
+                     eids=None, return_eids: bool = False, perm_buffer=None,
+                     name=None):
+    """Uniform neighbor sampling on CSC (host-side; reference
+    geometric/sampling/neighbors.py)."""
+    import numpy as np
+
+    r = np.asarray(row._data if isinstance(row, Tensor) else row)
+    cp = np.asarray(colptr._data if isinstance(colptr, Tensor) else colptr)
+    nodes = np.asarray(input_nodes._data if isinstance(input_nodes, Tensor)
+                       else input_nodes)
+    out_neighbors, out_counts = [], []
+    rng = np.random.default_rng(0)
+    for n in nodes.tolist():
+        lo, hi = int(cp[n]), int(cp[n + 1])
+        neigh = r[lo:hi]
+        if 0 <= sample_size < len(neigh):
+            neigh = rng.choice(neigh, size=sample_size, replace=False)
+        out_neighbors.append(neigh)
+        out_counts.append(len(neigh))
+    return (Tensor(np.concatenate(out_neighbors) if out_neighbors
+                   else np.zeros(0, r.dtype)),
+            Tensor(np.asarray(out_counts)))

@@ -130,8 +130,11 @@ def fused_bias_act(x, bias=None, act_method="gelu", **kw):
     """reference: fused_bias_act_kernel (phi fusion)."""
     if bias is not None:
         x = x + bias
-    if act_method in ("gelu", "geglu"):
+    if act_method == "gelu":
         return jax.nn.gelu(x)
+    if act_method == "geglu":
+        a, b = jnp.split(x, 2, -1)
+        return jax.nn.gelu(a) * b
     if act_method in ("swiglu",):
         a, b = jnp.split(x, 2, -1)
         return jax.nn.silu(a) * b
@@ -250,11 +253,10 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
     """reference: weight_quantize op → (quantized weights, scales)."""
     import jax.numpy as jnp
 
+    from ....ops.quant import absmax_quantize_int8
+
     arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-    scale = jnp.abs(arr).max(axis=0, keepdims=True).astype(jnp.float32) / 127.0
-    scale = jnp.where(scale == 0, 1.0, scale)
-    q = jnp.clip(jnp.round(arr.astype(jnp.float32) / scale), -127, 127
-                 ).astype(jnp.int8)
+    q, scale = absmax_quantize_int8(arr, axis=0)
     return Tensor(q), Tensor(scale[0])
 
 

@@ -141,17 +141,15 @@ def _mm(x, w, cfg):
 
 
 def quantize_weights_int8(params: dict) -> dict:
-    """Weight-only int8: per-column absmax scales (reference:
-    weight_quantize op). Norm gains and embeddings stay high-precision."""
+    """Weight-only int8: per-column absmax scales (shared primitive with
+    incubate weight_quantize). Norm gains and embeddings stay
+    high-precision."""
+    from ..ops.quant import absmax_quantize_int8
+
     def q(path, a):
         if a.ndim < 2 or "norm" in path or path == "wte":
             return a
-        scale = jnp.abs(a).max(axis=-2, keepdims=True).astype(jnp.float32) \
-            / 127.0
-        scale = jnp.where(scale == 0, 1.0, scale)
-        wq = jnp.clip(jnp.round(a.astype(jnp.float32) / scale), -127, 127
-                      ).astype(jnp.int8)
-        return (wq, scale.astype(jnp.bfloat16))
+        return absmax_quantize_int8(a, axis=-2, scale_dtype=jnp.bfloat16)
 
     out = {"wte": params["wte"], "final_norm": params["final_norm"],
            "head": q("head", params["head"]), "blocks": {}}
@@ -167,8 +165,12 @@ def _repeat_kv(x, n_rep):
     return jnp.repeat(x, n_rep, axis=2)
 
 
-def block_apply(bp, x, cfg: LlamaConfig, cos, sin, use_flash=True):
-    """Training/prefill block: full-sequence causal attention."""
+def block_apply(bp, x, cfg: LlamaConfig, cos, sin, use_flash=True,
+                return_kv: bool = False):
+    """Training/prefill block: full-sequence causal attention.
+    ``return_kv=True`` additionally returns the (pre-repeat) rotated k/v —
+    the prefill path uses this to fill the decode cache with the SAME block
+    computation (no duplicated transformer math)."""
     B, T, H = x.shape
     nH, nKV, dH = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     h = rms_norm(x, bp["attn_norm"], cfg.rms_eps)
@@ -179,15 +181,14 @@ def block_apply(bp, x, cfg: LlamaConfig, cos, sin, use_flash=True):
     k = apply_rope(k, cos, sin)
     kf = _repeat_kv(k, nH // nKV)
     vf = _repeat_kv(v, nH // nKV)
+    o = None
     if use_flash:
         from ..ops.pallas.flash_attention import (flash_attention_raw,
                                                   supported)
 
         if supported(q.shape, q.dtype):
             o = flash_attention_raw(q, kf, vf, causal=True)
-        else:
-            o = _sdpa(q, kf, vf)
-    else:
+    if o is None:
         o = _sdpa(q, kf, vf)
     x = x + _mm(o.reshape(B, T, nH * dH), bp["wo"], cfg)
     h = rms_norm(x, bp["ffn_norm"], cfg.rms_eps)
@@ -195,6 +196,8 @@ def block_apply(bp, x, cfg: LlamaConfig, cos, sin, use_flash=True):
     up = _mm(h, bp["w_up"], cfg)
     x = x + _mm(jax.nn.silu(gate.astype(jnp.float32)).astype(cfg.dtype) * up,
                 bp["w_down"], cfg)
+    if return_kv:
+        return x, k, v
     return x
 
 
@@ -293,9 +296,10 @@ class LlamaForCausalLM:
         self.max_seq = max_seq_len or cfg.max_seq_len
         self._prefill = jax.jit(self._prefill_impl)
         self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
+        # only the scan length is static; temperature/top_p are traced
+        # operands so per-request sampling configs reuse one executable
         self._decode_n = jax.jit(self._decode_n_impl, donate_argnums=(1,),
-                                 static_argnames=("n", "temperature",
-                                                 "top_p"))
+                                 static_argnames=("n", "greedy"))
 
     def _empty_cache(self, B):
         L, S = self.cfg.n_layers, self.max_seq
@@ -304,31 +308,20 @@ class LlamaForCausalLM:
         return {"k": z, "v": z}
 
     def _prefill_impl(self, params, tokens, cache):
-        """Full-sequence forward that also fills the cache."""
+        """Full-sequence forward (the shared block_apply, flash path
+        included) that also fills the decode cache."""
         cfg = self.cfg
         B, T = tokens.shape
         x = params["wte"][tokens].astype(cfg.dtype)
         cos, sin = rope_angles(cfg, jnp.arange(T))
         cos, sin = cos[None, :, None, :], sin[None, :, None, :]
-        nH, nKV, dH = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
         def body(carry, inp):
             x = carry
             bp, ck, cv = inp
-            h = rms_norm(x, bp["attn_norm"], cfg.rms_eps)
-            q = _mm(h, bp["wq"], cfg).reshape(B, T, nH, dH)
-            k = _mm(h, bp["wk"], cfg).reshape(B, T, nKV, dH)
-            v = _mm(h, bp["wv"], cfg).reshape(B, T, nKV, dH)
-            q = apply_rope(q, cos, sin)
-            k = apply_rope(k, cos, sin)
+            x, k, v = block_apply(bp, x, cfg, cos, sin, return_kv=True)
             ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
             cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
-            o = _sdpa(q, _repeat_kv(k, nH // nKV), _repeat_kv(v, nH // nKV))
-            x = x + _mm(o.reshape(B, T, nH * dH), bp["wo"], cfg)
-            h = rms_norm(x, bp["ffn_norm"], cfg.rms_eps)
-            x = x + _mm(jax.nn.silu(_mm(h, bp["w_gate"], cfg).astype(
-                jnp.float32)).astype(cfg.dtype) * _mm(h, bp["w_up"], cfg),
-                bp["w_down"], cfg)
             return x, (ck, cv)
 
         x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"],
@@ -356,8 +349,8 @@ class LlamaForCausalLM:
         logits = _mm(x, params["head"], cfg).astype(jnp.float32)
         return logits[:, 0], {"k": ks, "v": vs}
 
-    def _decode_n_impl(self, params, cache, first_token, start_pos, key, *,
-                       n, temperature, top_p):
+    def _decode_n_impl(self, params, cache, first_token, start_pos, key,
+                       temperature, top_p, *, n, greedy):
         """n decode steps in ONE program (lax.scan): kills the per-token
         host/RPC dispatch that otherwise bounds serving latency — the
         fused_multi_transformer decode loop of the reference, compiled."""
@@ -366,7 +359,7 @@ class LlamaForCausalLM:
             cache, tok, pos, key = carry
             logits, cache = self._decode_impl(params, cache, tok, pos)
             key, sub = jax.random.split(key)
-            nxt = self._sample(logits, sub, temperature, top_p)
+            nxt = self._sample(logits, sub, temperature, top_p, greedy)
             return (cache, nxt, pos + 1, key), nxt
 
         (cache, _, _, _), toks = lax.scan(
@@ -374,17 +367,19 @@ class LlamaForCausalLM:
         return toks, cache
 
     @staticmethod
-    def _sample(logits, key, temperature, top_p):
-        if temperature == 0.0:
+    def _sample(logits, key, temperature, top_p, greedy: bool):
+        """Branch-free over traced temperature/top_p; only greedy is a
+        program variant."""
+        if greedy:
             return jnp.argmax(logits, -1)
-        logits = logits / temperature
-        if top_p < 1.0:
-            sorted_logits = jnp.sort(logits, -1)[..., ::-1]
-            probs = jax.nn.softmax(sorted_logits, -1)
-            cum = jnp.cumsum(probs, -1)
-            cutoff_idx = jnp.sum(cum < top_p, -1, keepdims=True)
-            cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, -1)
-            logits = jnp.where(logits < cutoff, -1e30, logits)
+        logits = logits / jnp.maximum(jnp.asarray(temperature, jnp.float32),
+                                      1e-6)
+        sorted_logits = jnp.sort(logits, -1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, -1)
+        cum = jnp.cumsum(probs, -1)
+        cutoff_idx = jnp.sum(cum < top_p, -1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, -1)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
         return jax.random.categorical(key, logits, -1)
 
     def generate(self, input_ids, max_new_tokens: int = 32,
@@ -396,9 +391,12 @@ class LlamaForCausalLM:
         assert T + max_new_tokens <= self.max_seq, "exceeds KV cache length"
         cache = self._empty_cache(B)
         key = jax.random.PRNGKey(seed)
+        greedy = temperature == 0.0
+        temp_arr = jnp.asarray(temperature, jnp.float32)
+        top_p_arr = jnp.asarray(top_p, jnp.float32)
         logits, cache = self._prefill(self.params, tokens, cache)
         key, sub = jax.random.split(key)
-        first = self._sample(logits, sub, temperature, top_p)
+        first = self._sample(logits, sub, temp_arr, top_p_arr, greedy)
         if max_new_tokens == 1:
             return np.asarray(first)[:, None]
         if eos_token_id is None:
@@ -406,8 +404,8 @@ class LlamaForCausalLM:
             # token is written at cache slot T (slots 0..T-1 hold the prompt)
             toks, cache = self._decode_n(
                 self.params, cache, first, jnp.asarray(T, jnp.int32),
-                key, n=max_new_tokens - 1, temperature=temperature,
-                top_p=top_p)
+                key, temp_arr, top_p_arr, n=max_new_tokens - 1,
+                greedy=greedy)
             return np.concatenate([np.asarray(first)[:, None],
                                    np.asarray(toks).T.reshape(
                                        B, max_new_tokens - 1)], axis=1)
@@ -420,7 +418,7 @@ class LlamaForCausalLM:
             logits, cache = self._decode(self.params, cache, nxt,
                                          jnp.asarray(pos, jnp.int32))
             key, sub = jax.random.split(key)
-            nxt = self._sample(logits, sub, temperature, top_p)
+            nxt = self._sample(logits, sub, temp_arr, top_p_arr, greedy)
             out.append(nxt)
             if bool((nxt == eos_token_id).all()):
                 break

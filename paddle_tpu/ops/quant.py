@@ -1,0 +1,26 @@
+"""Shared weight-quantization primitives.
+
+One implementation of per-column absmax int8 (reference: weight_quantize op,
+phi/kernels/gpu/weight_quantize_kernel.cu) used by both the incubate
+functional API and the LLaMA weight-only inference path.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["absmax_quantize_int8"]
+
+
+def absmax_quantize_int8(arr, axis: int = -2, scale_dtype=jnp.float32):
+    """Quantize along all dims except the output-channel dim.
+
+    Returns (int8 weights, scales) with ``scales`` keeping the reduced dims
+    (broadcastable for dequant-in-matmul).
+    """
+    scale = jnp.abs(arr).max(axis=axis, keepdims=True).astype(jnp.float32) \
+        / 127.0
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(arr.astype(jnp.float32) / scale), -127, 127
+                 ).astype(jnp.int8)
+    return q, scale.astype(scale_dtype)

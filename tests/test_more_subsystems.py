@@ -1,0 +1,114 @@
+"""Quantization / geometric / text / audio / device tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+
+
+def test_qat_quantize_and_convert():
+    from paddle_tpu.quantization import QAT
+
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    qat = QAT()
+    qnet = qat.quantize(net)
+    x = pt.randn([4, 8])
+    y = qnet(x)
+    assert y.shape == [4, 4]
+    # QAT training still works
+    opt = pt.optimizer.SGD(learning_rate=0.01, parameters=net.parameters())
+    loss = qnet(x).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    qat.convert(qnet)
+    y2 = qnet(x)
+    assert y2.shape == [4, 4]
+
+
+def test_fake_quant_ste_gradient():
+    from paddle_tpu.quantization import fake_quant
+
+    x = pt.to_tensor(np.linspace(-0.9, 0.9, 16, dtype=np.float32))
+    x.stop_gradient = False
+    y = fake_quant(x, 1.0, bits=8)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 1.0)  # straight-through
+
+
+def test_ptq_observes_and_converts():
+    from paddle_tpu.quantization import PTQ
+
+    net = nn.Sequential(nn.Linear(8, 8))
+    ptq = PTQ()
+    ptq.quantize(net)
+    for _ in range(3):
+        net(pt.randn([2, 8]))
+    ptq.convert(net)
+    assert any(o._max > 0 for o in ptq._observers.values())
+
+
+def test_send_u_recv():
+    from paddle_tpu.geometric import send_u_recv
+
+    x = pt.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+    src = pt.to_tensor(np.array([0, 1, 2, 0]))
+    dst = pt.to_tensor(np.array([1, 2, 1, 0]))
+    out = send_u_recv(x, src, dst, reduce_op="sum")
+    np.testing.assert_allclose(out.numpy(), [[1.0], [4.0], [2.0]])
+
+
+def test_segment_ops():
+    from paddle_tpu.geometric import segment_mean, segment_sum
+
+    data = pt.to_tensor(np.array([[1.0], [2.0], [3.0], [4.0]], np.float32))
+    ids = pt.to_tensor(np.array([0, 0, 1, 1]))
+    np.testing.assert_allclose(segment_sum(data, ids).numpy()[:2],
+                               [[3.0], [7.0]])
+    np.testing.assert_allclose(segment_mean(data, ids).numpy()[:2],
+                               [[1.5], [3.5]])
+
+
+def test_viterbi_decode():
+    from paddle_tpu.text import ViterbiDecoder
+
+    # 2 tags; strong self-transition; emissions favor tag 0 then tag 1
+    trans = pt.to_tensor(np.array([[1.0, -1.0], [-1.0, 1.0]], np.float32))
+    pots = pt.to_tensor(np.array([[[2.0, 0.0], [2.0, 0.0], [0.0, 5.0]]],
+                                 np.float32))
+    dec = ViterbiDecoder(trans)
+    scores, path = dec(pots, pt.to_tensor(np.array([3])))
+    assert path.shape == [1, 3]
+    assert path.numpy()[0, -1] == 1
+
+
+def test_audio_mel_spectrogram():
+    from paddle_tpu.audio import features
+
+    sig = pt.to_tensor(np.sin(np.linspace(0, 100, 2048)).astype(np.float32))
+    mel = features.MelSpectrogram(sr=8000, n_fft=256, n_mels=16)(sig)
+    assert mel.shape[0] == 16
+    mfcc = features.MFCC(sr=8000, n_mfcc=8, n_fft=256, n_mels=16)(sig)
+    assert mfcc.shape[0] == 8
+
+
+def test_device_api():
+    import paddle_tpu.device as dev
+
+    assert dev.device_count() >= 1
+    dev.synchronize()
+    assert not dev.cuda.is_available()
+    s = dev.current_stream()
+    s.synchronize()
+
+
+def test_onnx_export_stablehlo(tmp_path):
+    m = nn.Linear(4, 2)
+    from paddle_tpu.static import InputSpec
+
+    out = pt.onnx.export(m, str(tmp_path / "model"),
+                         input_spec=[InputSpec([1, 4], "float32")])
+    import os
+
+    assert os.path.exists(out) and os.path.getsize(out) > 0
