@@ -25,11 +25,31 @@ class _Flag:
 
 
 class FlagRegistry:
-    """Process-global typed flag store with FLAGS_* env override."""
+    """Process-global typed flag store with FLAGS_* env override.
+
+    Backed by the native C++ registry (core/native/flags_native.cc — the
+    equivalent of the reference's flags_native.cc) when the toolchain is
+    available: values live in the native store so C++ runtime components
+    read the same flags; this class keeps the python type metadata and
+    falls back to a pure-python store otherwise.
+    """
 
     def __init__(self):
         self._flags: dict[str, _Flag] = {}
         self._lock = threading.RLock()
+        self._native = None
+        self._native_tried = False
+
+    def _lib(self):
+        if not self._native_tried:
+            self._native_tried = True
+            try:
+                from . import native
+
+                self._native = native.load()
+            except Exception:
+                self._native = None
+        return self._native
 
     def define(self, name: str, default: Any, help: str = "") -> None:
         with self._lock:
@@ -40,6 +60,10 @@ class FlagRegistry:
             if env is not None:
                 value = self._parse(env, type(default))
             self._flags[name] = _Flag(name, default, value, type(default), help)
+            lib = self._lib()
+            if lib is not None:
+                lib.pt_flag_define(name.encode(), str(value).encode(),
+                                   help.encode())
 
     @staticmethod
     def _parse(text: str, ty: type) -> Any:
@@ -48,6 +72,9 @@ class FlagRegistry:
         return ty(text)
 
     def get(self, name: str) -> Any:
+        # reads stay on the python cache (dispatch queries flags per-op);
+        # set() writes through to the native store, which is what C++
+        # components read
         with self._lock:
             return self._flags[name].value
 
@@ -57,6 +84,9 @@ class FlagRegistry:
             if not isinstance(value, flag.type):
                 value = self._parse(str(value), flag.type)
             flag.value = value
+            lib = self._native
+            if lib is not None:
+                lib.pt_flag_set(name.encode(), str(value).encode())
 
     def has(self, name: str) -> bool:
         with self._lock:

@@ -1,0 +1,98 @@
+"""Native (C++) runtime components + ctypes bindings.
+
+The reference implements its runtime layer in C++ (flags registry
+paddle/common/flags_native.cc; TCPStore phi/core/distributed/store/
+tcp_store.h; DataFeed fluid/framework/data_feed.cc). These are their
+TPU-native equivalents, compiled on first use with g++ into a shared
+library cached next to the sources (content-hashed), bound via ctypes
+(no pybind11 in this build). Every consumer has a pure-python fallback so
+the framework still works where no toolchain exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["flags_native.cc", "tcp_store.cc", "data_feed.cc"]
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _source_hash() -> str:
+    h = hashlib.sha256()
+    for s in _SOURCES:
+        with open(os.path.join(_DIR, s), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Build (if needed) and load the native library; None if unavailable."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        tag = _source_hash()
+        so_path = os.path.join(_DIR, f"libpaddle_tpu_native_{tag}.so")
+        if not os.path.exists(so_path):
+            srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   "-pthread", "-o", so_path] + srcs
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=120)
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(so_path)
+        except OSError:
+            return None
+        _bind(lib)
+        _LIB = lib
+        return _LIB
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    lib.pt_flag_define.argtypes = [c.c_char_p, c.c_char_p, c.c_char_p]
+    lib.pt_flag_set.argtypes = [c.c_char_p, c.c_char_p]
+    lib.pt_flag_set.restype = c.c_int
+    lib.pt_flag_get.argtypes = [c.c_char_p, c.c_char_p, c.c_int]
+    lib.pt_flag_get.restype = c.c_int
+    lib.pt_flag_count.restype = c.c_int
+    lib.pt_flag_names.argtypes = [c.c_char_p, c.c_int]
+    lib.pt_flag_names.restype = c.c_int
+
+    lib.pt_store_master_start.argtypes = [c.c_int]
+    lib.pt_store_master_start.restype = c.c_void_p
+    lib.pt_store_master_stop.argtypes = [c.c_void_p]
+    lib.pt_store_connect.argtypes = [c.c_char_p, c.c_int, c.c_int]
+    lib.pt_store_connect.restype = c.c_int
+    lib.pt_store_set.argtypes = [c.c_int, c.c_char_p, c.c_char_p, c.c_int]
+    lib.pt_store_set.restype = c.c_int
+    lib.pt_store_get.argtypes = [c.c_int, c.c_char_p, c.c_char_p, c.c_int]
+    lib.pt_store_get.restype = c.c_int
+    lib.pt_store_add.argtypes = [c.c_int, c.c_char_p, c.c_longlong]
+    lib.pt_store_add.restype = c.c_longlong
+    lib.pt_store_close.argtypes = [c.c_int]
+
+    lib.pt_feed_open.argtypes = [c.c_char_p, c.c_int, c.c_int, c.c_int,
+                                 c.c_ulonglong, c.c_int, c.c_int]
+    lib.pt_feed_open.restype = c.c_void_p
+    lib.pt_feed_next.argtypes = [c.c_void_p, c.POINTER(c.c_int32)]
+    lib.pt_feed_next.restype = c.c_int
+    lib.pt_feed_num_tokens.argtypes = [c.c_void_p]
+    lib.pt_feed_num_tokens.restype = c.c_longlong
+    lib.pt_feed_close.argtypes = [c.c_void_p]
+
+
+def available() -> bool:
+    return load() is not None

@@ -1,0 +1,148 @@
+// Native token data feed: threaded batch assembly for LM training.
+//
+// Re-design of the reference's C++ ingestion pipeline
+// (paddle/fluid/framework/data_feed.cc DataFeed/Dataset: worker threads
+// parse records into channel queues the trainers pop). TPU translation:
+// the host-side bottleneck for LM training is assembling fixed-shape
+// [batch, seq+1] int32 windows from a token stream fast enough to keep the
+// chip fed; this feed mmap-reads a token file (or serves a caller-provided
+// buffer), has N filler threads cutting (optionally shuffled) windows into
+// a bounded ring of ready batches, and hands zero-copy-out batches to
+// Python through ctypes.
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Batch {
+  std::vector<int32_t> data;
+};
+
+struct Feed {
+  const int32_t* tokens = nullptr;   // token stream
+  size_t n_tokens = 0;
+  bool owns_map = false;
+  size_t map_len = 0;
+
+  int batch = 0;
+  int window = 0;                    // seq_len + 1 (inputs+labels)
+  bool shuffle = false;
+  uint64_t seed = 0;
+
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_space;
+  std::queue<Batch> ready;
+  size_t capacity = 4;
+  std::atomic<uint64_t> cursor{0};
+  std::atomic<bool> stopping{false};
+  std::vector<std::thread> fillers;
+};
+
+void fill_loop(Feed* f, int worker_id) {
+  std::mt19937_64 rng(f->seed + static_cast<uint64_t>(worker_id));
+  const size_t n_windows = f->n_tokens / static_cast<size_t>(f->window);
+  if (n_windows == 0) return;
+  const size_t bsz = static_cast<size_t>(f->batch);
+  const size_t w = static_cast<size_t>(f->window);
+  while (!f->stopping.load()) {
+    Batch b;
+    b.data.resize(bsz * w);
+    for (size_t i = 0; i < bsz; ++i) {
+      size_t idx;
+      if (f->shuffle) {
+        idx = rng() % n_windows;
+      } else {
+        idx = f->cursor.fetch_add(1) % n_windows;
+      }
+      std::memcpy(&b.data[i * w], f->tokens + idx * w, w * sizeof(int32_t));
+    }
+    std::unique_lock<std::mutex> g(f->mu);
+    f->cv_space.wait(g, [f] {
+      return f->stopping.load() || f->ready.size() < f->capacity;
+    });
+    if (f->stopping.load()) return;
+    f->ready.push(std::move(b));
+    g.unlock();
+    f->cv_ready.notify_one();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a feed over a binary int32 token file. Returns handle or null.
+void* pt_feed_open(const char* path, int batch, int seq_len, int shuffle,
+                   unsigned long long seed, int n_threads, int capacity) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (::fstat(fd, &st) != 0 || st.st_size < 4) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* map = ::mmap(nullptr, static_cast<size_t>(st.st_size), PROT_READ,
+                     MAP_PRIVATE, fd, 0);
+  ::close(fd);
+  if (map == MAP_FAILED) return nullptr;
+
+  Feed* f = new Feed();
+  f->tokens = static_cast<const int32_t*>(map);
+  f->n_tokens = static_cast<size_t>(st.st_size) / 4;
+  f->owns_map = true;
+  f->map_len = static_cast<size_t>(st.st_size);
+  f->batch = batch;
+  f->window = seq_len + 1;
+  f->shuffle = shuffle != 0;
+  f->seed = seed;
+  f->capacity = capacity > 0 ? static_cast<size_t>(capacity) : 4;
+  int nt = n_threads > 0 ? n_threads : 2;
+  for (int i = 0; i < nt; ++i) f->fillers.emplace_back(fill_loop, f, i);
+  return f;
+}
+
+// Pop one ready batch into out[batch * (seq_len+1)]. Blocks. 0 on success.
+int pt_feed_next(void* handle, int32_t* out) {
+  Feed* f = static_cast<Feed*>(handle);
+  std::unique_lock<std::mutex> g(f->mu);
+  f->cv_ready.wait(g, [f] { return f->stopping.load() || !f->ready.empty(); });
+  if (f->ready.empty()) return -1;
+  Batch b = std::move(f->ready.front());
+  f->ready.pop();
+  g.unlock();
+  f->cv_space.notify_one();
+  std::memcpy(out, b.data.data(), b.data.size() * sizeof(int32_t));
+  return 0;
+}
+
+long long pt_feed_num_tokens(void* handle) {
+  return static_cast<long long>(static_cast<Feed*>(handle)->n_tokens);
+}
+
+void pt_feed_close(void* handle) {
+  Feed* f = static_cast<Feed*>(handle);
+  if (!f) return;
+  f->stopping.store(true);
+  f->cv_ready.notify_all();
+  f->cv_space.notify_all();
+  for (auto& t : f->fillers)
+    if (t.joinable()) t.join();
+  if (f->owns_map)
+    ::munmap(const_cast<int32_t*>(f->tokens), f->map_len);
+  delete f;
+}
+
+}  // extern "C"

@@ -1,0 +1,119 @@
+// Native flag registry.
+//
+// Re-design of the reference's gflags-like native registry
+// (paddle/common/flags_native.cc; macros paddle/common/flags.h:83
+// PD_DEFINE_VARIABLE): a process-global string->value store with
+// env-var override (FLAGS_<name>), typed get/set, and a C ABI for the
+// Python binding (ctypes — no pybind11 in this build).
+//
+// Thread-safe: the runtime reads flags from dispatch hot paths while
+// user threads flip them.
+
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+struct FlagEntry {
+  std::string value;
+  std::string default_value;
+  std::string help;
+};
+
+class FlagRegistry {
+ public:
+  static FlagRegistry& Instance() {
+    static FlagRegistry inst;
+    return inst;
+  }
+
+  void Define(const char* name, const char* def, const char* help) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = flags_.find(name);
+    if (it != flags_.end()) return;  // first definition wins
+    FlagEntry e;
+    e.default_value = def;
+    e.help = help ? help : "";
+    // env override: FLAGS_<name>
+    std::string env_key = std::string("FLAGS_") + name;
+    const char* env = std::getenv(env_key.c_str());
+    e.value = env ? env : def;
+    flags_[name] = e;
+  }
+
+  bool Set(const char* name, const char* value) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = flags_.find(name);
+    if (it == flags_.end()) return false;
+    it->second.value = value;
+    return true;
+  }
+
+  // Returns length written (excl. NUL) or -1 if missing.
+  int Get(const char* name, char* out, int cap) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = flags_.find(name);
+    if (it == flags_.end()) return -1;
+    const std::string& v = it->second.value;
+    int n = static_cast<int>(v.size());
+    if (out && cap > 0) {
+      int c = n < cap - 1 ? n : cap - 1;
+      std::memcpy(out, v.data(), c);
+      out[c] = '\0';
+    }
+    return n;
+  }
+
+  int Count() {
+    std::lock_guard<std::mutex> g(mu_);
+    return static_cast<int>(flags_.size());
+  }
+
+  // Write all names joined by '\n' into out.
+  int Names(char* out, int cap) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::string joined;
+    for (auto& kv : flags_) {
+      if (!joined.empty()) joined += '\n';
+      joined += kv.first;
+    }
+    int n = static_cast<int>(joined.size());
+    if (out && cap > 0) {
+      int c = n < cap - 1 ? n : cap - 1;
+      std::memcpy(out, joined.data(), c);
+      out[c] = '\0';
+    }
+    return n;
+  }
+
+ private:
+  std::mutex mu_;
+  std::map<std::string, FlagEntry> flags_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void pt_flag_define(const char* name, const char* def, const char* help) {
+  FlagRegistry::Instance().Define(name, def, help);
+}
+
+int pt_flag_set(const char* name, const char* value) {
+  return FlagRegistry::Instance().Set(name, value) ? 0 : -1;
+}
+
+int pt_flag_get(const char* name, char* out, int cap) {
+  return FlagRegistry::Instance().Get(name, out, cap);
+}
+
+int pt_flag_count() { return FlagRegistry::Instance().Count(); }
+
+int pt_flag_names(char* out, int cap) {
+  return FlagRegistry::Instance().Names(out, cap);
+}
+
+}  // extern "C"

@@ -1,0 +1,237 @@
+// TCPStore: key-value rendezvous for multi-host bootstrap.
+//
+// Re-design of the reference's TCPStore
+// (paddle/phi/core/distributed/store/tcp_store.h:121, tcp_utils.cc):
+// one host runs the master (a small epoll-free threaded TCP server);
+// every process connects as a client. Ops: SET, GET (blocking via WAIT),
+// ADD (atomic fetch-add, used for rank counting), WAIT (block until key
+// exists). Wire format: u8 op | u32 keylen | key | u32 vallen | val.
+//
+// The jax coordination service covers device-runtime bootstrap; this
+// store serves the *framework-level* rendezvous the reference exposes to
+// users (master discovery, barrier counters, elastic membership) without
+// bringing in etcd.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t { OP_SET = 1, OP_GET = 2, OP_ADD = 3, OP_WAIT = 4 };
+
+struct Master {
+  int listen_fd = -1;
+  std::thread accept_thread;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> kv;
+  std::vector<std::thread> workers;
+  bool stopping = false;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool read_str(int fd, std::string* out) {
+  uint32_t len;
+  if (!read_full(fd, &len, 4)) return false;
+  out->resize(len);
+  return len == 0 || read_full(fd, &(*out)[0], len);
+}
+
+bool write_str(int fd, const std::string& s) {
+  uint32_t len = static_cast<uint32_t>(s.size());
+  if (!write_full(fd, &len, 4)) return false;
+  return s.empty() || write_full(fd, s.data(), s.size());
+}
+
+void serve_client(Master* m, int fd) {
+  for (;;) {
+    uint8_t op;
+    if (!read_full(fd, &op, 1)) break;
+    std::string key;
+    if (!read_str(fd, &key)) break;
+    if (op == OP_SET) {
+      std::string val;
+      if (!read_str(fd, &val)) break;
+      {
+        std::lock_guard<std::mutex> g(m->mu);
+        m->kv[key] = val;
+      }
+      m->cv.notify_all();
+      uint8_t ok = 0;
+      if (!write_full(fd, &ok, 1)) break;
+    } else if (op == OP_GET || op == OP_WAIT) {
+      std::unique_lock<std::mutex> g(m->mu);
+      m->cv.wait(g, [&] { return m->stopping || m->kv.count(key); });
+      if (m->stopping) break;
+      std::string val = m->kv[key];
+      g.unlock();
+      if (!write_str(fd, val)) break;
+    } else if (op == OP_ADD) {
+      std::string delta_s;
+      if (!read_str(fd, &delta_s)) break;
+      int64_t delta = std::strtoll(delta_s.c_str(), nullptr, 10);
+      int64_t result;
+      {
+        std::lock_guard<std::mutex> g(m->mu);
+        int64_t cur = 0;
+        auto it = m->kv.find(key);
+        if (it != m->kv.end()) cur = std::strtoll(it->second.c_str(),
+                                                  nullptr, 10);
+        result = cur + delta;
+        m->kv[key] = std::to_string(result);
+      }
+      m->cv.notify_all();
+      if (!write_str(fd, std::to_string(result))) break;
+    }
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start a master on port; returns opaque handle (or 0 on failure).
+void* pt_store_master_start(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 64) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  Master* m = new Master();
+  m->listen_fd = fd;
+  m->accept_thread = std::thread([m] {
+    for (;;) {
+      int cfd = ::accept(m->listen_fd, nullptr, nullptr);
+      if (cfd < 0) break;  // listen_fd closed => shutdown
+      int one = 1;
+      ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(m->mu);
+      m->workers.emplace_back(serve_client, m, cfd);
+    }
+  });
+  return m;
+}
+
+void pt_store_master_stop(void* handle) {
+  Master* m = static_cast<Master*>(handle);
+  if (!m) return;
+  {
+    std::lock_guard<std::mutex> g(m->mu);
+    m->stopping = true;
+  }
+  m->cv.notify_all();
+  ::shutdown(m->listen_fd, SHUT_RDWR);
+  ::close(m->listen_fd);
+  if (m->accept_thread.joinable()) m->accept_thread.join();
+  for (auto& t : m->workers)
+    if (t.joinable()) t.detach();  // blocked clients die with process
+  delete m;
+}
+
+// Client: connect, returns fd (<0 on failure).
+int pt_store_connect(const char* host, int port, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  int tries = timeout_ms / 100 + 1;
+  while (tries-- > 0) {
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr),
+                  sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    ::usleep(100 * 1000);
+    ::close(fd);
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  }
+  ::close(fd);
+  return -1;
+}
+
+int pt_store_set(int fd, const char* key, const char* val, int val_len) {
+  uint8_t op = OP_SET;
+  if (!write_full(fd, &op, 1)) return -1;
+  if (!write_str(fd, key)) return -1;
+  if (!write_str(fd, std::string(val, static_cast<size_t>(val_len))))
+    return -1;
+  uint8_t ok;
+  return read_full(fd, &ok, 1) ? 0 : -1;
+}
+
+// GET blocks until key exists; returns value length (or -1).
+int pt_store_get(int fd, const char* key, char* out, int cap) {
+  uint8_t op = OP_GET;
+  if (!write_full(fd, &op, 1)) return -1;
+  if (!write_str(fd, key)) return -1;
+  std::string val;
+  if (!read_str(fd, &val)) return -1;
+  int n = static_cast<int>(val.size());
+  if (out && cap > 0) {
+    int c = n < cap ? n : cap;
+    std::memcpy(out, val.data(), static_cast<size_t>(c));
+  }
+  return n;
+}
+
+long long pt_store_add(int fd, const char* key, long long delta) {
+  uint8_t op = OP_ADD;
+  if (!write_full(fd, &op, 1)) return -1;
+  if (!write_str(fd, key)) return -1;
+  if (!write_str(fd, std::to_string(delta))) return -1;
+  std::string val;
+  if (!read_str(fd, &val)) return -1;
+  return std::strtoll(val.c_str(), nullptr, 10);
+}
+
+void pt_store_close(int fd) { ::close(fd); }
+
+}  // extern "C"

@@ -1,0 +1,149 @@
+"""TCPStore: framework-level rendezvous (native-backed).
+
+Python surface of the native store (core/native/tcp_store.cc), mirroring
+the reference's paddle.distributed TCPStore
+(phi/core/distributed/store/tcp_store.h:121; Store base store.h:24):
+set/get (blocking)/add/wait + a counter-based barrier. Falls back to an
+in-process dict store when single-host (is_master and host == client) and
+the native lib is unavailable.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+__all__ = ["TCPStore", "Store"]
+
+
+class Store:
+    def set(self, key: str, value) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def add(self, key: str, amount: int) -> int:
+        raise NotImplementedError
+
+    def wait(self, keys) -> None:
+        for k in keys if isinstance(keys, (list, tuple)) else [keys]:
+            self.get(k)
+
+
+class _LocalStore(Store):
+    """In-process fallback (single-host tests without the native lib)."""
+
+    def __init__(self):
+        self._kv: dict = {}
+        self._cv = threading.Condition()
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        with self._cv:
+            self._kv[key] = bytes(value)
+            self._cv.notify_all()
+
+    def get(self, key):
+        with self._cv:
+            self._cv.wait_for(lambda: key in self._kv)
+            return self._kv[key]
+
+    def add(self, key, amount):
+        with self._cv:
+            cur = int(self._kv.get(key, b"0"))
+            cur += int(amount)
+            self._kv[key] = str(cur).encode()
+            self._cv.notify_all()
+            return cur
+
+
+class TCPStore(Store):
+    def __init__(self, host: str = "127.0.0.1", port: int = 6170,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 900.0):
+        from ..core import native
+
+        self.host = host
+        self.port = int(port)
+        self.is_master = is_master
+        self._lib = native.load()
+        self._master_handle = None
+        self._fd = -1
+        self._local: Optional[_LocalStore] = None
+
+        if self._lib is None:
+            if world_size > 1:
+                raise RuntimeError(
+                    "TCPStore needs the native library for multi-process "
+                    "rendezvous (g++ unavailable?)")
+            self._local = _LocalStore()
+            return
+
+        if is_master:
+            self._master_handle = self._lib.pt_store_master_start(self.port)
+            if not self._master_handle:
+                raise RuntimeError(f"cannot bind TCPStore master on port "
+                                   f"{self.port}")
+        self._fd = self._lib.pt_store_connect(
+            host.encode(), self.port, int(timeout * 1000))
+        if self._fd < 0:
+            raise RuntimeError(f"cannot connect TCPStore at {host}:{port}")
+
+    # -- ops ----------------------------------------------------------------
+    def set(self, key: str, value) -> None:
+        if self._local is not None:
+            return self._local.set(key, value)
+        if isinstance(value, str):
+            value = value.encode()
+        value = bytes(value)
+        rc = self._lib.pt_store_set(self._fd, key.encode(), value,
+                                    len(value))
+        if rc != 0:
+            raise RuntimeError("TCPStore set failed")
+
+    def get(self, key: str) -> bytes:
+        if self._local is not None:
+            return self._local.get(key)
+        import ctypes
+
+        cap = 1 << 16
+        buf = ctypes.create_string_buffer(cap)
+        n = self._lib.pt_store_get(self._fd, key.encode(), buf, cap)
+        if n < 0:
+            raise RuntimeError("TCPStore get failed")
+        return buf.raw[:n]
+
+    def add(self, key: str, amount: int = 1) -> int:
+        if self._local is not None:
+            return self._local.add(key, amount)
+        out = self._lib.pt_store_add(self._fd, key.encode(), int(amount))
+        return int(out)
+
+    def barrier(self, key: str, world_size: int, timeout: float = 300.0):
+        """Counter barrier: arrive, then wait for everyone."""
+        arrived = self.add(f"{key}/count", 1)
+        if arrived == world_size:
+            self.set(f"{key}/go", b"1")
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                if self.get(f"{key}/go") == b"1":
+                    return
+            except RuntimeError:
+                pass
+            time.sleep(0.01)
+        raise TimeoutError(f"barrier {key} timed out")
+
+    def __del__(self):
+        try:
+            if self._lib is not None:
+                if self._fd >= 0:
+                    self._lib.pt_store_close(self._fd)
+                if self._master_handle:
+                    self._lib.pt_store_master_stop(self._master_handle)
+        except Exception:
+            pass

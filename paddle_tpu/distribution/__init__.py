@@ -74,23 +74,12 @@ class Distribution:
     def kl_divergence(self, other):
         return kl_divergence(self, other)
 
-    def _extend(self, shape):
-        return tuple(shape) + tuple(jnp.broadcast_shapes(
-            *(jnp.shape(a) for a in self._params())))
-
-    def _params(self):
-        return ()
-
-
 class Normal(Distribution):
     def __init__(self, loc, scale, name=None):
         self.loc = _arr(loc)
         self.scale = _arr(scale)
         super().__init__(jnp.broadcast_shapes(self.loc.shape,
                                               self.scale.shape))
-
-    def _params(self):
-        return (self.loc, self.scale)
 
     @property
     def mean(self):

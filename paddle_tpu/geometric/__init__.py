@@ -136,19 +136,31 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
                               else count)))
 
 
+_SAMPLER_RNG = None
+
+
 def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
                      eids=None, return_eids: bool = False, perm_buffer=None,
                      name=None):
     """Uniform neighbor sampling on CSC (host-side; reference
-    geometric/sampling/neighbors.py)."""
+    geometric/sampling/neighbors.py). Draws from the framework's global
+    seed — fresh samples per call, reproducible under paddle_tpu.seed."""
     import numpy as np
 
+    if return_eids:
+        raise NotImplementedError("return_eids is not supported yet")
     r = np.asarray(row._data if isinstance(row, Tensor) else row)
     cp = np.asarray(colptr._data if isinstance(colptr, Tensor) else colptr)
     nodes = np.asarray(input_nodes._data if isinstance(input_nodes, Tensor)
                        else input_nodes)
     out_neighbors, out_counts = [], []
-    rng = np.random.default_rng(0)
+    global _SAMPLER_RNG
+    if _SAMPLER_RNG is None:
+        from ..core import random as _random
+
+        seed = int(np.asarray(_random.next_key())[-1])
+        _SAMPLER_RNG = np.random.default_rng(seed)
+    rng = _SAMPLER_RNG
     for n in nodes.tolist():
         lo, hi = int(cp[n]), int(cp[n + 1])
         neigh = r[lo:hi]

@@ -1,0 +1,82 @@
+"""TokenDataFeed: native-threaded LM batch feed.
+
+Python surface of core/native/data_feed.cc (the reference's C++
+DataFeed/Dataset ingestion, fluid/framework/data_feed.cc): mmap a binary
+int32 token file, N native threads assemble [batch, seq_len+1] windows
+into a bounded ring, Python pops ready batches with one memcpy. Falls
+back to a numpy implementation when the native lib is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["TokenDataFeed"]
+
+
+class TokenDataFeed:
+    def __init__(self, path: str, batch_size: int, seq_len: int,
+                 shuffle: bool = True, seed: int = 0, num_threads: int = 2,
+                 capacity: int = 8):
+        from ..core import native
+
+        self.batch_size = int(batch_size)
+        self.seq_len = int(seq_len)
+        self._window = self.seq_len + 1
+        self._lib = native.load()
+        self._handle = None
+        self._np_tokens: Optional[np.ndarray] = None
+        self._rng = np.random.default_rng(seed)
+        self._cursor = 0
+
+        if self._lib is not None:
+            self._handle = self._lib.pt_feed_open(
+                path.encode(), self.batch_size, self.seq_len,
+                1 if shuffle else 0, seed, num_threads, capacity)
+        if self._handle is None or not self._handle:
+            self._handle = None
+            self._np_tokens = np.fromfile(path, dtype=np.int32)
+            self._shuffle = shuffle
+
+    @property
+    def num_tokens(self) -> int:
+        if self._handle:
+            return int(self._lib.pt_feed_num_tokens(self._handle))
+        return int(self._np_tokens.size)
+
+    def next(self) -> tuple[np.ndarray, np.ndarray]:
+        """Returns (inputs [B, S], labels [B, S]) int32."""
+        if self._handle:
+            out = np.empty((self.batch_size, self._window), np.int32)
+            rc = self._lib.pt_feed_next(
+                self._handle,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            if rc != 0:
+                raise StopIteration
+        else:
+            n_windows = self._np_tokens.size // self._window
+            out = np.empty((self.batch_size, self._window), np.int32)
+            for i in range(self.batch_size):
+                if self._shuffle:
+                    idx = int(self._rng.integers(0, n_windows))
+                else:
+                    idx = self._cursor % n_windows
+                    self._cursor += 1
+                out[i] = self._np_tokens[idx * self._window:
+                                         (idx + 1) * self._window]
+        return out[:, :-1], out[:, 1:]
+
+    def __iter__(self):
+        while True:
+            yield self.next()
+
+    def close(self):
+        if self._handle and self._lib is not None:
+            self._lib.pt_feed_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        self.close()

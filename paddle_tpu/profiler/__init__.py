@@ -122,6 +122,7 @@ class Profiler:
         self._tracing = False
         self._op_counts: dict = defaultdict(int)
         self._hook = None
+        self._handler_fired = False
         self._step_times: list = []
         self._last_step_t = None
 
@@ -138,10 +139,14 @@ class Profiler:
     def stop(self):
         if self._hook in DISPATCH_HOOKS:
             DISPATCH_HOOKS.remove(self._hook)
+        was_tracing = self._tracing
         if self._tracing:
             jax.profiler.stop_trace()
             self._tracing = False
-        if self._on_trace_ready is not None:
+        # fire the handler only if recording happened and step() didn't
+        # already fire it at a RECORD_AND_RETURN boundary
+        if self._on_trace_ready is not None and was_tracing \
+                and not self._handler_fired:
             self._on_trace_ready(self)
 
     def step(self, num_samples: Optional[int] = None):
@@ -156,6 +161,7 @@ class Profiler:
             self._maybe_toggle_trace()
         if self._state == ProfilerState.RECORD_AND_RETURN and \
                 self._on_trace_ready is not None:
+            self._handler_fired = True
             self._on_trace_ready(self)
 
     def _maybe_toggle_trace(self):

@@ -26,16 +26,22 @@ __all__ = ["QuantConfig", "QAT", "PTQ", "ImperativeQuantAware",
 
 
 @op("fake_quantize")
-def _fake_quant_op(x, *, scale, bits):
+def _fake_quant_op(x, scale, *, bits):
     qmax = 2.0 ** (bits - 1) - 1
+    # scale is a statistic, not a learned path (absmax fake-quant)
+    safe = jax.lax.stop_gradient(
+        jnp.maximum(jnp.asarray(scale, jnp.float32), 1e-8))
     # STE: round in forward, identity gradient
-    scaled = x / scale * qmax
+    scaled = x / safe * qmax
     rounded = scaled + jax.lax.stop_gradient(jnp.round(scaled) - scaled)
-    return jnp.clip(rounded, -qmax, qmax) * scale / qmax
+    return jnp.clip(rounded, -qmax, qmax) * safe / qmax
 
 
-def fake_quant(x, scale: float, bits: int = 8):
-    return _fake_quant_op(x, scale=float(scale), bits=bits)
+def fake_quant(x, scale, bits: int = 8):
+    """``scale`` may be a python float or a (possibly traced) Tensor."""
+    if not isinstance(scale, Tensor):
+        scale = float(scale)
+    return _fake_quant_op(x, scale, bits=bits)
 
 
 def quant(x, scale, bits: int = 8):
@@ -60,7 +66,8 @@ class AbsmaxObserver:
 
     def observe(self, x):
         arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-        self._max = max(self._max, float(jnp.abs(arr).max()))
+        if not isinstance(arr, jax.core.Tracer):
+            self._max = max(self._max, float(jnp.abs(arr).max()))
         return x
 
     def scale(self) -> float:
@@ -92,8 +99,10 @@ class QuantedLinear(Layer):
     def forward(self, x):
         self.act_observer.observe(x)
         w = self.inner.weight
-        w_scale = float(jnp.abs(w._data).max())
-        wq = fake_quant(w, w_scale or 1.0, self.bits)
+        # weight scale as a traced expression: no host sync per step, and
+        # QAT models compile under jit.to_static
+        w_scale = w.abs().max()
+        wq = fake_quant(w, w_scale, self.bits)
         xq = fake_quant(x, self.act_observer.scale(), self.bits)
         from ..nn import functional as F
 

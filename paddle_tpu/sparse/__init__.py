@@ -172,12 +172,17 @@ def add(x, y, name=None):
 
 def multiply(x, y, name=None):
     if is_sparse(y):
-        return SparseCooTensor(
-            jsparse.BCOO((_as_bcoo(x).data * _as_bcoo(y).data,
-                          _as_bcoo(x).indices), shape=tuple(x.shape)))
+        # pattern-aware elementwise product (intersection of sparsity
+        # patterns), NOT a positional data-array product
+        out = jsparse.bcoo_multiply_sparse(_as_bcoo(x), _as_bcoo(y))
+        return SparseCooTensor(out)
     b = _as_bcoo(x)
+    yv = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+    if yv.ndim == 0:
+        return SparseCooTensor(jsparse.BCOO((b.data * yv, b.indices),
+                                            shape=b.shape))
     return SparseCooTensor(jsparse.BCOO(
-        (b.data * jnp.asarray(y), b.indices), shape=tuple(x.shape)))
+        (jsparse.bcoo_multiply_dense(b, yv), b.indices), shape=b.shape))
 
 
 def _unary(fn):

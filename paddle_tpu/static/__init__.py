@@ -152,6 +152,13 @@ class Executor:
             arr = value._data if isinstance(value, Tensor) else \
                 jnp.asarray(value)
             var._bump(arr)
+        if fetch_list and not program._build_fns:
+            raise RuntimeError(
+                "Executor.run: this Program recorded no build functions, so "
+                "fetch targets would return stale build-time values. "
+                "Register the computation via program._build_fns.append(fn) "
+                "(see tests/test_subsystems.py) or port the script to "
+                "paddle_tpu.jit.to_static.")
         for fn in program._build_fns:
             fn()
         outs = []

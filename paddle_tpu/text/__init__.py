@@ -51,7 +51,9 @@ def _viterbi(potentials, transitions, lengths, *, include_bos_eos_tag):
     def backtrack(carry, idx_t):
         cur = carry
         prev = jnp.take_along_axis(idx_t, cur[:, None], 1)[:, 0]
-        return prev, cur
+        # emit prev (tag_{t-1}) for step t: stacked outputs are
+        # tag_0..tag_{T-2}; best_last appended below completes the path
+        return prev, prev
 
     _, path_rev = lax.scan(backtrack, best_last, idxs, reverse=True)
     path = jnp.concatenate([jnp.moveaxis(path_rev, 0, 1),

@@ -112,3 +112,33 @@ def test_onnx_export_stablehlo(tmp_path):
     import os
 
     assert os.path.exists(out) and os.path.getsize(out) > 0
+
+
+def test_viterbi_matches_brute_force():
+    import itertools
+
+    from paddle_tpu.text import viterbi_decode
+
+    rng = np.random.RandomState(3)
+    B, T, N = 1, 4, 3
+    pots = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    score, path = viterbi_decode(pt.to_tensor(pots), pt.to_tensor(trans),
+                                 pt.to_tensor(np.array([T])))
+    best = None
+    for p in itertools.product(range(N), repeat=T):
+        s = pots[0, 0, p[0]] + sum(trans[p[i - 1], p[i]] + pots[0, i, p[i]]
+                                   for i in range(1, T))
+        if best is None or s > best[0]:
+            best = (s, p)
+    assert tuple(int(t) for t in path.numpy()[0]) == best[1]
+    np.testing.assert_allclose(float(score.numpy()[0]), best[0], rtol=1e-5)
+
+
+def test_sparse_multiply_pattern_intersection():
+    from paddle_tpu import sparse
+
+    x = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [2.0, 3.0], shape=[2, 2])
+    y = sparse.sparse_coo_tensor([[0, 1], [1, 0]], [5.0, 7.0], shape=[2, 2])
+    out = sparse.multiply(x, y)
+    np.testing.assert_allclose(out.to_dense().numpy(), np.zeros((2, 2)))
