@@ -51,7 +51,7 @@ def main():
         "TPU" in jax.devices()[0].device_kind
     if on_tpu:
         cfg = gpt_presets("gpt3-350m")
-        batch, steps, warmup = 8, 20, 3
+        batch, steps, warmup = 8, 20, 8
     else:  # CI / CPU smoke: tiny model, still exercises the full path
         cfg = GPTConfig(vocab_size=1024, hidden=256, n_layers=4, n_heads=4,
                         seq_len=256)
@@ -63,8 +63,12 @@ def main():
         cfg, mesh, lr=1e-4, n_microbatches=1, zero1=n_dev > 1)
 
     rng = np.random.RandomState(0)
-    toks = rng.randint(0, cfg.vocab_size, size=(batch, cfg.seq_len))
-    labs = rng.randint(0, cfg.vocab_size, size=(batch, cfg.seq_len))
+    # stage the batch on device once: re-uploading numpy per step costs an
+    # extra host->device transfer (expensive over remote-device tunnels)
+    toks = step.put_batch(rng.randint(0, cfg.vocab_size,
+                                      size=(batch, cfg.seq_len)))
+    labs = step.put_batch(rng.randint(0, cfg.vocab_size,
+                                      size=(batch, cfg.seq_len)))
 
     for _ in range(warmup):
         loss, params, opt_state = step(params, opt_state, toks, labs)

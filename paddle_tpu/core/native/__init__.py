@@ -43,14 +43,23 @@ def load() -> Optional[ctypes.CDLL]:
         tag = _source_hash()
         so_path = os.path.join(_DIR, f"libpaddle_tpu_native_{tag}.so")
         if not os.path.exists(so_path):
+            # build to a per-process temp path then rename atomically:
+            # concurrent ranks must never CDLL a half-written .so
+            tmp_path = f"{so_path}.tmp.{os.getpid()}"
             srcs = [os.path.join(_DIR, s) for s in _SOURCES]
             cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                   "-pthread", "-o", so_path] + srcs
+                   "-pthread", "-o", tmp_path] + srcs
             try:
                 subprocess.run(cmd, check=True, capture_output=True,
                                timeout=120)
+                os.replace(tmp_path, so_path)
             except Exception:
-                return None
+                try:
+                    os.unlink(tmp_path)
+                except OSError:
+                    pass
+                if not os.path.exists(so_path):
+                    return None
         try:
             lib = ctypes.CDLL(so_path)
         except OSError:

@@ -60,13 +60,13 @@ void fill_loop(Feed* f, int worker_id) {
   while (!f->stopping.load()) {
     Batch b;
     b.data.resize(bsz * w);
+    // non-shuffle: reserve a contiguous window range per batch so batches
+    // are internally sequential (single filler thread enforces global
+    // order, see pt_feed_open)
+    size_t base = f->shuffle ? 0 : f->cursor.fetch_add(bsz);
     for (size_t i = 0; i < bsz; ++i) {
-      size_t idx;
-      if (f->shuffle) {
-        idx = rng() % n_windows;
-      } else {
-        idx = f->cursor.fetch_add(1) % n_windows;
-      }
+      size_t idx = f->shuffle ? (rng() % n_windows)
+                              : ((base + i) % n_windows);
       std::memcpy(&b.data[i * w], f->tokens + idx * w, w * sizeof(int32_t));
     }
     std::unique_lock<std::mutex> g(f->mu);
@@ -102,6 +102,13 @@ void* pt_feed_open(const char* path, int batch, int seq_len, int shuffle,
   Feed* f = new Feed();
   f->tokens = static_cast<const int32_t*>(map);
   f->n_tokens = static_cast<size_t>(st.st_size) / 4;
+  if (f->n_tokens < static_cast<size_t>(seq_len + 1)) {
+    // fewer tokens than one window: filler threads would exit instantly
+    // and pt_feed_next would block forever
+    ::munmap(map, static_cast<size_t>(st.st_size));
+    delete f;
+    return nullptr;
+  }
   f->owns_map = true;
   f->map_len = static_cast<size_t>(st.st_size);
   f->batch = batch;
@@ -109,7 +116,8 @@ void* pt_feed_open(const char* path, int batch, int seq_len, int shuffle,
   f->shuffle = shuffle != 0;
   f->seed = seed;
   f->capacity = capacity > 0 ? static_cast<size_t>(capacity) : 4;
-  int nt = n_threads > 0 ? n_threads : 2;
+  // deterministic order for sequential reads requires one filler
+  int nt = shuffle ? (n_threads > 0 ? n_threads : 2) : 1;
   for (int i = 0; i < nt; ++i) f->fillers.emplace_back(fill_loop, f, i);
   return f;
 }

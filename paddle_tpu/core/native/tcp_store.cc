@@ -38,6 +38,7 @@ struct Master {
   std::condition_variable cv;
   std::map<std::string, std::string> kv;
   std::vector<std::thread> workers;
+  std::vector<int> client_fds;
   bool stopping = false;
 };
 
@@ -148,6 +149,7 @@ void* pt_store_master_start(int port) {
       int one = 1;
       ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       std::lock_guard<std::mutex> g(m->mu);
+      m->client_fds.push_back(cfd);
       m->workers.emplace_back(serve_client, m, cfd);
     }
   });
@@ -160,13 +162,17 @@ void pt_store_master_stop(void* handle) {
   {
     std::lock_guard<std::mutex> g(m->mu);
     m->stopping = true;
+    // unblock workers stuck in read(): shut their sockets down
+    for (int fd : m->client_fds) ::shutdown(fd, SHUT_RDWR);
   }
   m->cv.notify_all();
   ::shutdown(m->listen_fd, SHUT_RDWR);
   ::close(m->listen_fd);
   if (m->accept_thread.joinable()) m->accept_thread.join();
+  // JOIN (not detach): workers must be done before Master is freed,
+  // else they race a destroyed mutex/map (use-after-free)
   for (auto& t : m->workers)
-    if (t.joinable()) t.detach();  // blocked clients die with process
+    if (t.joinable()) t.join();
   delete m;
 }
 

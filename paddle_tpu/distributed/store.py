@@ -111,11 +111,14 @@ class TCPStore(Store):
         import ctypes
 
         cap = 1 << 16
-        buf = ctypes.create_string_buffer(cap)
-        n = self._lib.pt_store_get(self._fd, key.encode(), buf, cap)
-        if n < 0:
-            raise RuntimeError("TCPStore get failed")
-        return buf.raw[:n]
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.pt_store_get(self._fd, key.encode(), buf, cap)
+            if n < 0:
+                raise RuntimeError("TCPStore get failed")
+            if n <= cap:
+                return buf.raw[:n]
+            cap = n  # value larger than the buffer: refetch full-size
 
     def add(self, key: str, amount: int = 1) -> int:
         if self._local is not None:
@@ -124,17 +127,17 @@ class TCPStore(Store):
         return int(out)
 
     def barrier(self, key: str, world_size: int, timeout: float = 300.0):
-        """Counter barrier: arrive, then wait for everyone."""
+        """Counter barrier: arrive, then wait for everyone.
+
+        Polls with add(key, 0) (non-blocking peek — a blocking get would
+        make the timeout unreachable when a peer dies before arriving)."""
         arrived = self.add(f"{key}/count", 1)
-        if arrived == world_size:
-            self.set(f"{key}/go", b"1")
+        if arrived >= world_size:
+            return
         deadline = time.time() + timeout
         while time.time() < deadline:
-            try:
-                if self.get(f"{key}/go") == b"1":
-                    return
-            except RuntimeError:
-                pass
+            if self.add(f"{key}/count", 0) >= world_size:
+                return
             time.sleep(0.01)
         raise TimeoutError(f"barrier {key} timed out")
 

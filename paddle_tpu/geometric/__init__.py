@@ -136,9 +136,6 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
                               else count)))
 
 
-_SAMPLER_RNG = None
-
-
 def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
                      eids=None, return_eids: bool = False, perm_buffer=None,
                      name=None):
@@ -154,13 +151,11 @@ def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
     nodes = np.asarray(input_nodes._data if isinstance(input_nodes, Tensor)
                        else input_nodes)
     out_neighbors, out_counts = [], []
-    global _SAMPLER_RNG
-    if _SAMPLER_RNG is None:
-        from ..core import random as _random
+    # fresh stream per call from the global key: fresh samples every call,
+    # reproducible after paddle_tpu.seed
+    from ..core import random as _random
 
-        seed = int(np.asarray(_random.next_key())[-1])
-        _SAMPLER_RNG = np.random.default_rng(seed)
-    rng = _SAMPLER_RNG
+    rng = np.random.default_rng(int(np.asarray(_random.next_key())[-1]))
     for n in nodes.tolist():
         lo, hi = int(cp[n]), int(cp[n + 1])
         neigh = r[lo:hi]

@@ -240,10 +240,12 @@ def moe_block_apply(mp: dict, x, cfg: GPTConfig):
 
 
 def model_apply(params: dict, tokens, cfg: GPTConfig, sp_constraint=None,
-                blocks_fn=None):
-    """Forward to logits. ``blocks_fn(params_blocks, x)`` overrides the
-    dense-stack execution (the pipeline path passes the shard_map'd stage
-    runner); default is a remat'd lax.scan over stacked layers."""
+                blocks_fn=None, return_hidden: bool = False):
+    """Forward to logits (or the final hidden states with
+    ``return_hidden`` — the chunked-loss path projects to vocab itself).
+    ``blocks_fn(params_blocks, x)`` overrides the dense-stack execution
+    (the pipeline path passes the shard_map'd stage runner); default is a
+    remat'd lax.scan over stacked layers."""
     B, T = tokens.shape
     x = params["wte"][tokens].astype(cfg.dtype) + \
         params["wpe"][:T].astype(cfg.dtype)
@@ -256,7 +258,10 @@ def model_apply(params: dict, tokens, cfg: GPTConfig, sp_constraint=None,
         fn = functools.partial(block_apply, cfg=cfg,
                                sp_constraint=sp_constraint)
         if cfg.remat:
-            fn = jax.checkpoint(fn)
+            # save matmul outputs, recompute elementwise: cheaper backward
+            # than full-block remat at slightly higher memory
+            fn = jax.checkpoint(
+                fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
 
         def body(carry, bp):
             return fn(bp, carry), None
@@ -274,19 +279,57 @@ def model_apply(params: dict, tokens, cfg: GPTConfig, sp_constraint=None,
         (x, aux), _ = lax.scan(moe_body, (x, aux), params["moe"])
 
     x = _layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.eps)
+    if return_hidden:
+        return x, aux
     head = (params["wte"].T if cfg.tie_embeddings else params["head_w"])
     logits = jnp.einsum("bth,hv->btv", x, head.astype(cfg.dtype),
                         preferred_element_type=jnp.float32)
     return logits, aux
 
 
+def _chunked_ce(x, head, labels, chunk: int):
+    """Cross-entropy without materializing [B, T, V] logits: scan over
+    token chunks, rematerializing each chunk's logits in backward.
+
+    This is the memory role of the reference's fused softmax-CE kernels
+    (c_softmax_with_cross_entropy / ParallelCrossEntropy): the full-vocab
+    logit tensor (the largest activation in GPT training by far) never
+    lives in HBM; peak extra memory is [B, chunk, V].
+    """
+    B, T, H = x.shape
+    n = max(1, T // chunk)
+    while T % n:
+        n -= 1
+    c = T // n
+    xs = jnp.moveaxis(x.reshape(B, n, c, H), 1, 0)
+    ls = jnp.moveaxis(labels.reshape(B, n, c), 1, 0)
+
+    @jax.checkpoint
+    def body(acc, inp):
+        xc, lc = inp
+        logits = jnp.einsum("bth,hv->btv", xc, head,
+                            preferred_element_type=jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, lc[..., None], axis=-1)[..., 0]
+        return acc + (lse - gold).sum(), None
+
+    total, _ = lax.scan(body, jnp.zeros((), jnp.float32), (xs, ls))
+    return total / (B * T)
+
+
 def loss_fn(params, tokens, labels, cfg: GPTConfig, sp_constraint=None,
-            blocks_fn=None):
+            blocks_fn=None, loss_chunk: int = 256):
     """Causal LM cross-entropy in fp32 (the reference's
     ParallelCrossEntropy semantics for mp-sharded logits come from GSPMD
-    partitioning the log-sum-exp)."""
+    partitioning the log-sum-exp). ``loss_chunk`` > 0 streams the vocab
+    projection (see _chunked_ce); 0 materializes full logits."""
+    if loss_chunk:
+        hidden, aux = model_apply(params, tokens, cfg, sp_constraint,
+                                  blocks_fn, return_hidden=True)
+        head = (params["wte"].T if cfg.tie_embeddings else params["head_w"])
+        nll = _chunked_ce(hidden, head.astype(cfg.dtype), labels, loss_chunk)
+        return nll + 0.01 * aux
     logits, aux = model_apply(params, tokens, cfg, sp_constraint, blocks_fn)
-    V = logits.shape[-1]
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
     nll = (lse - gold).mean()

@@ -41,8 +41,8 @@ def supported(shape, dtype) -> bool:
     return s % BLOCK_Q == 0 and s >= BLOCK_Q and d in (64, 128, 256)
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, sm_scale, block_k,
-                      seq_len):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, causal,
+                      sm_scale, block_k, seq_len):
     import jax.experimental.pallas as pl
 
     q_idx = pl.program_id(2)
@@ -82,10 +82,14 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, sm_scale, block_k,
 
     m_i, l_i, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m_i, l_i, acc))
     o_ref[...] = (acc / l_i[:, None]).astype(o_ref.dtype)
+    if lse_ref is not None:
+        lse_ref[...] = jnp.broadcast_to((m_i + jnp.log(l_i))[None, :],
+                                        lse_ref.shape)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "sm_scale"))
-def _flash_fwd(q, k, v, causal: bool, sm_scale: float):
+@functools.partial(jax.jit, static_argnames=("causal", "sm_scale",
+                                             "with_lse"))
+def _flash_fwd(q, k, v, causal: bool, sm_scale: float, with_lse: bool = False):
     import jax.experimental.pallas as pl
 
     b, s, h, d = q.shape
@@ -98,27 +102,173 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float):
     block_k = min(BLOCK_K, s)
 
     grid = (b, h, s // block_q)
-    out = pl.pallas_call(
-        functools.partial(
-            _flash_fwd_kernel,
-            causal=causal,
-            sm_scale=sm_scale,
-            block_k=block_k,
-            seq_len=s,
-        ),
+    out_shapes = [jax.ShapeDtypeStruct((b, h, s, d), q.dtype)]
+    out_specs = [pl.BlockSpec((None, None, block_q, d),
+                              lambda ib, ih, iq: (ib, ih, iq, 0))]
+    if with_lse:
+        # rank-4 with an 8-row broadcast dim: Pallas TPU requires the last
+        # two block dims divisible by (8, 128), ruling out rank-1 blocks
+        out_shapes.append(jax.ShapeDtypeStruct((b, h, 8, s), jnp.float32))
+        out_specs.append(pl.BlockSpec((None, None, 8, block_q),
+                                      lambda ib, ih, iq: (ib, ih, 0, iq)))
+    kern = functools.partial(
+        _flash_fwd_kernel, causal=causal, sm_scale=sm_scale,
+        block_k=block_k, seq_len=s)
+    if not with_lse:
+        kern = functools.partial(kern, lse_ref=None)
+    res = pl.pallas_call(
+        kern,
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, None, block_q, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
             pl.BlockSpec((None, None, s, d), lambda ib, ih, iq: (ib, ih, 0, 0)),
             pl.BlockSpec((None, None, s, d), lambda ib, ih, iq: (ib, ih, 0, 0)),
         ],
-        out_specs=pl.BlockSpec(
-            (None, None, block_q, d), lambda ib, ih, iq: (ib, ih, iq, 0)
-        ),
-        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        out_specs=out_specs if with_lse else out_specs[0],
+        out_shape=out_shapes if with_lse else out_shapes[0],
         interpret=_interpret_mode(),
     )(qt, kt, vt)
-    return jnp.swapaxes(out, 1, 2)
+    if with_lse:
+        out, lse = res
+        return jnp.swapaxes(out, 1, 2), lse
+    return jnp.swapaxes(res, 1, 2)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, causal, sm_scale, block_k, seq_len):
+    import jax.experimental.pallas as pl
+
+    q_idx = pl.program_id(2)
+    q = q_ref[...].astype(jnp.float32) * sm_scale      # [bq, d]
+    do = do_ref[...].astype(jnp.float32)               # [bq, d]
+    lse = lse_ref[0, :]                                # [bq] (8-row packed)
+    delta = delta_ref[0, :]
+    bq = q.shape[0]
+    q_offs = q_idx * bq + jax.lax.iota(jnp.int32, bq)
+
+    num_k_blocks = seq_len // block_k
+    if causal:
+        num_k_blocks = jax.lax.div((q_idx + 1) * bq + block_k - 1, block_k)
+
+    def body(kb, dq):
+        k = k_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            k_offs = kb * block_k + jax.lax.iota(jnp.int32, block_k)
+            p = jnp.where(q_offs[:, None] >= k_offs[None, :], p, 0.0)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_k_blocks, body,
+                           jnp.zeros_like(q))
+    dq_ref[...] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, causal, sm_scale, block_q,
+                          seq_len):
+    import jax.experimental.pallas as pl
+
+    k_idx = pl.program_id(2)
+    k = k_ref[...].astype(jnp.float32)                 # [bk, d]
+    v = v_ref[...].astype(jnp.float32)
+    bk = k.shape[0]
+    k_offs = k_idx * bk + jax.lax.iota(jnp.int32, bk)
+
+    num_q_blocks = seq_len // block_q
+    start_q = 0
+    if causal:
+        start_q = jax.lax.div(k_idx * bk, block_q)
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[pl.dslice(qb * block_q, block_q), :].astype(jnp.float32) \
+            * sm_scale
+        do = do_ref[pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.dslice(qb * block_q, block_q)]
+        delta = delta_ref[0, pl.dslice(qb * block_q, block_q)]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            q_offs = qb * block_q + jax.lax.iota(jnp.int32, block_q)
+            p = jnp.where(q_offs[:, None] >= k_offs[None, :], p, 0.0)
+        dv_new = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_new = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk, dv = jax.lax.fori_loop(start_q, num_q_blocks, body,
+                               (jnp.zeros_like(k), jnp.zeros_like(v)))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "sm_scale"))
+def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float):
+    """Tiled backward: dq over q-blocks, dk/dv over k-blocks, never
+    materializing the [S, S] score matrix (the role of the reference's
+    flash_attn_bwd CUDA kernels, flash_attn_grad_kernel.cu)."""
+    import jax.experimental.pallas as pl
+
+    b, s, h, d = q.shape
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    ot = jnp.swapaxes(o, 1, 2)
+    dot_ = jnp.swapaxes(do, 1, 2).astype(jnp.float32)
+    delta = jnp.sum(dot_ * ot.astype(jnp.float32), axis=-1)   # [b, h, s]
+    delta = jnp.broadcast_to(delta[:, :, None, :], (b, h, 8, s))
+
+    block_q = min(BLOCK_Q, s)
+    block_k = min(BLOCK_K, s)
+
+    full = lambda ib, ih, i: (ib, ih, 0, 0)
+    blk_q4 = lambda ib, ih, iq: (ib, ih, iq, 0)
+    pack_q = lambda ib, ih, iq: (ib, ih, 0, iq)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, causal=causal,
+                          sm_scale=sm_scale, block_k=block_k, seq_len=s),
+        grid=(b, h, s // block_q),
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, d), blk_q4),
+            pl.BlockSpec((None, None, s, d), full),
+            pl.BlockSpec((None, None, s, d), full),
+            pl.BlockSpec((None, None, block_q, d), blk_q4),
+            pl.BlockSpec((None, None, 8, block_q), pack_q),
+            pl.BlockSpec((None, None, 8, block_q), pack_q),
+        ],
+        out_specs=pl.BlockSpec((None, None, block_q, d), blk_q4),
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        interpret=_interpret_mode(),
+    )(qt, kt, vt, dot_, lse, delta)
+
+    full_pack = lambda ib, ih, ik: (ib, ih, 0, 0)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, causal=causal,
+                          sm_scale=sm_scale, block_q=block_q, seq_len=s),
+        grid=(b, h, s // block_k),
+        in_specs=[
+            pl.BlockSpec((None, None, s, d), full),
+            pl.BlockSpec((None, None, block_k, d), blk_q4),
+            pl.BlockSpec((None, None, block_k, d), blk_q4),
+            pl.BlockSpec((None, None, s, d), full),
+            pl.BlockSpec((None, None, 8, s), full_pack),
+            pl.BlockSpec((None, None, 8, s), full_pack),
+        ],
+        out_specs=[pl.BlockSpec((None, None, block_k, d), blk_q4),
+                   pl.BlockSpec((None, None, block_k, d), blk_q4)],
+        out_shape=[jax.ShapeDtypeStruct((b, h, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, s, d), v.dtype)],
+        interpret=_interpret_mode(),
+    )(qt, kt, vt, dot_, lse, delta)
+
+    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+            jnp.swapaxes(dv, 1, 2))
 
 
 def _sdpa_fallback(q, k, v, causal, sm_scale):
@@ -137,6 +287,28 @@ def _sdpa_fallback(q, k, v, causal, sm_scale):
     return jnp.swapaxes(o, 1, 2)
 
 
+def _library_flash(q, k, v, causal: bool, scale: float):
+    """Route to jax's TPU Pallas flash kernels (fwd AND bwd kernels) when
+    running on real TPU — the custom_vjp below keeps backward memory
+    bounded but recomputes full S×S logits (HBM-bound); the library bwd
+    kernel tiles it. Returns None when not applicable."""
+    if jax.default_backend() != "tpu":
+        return None
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as tpu_flash)
+    except Exception:
+        return None
+    b, s, h, d = q.shape
+    if s % 128 != 0 or d not in (64, 128, 256):
+        return None
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = tpu_flash(qt, kt, vt, causal=causal, sm_scale=scale)
+    return jnp.swapaxes(out, 1, 2)
+
+
 def flash_attention_raw(q, k, v, causal: bool = False, sm_scale: float | None = None):
     """Differentiable flash attention: Pallas forward, XLA-expression VJP.
 
@@ -148,18 +320,46 @@ def flash_attention_raw(q, k, v, causal: bool = False, sm_scale: float | None = 
     """
     scale = sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5)
 
+    # The jax-library TPU kernel measured 4x SLOWER than this kernel+XLA-bwd
+    # on v5e at GPT-350M shapes (default block sizes); opt-in via flag.
+    from ...core.flags import GLOBAL_FLAGS
+
+    if GLOBAL_FLAGS.has("use_library_flash_attention") and \
+            GLOBAL_FLAGS.get("use_library_flash_attention"):
+        lib_out = _library_flash(q, k, v, causal, scale)
+        if lib_out is not None:
+            return lib_out
+
+    # Backward choice: the Pallas bwd kernels (tiled dq/dkv, O(S) memory)
+    # are correct but currently unpipelined — measured far slower than the
+    # XLA-expression vjp on v5e, so they're opt-in until block-level tuning
+    # lands. The default sdpa-vjp backward materializes S×S per layer
+    # transiently, which outer remat keeps bounded.
+    use_kernel_bwd = GLOBAL_FLAGS.has("flash_attention_kernel_bwd") and \
+        GLOBAL_FLAGS.get("flash_attention_kernel_bwd")
+
     @jax.custom_vjp
     def fa(q, k, v):
         return _flash_fwd(q, k, v, causal, scale)
 
-    def fwd(q, k, v):
-        return fa(q, k, v), (q, k, v)
+    if use_kernel_bwd:
+        def fwd(q, k, v):
+            o, lse = _flash_fwd(q, k, v, causal, scale, with_lse=True)
+            return o, (q, k, v, o, lse)
 
-    def bwd(res, g):
-        q, k, v = res
-        _, vjp = jax.vjp(lambda a, b, c: _sdpa_fallback(a, b, c, causal, scale),
-                         q, k, v)
-        return vjp(g)
+        def bwd(res, g):
+            q, k, v, o, lse = res
+            return _flash_bwd(q, k, v, o, lse, g, causal, scale)
+    else:
+        def fwd(q, k, v):
+            return fa(q, k, v), (q, k, v)
+
+        def bwd(res, g):
+            q, k, v = res
+            _, vjp = jax.vjp(
+                lambda a, b, c: _sdpa_fallback(a, b, c, causal, scale),
+                q, k, v)
+            return vjp(g)
 
     fa.defvjp(fwd, bwd)
     return fa(q, k, v)

@@ -186,13 +186,22 @@ def make_sharded_train_step(cfg: GPTConfig, mesh: Mesh, lr: float = 1e-4,
 
     jitted = jax.jit(step, donate_argnums=(0, 1))
 
+    def put_batch(arr):
+        """Shard a host batch over dp. Call once per batch; feeding numpy
+        directly to step_fn also works but re-uploads every call (costly
+        over remote-device tunnels)."""
+        return jax.device_put(arr, NamedSharding(
+            mesh, _sanitize(P("dp"), arr.shape, mesh)))
+
     def step_fn(params, opt_state, tokens, labels):
-        tokens = jax.device_put(tokens, NamedSharding(
-            mesh, _sanitize(P("dp"), tokens.shape, mesh)))
-        labels = jax.device_put(labels, NamedSharding(
-            mesh, _sanitize(P("dp"), labels.shape, mesh)))
+        if not isinstance(tokens, jax.Array):
+            tokens = put_batch(tokens)
+        if not isinstance(labels, jax.Array):
+            labels = put_batch(labels)
         # context mesh for the partial-manual pipeline shard_map
         with jax.sharding.set_mesh(mesh):
             return jitted(params, opt_state, tokens, labels)
+
+    step_fn.put_batch = put_batch
 
     return step_fn, params, opt_state

@@ -128,6 +128,7 @@ class Profiler:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
+        self._handler_fired = False  # fresh start/stop cycle
         self._state = self._sched(self._step)
         self._maybe_toggle_trace()
         hook = lambda name: self._op_counts.__setitem__(
