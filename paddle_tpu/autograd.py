@@ -175,3 +175,94 @@ class saved_tensors_hooks:
     def __exit__(self, *exc):
         saved_tensors_hooks._active.pop()
         return False
+
+
+# ---------------------------------------------------------------------------
+# functional transforms (reference: python/paddle/autograd/autograd.py:461
+# jacobian/hessian; incubate functional vjp/jvp)
+# ---------------------------------------------------------------------------
+
+def _pure(func):
+    """Lift a Tensor->Tensor function to arrays (for jax transforms)."""
+
+    def fn(*arrays):
+        with no_grad():
+            out = func(*[Tensor(a, stop_gradient=True) for a in arrays])
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+        return out._data if isinstance(out, Tensor) else out
+
+    return fn
+
+
+def _wrap_tree(tree):
+    return jax.tree.map(lambda a: Tensor(a), tree)
+
+
+def jacobian(func_or_ys, xs, batch_axis=None):
+    """Full Jacobian of ``func(xs)`` w.r.t. xs (functional form; the
+    reference's lazy-row Jacobian object API evaluates the same values).
+    XLA computes it as one vectorized program (forward-over-reverse)."""
+    if not callable(func_or_ys):
+        raise TypeError("jacobian expects a callable; the legacy "
+                        "(ys, xs) form requires retained graphs")
+    single = not isinstance(xs, (list, tuple))
+    if single:
+        # integer argnums: no per-argnum tuple to unwrap, so multi-output
+        # functions keep their full output structure
+        jac = jax.jacrev(_pure(func_or_ys), argnums=0)(xs._data)
+        return _wrap_tree(jac)
+    arrays = [x._data for x in xs]
+    jac = jax.jacrev(_pure(func_or_ys),
+                     argnums=tuple(range(len(arrays))))(*arrays)
+    return _wrap_tree(jac)
+
+
+def hessian(func, xs, batch_axis=None):
+    """Hessian of a scalar-output function (reference autograd.py)."""
+    single = not isinstance(xs, (list, tuple))
+    xs_t = [xs] if single else list(xs)
+    arrays = [x._data for x in xs_t]
+    hes = jax.hessian(_pure(func), argnums=tuple(range(len(arrays))))(*arrays)
+    hes = _wrap_tree(hes)
+    if single:
+        h = hes[0] if isinstance(hes, (tuple, list)) else hes
+        return h[0] if isinstance(h, (tuple, list)) else h
+    return hes
+
+
+def vjp(func, xs, v=None):
+    """(outputs, vjp_result) — reference incubate.autograd.vjp."""
+    single = not isinstance(xs, (list, tuple))
+    xs_t = [xs] if single else list(xs)
+    arrays = [x._data for x in xs_t]
+    outs, pullback = jax.vjp(_pure(func), *arrays)
+    if v is None:
+        v_arr = jnp.ones_like(outs) if not isinstance(outs, tuple) else \
+            tuple(jnp.ones_like(o) for o in outs)
+    else:
+        v_arr = v._data if isinstance(v, Tensor) else \
+            tuple(t._data for t in v)
+    grads = pullback(v_arr)
+    grads = _wrap_tree(grads)
+    outs = _wrap_tree(outs)
+    if single:
+        grads = grads[0] if isinstance(grads, (tuple, list)) else grads
+    return outs, grads
+
+
+def jvp(func, xs, v=None):
+    """(outputs, jvp_result) — forward-mode directional derivative."""
+    single = not isinstance(xs, (list, tuple))
+    xs_t = [xs] if single else list(xs)
+    arrays = [x._data for x in xs_t]
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrays)
+    else:
+        v_t = [v] if single else list(v)
+        tangents = tuple(t._data for t in v_t)
+    outs, tangent_out = jax.jvp(_pure(func), tuple(arrays), tangents)
+    return _wrap_tree(outs), _wrap_tree(tangent_out)
+
+
+__all__ += ["jacobian", "hessian", "vjp", "jvp"]

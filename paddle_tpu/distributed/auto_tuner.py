@@ -1,0 +1,136 @@
+"""Auto-tuner: parallel-configuration search.
+
+Re-design of python/paddle/distributed/auto_tuner (tuner.py, prune.py,
+recorder.py): enumerate (dp, mp, pp, micro-batch) candidates for a device
+count, prune infeasible ones (divisibility, memory estimate), rank by an
+analytic cost model, and optionally measure the top candidates with a
+user-supplied runner (the reference launches real trials; here the runner
+is injected so tests/one-chip environments can measure dry-run step time).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Callable, Optional, Sequence
+
+__all__ = ["TunerConfig", "Candidate", "AutoTuner", "tune"]
+
+
+@dataclasses.dataclass
+class TunerConfig:
+    n_devices: int = 8
+    global_batch_size: int = 32
+    # model shape for the cost/memory model
+    hidden: int = 1024
+    n_layers: int = 24
+    vocab_size: int = 50304
+    seq_len: int = 1024
+    # hardware model
+    hbm_bytes: float = 16e9
+    flops_per_chip: float = 197e12
+    ici_bandwidth: float = 4.5e10     # bytes/s per link (v5e)
+    # search space caps
+    max_mp: int = 8
+    max_pp: int = 8
+
+
+@dataclasses.dataclass
+class Candidate:
+    dp: int
+    mp: int
+    pp: int
+    micro_batch: int
+    est_step_time: float = 0.0
+    est_mem_bytes: float = 0.0
+    measured_time: Optional[float] = None
+    pruned: Optional[str] = None
+
+    @property
+    def key(self):
+        return (self.dp, self.mp, self.pp, self.micro_batch)
+
+
+class AutoTuner:
+    def __init__(self, cfg: TunerConfig):
+        self.cfg = cfg
+        self.history: list[Candidate] = []
+
+    # -- search space -------------------------------------------------------
+    def candidates(self) -> list[Candidate]:
+        c = self.cfg
+        out = []
+        for mp, pp in itertools.product(range(1, c.max_mp + 1),
+                                        range(1, c.max_pp + 1)):
+            if c.n_devices % (mp * pp):
+                continue
+            dp = c.n_devices // (mp * pp)
+            if c.global_batch_size % dp:
+                continue
+            per_dp = c.global_batch_size // dp
+            for micro in [m for m in (1, 2, 4, 8, 16) if per_dp % m == 0]:
+                out.append(Candidate(dp=dp, mp=mp, pp=pp, micro_batch=micro))
+        return out
+
+    # -- prune + cost (reference prune.py / cost model) ---------------------
+    def _param_bytes(self) -> float:
+        c = self.cfg
+        p = c.vocab_size * c.hidden + c.n_layers * 12 * c.hidden ** 2
+        return p * (4 + 8 + 4)  # fp32 master + adam moments + bf16 copy
+
+    def evaluate(self, cand: Candidate) -> Candidate:
+        c = self.cfg
+        shard = cand.mp * cand.pp  # params divided across mp*pp
+        mem = self._param_bytes() / shard
+        act = (c.global_batch_size // cand.dp) * c.seq_len * c.hidden * 2 \
+            * c.n_layers / cand.pp / max(1, cand.micro_batch)
+        cand.est_mem_bytes = mem + act
+        if cand.est_mem_bytes > c.hbm_bytes * 0.9:
+            cand.pruned = "memory"
+            return cand
+        if cand.mp > 1 and c.hidden % cand.mp:
+            cand.pruned = "mp-divisibility"
+            return cand
+        if cand.pp > 1 and c.n_layers % cand.pp:
+            cand.pruned = "pp-divisibility"
+            return cand
+        # compute: 6PB flops over dp*mp*pp chips; comm: mp allreduce per
+        # layer + pp bubble
+        p_dense = c.vocab_size * c.hidden + c.n_layers * 12 * c.hidden ** 2
+        flops = 6 * p_dense * c.global_batch_size * c.seq_len
+        t_compute = flops / (c.flops_per_chip * c.n_devices * 0.45)
+        t_mp = 0.0
+        if cand.mp > 1:
+            bytes_per_layer = (c.global_batch_size // cand.dp) * c.seq_len \
+                * c.hidden * 2 * 4
+            t_mp = c.n_layers * bytes_per_layer / c.ici_bandwidth
+        bubble = (cand.pp - 1) / max(1, (c.global_batch_size //
+                                         cand.dp // cand.micro_batch))
+        cand.est_step_time = (t_compute + t_mp) * (1 + bubble)
+        return cand
+
+    # -- drive --------------------------------------------------------------
+    def tune(self, runner: Optional[Callable[[Candidate], float]] = None,
+             top_k: int = 3) -> Candidate:
+        cands = [self.evaluate(c) for c in self.candidates()]
+        self.history = cands
+        valid = [c for c in cands if c.pruned is None]
+        if not valid:
+            raise RuntimeError("no feasible parallel config found "
+                               f"(searched {len(cands)})")
+        valid.sort(key=lambda c: c.est_step_time)
+        if runner is None:
+            return valid[0]
+        best, best_t = None, float("inf")
+        for c in valid[:top_k]:
+            c.measured_time = runner(c)
+            if c.measured_time < best_t:
+                best, best_t = c, c.measured_time
+        return best
+
+
+def tune(tuner_cfg: dict, runner=None) -> Candidate:
+    """reference tuner.py entry: dict-config interface."""
+    cfg = TunerConfig(**{k: v for k, v in tuner_cfg.items()
+                         if k in TunerConfig.__dataclass_fields__})
+    return AutoTuner(cfg).tune(runner=runner)

@@ -1,0 +1,119 @@
+"""Elastic training: membership, health, scale in/out.
+
+Re-design of python/paddle/distributed/fleet/elastic/manager.py:125
+(ElasticManager): the reference keeps etcd leases with TTL, watches the
+node directory, and relaunches trainers with recomputed endpoints on
+membership change (exit code 101 signals elastic relaunch, :33).
+
+TPU translation: membership rides the framework TCPStore (native,
+distributed/store.py) instead of etcd — each node heartbeats
+``nodes/<host>`` with a timestamp; the manager scans for stale leases.
+Rescale on a TPU slice means re-checkpointing and relaunching with a new
+mesh (ICI topology is fixed per slice, SURVEY.md §7 hard parts), so
+``on_change`` receives the new host list and the trainer is expected to
+checkpoint + exit with ELASTIC_EXIT_CODE like the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from ..store import TCPStore
+
+__all__ = ["ElasticManager", "ELASTIC_EXIT_CODE"]
+
+ELASTIC_EXIT_CODE = 101          # reference manager.py:33
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
+
+
+class ElasticManager:
+    def __init__(self, host: Optional[str] = None, store: Optional[TCPStore]
+                 = None, np: int = 1, ttl: float = 60.0,
+                 heartbeat_interval: float = 10.0,
+                 on_change: Optional[Callable[[list], None]] = None,
+                 master: str = "127.0.0.1:6170", is_master: bool = False):
+        self.host = host or os.environ.get("POD_IP", f"pid-{os.getpid()}")
+        self.np = int(os.environ.get("PADDLE_ELASTIC_NP", np))
+        self.ttl = float(os.environ.get("PADDLE_ELASTIC_TTL", ttl))
+        self.heartbeat_interval = heartbeat_interval
+        self.on_change = on_change
+        if store is None:
+            h, _, p = master.partition(":")
+            store = TCPStore(h, int(p or 6170), is_master=is_master,
+                             world_size=self.np)
+        self.store = store
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self.hosts: list[str] = []
+        self.elastic_level = int(os.environ.get(
+            "PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", 1))
+
+    # -- membership ---------------------------------------------------------
+    def register(self):
+        """Join + start heartbeating (the etcd lease of the reference).
+
+        Membership uses per-host keys claimed via the atomic counter — a
+        read-modify-write of one list would drop concurrently registering
+        hosts (the etcd node-dir this replaces is also per-key)."""
+        if self.host not in self._read_hosts():
+            idx = self.store.add("elastic/nhosts", 1) - 1
+            self.store.set(f"elastic/hostname/{idx}", self.host)
+        self._beat()
+        t = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _beat(self):
+        self.store.set(f"elastic/beat/{self.host}", str(time.time()))
+        self.store.add(f"elastic/beat_flag/{self.host}", 1)
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(self.heartbeat_interval):
+            self._beat()
+
+    def _read_hosts(self) -> list:
+        n = self.store.add("elastic/nhosts", 0)
+        return [self.store.get(f"elastic/hostname/{i}").decode()
+                for i in range(n)]
+
+    def live_hosts(self) -> list:
+        """Hosts whose heartbeat is within TTL (stale leases expire)."""
+        now = time.time()
+        live = []
+        for h in self._read_hosts():
+            if self.store.add(f"elastic/beat_flag/{h}", 0) < 1:
+                continue
+            ts = float(self.store.get(f"elastic/beat/{h}").decode())
+            if now - ts <= self.ttl:
+                live.append(h)
+        return live
+
+    # -- watch / rescale ----------------------------------------------------
+    def _match(self, hosts: Optional[list] = None) -> bool:
+        """reference manager.py:410 — live membership equals target np."""
+        hosts = hosts if hosts is not None else self.live_hosts()
+        return len(hosts) == self.np
+
+    def watch(self, interval: float = 5.0):
+        """Blocking watch loop: invokes on_change when membership changes
+        (the trainer should checkpoint and exit ELASTIC_EXIT_CODE)."""
+        prev = sorted(self.live_hosts())
+        while not self._stop.wait(interval):
+            cur = sorted(self.live_hosts())
+            if cur != prev:
+                prev = cur
+                if self.on_change is not None:
+                    self.on_change(cur)
+
+    def endpoints(self, port: int = 8200) -> str:
+        """Recomputed trainer endpoints, stable-sorted to minimize rank
+        movement on scale-in (reference :513)."""
+        return ",".join(f"{h}:{port}" for h in sorted(self.live_hosts()))
+
+    def exit(self, completed: bool = True):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)

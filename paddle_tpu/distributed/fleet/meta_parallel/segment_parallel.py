@@ -1,0 +1,62 @@
+"""SEP: segment (sequence-axis) parallelism.
+
+Re-design of fleet/meta_parallel/segment_parallel.py:26 (SegmentParallel)
+and the sep usage pattern (test/collective/fleet/hybrid_parallel_sep_model.py
+:143-145 — the model splits the sequence before attention and concats
+after, using sep-group collectives; params broadcast across sep).
+
+TPU translation: parameters replicate over the "sep" axis (one logical
+copy) and the model marks its sequence splits with ``split_sequence`` /
+``concat_sequence`` — reshardings over the sep axis that XLA lowers to the
+all-to-alls of the reference pattern.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....core.tensor import Tensor
+from ...autograd_collectives import gather_axis, scatter_axis
+from ...topology import get_hybrid_communicate_group
+
+__all__ = ["SegmentParallel", "split_sequence", "concat_sequence"]
+
+
+def _mesh():
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        raise RuntimeError("fleet.init must run before segment parallel")
+    return hcg.mesh
+
+
+def split_sequence(x: Tensor, axis: int = 1) -> Tensor:
+    """Shard the sequence dim over the sep axis (the Split before
+    attention in the reference test model)."""
+    return scatter_axis(x, _mesh(), axis, "sep")
+
+
+def concat_sequence(x: Tensor, axis: int = 1) -> Tensor:
+    """Re-replicate the sequence dim (the Concat after attention)."""
+    return gather_axis(x, _mesh(), axis)
+
+
+class SegmentParallel:
+    """Model wrapper: one logical parameter copy across sep (the
+    reference broadcasts params across the sep group at wrap time)."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        self._layers = layers
+        mesh = _mesh()
+        for p in layers.parameters():
+            sh = getattr(p._data, "sharding", None)
+            if not (isinstance(sh, NamedSharding) and sh.mesh == mesh):
+                p._bump(jax.device_put(p._data, NamedSharding(mesh, P())))
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    __call__ = forward
+
+    def __getattr__(self, name):
+        return getattr(self._layers, name)

@@ -1,0 +1,127 @@
+"""Megatron-style sequence parallelism.
+
+Re-design of python/paddle/distributed/fleet/utils/sequence_parallel_utils.py
+(ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp PyLayers :85-140,
+ColumnSequenceParallelLinear:427, RowSequenceParallelLinear:562).
+
+TPU translation: between TP blocks, activations shard the sequence dim
+over "mp" instead of replicating — each collective PyLayer pair becomes a
+single differentiable resharding (autograd_collectives.reshard), whose
+forward/backward XLA lowers to the exact all-gather / reduce-scatter pair
+the reference issues by hand. The Column/Row layers are the fleet TP
+layers plus the sequence-dim resharding at entry/exit.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer.layers import Layer
+from ..autograd_collectives import gather_axis, reshard_op, scatter_axis
+from ..topology import get_hybrid_communicate_group
+from .mp_layers import _mp_mesh, _shard_param
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "mark_as_sequence_parallel_parameter",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+    "register_sequence_parallel_allreduce_hooks",
+]
+
+
+def _seq_dim(t: Tensor) -> int:
+    # reference layout [s, b, h] or [b, s, h]; shard dim 0 like the
+    # reference's ScatterOp (it assumes s-major)
+    return 0
+
+
+class ScatterOp:
+    """Split the sequence dim across mp (reference :85; backward =
+    all-gather, provided by the reshard vjp)."""
+
+    @staticmethod
+    def apply(x: Tensor, axis: int = 0) -> Tensor:
+        return scatter_axis(x, _mp_mesh(), axis, "mp")
+
+
+class GatherOp:
+    """All-gather the sequence dim (reference :103; backward = split)."""
+
+    @staticmethod
+    def apply(x: Tensor, axis: int = 0) -> Tensor:
+        return gather_axis(x, _mp_mesh(), axis)
+
+
+AllGatherOp = GatherOp
+
+
+class ReduceScatterOp:
+    """Reduce partial sums + scatter the sequence dim (reference :124).
+    On a GSPMD runtime partial sums are reduced by the producing
+    contraction, so this reshards (the reduce already happened); kept for
+    ported-code structure."""
+
+    @staticmethod
+    def apply(x: Tensor, axis: int = 0) -> Tensor:
+        return scatter_axis(x, _mp_mesh(), axis, "mp")
+
+
+def mark_as_sequence_parallel_parameter(param):
+    param.is_sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """reference :192: SP params (norms) need grad allreduce across mp.
+    Grads of replicated params are already reduced by sharding propagation;
+    no-op kept for porting parity."""
+    return None
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """reference :427: all-gather the s-sharded input, column-parallel
+    matmul leaving outputs mp-sharded on the feature dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, name=None, mp_group=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        _shard_param(self.weight, P(None, "mp"))
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+        if self.bias is not None:
+            _shard_param(self.bias, P("mp"))
+        self.gather_output = gather_output
+
+    def forward(self, x):
+        x = GatherOp.apply(x)                 # seq: sharded -> full
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            y = gather_axis(y, _mp_mesh(), y.ndim - 1)
+        return y
+
+
+class RowSequenceParallelLinear(Layer):
+    """reference :562: row-parallel matmul (feature-sharded input), then
+    reduce-scatter the output's sequence dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, name=None,
+                 mp_group=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        _shard_param(self.weight, P("mp", None))
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        return ReduceScatterOp.apply(y)       # seq: full -> sharded

@@ -1,0 +1,169 @@
+"""RPC: remote function invocation between framework processes.
+
+Re-design of python/paddle/distributed/rpc/rpc.py:85,160,206 (init_rpc /
+rpc_sync / rpc_async over TensorPipe). TPU translation: the transport is
+the framework TCPStore (native TCP, distributed/store.py) instead of
+TensorPipe — each worker runs a serve thread polling its inbox key;
+requests/replies are pickled payloads. This serves the reference's RPC use
+cases (control-plane coordination, parameter pulls in PS-style setups);
+bulk tensor movement belongs on ICI collectives, not RPC.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from .store import TCPStore
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_current_worker_info", "get_worker_info", "get_all_worker_infos"]
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str = "127.0.0.1"
+    port: int = 0
+
+
+class _RpcState:
+    def __init__(self):
+        self.store: Optional[TCPStore] = None
+        self.name: Optional[str] = None
+        self.rank: int = -1
+        self.world_size: int = 0
+        self.serving = False
+        self.stop = threading.Event()
+        self.threads: list = []
+
+
+_STATE = _RpcState()
+
+
+def init_rpc(name: str, rank: int = -1, world_size: int = 1,
+             master_endpoint: str = "127.0.0.1:6180"):
+    """reference rpc.py:85. The rank-0 process hosts the store master."""
+    host, _, port = master_endpoint.partition(":")
+    _STATE.store = TCPStore(host, int(port or 6180), is_master=(rank == 0),
+                            world_size=world_size)
+    _STATE.name = name
+    _STATE.rank = rank
+    _STATE.world_size = world_size
+    _STATE.store.set(f"rpc/worker/{name}", str(rank).encode())
+    idx = _STATE.store.add("rpc/registered", 1) - 1
+    _STATE.store.set(f"rpc/workername/{idx}", name.encode())
+    _STATE.stop.clear()
+    t = threading.Thread(target=_serve_loop, daemon=True)
+    t.start()
+    _STATE.threads.append(t)
+    # wait for everyone (reference barriers in init_rpc)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if _STATE.store.add("rpc/registered", 0) >= world_size:
+            return
+        time.sleep(0.05)
+    raise TimeoutError("init_rpc: peers did not register")
+
+
+def _serve_loop():
+    st = _STATE
+    seq = 0
+    while not st.stop.is_set():
+        key = f"rpc/inbox/{st.name}/{seq}"
+        # non-blocking poll: presence flag per message
+        if st.store.add(key + "/flag", 0) >= 1:
+            payload = st.store.get(key)
+            req = pickle.loads(payload)
+            try:
+                result = req["fn"](*req.get("args", ()),
+                                   **req.get("kwargs", {}))
+                resp = {"ok": True, "value": result}
+            except Exception as e:  # noqa: BLE001 - forwarded to caller
+                resp = {"ok": False, "error": repr(e)}
+            st.store.set(f"rpc/result/{req['id']}", pickle.dumps(resp))
+            st.store.add(f"rpc/result/{req['id']}/flag", 1)
+            seq += 1
+        else:
+            time.sleep(0.005)
+
+
+class _Future:
+    def __init__(self, req_id: str, timeout: float):
+        self.req_id = req_id
+        self.timeout = timeout
+        self._result = None
+        self._done = False
+
+    def wait(self):
+        if self._done:
+            return self._unwrap()
+        deadline = time.time() + self.timeout
+        key = f"rpc/result/{self.req_id}"
+        while time.time() < deadline:
+            # the responder sets the value BEFORE raising the flag, so a
+            # raised flag makes the (otherwise blocking) get safe
+            if _STATE.store.add(key + "/flag", 0) >= 1:
+                self._result = pickle.loads(_STATE.store.get(key))
+                self._done = True
+                return self._unwrap()
+            time.sleep(0.005)
+        raise TimeoutError(f"rpc {self.req_id} timed out")
+
+    def _unwrap(self):
+        if self._result["ok"]:
+            return self._result["value"]
+        raise RuntimeError(f"rpc remote error: {self._result['error']}")
+
+
+def _send(to: str, fn, args, kwargs, timeout: float) -> _Future:
+    st = _STATE
+    if st.store is None:
+        raise RuntimeError("call init_rpc first")
+    req_id = uuid.uuid4().hex
+    # per-target sequence number via atomic counter
+    seq = st.store.add(f"rpc/seq/{to}", 1) - 1
+    key = f"rpc/inbox/{to}/{seq}"
+    st.store.set(key, pickle.dumps({"id": req_id, "fn": fn, "args": args,
+                                    "kwargs": kwargs}))
+    st.store.add(key + "/flag", 1)
+    return _Future(req_id, timeout)
+
+
+def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 30.0):
+    """reference rpc.py:160."""
+    return _send(to, fn, args, kwargs or {}, timeout).wait()
+
+
+def rpc_async(to: str, fn, args=(), kwargs=None, timeout: float = 30.0):
+    """reference rpc.py:206; returns a future with .wait()."""
+    return _send(to, fn, args, kwargs or {}, timeout)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    return WorkerInfo(_STATE.name or "", _STATE.rank)
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    rank = int(_STATE.store.get(f"rpc/worker/{name}").decode())
+    return WorkerInfo(name, rank)
+
+
+def get_all_worker_infos() -> list:
+    n = _STATE.store.add("rpc/registered", 0)
+    return [get_worker_info(
+        _STATE.store.get(f"rpc/workername/{i}").decode())
+        for i in range(n)]
+
+
+def shutdown():
+    _STATE.stop.set()
+    for t in _STATE.threads:
+        t.join(timeout=2)
+    _STATE.threads.clear()
+    _STATE.store = None

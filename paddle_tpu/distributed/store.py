@@ -74,6 +74,11 @@ class TCPStore(Store):
         self._master_handle = None
         self._fd = -1
         self._local: Optional[_LocalStore] = None
+        # one connection PER THREAD: a shared socket corrupts the protocol
+        # under concurrent requests, and a lock would let one thread's
+        # blocking get() deadlock the setter thread that must unblock it
+        self._tls = threading.local()
+        self._timeout_ms = int(timeout * 1000)
 
         if self._lib is None:
             if world_size > 1:
@@ -88,10 +93,18 @@ class TCPStore(Store):
             if not self._master_handle:
                 raise RuntimeError(f"cannot bind TCPStore master on port "
                                    f"{self.port}")
-        self._fd = self._lib.pt_store_connect(
-            host.encode(), self.port, int(timeout * 1000))
-        if self._fd < 0:
-            raise RuntimeError(f"cannot connect TCPStore at {host}:{port}")
+        self._fd = self._get_fd()  # eagerly validate connectivity
+
+    def _get_fd(self) -> int:
+        fd = getattr(self._tls, "fd", None)
+        if fd is None:
+            fd = self._lib.pt_store_connect(self.host.encode(), self.port,
+                                            self._timeout_ms)
+            if fd < 0:
+                raise RuntimeError(
+                    f"cannot connect TCPStore at {self.host}:{self.port}")
+            self._tls.fd = fd
+        return fd
 
     # -- ops ----------------------------------------------------------------
     def set(self, key: str, value) -> None:
@@ -100,7 +113,7 @@ class TCPStore(Store):
         if isinstance(value, str):
             value = value.encode()
         value = bytes(value)
-        rc = self._lib.pt_store_set(self._fd, key.encode(), value,
+        rc = self._lib.pt_store_set(self._get_fd(), key.encode(), value,
                                     len(value))
         if rc != 0:
             raise RuntimeError("TCPStore set failed")
@@ -113,7 +126,8 @@ class TCPStore(Store):
         cap = 1 << 16
         while True:
             buf = ctypes.create_string_buffer(cap)
-            n = self._lib.pt_store_get(self._fd, key.encode(), buf, cap)
+            n = self._lib.pt_store_get(self._get_fd(), key.encode(), buf,
+                                       cap)
             if n < 0:
                 raise RuntimeError("TCPStore get failed")
             if n <= cap:
@@ -123,7 +137,8 @@ class TCPStore(Store):
     def add(self, key: str, amount: int = 1) -> int:
         if self._local is not None:
             return self._local.add(key, amount)
-        out = self._lib.pt_store_add(self._fd, key.encode(), int(amount))
+        out = self._lib.pt_store_add(self._get_fd(), key.encode(),
+                                     int(amount))
         return int(out)
 
     def barrier(self, key: str, world_size: int, timeout: float = 300.0):

@@ -1,0 +1,4 @@
+from . import moe_utils
+from .moe_utils import global_gather, global_scatter
+
+__all__ = ["moe_utils", "global_scatter", "global_gather"]

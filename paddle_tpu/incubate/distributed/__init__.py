@@ -1,0 +1,5 @@
+"""incubate.distributed (reference: python/paddle/incubate/distributed)."""
+
+from . import models
+
+__all__ = ["models"]

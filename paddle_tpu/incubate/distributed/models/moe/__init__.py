@@ -1,0 +1,16 @@
+"""MoE user API (reference: python/paddle/incubate/distributed/models/moe —
+MoELayer:263, gate/{naive,gshard,switch}_gate.py).
+
+TPU translation (SURVEY.md §8.5): the reference dispatches tokens with
+variable-size all-to-alls driven by count tensors (global_scatter/gather).
+XLA needs static shapes, so dispatch is capacity-bounded one-hot einsum
+(GShard): tokens route to [E, C, H] buffers, every expert runs on its
+buffer, results combine weighted by gate scores. With the expert dim
+sharded over the dp axis ("ep" group), XLA lowers the dispatch/combine
+einsums to the same all-to-alls the reference issues by hand.
+"""
+
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+from .moe_layer import MoELayer
+
+__all__ = ["MoELayer", "BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]

@@ -1,0 +1,95 @@
+"""MoE gates (reference: incubate/distributed/models/moe/gate/)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..... import nn
+from .....core.tensor import Tensor
+
+__all__ = ["BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]
+
+
+class BaseGate(nn.Layer):
+    def __init__(self, num_expert: int, world_size: int = 1):
+        super().__init__()
+        self.world_size = world_size
+        self.num_expert = num_expert
+        self.tot_expert = world_size * num_expert
+        self.loss = None
+
+    def get_loss(self, clear: bool = True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+
+class NaiveGate(BaseGate):
+    """Plain top-k softmax gate (reference naive_gate.py)."""
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 2):
+        super().__init__(num_expert, world_size)
+        self.gate = nn.Linear(d_model, self.tot_expert)
+        self.top_k = topk
+
+    def forward(self, inp, return_all_scores: bool = False):
+        gate_logits = self.gate(inp)
+        gate_prob = nn.functional.softmax(gate_logits, axis=-1)
+        topk_val, topk_idx = gate_prob.topk(self.top_k, axis=-1)
+        if return_all_scores:
+            return topk_val, topk_idx, gate_logits
+        return topk_val, topk_idx
+
+
+class GShardGate(NaiveGate):
+    """Top-2 gate with capacity + load-balance aux loss
+    (reference gshard_gate.py; capacity limiting via
+    _limit_by_capacity in the reference becomes the dense-dispatch
+    capacity bound in MoELayer)."""
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 2, capacity=(1.2, 2.4), random_routing: bool = True,
+                 group=None):
+        super().__init__(d_model, num_expert, world_size, topk=2)
+        self.capacity = capacity
+
+    def forward(self, x):
+        topk_val, topk_idx, logits = super().forward(x,
+                                                     return_all_scores=True)
+        # load-balance loss: E * sum_e mean(router_prob_e) * mean(is_top1_e)
+        prob = nn.functional.softmax(logits, axis=-1)
+        top1 = topk_idx[:, 0]
+        import paddle_tpu as pt
+
+        onehot = pt.to_tensor(
+            jnp.asarray(
+                (top1._data[:, None] ==
+                 jnp.arange(self.tot_expert)[None, :]).astype(jnp.float32)))
+        me = prob.mean(axis=0)
+        ce = onehot.mean(axis=0)
+        self.loss = (me * ce).sum() * self.tot_expert
+        return topk_val, topk_idx
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 switch gate (reference switch_gate.py)."""
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 1, switch_eps: float = 0.1, capacity=(1.2, 2.4),
+                 group=None):
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.switch_eps = switch_eps
+
+    def forward(self, x):
+        # reference adds uniform noise to logits while training
+        if getattr(self, "training", True) and self.switch_eps > 0:
+            import paddle_tpu as pt
+
+            noise = pt.rand(x.shape[:-1] + [self.tot_expert])
+            noise = noise.scale(2 * self.switch_eps) + (1 - self.switch_eps)
+            h = self.gate(x) * noise
+            prob = nn.functional.softmax(h, axis=-1)
+            return prob.topk(1, axis=-1)
+        return super().forward(x)

@@ -1,0 +1,105 @@
+"""MoELayer: expert-parallel mixture-of-experts over arbitrary expert Layers.
+
+Re-design of incubate/distributed/models/moe/moe_layer.py:263. The
+reference's MoEScatter/MoEGather PyLayers call global_scatter/global_gather
+(variable-size all-to-all driven by count tensors, moe_utils.py:20,153);
+here dispatch/combine are capacity-bounded one-hot einsums with static
+shapes — each expert sees a fixed [capacity, H] buffer, overflow tokens
+drop from that slot (standard TPU MoE). The whole dispatch+experts+combine
+runs as ONE tape op (expert params bound as differentiable inputs, the
+same functionalization as fleet/recompute.py), so eager autograd and
+program capture both work and XLA fuses the routing einsums.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..... import nn
+from .....core import autograd as _autograd
+from .....core.dispatch import OpDef, op_call
+from .....core.tensor import Tensor
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+__all__ = ["MoELayer"]
+
+
+class MoELayer(nn.Layer):
+    def __init__(self, d_model: int, experts: Sequence[nn.Layer],
+                 gate=None, moe_group=None, mp_group=None,
+                 recompute_interval: int = 0, capacity_factor: float = 1.25,
+                 **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        self.experts = nn.LayerList(list(experts))
+        self.num_expert = len(self.experts)
+        self.capacity_factor = capacity_factor
+        if gate is None or isinstance(gate, dict):
+            gate_cfg = gate if isinstance(gate, dict) else {}
+            typ = gate_cfg.get("type", "gshard")
+            topk = gate_cfg.get("top_k", 2)
+            cls = {"naive": NaiveGate, "gshard": GShardGate,
+                   "switch": SwitchGate}[typ]
+            gate = cls(d_model, self.num_expert, topk=topk)
+        self.gate = gate
+
+    def _routing_impl(self, param_arrays, x, vals, idxs, *, capacity):
+        """Pure function of (expert params, tokens, gate outputs)."""
+        experts = list(self.experts)
+        params = [p for e in experts for p in e.parameters()]
+        originals = [p._data for p in params]
+        for p, a in zip(params, param_arrays):
+            p._data = a
+        try:
+            E = len(experts)
+            N = x.shape[0]
+            K = vals.shape[-1]
+            vals = vals.astype(jnp.float32)
+            idxs = idxs.astype(jnp.int32)
+            out = jnp.zeros_like(x)
+            combined = jnp.zeros((N,), jnp.float32)
+            for kslot in range(K):
+                sel = idxs[:, kslot]
+                gatev = vals[:, kslot]
+                onehot = jax.nn.one_hot(sel, E, dtype=jnp.int32)
+                pos = (jnp.cumsum(onehot, axis=0) - 1) * onehot
+                pos_in_e = pos.sum(-1)
+                keep = pos_in_e < capacity
+                disp = (jax.nn.one_hot(sel, E, dtype=x.dtype)[:, :, None]
+                        * jax.nn.one_hot(jnp.where(keep, pos_in_e, capacity),
+                                         capacity + 1,
+                                         dtype=x.dtype)[:, None, :capacity])
+                xin = jnp.einsum("nec,nh->ech", disp, x)
+                outs = []
+                with _autograd.no_grad():
+                    for e, expert in enumerate(experts):
+                        outs.append(expert(Tensor(xin[e]))._data)
+                eo = jnp.stack(outs, 0)
+                comb = disp * gatev[:, None, None].astype(x.dtype)
+                out = out + jnp.einsum("nec,ech->nh", comb, eo)
+                combined = combined + jnp.where(keep, gatev, 0.0)
+            denom = jnp.clip(combined, 1e-9)[:, None].astype(x.dtype)
+            return out / denom
+        finally:
+            for p, o in zip(params, originals):
+                p._data = o
+
+    def forward(self, inp: Tensor) -> Tensor:
+        orig_shape = inp.shape
+        x = inp.reshape([-1, self.d_model])
+        N = x.shape[0]
+        topk_val, topk_idx = self.gate(x)
+        K = topk_val.shape[-1]
+        C = max(1, int(self.capacity_factor * N * K / self.num_expert))
+
+        params = [p for e in self.experts for p in e.parameters()]
+        opdef = OpDef("moe_dispatch",
+                      lambda pa, xa, va, ia, capacity: self._routing_impl(
+                          pa, xa, va, ia, capacity=capacity),
+                      True, "none")
+        out = op_call(opdef, (params, x, topk_val, topk_idx),
+                      {"capacity": C})
+        return out.reshape(orig_shape)

@@ -1,0 +1,135 @@
+"""MoE layer / sequence-parallel / segment-parallel tests (reference:
+test/collective/fleet/{test_moe_api, hybrid_parallel_sep_model,
+sequence_parallel} suites — parallel result == serial result)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+
+
+@pytest.fixture(autouse=True)
+def _env():
+    strat = fleet.DistributedStrategy()
+    strat.hybrid_configs = {"dp_degree": 1, "mp_degree": 4, "sep_degree": 2}
+    fleet.init(strategy=strat)
+    yield
+
+
+def test_moe_layer_trains():
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    pt.seed(0)
+    d = 16
+    experts = [nn.Sequential(nn.Linear(d, 32), nn.GELU(), nn.Linear(32, d))
+               for _ in range(4)]
+    moe = MoELayer(d_model=d, experts=experts, gate={"type": "gshard",
+                                                     "top_k": 2})
+    opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=moe.parameters())
+    x = pt.randn([8, 4, d])
+    losses = []
+    for _ in range(4):
+        y = moe(x)
+        assert y.shape == [8, 4, d]
+        loss = (y - 1.0).pow(2).mean()
+        gl = moe.gate.get_loss()
+        if gl is not None:
+            loss = loss + gl.scale(0.01)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    # expert + gate params received gradients on the last step? (cleared) —
+    # check a fresh backward
+    y = moe(x)
+    y.sum().backward()
+    assert moe.gate.gate.weight.grad is not None
+    assert experts[0][0].weight.grad is not None
+
+
+def test_moe_capacity_bounds_dispatch():
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    pt.seed(1)
+    d = 8
+    experts = [nn.Linear(d, d) for _ in range(2)]
+    moe = MoELayer(d_model=d, experts=experts, capacity_factor=0.25,
+                   gate={"type": "naive", "top_k": 1})
+    y = moe(pt.randn([16, d]))
+    assert y.shape == [16, d]  # overflow tokens drop, shape is static
+
+
+def test_global_scatter_gather_roundtrip():
+    from paddle_tpu.distributed.utils import global_gather, global_scatter
+
+    x = pt.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
+    lc = pt.to_tensor(np.array([2, 1, 3]))
+    gc = pt.to_tensor(np.array([2, 1, 3]))
+    s = global_scatter(x, lc, gc)
+    g = global_gather(s, lc, gc)
+    np.testing.assert_array_equal(g.numpy(), x.numpy())
+
+
+def test_sequence_parallel_matches_serial():
+    from paddle_tpu.distributed.fleet.sequence_parallel_utils import (
+        ColumnSequenceParallelLinear, GatherOp, RowSequenceParallelLinear,
+        ScatterOp)
+
+    rng = np.random.RandomState(0)
+    w1 = rng.randn(16, 32).astype(np.float32)
+    w2 = rng.randn(32, 16).astype(np.float32)
+    x_np = rng.randn(8, 2, 16).astype(np.float32)  # [s, b, h]
+
+    s1 = nn.Linear(16, 32, bias_attr=False)
+    s2 = nn.Linear(32, 16, bias_attr=False)
+    s1.weight.set_value(pt.to_tensor(w1))
+    s2.weight.set_value(pt.to_tensor(w2))
+
+    col = ColumnSequenceParallelLinear(16, 32, has_bias=False)
+    row = RowSequenceParallelLinear(32, 16, has_bias=False)
+    col.weight.set_value(pt.to_tensor(w1))
+    row.weight.set_value(pt.to_tensor(w2))
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed.fleet.mp_layers import _shard_param
+
+    _shard_param(col.weight, P(None, "mp"))
+    _shard_param(row.weight, P("mp", None))
+
+    x1 = pt.to_tensor(x_np); x1.stop_gradient = False
+    x2 = pt.to_tensor(x_np); x2.stop_gradient = False
+
+    ref = s2(s1(x1))
+    xs = ScatterOp.apply(x2)           # seq-shard entry
+    out = row(col(xs))
+    out_full = GatherOp.apply(out)     # back to replicated for comparison
+    np.testing.assert_allclose(ref.numpy(), out_full.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+    ref.sum().backward()
+    out_full.sum().backward()
+    np.testing.assert_allclose(s1.weight.grad.numpy(),
+                               col.weight.grad.numpy(), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(x1.grad.numpy(), x2.grad.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_segment_parallel_split_concat():
+    from paddle_tpu.distributed.fleet.meta_parallel.segment_parallel import (
+        SegmentParallel, concat_sequence, split_sequence)
+
+    model = nn.Linear(8, 8)
+    wrapped = SegmentParallel(model)
+    x = pt.randn([2, 8, 8])
+    x.stop_gradient = False
+    xs = split_sequence(x, axis=1)
+    y = wrapped(xs)
+    out = concat_sequence(y, axis=1)
+    assert out.shape == [2, 8, 8]
+    out.sum().backward()
+    assert model.weight.grad is not None
+    assert np.isfinite(x.grad.numpy()).all()
