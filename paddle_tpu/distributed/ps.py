@@ -1,0 +1,167 @@
+"""Parameter server (PS-lite): sharded tables served over framework RPC.
+
+Re-design of the reference's parameter-server stack at the capability level
+(paddle/fluid/distributed/ps/ 35k LoC: brpc client/server, sharded
+dense/sparse tables + accessors; python/paddle/distributed/ps;
+fleet/meta_optimizers/parameter_server_optimizer.py). The reference serves
+trillion-parameter sparse embeddings from CPU parameter servers while GPU
+trainers pull/push.
+
+TPU translation: dense model state belongs on-chip (ZeRO over the mesh
+beats a PS for dense params on ICI), so the PS niche that REMAINS is
+host-memory embedding tables too large for HBM. This module provides that:
+- ``SparseTable``: a host-RAM hash table of embedding rows with lazy init
+  and SGD/Adagrad push rules (the reference's table + accessor).
+- ``PsServer``: serves get/push for its shard of keys over distributed.rpc
+  (the brpc service role).
+- ``PsClient``: key-sharded pull/push used by trainers; pairs with the
+  on-chip model through plain numpy arrays feeding jitted steps.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from . import rpc
+
+__all__ = ["SparseTable", "PsServer", "PsClient"]
+
+
+class SparseTable:
+    """Host-memory embedding table shard (reference: ps/table/
+    memory_sparse_table). Rows materialize on first touch (the reference's
+    lazy feature creation for unbounded id spaces)."""
+
+    def __init__(self, dim: int, init_std: float = 0.01, seed: int = 0,
+                 optimizer: str = "sgd", lr: float = 0.05):
+        self.dim = dim
+        self.init_std = init_std
+        self.optimizer = optimizer
+        self.lr = lr
+        self._rows: dict[int, np.ndarray] = {}
+        self._accum: dict[int, np.ndarray] = {}
+        self._rng = np.random.default_rng(seed)
+        self._mu = threading.Lock()
+
+    def pull(self, keys) -> np.ndarray:
+        out = np.empty((len(keys), self.dim), np.float32)
+        with self._mu:
+            for i, k in enumerate(np.asarray(keys, np.int64)):
+                row = self._rows.get(int(k))
+                if row is None:
+                    row = (self._rng.standard_normal(self.dim)
+                           * self.init_std).astype(np.float32)
+                    self._rows[int(k)] = row
+                out[i] = row
+        return out
+
+    def push(self, keys, grads) -> None:
+        grads = np.asarray(grads, np.float32)
+        with self._mu:
+            for k, g in zip(np.asarray(keys, np.int64), grads):
+                k = int(k)
+                row = self._rows.get(k)
+                if row is None:
+                    continue
+                if self.optimizer == "adagrad":
+                    acc = self._accum.setdefault(
+                        k, np.zeros(self.dim, np.float32))
+                    acc += g * g
+                    row -= self.lr * g / (np.sqrt(acc) + 1e-8)
+                else:
+                    row -= self.lr * g
+
+    def __len__(self):
+        return len(self._rows)
+
+    def state_dict(self):
+        with self._mu:
+            return {"rows": dict(self._rows), "accum": dict(self._accum)}
+
+    def load_state_dict(self, sd):
+        with self._mu:
+            self._rows = dict(sd["rows"])
+            self._accum = dict(sd.get("accum", {}))
+
+
+# module-level registry so rpc-invoked functions (pickled by name) can
+# reach the serving tables
+_SERVED_TABLES: dict[str, SparseTable] = {}
+
+
+def _ps_pull(table: str, keys):
+    return _SERVED_TABLES[table].pull(keys)
+
+
+def _ps_push(table: str, keys, grads):
+    _SERVED_TABLES[table].push(keys, grads)
+    return True
+
+
+def _ps_size(table: str):
+    return len(_SERVED_TABLES[table])
+
+
+class PsServer:
+    """One PS process: registers its tables and serves rpc requests
+    (reference: BrpcPsServer). Call after rpc.init_rpc(name, ...)."""
+
+    def __init__(self, tables: Optional[dict] = None):
+        self.tables = tables or {}
+        for name, t in self.tables.items():
+            _SERVED_TABLES[name] = t
+
+    def add_table(self, name: str, table: SparseTable):
+        self.tables[name] = table
+        _SERVED_TABLES[name] = table
+
+
+class PsClient:
+    """Key-sharded pull/push across PS workers (reference: BrpcPsClient;
+    shard = key % n_servers, the reference's default hash placement)."""
+
+    def __init__(self, server_names: list):
+        self.servers = list(server_names)
+
+    def _shard(self, keys):
+        keys = np.asarray(keys, np.int64)
+        sid = keys % len(self.servers)
+        return [(s, np.nonzero(sid == s)[0]) for s in range(len(self.servers))]
+
+    def pull(self, table: str, keys) -> np.ndarray:
+        keys = np.asarray(keys, np.int64)
+        if keys.size == 0:
+            # probe the table dim so empty shards still get a typed array
+            probe = rpc.rpc_sync(self.servers[0], _ps_pull,
+                                 args=(table, np.zeros(0, np.int64)))
+            return probe
+        out = None
+        for s, idx in self._shard(keys):
+            if idx.size == 0:
+                continue
+            rows = rpc.rpc_sync(self.servers[s], _ps_pull,
+                                args=(table, keys[idx]))
+            if out is None:
+                out = np.empty((len(keys), rows.shape[1]), np.float32)
+            out[idx] = rows
+        return out
+
+    def push(self, table: str, keys, grads) -> None:
+        keys = np.asarray(keys, np.int64)
+        grads = np.asarray(grads, np.float32)
+        futures = []
+        for s, idx in self._shard(keys):
+            if idx.size == 0:
+                continue
+            futures.append(rpc.rpc_async(
+                self.servers[s], _ps_push, args=(table, keys[idx],
+                                                 grads[idx])))
+        for f in futures:
+            f.wait()
+
+    def table_size(self, table: str) -> int:
+        return sum(rpc.rpc_sync(s, _ps_size, args=(table,))
+                   for s in self.servers)

@@ -6,7 +6,8 @@ paddle_tpu.distributed.fleet.
 """
 
 from .pipeline import pipeline_blocks_fn
+from .ring_attention import ring_attention
 from .train_step import make_sharded_train_step, shard_gpt_params
 
 __all__ = ["pipeline_blocks_fn", "make_sharded_train_step",
-           "shard_gpt_params"]
+           "shard_gpt_params", "ring_attention"]

@@ -1,0 +1,144 @@
+"""Ring attention: exact context parallelism for long sequences.
+
+Goes beyond the reference (SURVEY.md §2 checklist: "no ring attention /
+Ulysses / context-parallel attention in this snapshot" — long context there
+rides Megatron-SP + flash-attn). Here the sequence axis is a first-class
+mesh axis: q/k/v shard the sequence over "cp"; each step of a ring pass
+computes blockwise attention of the local q chunk against the current k/v
+chunk, merges with the running online-softmax state (m, l, acc), then
+rotates k/v one hop around the ring (lax.ppermute over ICI neighbours) —
+compute overlaps the collective, the full S×S score matrix never exists,
+and per-device memory is O(S/cp). Causal masking drops fully-masked hops.
+
+Layout: q/k/v [B, S, H, D] globally; inside the ring each device holds
+[B, S/cp, H, D]. Differentiable (jax.grad through ppermute+scan is the
+reverse ring).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["ring_attention"]
+
+
+def _block_attn(q, k, v, scale, mask=None):
+    """Blockwise scores for one (q-chunk, kv-chunk) pair.
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D] → (scores-stats, weighted-values).
+    Returns (m, l, acc) partials in fp32."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -1e30)
+    m = s.max(axis=-1)                                    # [B, H, Sq]
+    p = jnp.exp(s - m[..., None])
+    l = p.sum(axis=-1)
+    acc = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return m, l, acc
+
+
+def _merge(m1, l1, a1, m2, l2, a2):
+    """Merge two online-softmax partial states."""
+    m = jnp.maximum(m1, m2)
+    c1 = jnp.exp(m1 - m)
+    c2 = jnp.exp(m2 - m)
+    l = l1 * c1 + l2 * c2
+    a = a1 * c1[..., None] + a2 * c2[..., None]
+    return m, l, a
+
+
+def _ring_local(q, k, v, *, axis, causal, scale, cp):
+    """Per-device body: q/k/v are the local sequence chunks."""
+    B, Sq, H, D = q.shape
+    my = lax.axis_index(axis)
+
+    m0 = jnp.full((B, H, Sq), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    a0 = jnp.zeros((B, H, Sq, D), jnp.float32)
+
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def hop(carry, step):
+        m, l, a, kc, vc = carry
+        # kv chunk currently held arrived from device (my - step) % cp
+        src = (my - step) % cp
+        if causal:
+            # global positions: q rows my*Sq.., k cols src*Sq..
+            q_pos = my * Sq + jnp.arange(Sq)
+            k_pos = src * Sq + jnp.arange(kc.shape[1])
+            mask = q_pos[:, None] >= k_pos[None, :]
+            need = jnp.any(mask)
+
+            def compute(args):
+                m, l, a, kc, vc = args
+                mh, lh, ah = _block_attn(q, kc, vc, scale, mask[None, None])
+                return _merge(m, l, a, mh, lh, ah)
+
+            # lax.cond actually SKIPS the block compute on fully-masked
+            # hops (~half the hops under causal) instead of discarding it
+            m, l, a = lax.cond(need, compute,
+                               lambda args: (args[0], args[1], args[2]),
+                               (m, l, a, kc, vc))
+        else:
+            mh, lh, ah = _block_attn(q, kc, vc, scale)
+            m, l, a = _merge(m, l, a, mh, lh, ah)
+        kc = lax.ppermute(kc, axis, perm)
+        vc = lax.ppermute(vc, axis, perm)
+        return (m, l, a, kc, vc), None
+
+    (m, l, a, _, _), _ = lax.scan(hop, (m0, l0, a0, k, v),
+                                  jnp.arange(cp))
+    out = a / jnp.clip(l, 1e-30)[..., None]               # [B, H, Sq, D]
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sep",
+                   causal: bool = True, sm_scale=None):
+    """Context-parallel exact attention over the ``axis`` ring.
+
+    q/k/v: [B, S, H, D] global arrays (S divisible by the axis size).
+    Works under jit with the context mesh set (``jax.sharding.set_mesh``)
+    like the compiled pipeline; eagerly it wraps itself in jit.
+    """
+    cp = mesh.shape[axis]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if cp == 1:
+        m, l, a = _block_attn(
+            q, k, v, scale,
+            (jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))[None, None]
+             if causal else None))
+        return jnp.einsum("bhqd->bqhd",
+                          a / jnp.clip(l, 1e-30)[..., None]).astype(q.dtype)
+
+    run = _build_ring(axis, causal, float(scale), cp)
+    if isinstance(q, jax.core.Tracer):
+        # inside an outer jit: the caller provides the context mesh
+        return run(q, k, v)
+    with jax.sharding.set_mesh(mesh):
+        return _jitted_ring(axis, causal, float(scale), cp)(q, k, v)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_ring(axis, causal, scale, cp):
+    spec = P(None, axis)  # shard the sequence dim
+    return jax.shard_map(
+        functools.partial(_ring_local, axis=axis, causal=causal,
+                          scale=scale, cp=cp),
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={axis},
+        check_vma=False,
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_ring(axis, causal, scale, cp):
+    # cached per config: a fresh jit per eager call would recompile
+    return jax.jit(_build_ring(axis, causal, scale, cp))

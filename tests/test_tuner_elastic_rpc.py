@@ -105,3 +105,34 @@ def test_functional_autograd():
     np.testing.assert_allclose(g.numpy(), [2.0, 4.0], rtol=1e-6)
     _, t = jvp(lambda x: (x * x).sum(), x)
     np.testing.assert_allclose(float(t.numpy()), 6.0, rtol=1e-6)
+
+
+def test_parameter_server_pull_push():
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.ps import PsClient, PsServer, SparseTable
+    import os
+
+    port = 19300 + os.getpid() % 500
+    rpc.init_rpc("ps0", rank=0, world_size=1,
+                 master_endpoint=f"127.0.0.1:{port}")
+    try:
+        server = PsServer({"emb": SparseTable(dim=4, lr=0.5, seed=0)})
+        client = PsClient(["ps0"])
+        keys = np.array([3, 7, 3, 100])
+        rows = client.pull("emb", keys)
+        assert rows.shape == (4, 4)
+        np.testing.assert_array_equal(rows[0], rows[2])  # same key, same row
+
+        grads = np.ones((4, 4), np.float32)
+        client.push("emb", keys, grads)
+        rows2 = client.pull("emb", keys)
+        # sgd lr=0.5: key 100 (index 3) pushed once; key 3 (indices 0 and
+        # 2) appears twice in the batch so both grads apply sequentially
+        np.testing.assert_allclose(rows2[3], rows[3] - 0.5, rtol=1e-6)
+        np.testing.assert_allclose(rows2[0], rows[0] - 1.0, rtol=1e-6)
+        assert client.table_size("emb") == 3
+        # empty batch: typed (0, dim) array, not None
+        empty = client.pull("emb", np.array([], np.int64))
+        assert empty.shape == (0, 4)
+    finally:
+        rpc.shutdown()
