@@ -126,6 +126,9 @@ define_flag("use_bf16_matmul", False, "Force bf16 accumulation inputs for matmul
 define_flag("log_compiles", False, "Log XLA compilations triggered by the runtime.")
 define_flag("deterministic", False, "Prefer deterministic kernel lowering.")
 define_flag("allocator_strategy", "auto_growth", "Kept for API parity; XLA owns HBM.")
+define_flag("device_fft", False,
+            "Run paddle.fft on device on TPU (default host numpy; some TPU "
+            "runtimes reject FFT programs).")
 define_flag("flash_attention_kernel_bwd", False,
             "Use the Pallas tiled backward kernels for flash attention "
             "(pending block-size tuning; default is the XLA-expression vjp).")

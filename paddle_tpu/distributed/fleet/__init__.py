@@ -133,8 +133,12 @@ def distributed_model(model):
     strategy = _FLEET_STATE["strategy"] or DistributedStrategy()
     mode = hcg.get_parallel_mode()
     if mode == ParallelMode.PIPELINE_PARALLEL:
-        from .meta_parallel.pipeline_parallel import PipelineParallel
+        from .meta_parallel.pipeline_parallel import (
+            PipelineParallel, PipelineParallelWithInterleave)
 
+        # reference fleet/model.py dispatches by virtual-stage count
+        if getattr(model, "_num_virtual", 1) > 1:
+            return PipelineParallelWithInterleave(model, hcg, strategy)
         return PipelineParallel(model, hcg, strategy)
     if mode in (ParallelMode.TENSOR_PARALLEL, ParallelMode.SEGMENT_PARALLEL):
         from .meta_parallel.tensor_parallel import TensorParallel

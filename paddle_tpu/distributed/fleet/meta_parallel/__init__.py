@@ -1,12 +1,14 @@
 """Meta-parallel model wrappers (reference: fleet/meta_parallel/)."""
 
 from .tensor_parallel import TensorParallel
-from .pipeline_parallel import PipelineParallel
+from .pipeline_parallel import (PipelineParallel,
+                                PipelineParallelWithInterleave)
 from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer
 
 __all__ = [
     "TensorParallel",
     "PipelineParallel",
+    "PipelineParallelWithInterleave",
     "LayerDesc",
     "SharedLayerDesc",
     "PipelineLayer",

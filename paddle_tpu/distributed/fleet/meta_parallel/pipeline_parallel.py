@@ -31,7 +31,7 @@ from ....core.tensor import Tensor
 from ....core import autograd as _autograd
 from ..meta_parallel.pp_layers import PipelineLayer
 
-__all__ = ["PipelineParallel"]
+__all__ = ["PipelineParallel", "PipelineParallelWithInterleave"]
 
 
 class PipelineParallel:
@@ -68,10 +68,13 @@ class PipelineParallel:
         return reshard_op(t, mesh, P(*entries))
 
     def _forward_step(self, micro_input, labels=None):
+        # segment walk covers both plain (V=1: segment g on stage g) and
+        # interleaved VPP layouts (segment g on stage g % pp) — activations
+        # hop to the owning stage's submesh before each chunk
         x = micro_input
-        for s in range(self.num_stages):
-            x = self._to_stage(x, s)
-            x = self._layers.forward_stage(x, s)
+        for g in range(self._layers.num_segments):
+            x = self._to_stage(x, self._layers.segment_stage(g))
+            x = self._layers.forward_segment(x, g)
         if self._layers._loss_fn is not None and labels is not None:
             return self._layers._loss_fn(x, labels)
         return x
@@ -181,3 +184,19 @@ class PipelineParallel:
 
     def __getattr__(self, name):
         return getattr(self._layers, name)
+
+
+class PipelineParallelWithInterleave(PipelineParallel):
+    """Interleaved / virtual pipeline (reference pipeline_parallel.py:1161
+    PipelineParallelWithInterleave): each physical stage owns
+    num_virtual_pipeline_stages non-contiguous model chunks, shrinking the
+    bubble. The segment walk in ``_forward_step`` already drives the
+    interleaved placement; this subclass exists for API parity and
+    validates the layer was built with virtual stages."""
+
+    def __init__(self, layers: PipelineLayer, hcg, strategy):
+        super().__init__(layers, hcg, strategy)
+        if layers._num_virtual <= 1:
+            raise ValueError(
+                "PipelineParallelWithInterleave needs a PipelineLayer built "
+                "with num_virtual_pipeline_stages > 1")

@@ -154,18 +154,38 @@ class PipelineLayer(Layer):
                                  "topology is required")
             num_stages = self._hcg.get_pipe_parallel_world_size()
         self._num_stages = int(num_stages)
+        self._num_virtual = int(num_virtual_pipeline_stages or 1)
 
-        seg = SegmentLayers(self._layers_desc, self._num_stages,
-                            method=seg_method)
+        seg = SegmentLayers(
+            self._layers_desc, self._num_stages, method=seg_method,
+            num_virtual_pipeline_stage=(self._num_virtual
+                                        if self._num_virtual > 1 else None))
         self.segment_parts = seg.do_segment()
 
         self._stage_meshes = self._build_stage_meshes()
         self._stage_layers: list[list[Layer]] = []
         self._shared_layers: dict[str, Layer] = {}
         self.run_function: list = []
+        # Interleave (VPP, reference pp_layers.py virtual pipeline): with V
+        # virtual stages there are pp*V segments; segment g runs on
+        # physical stage g % pp, so each stage owns V non-contiguous chunks.
+        n_segments = self._num_stages * self._num_virtual
+        if len(self.segment_parts) != n_segments + 1:
+            raise ValueError(
+                f"segmentation produced {len(self.segment_parts) - 1} "
+                f"segments but pp({self._num_stages}) x "
+                f"virtual({self._num_virtual}) = {n_segments} are required "
+                "(a seg_method list must carry pp*V boundaries)")
+        self._segment_stage = [g % self._num_stages
+                               for g in range(n_segments)]
+        self._built_by_index: dict[int, Layer] = {}
         for s in range(self._num_stages):
             built = []
-            for i in range(self.segment_parts[s], self.segment_parts[s + 1]):
+            owned = [i for g in range(n_segments)
+                     if self._segment_stage[g] == s
+                     for i in range(self.segment_parts[g],
+                                    self.segment_parts[g + 1])]
+            for i in owned:
                 desc = self._layers_desc[i]
                 if isinstance(desc, SharedLayerDesc):
                     if desc.layer_name not in self._shared_layers:
@@ -193,12 +213,14 @@ class PipelineLayer(Layer):
                     # plain functions (e.g. reshape lambdas) are allowed
                     built.append(desc)
                     self.run_function.append(desc)
+                    self._built_by_index[i] = desc
                     continue
                 else:
                     raise TypeError(f"bad layer desc {desc!r}")
                 self.add_sublayer(f"stage{s}_{len(built)}", lyr)
                 built.append(lyr)
                 self.run_function.append(lyr)
+                self._built_by_index[i] = lyr
             self._stage_layers.append(built)
             self._place_stage_params(s)
 
@@ -255,18 +277,41 @@ class PipelineLayer(Layer):
         return self._stage_layers[s]
 
     def get_stage_from_index(self, layer_idx: int) -> int:
-        for s in range(self._num_stages):
-            if self.segment_parts[s] <= layer_idx < self.segment_parts[s + 1]:
-                return s
+        """Physical stage owning model-order layer layer_idx (interleave:
+        its segment's stage, g % pp)."""
+        for g in range(self.num_segments):
+            if self.segment_parts[g] <= layer_idx < self.segment_parts[g + 1]:
+                return self._segment_stage[g]
         raise ValueError(layer_idx)
 
-    def forward_stage(self, x, s: int):
-        for lyr in self._stage_layers[s]:
-            x = lyr(x)
+    @property
+    def num_segments(self) -> int:
+        return self._num_stages * self._num_virtual
+
+    def segment_stage(self, g: int) -> int:
+        """Physical stage owning segment g (interleave: g % pp)."""
+        return self._segment_stage[g]
+
+    def forward_segment(self, x, g: int):
+        """Run virtual segment g's layers (model order)."""
+        for i in range(self.segment_parts[g], self.segment_parts[g + 1]):
+            x = self._built_by_index[i](x)
         return x
 
+    def forward_stage(self, x, s: int):
+        """Non-interleaved stage body (V=1: one contiguous segment)."""
+        if self._num_virtual == 1:
+            for lyr in self._stage_layers[s]:
+                x = lyr(x)
+            return x
+        # interleaved: stage s's segments are s, s+pp, ... — but model
+        # order interleaves stages, so a 'stage-by-stage' walk is invalid
+        raise RuntimeError("interleaved PipelineLayer must be driven by "
+                           "segments (forward_segment), not stages")
+
     def forward(self, x):
-        """Full serial forward (debug / single-stage path)."""
-        for s in range(self._num_stages):
-            x = self.forward_stage(x, s)
+        """Full serial forward (debug / single-stage path): model order =
+        segment order."""
+        for g in range(self.num_segments):
+            x = self.forward_segment(x, g)
         return x

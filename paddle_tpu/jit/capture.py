@@ -169,6 +169,17 @@ class StaticFunction:
         key = _guard_key(args, kwargs, len(state_vals))
         compiled: Optional[_Compiled] = self._cache.get(key)
         if compiled is None:
+            # The weak parameter registry can hold dead-but-uncollected
+            # Layers (reference cycles defer GC); their stale, possibly
+            # differently-placed buffers would poison the state snapshot.
+            # Collect only on the compile path (amortized).
+            import gc
+
+            gc.collect()
+            state_vals, state_setters = _snapshot()
+            key = _guard_key(args, kwargs, len(state_vals))
+            compiled = self._cache.get(key)
+        if compiled is None:
             compiled = self._compile(args, kwargs, state_vals)
             self._cache[key] = compiled
             # State created during the trace (e.g. optimizer moments) holds

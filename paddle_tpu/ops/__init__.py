@@ -11,9 +11,10 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
-from . import creation, linalg, manipulation, math
+from . import creation, extra, linalg, manipulation, math
 
 from .creation import *  # noqa: F401,F403
+from .extra import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403

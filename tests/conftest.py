@@ -34,3 +34,9 @@ def _seed_everything():
     paddle_tpu.seed(2024)
     np.random.seed(2024)
     yield
+    # Drop dead Layers/optimizers promptly: the capture state registry
+    # (jit/capture.py) reflects live Parameters, and reference cycles in
+    # Layer graphs otherwise survive into later tests.
+    import gc
+
+    gc.collect()

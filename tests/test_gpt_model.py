@@ -159,3 +159,51 @@ def test_ring_attention_matches_dense():
     np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref),
                                rtol=2e-3, atol=2e-3)
 
+
+
+def test_interleaved_pipeline_matches_serial():
+    """VPP (2 virtual stages on pp=2) must match serial grad accumulation
+    (reference: hybrid_parallel_pp_interleave tests)."""
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        LayerDesc, PipelineLayer, PipelineParallelWithInterleave)
+    from paddle_tpu.optimizer import SGD
+
+    strat = fleet.DistributedStrategy()
+    strat.hybrid_configs = {"dp_degree": 2, "pp_degree": 2}
+    strat.pipeline_configs = {"accumulate_steps": 2, "micro_batch_size": 4}
+    fleet.init(strategy=strat)
+
+    rng = np.random.RandomState(0)
+    Ws = [rng.randn(8, 8).astype(np.float32) * 0.4 for _ in range(4)]
+    X = rng.randn(8, 8).astype(np.float32)
+    Y = rng.randint(0, 8, size=(8,))
+
+    def loss_fn(pred, label):
+        return nn.functional.cross_entropy(pred, label)
+
+    descs = [LayerDesc(nn.Linear, 8, 8, bias_attr=False) for _ in range(4)]
+    pipe = PipelineLayer(descs, loss_fn=loss_fn,
+                         num_virtual_pipeline_stages=2)
+    # model-order layer i lives at _built_by_index[i]
+    for i, w in enumerate(Ws):
+        pipe._built_by_index[i].weight.set_value(pt.to_tensor(w))
+    model = PipelineParallelWithInterleave(
+        pipe, fleet.get_hybrid_communicate_group(), strat)
+    opt = SGD(learning_rate=0.05, parameters=pipe.parameters())
+    vpp_loss = float(model.train_batch(
+        (pt.to_tensor(X), pt.to_tensor(Y)), opt).numpy())
+
+    # serial reference with the same 2-microbatch accumulation
+    serial = [nn.Linear(8, 8, bias_attr=False) for _ in range(4)]
+    for l, w in zip(serial, Ws):
+        l.weight.set_value(pt.to_tensor(w))
+    tot = 0.0
+    for k in range(2):
+        h = pt.to_tensor(X[k * 4:(k + 1) * 4])
+        for l in serial:
+            h = l(h)
+        tot += float(loss_fn(h, pt.to_tensor(Y[k * 4:(k + 1) * 4])).numpy())
+    np.testing.assert_allclose(vpp_loss, tot / 2, rtol=1e-4)

@@ -161,3 +161,75 @@ class TestInplace:
         x = paddle.zeros([3, 3])
         x[1, :] = 5.0
         np.testing.assert_allclose(x.numpy()[1], [5.0, 5.0, 5.0])
+
+
+# ---------------------------------------------------------------------------
+# extra op tranche (ops/extra.py)
+# ---------------------------------------------------------------------------
+
+def test_extra_special_math():
+    import paddle_tpu as pt
+
+    x = pt.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    np.testing.assert_allclose(pt.fmod(x, pt.to_tensor(2.0)).numpy(),
+                               [1.0, 0.0, 1.0])
+    np.testing.assert_allclose(pt.trapezoid(x).numpy(), 4.0)
+    np.testing.assert_allclose(
+        pt.cumulative_trapezoid(x).numpy(), [1.5, 4.0])
+    np.testing.assert_allclose(pt.ldexp(x, pt.to_tensor(
+        np.array([1, 1, 1]))).numpy(), [2.0, 4.0, 6.0])
+    assert pt.nanmedian(pt.to_tensor(
+        np.array([1.0, np.nan, 3.0], np.float32))).numpy() == 2.0
+
+
+def test_extra_linalg_and_indexing():
+    import paddle_tpu as pt
+
+    m = pt.to_tensor(np.array([[2.0, 0.0], [0.0, 3.0]], np.float32))
+    np.testing.assert_allclose(pt.logdet(m).numpy(), np.log(6.0), rtol=1e-6)
+    np.testing.assert_allclose(pt.diagonal(m).numpy(), [2.0, 3.0])
+    d = pt.diag(pt.to_tensor(np.array([1.0, 2.0], np.float32)),
+                padding_value=9.0)
+    np.testing.assert_allclose(d.numpy(), [[1.0, 9.0], [9.0, 2.0]])
+
+    x = pt.to_tensor(np.zeros((3, 3), np.float32))
+    out = pt.index_fill(x, pt.to_tensor(np.array([0, 2])), 0, 7.0)
+    np.testing.assert_allclose(out.numpy()[0], 7.0)
+    np.testing.assert_allclose(out.numpy()[1], 0.0)
+
+    sel = pt.masked_select(pt.to_tensor(np.array([1.0, 2.0, 3.0])),
+                           pt.to_tensor(np.array([True, False, True])))
+    np.testing.assert_allclose(sel.numpy(), [1.0, 3.0])
+
+    u, counts = pt.unique(pt.to_tensor(np.array([3, 1, 3, 2])),
+                          return_counts=True)
+    np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+    np.testing.assert_array_equal(counts.numpy(), [1, 1, 2])
+
+    nz = pt.nonzero(pt.to_tensor(np.array([0, 5, 0, 7])))
+    np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
+
+
+def test_extra_shapes_distances_fft():
+    import paddle_tpu as pt
+
+    x = pt.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert pt.unflatten(x, 1, [3, 1]).shape == [2, 3, 1]
+    assert pt.ravel(x).shape == [6]
+    assert pt.atleast_2d(pt.to_tensor(np.array(3.0))).shape == [1, 1]
+
+    a = pt.to_tensor(np.array([[0.0, 0.0], [3.0, 4.0]], np.float32))
+    np.testing.assert_allclose(pt.pdist(a).numpy(), [5.0], rtol=1e-5)
+    c = pt.cdist(a, a)
+    np.testing.assert_allclose(c.numpy()[0, 1], 5.0, rtol=1e-5)
+
+    sig = pt.to_tensor(np.sin(np.linspace(0, 8 * np.pi, 64)).astype(
+        np.float32))
+    spec = pt.fft.rfft(sig)
+    assert spec.shape == [33]
+    rec = pt.fft.irfft(spec, n=64)
+    np.testing.assert_allclose(rec.numpy(), sig.numpy(), atol=1e-5)
+
+    bd = pt.block_diag(pt.to_tensor(np.ones((2, 2), np.float32)),
+                       pt.to_tensor(np.ones((1, 1), np.float32)))
+    assert bd.shape == [3, 3] and bd.numpy()[2, 2] == 1.0
