@@ -129,9 +129,10 @@ define_flag("allocator_strategy", "auto_growth", "Kept for API parity; XLA owns 
 define_flag("device_fft", False,
             "Run paddle.fft on device on TPU (default host numpy; some TPU "
             "runtimes reject FFT programs).")
-define_flag("flash_attention_kernel_bwd", False,
+define_flag("flash_attention_kernel_bwd", True,
             "Use the Pallas tiled backward kernels for flash attention "
-            "(pending block-size tuning; default is the XLA-expression vjp).")
+            "(512/1024 tiles, fastest measured on v5e); 0 falls back to "
+            "the XLA-expression vjp.")
 define_flag("use_library_flash_attention", False,
             "Route flash attention to jax's library TPU kernels.")
 define_flag(

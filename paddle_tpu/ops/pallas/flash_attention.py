@@ -29,16 +29,38 @@ def _interpret_mode() -> bool:
     return _INTERPRET
 
 
-BLOCK_Q = 128
-BLOCK_K = 128
+# Default tile-size caps. Measured on v5e at GPT-350M shapes (B8 S1024 H16
+# D64): 128x128 runs at ~60% the speed of 512x1024 — bigger q tiles amortize
+# the K/V VMEM residency and keep the MXU fed. _block_sizes() picks the
+# largest 128-multiple divisor of the sequence length under these caps, so
+# any seq divisible by 128 gets the Pallas path.
+BLOCK_Q = 512
+BLOCK_K = 1024
+
+_MIN_BLOCK = 128
+
+
+def _divisor_block(s: int, cap: int) -> int:
+    """Largest multiple of 128 that divides ``s`` and is <= cap (0 if none)."""
+    b = min(cap, s)
+    b -= b % _MIN_BLOCK
+    while b >= _MIN_BLOCK and s % b:
+        b -= _MIN_BLOCK
+    return b
+
+
+def _block_sizes(s: int) -> tuple[int, int]:
+    return _divisor_block(s, BLOCK_Q), _divisor_block(s, BLOCK_K)
 
 
 def supported(shape, dtype) -> bool:
-    """Pallas path needs seq divisible by the block and a MXU-friendly head dim."""
+    """Pallas path needs 128-aligned blocks dividing seq and a MXU-friendly
+    head dim."""
     if len(shape) != 4:
         return False
     _, s, _, d = shape
-    return s % BLOCK_Q == 0 and s >= BLOCK_Q and d in (64, 128, 256)
+    bq, bk = _block_sizes(s)
+    return bq >= _MIN_BLOCK and bk >= _MIN_BLOCK and d in (64, 128, 256)
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, causal,
@@ -98,8 +120,7 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, with_lse: bool = False):
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
 
-    block_q = min(BLOCK_Q, s)
-    block_k = min(BLOCK_K, s)
+    block_q, block_k = _block_sizes(s)
 
     grid = (b, h, s // block_q)
     out_shapes = [jax.ShapeDtypeStruct((b, h, s, d), q.dtype)]
@@ -223,8 +244,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float):
     delta = jnp.sum(dot_ * ot.astype(jnp.float32), axis=-1)   # [b, h, s]
     delta = jnp.broadcast_to(delta[:, :, None, :], (b, h, 8, s))
 
-    block_q = min(BLOCK_Q, s)
-    block_k = min(BLOCK_K, s)
+    block_q, block_k = _block_sizes(s)
 
     full = lambda ib, ih, i: (ib, ih, 0, 0)
     blk_q4 = lambda ib, ih, iq: (ib, ih, iq, 0)
@@ -296,16 +316,24 @@ def _library_flash(q, k, v, causal: bool, scale: float):
         return None
     try:
         from jax.experimental.pallas.ops.tpu.flash_attention import (
-            flash_attention as tpu_flash)
+            BlockSizes, flash_attention as tpu_flash)
     except Exception:
         return None
     b, s, h, d = q.shape
-    if s % 128 != 0 or d not in (64, 128, 256):
+    if not supported(q.shape, q.dtype):
         return None
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    out = tpu_flash(qt, kt, vt, causal=causal, sm_scale=scale)
+    # Tuned on v5e (GPT-350M shapes): 512/1024 tiles beat the library
+    # defaults ~2.5x on fwd+bwd.
+    bq, bk = _block_sizes(s)
+    bs = BlockSizes(block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+                    block_q_major_dkv=bq, block_k_major_dkv=bk,
+                    block_q_dkv=bq, block_k_dkv=bk,
+                    block_q_dq=bq, block_k_dq=bk, block_k_major_dq=bk)
+    out = tpu_flash(qt, kt, vt, causal=causal, sm_scale=scale,
+                    block_sizes=bs)
     return jnp.swapaxes(out, 1, 2)
 
 
@@ -331,12 +359,15 @@ def flash_attention_raw(q, k, v, causal: bool = False, sm_scale: float | None = 
             return lib_out
 
     # Backward choice: the Pallas bwd kernels (tiled dq/dkv, O(S) memory)
-    # are correct but currently unpipelined — measured far slower than the
-    # XLA-expression vjp on v5e, so they're opt-in until block-level tuning
-    # lands. The default sdpa-vjp backward materializes S×S per layer
-    # transiently, which outer remat keeps bounded.
-    use_kernel_bwd = GLOBAL_FLAGS.has("flash_attention_kernel_bwd") and \
-        GLOBAL_FLAGS.get("flash_attention_kernel_bwd")
+    # are the default — with the 512/1024 tiles they measure fastest on v5e
+    # (GPT-350M train step: 252ms vs 333ms for the sdpa-vjp backward and
+    # 271ms for the jax library kernels). Opt out via
+    # FLAGS_flash_attention_kernel_bwd=0 to fall back to the XLA-expression
+    # vjp (which transiently materializes S×S per layer; outer remat keeps
+    # it bounded).
+    use_kernel_bwd = (GLOBAL_FLAGS.get("flash_attention_kernel_bwd")
+                      if GLOBAL_FLAGS.has("flash_attention_kernel_bwd")
+                      else True)
 
     @jax.custom_vjp
     def fa(q, k, v):
