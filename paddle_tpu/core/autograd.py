@@ -25,6 +25,7 @@ import numpy as np
 
 __all__ = [
     "GradNode",
+    "WeightGradStore",
     "no_grad",
     "enable_grad",
     "is_grad_enabled",
@@ -91,6 +92,71 @@ def _zero_cotangent(aval_shape, aval_dtype):
     return np.zeros(aval_shape, dtype=jax.dtypes.float0)
 
 
+class WeightGradStore:
+    """Deferred weight-gradient computation for zero-bubble pipelines.
+
+    The reference's zero-bubble pass splits each matmul backward into an
+    input-grad op (on the critical path) and a weight-grad op scheduled
+    into the pipeline bubble (distributed/passes/pipeline_scheduler_pass/
+    pipeline_zero_bubble.py). Here the split happens on the eager tape:
+    while the store is enabled, ops that registered a split vjp (the
+    matmul family, core/dispatch.py:register_split_vjp) compute only
+    activation grads during ``backward()`` and enqueue a thunk that
+    produces the parameter grads when :meth:`flush` runs.
+
+    Grad hooks on deferred parameters fire per flushed thunk (i.e. per
+    microbatch) rather than once per backward — the same per-chunk hook
+    semantics the reference's split weight-grad ops have.
+    """
+
+    _tls = threading.local()
+
+    @classmethod
+    def _q(cls) -> list:
+        q = getattr(cls._tls, "queue", None)
+        if q is None:
+            q = cls._tls.queue = []
+        return q
+
+    @classmethod
+    def enabled(cls) -> bool:
+        return getattr(cls._tls, "enabled", False)
+
+    @classmethod
+    def enable(cls) -> None:
+        cls._tls.enabled = True
+
+    @classmethod
+    def disable(cls) -> None:
+        cls._tls.enabled = False
+
+    @classmethod
+    def put(cls, thunk) -> None:
+        cls._q().append(thunk)
+
+    @classmethod
+    def size(cls) -> int:
+        return len(cls._q())
+
+    @classmethod
+    def flush(cls, limit: int | None = None) -> int:
+        """Run up to ``limit`` deferred weight-grad thunks (all if None).
+        Returns the number executed. Thunks run oldest-first so per-layer
+        accumulation order matches the non-split schedule."""
+        q = cls._q()
+        n = len(q) if limit is None else min(limit, len(q))
+        with no_grad():
+            for _ in range(n):
+                thunk = q.pop(0)
+                for t, g in thunk():
+                    _leaf_receive(t, g)
+        return n
+
+    @classmethod
+    def clear(cls) -> None:
+        cls._q().clear()
+
+
 class GradNode:
     """One recorded op on the tape.
 
@@ -107,12 +173,17 @@ class GradNode:
         "out_dtypes",
         "multi_output",
         "released",
+        "split",
     )
 
     def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence, outs):
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)
+        # Optional split-backward rule: fn(cotangents) -> (in_grads with
+        # None at deferred slots, wgrad_fn) | None. Set by dispatch for ops
+        # with a registered split vjp (zero-bubble support).
+        self.split = None
         self.multi_output = isinstance(outs, (tuple, list))
         outs_t = outs if self.multi_output else (outs,)
         # None entries = optional outputs the op didn't produce
@@ -124,8 +195,7 @@ class GradNode:
     def num_outputs(self) -> int:
         return len(self.out_shapes)
 
-    def apply(self, out_grads: list):
-        """Run the pullback: per-output cotangents -> per-input gradients."""
+    def _cotangents(self, out_grads: list):
         if self.released:
             raise RuntimeError(
                 f"GradNode<{self.name}> has been released; pass "
@@ -140,21 +210,43 @@ class GradNode:
         # cotangent for an fp32 output (or vice versa) — jax.vjp requires
         # exact aval match, so cast to the recorded output dtype (the
         # reference casts in its generated GradNodes the same way).
-        cotangents = [
+        return [
             c.astype(d) if c is not None and d is not None
             and hasattr(c, "dtype") and c.dtype != d
             and c.dtype != jax.dtypes.float0 else c
             for c, d in zip(cotangents, self.out_dtypes)
         ]
+
+    def apply(self, out_grads: list):
+        """Run the pullback: per-output cotangents -> per-input gradients."""
+        cotangents = self._cotangents(out_grads)
         if self.multi_output:
             in_grads = self.vjp_fn(tuple(cotangents))
         else:
             in_grads = self.vjp_fn(cotangents[0])
         return in_grads
 
+    def apply_split(self, out_grads: list):
+        """Split application (zero-bubble): activation grads now, weight
+        grads deferred. Returns ``(in_grads, wgrad_pairs_fn)`` where
+        ``in_grads`` has None at deferred slots, or None if this node's
+        rule declines (caller falls back to :meth:`apply`)."""
+        cotangents = self._cotangents(out_grads)
+        res = self.split(cotangents)
+        if res is None:
+            return None
+        in_grads, wgrad_fn = res
+        tensors = list(self.inputs)
+
+        def pairs():
+            return [(tensors[i], g) for i, g in wgrad_fn().items()]
+
+        return in_grads, pairs
+
     def release(self):
         self.vjp_fn = None
         self.inputs = []
+        self.split = None
         self.released = True
 
 
@@ -291,7 +383,14 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False, _sink=None)
                             _write_grad(t, g, accumulate=True)
                     out_grads.append(g)
                 inputs = list(node.inputs)
-                in_grads = node.apply(out_grads)
+                in_grads = None
+                if node.split is not None and WeightGradStore.enabled():
+                    split_res = node.apply_split(out_grads)
+                    if split_res is not None:
+                        in_grads, wgrad_pairs = split_res
+                        WeightGradStore.put(wgrad_pairs)
+                if in_grads is None:
+                    in_grads = node.apply(out_grads)
                 if not retain_graph:
                     node.release()
                 for t, g in zip(inputs, in_grads):

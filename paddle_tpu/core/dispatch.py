@@ -66,6 +66,23 @@ class OpDef(NamedTuple):
 
 OP_REGISTRY: dict[str, OpDef] = {}
 
+# Zero-bubble split backward rules (the tape analog of the reference's
+# matmul-grad split in pipeline_zero_bubble.py). A rule has signature
+#   rule(arrays, weight_slots, kwargs, cotangents)
+#     -> (in_grads list with None at deferred slots,
+#         wgrad_fn() -> {slot: grad}) | None to decline
+# and is consulted by GradNode.apply_split only while
+# autograd.WeightGradStore is enabled.
+SPLIT_VJP: dict[str, Callable] = {}
+
+
+def register_split_vjp(name: str):
+    def deco(rule):
+        SPLIT_VJP[name] = rule
+        return rule
+
+    return deco
+
 # Set by paddle_tpu.amp when an auto_cast scope is active:
 #   {"enable": bool, "dtype": jnp dtype, "level": "O1"|"O2"}
 AMP_STATE: dict | None = None
@@ -142,6 +159,24 @@ def op_call(opdef: OpDef, args, kwargs):
 
         outs, vjp_fn = jax.vjp(primal, *arrays)
         node = autograd.GradNode(opdef.name, vjp_fn, leaves, outs)
+        rule = SPLIT_VJP.get(opdef.name)
+        if rule is not None:
+            # Deferrable slots: leaf parameters (no upstream node). The
+            # rule itself decides whether the pattern qualifies.
+            wslots = tuple(
+                i for i, t in enumerate(leaves)
+                if t._grad_node is None and not t.stop_gradient
+            )
+            if wslots:
+                saved = list(arrays)
+                extras = [a for a in t_args if not isinstance(a, _Ph)]
+                kw = dict(t_kwargs) if t_kwargs else {}
+                kw["_positional_extras"] = extras
+
+                def split(cotangents, _r=rule, _a=saved, _w=wslots, _k=kw):
+                    return _r(_a, _w, _k, cotangents)
+
+                node.split = split
     else:
         outs = opdef.impl(*_rebuild(t_args, arrays), **_rebuild(t_kwargs, arrays))
         if isinstance(outs, list):

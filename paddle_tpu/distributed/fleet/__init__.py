@@ -134,9 +134,15 @@ def distributed_model(model):
     mode = hcg.get_parallel_mode()
     if mode == ParallelMode.PIPELINE_PARALLEL:
         from .meta_parallel.pipeline_parallel import (
-            PipelineParallel, PipelineParallelWithInterleave)
+            PipelineParallel, PipelineParallelWithInterleave,
+            PipelineParallelZeroBubble)
 
-        # reference fleet/model.py dispatches by virtual-stage count
+        # reference fleet/model.py dispatches by virtual-stage count;
+        # schedule_mode "ZBH1" selects the zero-bubble scheduler
+        # (reference: pipeline_scheduler_pass ZeroBubble config)
+        pc = getattr(strategy, "pipeline_configs", {}) or {}
+        if str(pc.get("schedule_mode", "")).upper().startswith("ZB"):
+            return PipelineParallelZeroBubble(model, hcg, strategy)
         if getattr(model, "_num_virtual", 1) > 1:
             return PipelineParallelWithInterleave(model, hcg, strategy)
         return PipelineParallel(model, hcg, strategy)

@@ -2,13 +2,15 @@
 
 from .tensor_parallel import TensorParallel
 from .pipeline_parallel import (PipelineParallel,
-                                PipelineParallelWithInterleave)
+                                PipelineParallelWithInterleave,
+                                PipelineParallelZeroBubble)
 from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer
 
 __all__ = [
     "TensorParallel",
     "PipelineParallel",
     "PipelineParallelWithInterleave",
+    "PipelineParallelZeroBubble",
     "LayerDesc",
     "SharedLayerDesc",
     "PipelineLayer",

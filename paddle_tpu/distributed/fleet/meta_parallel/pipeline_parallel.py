@@ -31,7 +31,8 @@ from ....core.tensor import Tensor
 from ....core import autograd as _autograd
 from ..meta_parallel.pp_layers import PipelineLayer
 
-__all__ = ["PipelineParallel", "PipelineParallelWithInterleave"]
+__all__ = ["PipelineParallel", "PipelineParallelWithInterleave",
+           "PipelineParallelZeroBubble"]
 
 
 class PipelineParallel:
@@ -94,6 +95,20 @@ class PipelineParallel:
         return [Tensor(arr[i * (b // n):(i + 1) * (b // n)], stop_gradient=sg)
                 for i in range(n)]
 
+    # -- schedule hooks (overridden by the zero-bubble subclass) -------------
+    def _backward_step(self, loss, scaler, n):
+        scaled = loss.scale(1.0 / n)
+        if scaler is not None:
+            scaler.scale(scaled).backward()
+        else:
+            scaled.backward()
+
+    def _on_cooldown_slot(self, pending):
+        """Called once per cooldown iteration (no forward left to issue)."""
+
+    def _finish_schedule(self):
+        """Called after the last microbatch backward, before returning."""
+
     # -- the schedule --------------------------------------------------------
     def forward_backward_pipeline(self, data, scaler=None):
         """1F1B (reference :565): warmup forwards, steady 1F1B, cooldown
@@ -119,16 +134,15 @@ class PipelineParallel:
         while k_fwd < n or pending:
             if pending:
                 loss = pending.pop(0)
-                scaled = loss.scale(1.0 / n)
-                if scaler is not None:
-                    scaler.scale(scaled).backward()
-                else:
-                    scaled.backward()
+                self._backward_step(loss, scaler, n)
                 total = loss.detach() if total is None else total + loss.detach()
             if k_fwd < n:
                 loss = self._forward_step(micro_inputs[k_fwd], micro_labels[k_fwd])
                 pending.append(loss)
                 k_fwd += 1
+            else:
+                self._on_cooldown_slot(pending)
+        self._finish_schedule()
         self.total_loss = total.scale(1.0 / n) if total is not None else None
         return self.total_loss
 
@@ -184,6 +198,90 @@ class PipelineParallel:
 
     def __getattr__(self, name):
         return getattr(self._layers, name)
+
+
+class PipelineParallelZeroBubble(PipelineParallel):
+    """Zero-bubble (ZB-H1) schedule: backward is split into the
+    activation-grad pass B (critical path) and the weight-grad pass W,
+    deferred into the pipeline's cooldown bubble.
+
+    Redesign of the reference's static-graph zero-bubble scheduler pass
+    (distributed/passes/pipeline_scheduler_pass/pipeline_zero_bubble.py):
+    there the pass splits matmul_grad ops inside the per-stage program;
+    here the split happens on the eager tape — while a microbatch's
+    ``backward()`` runs, ops with a registered split vjp (the matmul
+    family) compute only activation grads and enqueue weight-grad thunks
+    in :class:`~paddle_tpu.core.autograd.WeightGradStore`, which this
+    schedule drains during the cooldown phase (one W per drained B, the
+    ZB-H1 filling rule) and fully before the optimizer step.
+    """
+
+    def _backward_step(self, loss, scaler, n):
+        """B pass: activation grads only; weight-grad thunks go to the
+        store."""
+        from ....core.autograd import WeightGradStore
+
+        WeightGradStore.enable()
+        try:
+            super()._backward_step(loss, scaler, n)
+        finally:
+            WeightGradStore.disable()
+
+    def _on_cooldown_slot(self, pending):
+        """Each drained B frees a bubble slot — fill it with one
+        microbatch's worth of deferred weight grads (the ZB-H1 rule)."""
+        from ....core.autograd import WeightGradStore
+
+        WeightGradStore.flush(
+            limit=max(1, WeightGradStore.size() // max(len(pending), 1)))
+
+    def _finish_schedule(self):
+        from ....core.autograd import WeightGradStore
+
+        WeightGradStore.flush()  # whatever the cooldown didn't absorb
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        from ....core.autograd import WeightGradStore
+
+        # A failed previous batch may have left stale thunks; they must
+        # not leak into this batch's gradients.
+        WeightGradStore.clear()
+        try:
+            return super().forward_backward_pipeline(data, scaler=scaler)
+        except BaseException:
+            WeightGradStore.clear()
+            raise
+
+    def static_scheduler(self):
+        """Emit the per-stage ZB-H1 schedule strings without running
+        (reference: PipelineParallel static_scheduler mode,
+        pipeline_parallel.py:576 — 'f0;f1;b0;…'; zero-bubble adds w's)."""
+        n = self.accumulate_steps
+        S = self.num_stages
+        out = []
+        for stage in range(S):
+            warmup = min(S - stage - 1, n)
+            steps = []
+            fwd = bwd = w = 0
+            for _ in range(warmup):
+                steps.append(f"f{fwd}")
+                fwd += 1
+            while fwd < n:
+                steps.append(f"f{fwd}")
+                fwd += 1
+                steps.append(f"b{bwd}")
+                bwd += 1
+            while bwd < n:
+                steps.append(f"b{bwd}")
+                bwd += 1
+                if w < bwd - 1:  # fill the freed slot with a deferred W
+                    steps.append(f"w{w}")
+                    w += 1
+            while w < n:
+                steps.append(f"w{w}")
+                w += 1
+            out.append(";".join(steps))
+        return out
 
 
 class PipelineParallelWithInterleave(PipelineParallel):

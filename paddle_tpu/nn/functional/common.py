@@ -34,6 +34,41 @@ def linear(x, weight, bias=None):
     return out
 
 
+from ...core.dispatch import register_split_vjp
+
+
+@register_split_vjp("linear")
+def _linear_split_vjp(arrays, wslots, kwargs, cots):
+    """Zero-bubble split: dx now (critical path), dW/db deferred.
+
+    The eager-tape analog of the reference's matmul-grad split in
+    distributed/passes/pipeline_scheduler_pass/pipeline_zero_bubble.py.
+    Declines (returns None) when the pattern is not the standard
+    activation @ 2-D-parameter case.
+    """
+    if 1 not in wslots:
+        return None
+    x, w = arrays[0], arrays[1]
+    b = arrays[2] if len(arrays) > 2 else None
+    if w.ndim != 2 or x.ndim < 2:
+        return None
+    if b is not None and (2 not in wslots or b.shape != (w.shape[1],)):
+        return None
+    g = cots[0]
+    dx = jnp.matmul(g, w.T).astype(x.dtype)
+    in_grads = [dx] + [None] * (len(arrays) - 1)
+
+    def wgrad():
+        g2 = g.reshape(-1, g.shape[-1])
+        x2 = x.reshape(-1, x.shape[-1])
+        out = {1: jnp.matmul(x2.T, g2).astype(w.dtype)}
+        if b is not None:
+            out[2] = g2.sum(0).astype(b.dtype)
+        return out
+
+    return in_grads, wgrad
+
+
 @op("dropout_impl")
 def _dropout_impl(x, key, p: float, upscale: bool):
     keep = 1.0 - p

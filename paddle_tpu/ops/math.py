@@ -329,6 +329,36 @@ def matmul(x, y, transpose_x=False, transpose_y=False):
     return jnp.matmul(x, y)
 
 
+from ..core.dispatch import register_split_vjp
+
+
+@register_split_vjp("matmul")
+def _matmul_split_vjp(arrays, wslots, kwargs, cots):
+    """Zero-bubble split for activation @ 2-D-parameter matmuls: dx now,
+    dy (the parameter grad) deferred to the WeightGradStore."""
+    extras = kwargs.get("_positional_extras") or []
+    tx = kwargs.get("transpose_x", extras[0] if len(extras) > 0 else False)
+    ty = kwargs.get("transpose_y", extras[1] if len(extras) > 1 else False)
+    if 1 not in wslots:
+        return None
+    x, y = arrays[0], arrays[1]
+    if y.ndim != 2 or x.ndim < 2:
+        return None
+    g = cots[0]
+    xm = jnp.swapaxes(x, -1, -2) if tx else x   # [..., m, k]
+    ym = y.T if ty else y                       # [k, n]
+    dxm = jnp.matmul(g, ym.T)                   # [..., m, k]
+    dx = (jnp.swapaxes(dxm, -1, -2) if tx else dxm).astype(x.dtype)
+
+    def wgrad():
+        g2 = g.reshape(-1, g.shape[-1])
+        x2 = xm.reshape(-1, xm.shape[-1])
+        dym = jnp.matmul(x2.T, g2)              # [k, n]
+        return {1: (dym.T if ty else dym).astype(y.dtype)}
+
+    return [dx, None], wgrad
+
+
 mm = matmul
 
 
