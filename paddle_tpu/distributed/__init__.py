@@ -52,6 +52,14 @@ from .auto_parallel import (
     unshard_dtensor,
 )
 from .sharding import group_sharded_parallel
+from .engine import (
+    DistModel,
+    Engine,
+    ShardDataloader,
+    Strategy,
+    shard_dataloader,
+    to_static,
+)
 from . import collective, fleet, topology
 
 __all__ = [
@@ -69,6 +77,8 @@ __all__ = [
     "get_placements", "sharding_constraint",
     "ShardingStage1", "ShardingStage2", "ShardingStage3",
     "group_sharded_parallel",
+    "Strategy", "DistModel", "to_static", "ShardDataloader",
+    "shard_dataloader", "Engine",
     "fleet",
 ]
 

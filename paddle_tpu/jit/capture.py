@@ -156,6 +156,10 @@ class StaticFunction:
         self._fn = fn
         self._cache: dict = {}
         self._donate = donate_states
+        # Introspection handles for the most recent compile (the analogs of
+        # the reference's dist_main_program / executor plan objects).
+        self.last_lowered = None
+        self.last_compiled = None
         functools.update_wrapper(self, fn)
 
     @property
@@ -227,6 +231,8 @@ class StaticFunction:
             # AOT trace+compile; pure() runs once with tracers here.
             lowered = jitted.lower(state_vals_outer, arg_arrays)
             compiled_exe = lowered.compile()
+            self.last_lowered = lowered
+            self.last_compiled = compiled_exe
         finally:
             # Tracing bound tracers into the live objects (params, RNG key);
             # restore the real arrays for the pre-existing leaves.
