@@ -1,0 +1,90 @@
+"""Inference Predictor tests (reference: AnalysisPredictor /
+paddle_infer.Config+create_predictor; test strategy: api tests in
+test/inference/).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import Config, PrecisionType, create_predictor
+
+
+def _net(seed=3):
+    pt.seed(seed)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def test_predictor_handles_roundtrip():
+    net = _net()
+    x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+
+    pred = create_predictor(Config(layer=net))
+    names = pred.get_input_names()
+    assert len(names) == 1
+    h = pred.get_input_handle(names[0])
+    h.copy_from_cpu(x)
+    assert pred.run() is True
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+
+    ref = net(pt.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-5)
+
+
+def test_predictor_direct_run_and_shape_cache():
+    net = _net()
+    pred = create_predictor(Config(layer=net))
+    rng = np.random.RandomState(1)
+    o1 = pred.run([rng.randn(2, 8).astype(np.float32)])
+    o2 = pred.run([rng.randn(4, 8).astype(np.float32)])  # new shape
+    o3 = pred.run([rng.randn(2, 8).astype(np.float32)])  # cached
+    assert o1[0].shape == (2, 4) and o2[0].shape == (4, 4)
+    assert len(pred._cache) == 2
+
+
+def test_predictor_bf16_precision():
+    net = _net()
+    cfg = Config(layer=net)
+    cfg.enable_low_precision(PrecisionType.Bfloat16)
+    pred = create_predictor(cfg)
+    x = np.random.RandomState(2).randn(2, 8).astype(np.float32)
+    out = pred.run([x])[0]
+    ref = np.asarray(net(pt.to_tensor(x)).numpy())
+    np.testing.assert_allclose(out.astype(np.float32), ref, rtol=5e-2,
+                               atol=5e-2)
+
+
+def test_predictor_clone_shares_weights():
+    net = _net()
+    pred = create_predictor(Config(layer=net))
+    x = np.random.RandomState(3).randn(2, 8).astype(np.float32)
+    a = pred.run([x])[0]
+    b = pred.clone().run([x])[0]
+    np.testing.assert_allclose(a, b)
+
+
+def test_predictor_from_saved_model(tmp_path):
+    class TinyNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 4)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    # make the class importable for the loader
+    import tests.test_inference as me
+
+    me.TinyNet = TinyNet
+    TinyNet.__module__ = "tests.test_inference"
+    TinyNet.__qualname__ = "TinyNet"
+
+    net = TinyNet()
+    path = str(tmp_path / "model")
+    pt.jit.save(net, path)
+    pred = create_predictor(Config(path))
+    x = np.random.RandomState(4).randn(2, 8).astype(np.float32)
+    out = pred.run([x])[0]
+    ref = np.asarray(net(pt.to_tensor(x)).numpy())
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
