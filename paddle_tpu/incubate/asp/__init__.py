@@ -1,0 +1,163 @@
+"""ASP: n:m (default 2:4) structured sparsity training.
+
+Re-design of python/paddle/incubate/asp/asp.py (ASPHelper,
+``prune_model``, ``decorate``, ``calculate_density``) and the mask
+generators in incubate/asp/utils.py (get_mask_1d / get_mask_2d_greedy /
+get_mask_2d_best).
+
+The reference targets NVIDIA sparse tensor cores (2:4 hardware). TPUs
+have no sparse-MXU mode, so the capability carried over is the
+*training* discipline: prune weights to an n:m pattern and keep them
+pruned through optimization (mask re-applied after every optimizer
+step), producing checkpoints deployable on sparse hardware or prunable
+for bandwidth. Masks are plain device arrays; the masked update fuses
+into the captured step like any other elementwise op.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = [
+    "calculate_density", "check_sparsity", "get_mask_1d",
+    "get_mask_2d_greedy", "prune_model", "decorate",
+    "set_excluded_layers", "reset_excluded_layers",
+    "OptimizerWithSparsityGuarantee",
+]
+
+# layer-name exclusions per model id (reference ASPHelper MASK maps)
+_EXCLUDED: dict[int, set] = {}
+# id(param) -> (param, mask Tensor)
+_MASKS: dict[int, tuple] = {}
+
+
+def calculate_density(x) -> float:
+    """Fraction of nonzeros (reference asp.py calculate_density)."""
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def get_mask_1d(weight: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """Keep the ``n`` largest-magnitude entries in every group of ``m``
+    consecutive elements along the last axis (reference
+    incubate/asp/utils.py get_mask_1d)."""
+    w = np.asarray(weight)
+    flat = w.reshape(-1, m) if w.size % m == 0 else None
+    if flat is None:
+        raise ValueError(f"weight size {w.size} not divisible by m={m}")
+    idx = np.argsort(-np.abs(flat), axis=1)[:, :n]
+    mask = np.zeros_like(flat, dtype=w.dtype)
+    np.put_along_axis(mask, idx, 1.0, axis=1)
+    return mask.reshape(w.shape)
+
+
+def get_mask_2d_greedy(weight: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """2-D variant: n:m along rows AND columns of each m×m tile, greedy
+    (reference get_mask_2d_greedy). Falls back to 1-D for non-2D."""
+    w = np.asarray(weight)
+    if w.ndim != 2 or w.shape[0] % m or w.shape[1] % m:
+        return get_mask_1d(w, n, m)
+    mask = np.zeros_like(w)
+    for i0 in range(0, w.shape[0], m):
+        for j0 in range(0, w.shape[1], m):
+            tile = np.abs(w[i0:i0 + m, j0:j0 + m])
+            tmask = np.zeros((m, m), dtype=w.dtype)
+            order = np.dstack(np.unravel_index(
+                np.argsort(-tile, axis=None), (m, m)))[0]
+            rows = np.zeros(m, int)
+            cols = np.zeros(m, int)
+            for r, c in order:
+                if rows[r] < n and cols[c] < n:
+                    tmask[r, c] = 1.0
+                    rows[r] += 1
+                    cols[c] += 1
+            mask[i0:i0 + m, j0:j0 + m] = tmask
+    return mask
+
+
+_MASK_ALGOS = {
+    "mask_1d": get_mask_1d,
+    "mask_2d_greedy": get_mask_2d_greedy,
+    "mask_2d_best": get_mask_2d_greedy,  # greedy is the deployable subset
+}
+
+
+def check_sparsity(x, n: int = 2, m: int = 4) -> bool:
+    """True iff every m-group along the last axis has <= (m - n) nonzeros
+    ... i.e. at most ``n`` nonzeros (reference check_mask_1d)."""
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    if arr.size % m:
+        return False
+    groups = arr.reshape(-1, m)
+    return bool((np.count_nonzero(groups, axis=1) <= n).all())
+
+
+def set_excluded_layers(model, layer_names):
+    """Skip these sublayer names when pruning (reference
+    asp.set_excluded_layers)."""
+    _EXCLUDED.setdefault(id(model), set()).update(layer_names)
+
+
+def reset_excluded_layers(model=None):
+    if model is None:
+        _EXCLUDED.clear()
+    else:
+        _EXCLUDED.pop(id(model), None)
+
+
+def _prunable_params(model):
+    """(name, param) pairs eligible for n:m pruning: 2-D+ weights of
+    Linear/Conv-family sublayers (reference ASPHelper._is_supported_layer)."""
+    excluded = _EXCLUDED.get(id(model), set())
+    out = []
+    for name, layer in model.named_sublayers(include_self=True):
+        if name in excluded:
+            continue
+        w = getattr(layer, "weight", None)
+        if w is not None and isinstance(w, Tensor) and w.ndim >= 2:
+            out.append((name or type(layer).__name__, w))
+    return out
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True):
+    """Prune supported weights to n:m and (with_mask) register masks so a
+    decorated optimizer keeps them sparse (reference asp.prune_model)."""
+    algo = _MASK_ALGOS[mask_algo]
+    masks = {}
+    for name, w in _prunable_params(model):
+        mask_np = algo(np.asarray(w.numpy()), n, m)
+        masked = np.asarray(w.numpy()) * mask_np
+        w.set_value(Tensor(jnp.asarray(masked)))
+        mask_t = Tensor(jnp.asarray(mask_np), stop_gradient=True)
+        masks[name] = mask_t
+        if with_mask:
+            _MASKS[id(w)] = (w, mask_t)
+    return masks
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies registered masks after every step (reference
+    asp.decorate → OptimizerWithSparsityGuarantee: the mask multiply the
+    reference does with assign ops lands here as one fused elementwise)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def step(self):
+        self._optimizer.step()
+        for w, mask in list(_MASKS.values()):
+            w.set_value(Tensor(w._data * mask._data))
+
+    def __getattr__(self, name):
+        return getattr(self._optimizer, name)
+
+
+def decorate(optimizer) -> OptimizerWithSparsityGuarantee:
+    return OptimizerWithSparsityGuarantee(optimizer)

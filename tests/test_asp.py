@@ -1,0 +1,78 @@
+"""ASP n:m structured-sparsity tests (reference: test/asp/ —
+prune_model produces valid 2:4 masks; decorated optimizer preserves
+sparsity through training steps)."""
+
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate import asp
+from paddle_tpu.optimizer import SGD
+
+
+def test_mask_1d_pattern_and_density():
+    rng = np.random.RandomState(0)
+    w = rng.randn(8, 16).astype(np.float32)
+    mask = asp.get_mask_1d(w, 2, 4)
+    assert asp.check_sparsity(mask, 2, 4)
+    assert asp.calculate_density(mask) == 0.5
+    # keeps the largest-magnitude entries per group
+    grp = (np.abs(w) * mask).reshape(-1, 4).sum(1)
+    best2 = np.sort(np.abs(w).reshape(-1, 4), axis=1)[:, -2:].sum(1)
+    np.testing.assert_allclose(grp, best2, rtol=1e-6)
+
+
+def test_mask_2d_greedy_both_axes():
+    rng = np.random.RandomState(1)
+    w = rng.randn(8, 8).astype(np.float32)
+    mask = asp.get_mask_2d_greedy(w, 2, 4)
+    m = mask.reshape(2, 4, 2, 4)
+    # each 4x4 tile: every row and column has exactly 2 nonzeros
+    for i in range(2):
+        for j in range(2):
+            tile = mask[i*4:(i+1)*4, j*4:(j+1)*4]
+            assert (np.count_nonzero(tile, axis=0) == 2).all()
+            assert (np.count_nonzero(tile, axis=1) == 2).all()
+
+
+def test_prune_model_and_sparse_training():
+    pt.seed(5)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    asp.prune_model(net, n=2, m=4)
+    for _, layer in net.named_sublayers():
+        w = getattr(layer, "weight", None)
+        if w is not None:
+            assert asp.check_sparsity(w, 2, 4)
+
+    opt = asp.decorate(SGD(learning_rate=0.1, parameters=net.parameters()))
+    rng = np.random.RandomState(0)
+    X = pt.to_tensor(rng.randn(16, 8).astype(np.float32))
+    Y = pt.to_tensor(rng.randint(0, 4, size=(16,)))
+    losses = []
+    for _ in range(5):
+        loss = nn.functional.cross_entropy(net(X), Y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    # sparsity survived training
+    for _, layer in net.named_sublayers():
+        w = getattr(layer, "weight", None)
+        if w is not None:
+            assert asp.check_sparsity(w, 2, 4)
+    asp.reset_excluded_layers()
+
+
+def test_excluded_layers():
+    pt.seed(6)
+    net = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+    names = [n for n, _ in net.named_sublayers()]
+    asp.set_excluded_layers(net, [names[0]])
+    asp.prune_model(net, 2, 4)
+    w0 = net[0].weight
+    w1 = net[1].weight
+    assert not asp.check_sparsity(w0, 2, 4) or \
+        asp.calculate_density(w0) > 0.5  # untouched dense weight
+    assert asp.check_sparsity(w1, 2, 4)
+    asp.reset_excluded_layers(net)
