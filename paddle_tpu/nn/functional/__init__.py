@@ -11,5 +11,7 @@ from .input import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
+from .vision import *  # noqa: F401,F403
 
-from . import activation, attention, common, conv, input, loss, norm, pooling
+from . import (activation, attention, common, conv, input, loss, norm,
+               pooling, vision)

@@ -1,0 +1,101 @@
+"""Vision functionals: grid_sample, affine_grid.
+
+Reference: python/paddle/nn/functional/vision.py (affine_grid:34,
+grid_sample:263; phi kernels grid_sample_kernel.cu, affine_grid_kernel).
+Both are gather/interpolation expressions XLA fuses; no custom kernel
+needed on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+
+__all__ = ["grid_sample", "affine_grid"]
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) / 2.0 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) / 2.0
+
+
+def _reflect(x, lo, hi):
+    # reflect coordinates into [lo, hi] (triangle wave)
+    rng = hi - lo
+    if rng <= 0:
+        return jnp.zeros_like(x)
+    x = jnp.abs(x - lo) % (2 * rng)
+    return lo + jnp.where(x > rng, 2 * rng - x, x)
+
+
+@op("grid_sample")
+def grid_sample(x, grid, mode: str = "bilinear", padding_mode: str = "zeros",
+                align_corners: bool = True):
+    """x [N, C, H, W], grid [N, Ho, Wo, 2] in [-1, 1] -> [N, C, Ho, Wo]."""
+    N, C, H, W = x.shape
+    gx = _unnormalize(grid[..., 0], W, align_corners)
+    gy = _unnormalize(grid[..., 1], H, align_corners)
+
+    if padding_mode == "border":
+        gx = jnp.clip(gx, 0, W - 1)
+        gy = jnp.clip(gy, 0, H - 1)
+    elif padding_mode == "reflection":
+        if align_corners:
+            gx = _reflect(gx, 0, W - 1)
+            gy = _reflect(gy, 0, H - 1)
+        else:
+            gx = jnp.clip(_reflect(gx, -0.5, W - 0.5), 0, W - 1)
+            gy = jnp.clip(_reflect(gy, -0.5, H - 0.5), 0, H - 1)
+
+    def sample(feat, yy, xx):
+        # feat [C, H, W]
+        if mode == "nearest":
+            yi = jnp.round(yy).astype(jnp.int32)
+            xi = jnp.round(xx).astype(jnp.int32)
+            valid = ((yi >= 0) & (yi < H) & (xi >= 0) & (xi < W))
+            vals = feat[:, jnp.clip(yi, 0, H - 1), jnp.clip(xi, 0, W - 1)]
+            return jnp.where(valid[None], vals, 0.0)
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1, x1 = y0 + 1, x0 + 1
+        wy1 = yy - y0
+        wx1 = xx - x0
+        wy0, wx0 = 1 - wy1, 1 - wx1
+
+        def at(yi, xi):
+            inb = ((yi >= 0) & (yi < H) & (xi >= 0) & (xi < W))
+            v = feat[:, jnp.clip(yi, 0, H - 1), jnp.clip(xi, 0, W - 1)]
+            return jnp.where(inb[None], v, 0.0)
+
+        return (at(y0, x0) * (wy0 * wx0)[None] + at(y0, x1) * (wy0 * wx1)[None]
+                + at(y1, x0) * (wy1 * wx0)[None]
+                + at(y1, x1) * (wy1 * wx1)[None])
+
+    out = jax.vmap(sample)(x, gy, gx)
+    return out.astype(x.dtype)
+
+
+@op("affine_grid")
+def affine_grid(theta, out_shape, align_corners: bool = True):
+    """theta [N, 2, 3] -> sampling grid [N, H, W, 2] (reference
+    affine_grid:34)."""
+    if hasattr(out_shape, "tolist"):
+        out_shape = [int(v) for v in out_shape.tolist()]
+    N, C, H, W = [int(v) for v in out_shape]
+
+    def linspace(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n)
+        step = 2.0 / n
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+
+    ys = linspace(H)
+    xs = linspace(W)
+    yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(xx)
+    base = jnp.stack([xx, yy, ones], axis=-1)          # [H, W, 3]
+    out = jnp.einsum("hwk,njk->nhwj", base, theta)
+    return out.astype(theta.dtype)

@@ -1,6 +1,6 @@
 """paddle_tpu.vision (reference: python/paddle/vision)."""
 
-from . import datasets, models, transforms
+from . import datasets, models, ops, transforms
 from .models import *  # noqa: F401,F403
 
-__all__ = ["models", "transforms", "datasets"]
+__all__ = ["models", "transforms", "datasets", "ops"]

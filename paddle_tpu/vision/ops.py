@@ -1,0 +1,886 @@
+"""Detection / vision operators.
+
+Reference surface: python/paddle/vision/ops.py (yolo_loss:69, yolo_box:277,
+prior_box:438, box_coder:584, deform_conv2d:766, distribute_fpn_proposals:
+1175, psroi_pool:1441, roi_pool:1572, roi_align:1705, nms:1934,
+generate_proposals:2106, matrix_nms:2358) backed in the reference by CUDA
+kernels (phi/kernels/gpu/roi_align_kernel.cu, nms_kernel.cu, ...).
+
+TPU translation: the samplers (roi_align/psroi/deform) are gather+bilinear
+expressions that XLA fuses; the selection ops (nms family) run as
+fixed-shape masked computations on device (suppression matrix instead of
+data-dependent loops) with the final dynamic-size index extraction on
+host — selection outputs are inherently dynamic-shaped, which XLA cannot
+return, and the reference does this postprocessing on CPU-sized data
+anyway.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = [
+    "roi_align", "roi_pool", "psroi_pool", "nms", "matrix_nms",
+    "box_coder", "prior_box", "yolo_box", "yolo_loss",
+    "distribute_fpn_proposals", "generate_proposals", "deform_conv2d",
+    "RoIAlign", "RoIPool", "PSRoIPool", "DeformConv2D",
+    "ConvNormActivation",
+]
+
+
+def _rois_with_batch(boxes, boxes_num):
+    """[sum_n, 4] boxes + per-image counts -> [sum_n] batch indices."""
+    counts = jnp.asarray(boxes_num)
+    return jnp.repeat(jnp.arange(counts.shape[0]), counts,
+                      total_repeat_length=boxes.shape[0])
+
+
+def _bilinear_gather(feat, y, x):
+    """feat [C, H, W]; y/x arbitrary same-shaped coords -> [C, *coords]."""
+    C, H, W = feat.shape
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1, x1 = y0 + 1, x0 + 1
+    wy1 = y - y0
+    wx1 = x - x0
+    wy0, wx0 = 1.0 - wy1, 1.0 - wx1
+
+    def at(yy, xx):
+        yy = jnp.clip(yy, 0, H - 1)
+        xx = jnp.clip(xx, 0, W - 1)
+        return feat[:, yy, xx]  # [C, *coords]
+
+    valid = (y > -1.0) & (y < H) & (x > -1.0) & (x < W)
+    out = (at(y0, x0) * (wy0 * wx0) + at(y0, x1) * (wy0 * wx1)
+           + at(y1, x0) * (wy1 * wx0) + at(y1, x1) * (wy1 * wx1))
+    return jnp.where(valid, out, 0.0)
+
+
+@op("roi_align")
+def _roi_align_op(x, boxes, batch_idx, *, output_size, spatial_scale,
+                  sampling_ratio, aligned):
+    ph, pw = output_size
+    off = 0.5 if aligned else 0.0
+
+    def one(box, b):
+        feat = x[b]                                   # [C, H, W]
+        x1, y1, x2, y2 = box * spatial_scale - off
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+        # sample grid [ph*sr, pw*sr]
+        ys = y1 + (jnp.arange(ph * sr) + 0.5) * rh / (ph * sr)
+        xs = x1 + (jnp.arange(pw * sr) + 0.5) * rw / (pw * sr)
+        yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+        vals = _bilinear_gather(feat, yy, xx)          # [C, ph*sr, pw*sr]
+        C = vals.shape[0]
+        vals = vals.reshape(C, ph, sr, pw, sr)
+        return vals.mean(axis=(2, 4))                  # [C, ph, pw]
+
+    return jax.vmap(one)(boxes, batch_idx)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """reference vision/ops.py:1705 / roi_align_kernel.cu — averaged
+    bilinear samples per output bin."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    bidx = _rois_with_batch(
+        boxes._data if isinstance(boxes, Tensor) else jnp.asarray(boxes),
+        boxes_num._data if isinstance(boxes_num, Tensor)
+        else jnp.asarray(boxes_num))
+    return _roi_align_op(x, boxes, Tensor(bidx), output_size=tuple(output_size),
+                         spatial_scale=float(spatial_scale),
+                         sampling_ratio=int(sampling_ratio),
+                         aligned=bool(aligned))
+
+
+@op("roi_pool")
+def _roi_pool_op(x, boxes, batch_idx, *, output_size, spatial_scale):
+    ph, pw = output_size
+    H, W = x.shape[2], x.shape[3]
+
+    def one(box, b):
+        feat = x[b]
+        x1 = jnp.floor(box[0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.floor(box[1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.ceil(box[2] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.ceil(box[3] * spatial_scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1, 1)
+        rw = jnp.maximum(x2 - x1, 1)
+        iy = jnp.arange(H)
+        ix = jnp.arange(W)
+
+        def bin_max(i, j):
+            hs = y1 + (i * rh) // ph
+            he = y1 + ((i + 1) * rh + ph - 1) // ph
+            ws = x1 + (j * rw) // pw
+            we = x1 + ((j + 1) * rw + pw - 1) // pw
+            m = ((iy[:, None] >= hs) & (iy[:, None] < he)
+                 & (ix[None, :] >= ws) & (ix[None, :] < we))
+            m = m & (iy[:, None] < H) & (ix[None, :] < W)
+            return jnp.where(m[None], feat, -jnp.inf).max(axis=(1, 2))
+
+        ii, jj = jnp.meshgrid(jnp.arange(ph), jnp.arange(pw), indexing="ij")
+        out = jax.vmap(jax.vmap(bin_max))(ii, jj)      # [ph, pw, C]
+        out = jnp.moveaxis(out, -1, 0)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return jax.vmap(one)(boxes, batch_idx)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """reference vision/ops.py:1572 — max pool per quantized bin."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    bidx = _rois_with_batch(
+        boxes._data if isinstance(boxes, Tensor) else jnp.asarray(boxes),
+        boxes_num._data if isinstance(boxes_num, Tensor)
+        else jnp.asarray(boxes_num))
+    return _roi_pool_op(x, boxes, Tensor(bidx),
+                        output_size=tuple(output_size),
+                        spatial_scale=float(spatial_scale))
+
+
+@op("psroi_pool")
+def _psroi_pool_op(x, boxes, batch_idx, *, output_size, spatial_scale,
+                   out_channels):
+    ph, pw = output_size
+    H, W = x.shape[2], x.shape[3]
+
+    def one(box, b):
+        feat = x[b]                                    # [C, H, W]
+        x1 = box[0] * spatial_scale
+        y1 = box[1] * spatial_scale
+        x2 = box[2] * spatial_scale
+        y2 = box[3] * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        iy = jnp.arange(H)
+        ix = jnp.arange(W)
+
+        def bin_mean(c_out, i, j):
+            hs = jnp.floor(y1 + i * bin_h).astype(jnp.int32)
+            he = jnp.ceil(y1 + (i + 1) * bin_h).astype(jnp.int32)
+            ws = jnp.floor(x1 + j * bin_w).astype(jnp.int32)
+            we = jnp.ceil(x1 + (j + 1) * bin_w).astype(jnp.int32)
+            m = ((iy[:, None] >= hs) & (iy[:, None] < he)
+                 & (ix[None, :] >= ws) & (ix[None, :] < we))
+            c_in = (c_out * ph + i) * pw + j           # position-sensitive
+            vals = jnp.where(m, feat[c_in], 0.0)
+            cnt = jnp.maximum(m.sum(), 1)
+            return vals.sum() / cnt
+
+        cc, ii, jj = jnp.meshgrid(jnp.arange(out_channels), jnp.arange(ph),
+                                  jnp.arange(pw), indexing="ij")
+        f = jax.vmap(jax.vmap(jax.vmap(bin_mean)))
+        return f(cc, ii, jj)                           # [C_out, ph, pw]
+
+    return jax.vmap(one)(boxes, batch_idx)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """reference vision/ops.py:1441 — position-sensitive average pool:
+    input channel (c*ph*pw + i*pw + j) feeds output channel c at bin
+    (i, j)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    C = x.shape[1]
+    ph, pw = output_size
+    if C % (ph * pw):
+        raise ValueError(f"channels {C} must be divisible by "
+                         f"output_size^2 {ph * pw}")
+    bidx = _rois_with_batch(
+        boxes._data if isinstance(boxes, Tensor) else jnp.asarray(boxes),
+        boxes_num._data if isinstance(boxes_num, Tensor)
+        else jnp.asarray(boxes_num))
+    return _psroi_pool_op(x, boxes, Tensor(bidx),
+                          output_size=tuple(output_size),
+                          spatial_scale=float(spatial_scale),
+                          out_channels=C // (ph * pw))
+
+
+# ---------------------------------------------------------------------------
+# selection family
+# ---------------------------------------------------------------------------
+
+def _iou_matrix(boxes):
+    """[N, 4] xyxy -> [N, N] IoU."""
+    area = jnp.maximum(boxes[:, 2] - boxes[:, 0], 0) * \
+        jnp.maximum(boxes[:, 3] - boxes[:, 1], 0)
+    lt = jnp.maximum(boxes[:, None, :2], boxes[None, :, :2])
+    rb = jnp.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _nms_keep_mask(boxes, scores, iou_threshold):
+    """Greedy NMS as a fixed-length device loop over score order."""
+    order = jnp.argsort(-scores)
+    iou = _iou_matrix(boxes)[order][:, order]
+    n = boxes.shape[0]
+
+    def body(i, keep):
+        # suppressed if any higher-ranked kept box overlaps > thr
+        over = jnp.where(jnp.arange(n) < i, keep, False)
+        sup_i = jnp.any(over & (iou[i] > iou_threshold))
+        return keep.at[i].set(~sup_i)
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    inv = jnp.zeros((n,), bool).at[order].set(keep)
+    return inv
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """reference vision/ops.py:1934 (phi nms_kernel.cu): returns kept box
+    indices, score-descending. Device-side suppression matrix + host-side
+    dynamic index extraction."""
+    b = boxes._data if isinstance(boxes, Tensor) else jnp.asarray(boxes)
+    s = None if scores is None else (
+        scores._data if isinstance(scores, Tensor) else jnp.asarray(scores))
+    if s is None:
+        s = -jnp.arange(b.shape[0], dtype=jnp.float32)  # input order
+    if category_idxs is not None:
+        # categorical NMS: offset boxes per category so classes don't
+        # suppress each other (the standard batched-nms trick)
+        c = category_idxs._data if isinstance(category_idxs, Tensor) \
+            else jnp.asarray(category_idxs)
+        off = (c.astype(b.dtype) * (b.max() + 1.0))[:, None]
+        keep = _nms_keep_mask(b + off, s, iou_threshold)
+    else:
+        keep = _nms_keep_mask(b, s, iou_threshold)
+    keep_np = np.asarray(keep)
+    s_np = np.asarray(s)
+    idx = np.nonzero(keep_np)[0]
+    idx = idx[np.argsort(-s_np[idx], kind="stable")]
+    if top_k is not None:
+        idx = idx[:top_k]
+    return Tensor(jnp.asarray(idx.astype(np.int64)), stop_gradient=True)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """reference vision/ops.py:2358 (matrix_nms_kernel): soft decay of
+    scores by pairwise IoU — one matmul-shaped computation, no loop."""
+    b = np.asarray(bboxes.numpy() if isinstance(bboxes, Tensor) else bboxes)
+    s = np.asarray(scores.numpy() if isinstance(scores, Tensor) else scores)
+    N, M = s.shape[0], b.shape[1]
+    outs, indices, rois_num = [], [], []
+    for n in range(N):
+        cls_all, score_all, box_all, idx_all = [], [], [], []
+        for c in range(s.shape[1]):
+            if c == background_label:
+                continue
+            sel = np.nonzero(s[n, c] > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            order = sel[np.argsort(-s[n, c][sel], kind="stable")][:nms_top_k]
+            sc = s[n, c][order]
+            bx = b[n][order]
+            iou = np.asarray(_iou_matrix(jnp.asarray(bx)))
+            iou = np.triu(iou, k=1)
+            max_iou = iou.max(axis=0, initial=0.0)  # per column (lower rank)
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - max_iou[None, :] ** 2)
+                               / gaussian_sigma).min(axis=0, initial=1.0,
+                                                     where=iou > 0)
+            else:
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    d = (1 - iou) / np.maximum(1 - max_iou[:, None], 1e-12)
+                decay = np.where(iou > 0, d, 1.0).min(axis=0, initial=1.0)
+            dec = sc * decay
+            keep = dec >= post_threshold
+            cls_all.append(np.full(keep.sum(), c))
+            score_all.append(dec[keep])
+            box_all.append(bx[keep])
+            idx_all.append(order[keep])
+        if score_all:
+            sc = np.concatenate(score_all)
+            order = np.argsort(-sc, kind="stable")[:keep_top_k]
+            out = np.concatenate([
+                np.concatenate(cls_all)[order, None].astype(np.float32),
+                sc[order, None].astype(np.float32),
+                np.concatenate(box_all)[order]], axis=1)
+            outs.append(out)
+            indices.append(np.concatenate(idx_all)[order])
+            rois_num.append(len(order))
+        else:
+            outs.append(np.zeros((0, 2 + M), np.float32))
+            indices.append(np.zeros((0,), np.int64))
+            rois_num.append(0)
+    out = Tensor(jnp.asarray(np.concatenate(outs)), stop_gradient=True)
+    ret = [out]
+    if return_index:
+        ret.append(Tensor(jnp.asarray(np.concatenate(indices).astype(
+            np.int64)), stop_gradient=True))
+    if return_rois_num:
+        ret.append(Tensor(jnp.asarray(np.asarray(rois_num, np.int32)),
+                          stop_gradient=True))
+    return ret[0] if len(ret) == 1 else tuple(ret)
+
+
+@op("box_coder")
+def _box_coder_op(prior_box, prior_box_var, target_box, *, code_type,
+                  box_normalized, axis):
+    pb = prior_box
+    pw = pb[:, 2] - pb[:, 0] + (0 if box_normalized else 1)
+    ph = pb[:, 3] - pb[:, 1] + (0 if box_normalized else 1)
+    px = pb[:, 0] + pw * 0.5
+    py = pb[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tb = target_box
+        tw = tb[:, 2] - tb[:, 0] + (0 if box_normalized else 1)
+        th = tb[:, 3] - tb[:, 1] + (0 if box_normalized else 1)
+        tx = tb[:, 0] + tw * 0.5
+        ty = tb[:, 1] + th * 0.5
+        out = jnp.stack([(tx[:, None] - px[None]) / pw[None],
+                         (ty[:, None] - py[None]) / ph[None],
+                         jnp.log(tw[:, None] / pw[None]),
+                         jnp.log(th[:, None] / ph[None])], axis=-1)
+        if prior_box_var is not None:
+            out = out / prior_box_var[None]
+        return out
+    # decode_center_size: target [N, M, 4]
+    tb = target_box
+    var = prior_box_var if prior_box_var is not None else None
+    exp = lambda a: a
+    if axis == 0:
+        pw_, ph_, px_, py_ = (a[None, :] for a in (pw, ph, px, py))
+        v = var[None] if var is not None else None
+    else:
+        pw_, ph_, px_, py_ = (a[:, None] for a in (pw, ph, px, py))
+        v = var[:, None] if var is not None else None
+    t = tb * v if v is not None else tb
+    ox = t[..., 0] * pw_ + px_
+    oy = t[..., 1] * ph_ + py_
+    ow = jnp.exp(t[..., 2]) * pw_
+    oh = jnp.exp(t[..., 3]) * ph_
+    sub = 0 if box_normalized else 1
+    return jnp.stack([ox - ow * 0.5, oy - oh * 0.5,
+                      ox + ow * 0.5 - sub, oy + oh * 0.5 - sub], axis=-1)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """reference vision/ops.py:584 — encode/decode boxes against priors."""
+    return _box_coder_op(prior_box, prior_box_var, target_box,
+                         code_type=code_type,
+                         box_normalized=bool(box_normalized), axis=int(axis))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """reference vision/ops.py:438 (SSD prior boxes): host-side numpy box
+    generation (shape depends only on static config)."""
+    H, W = int(input.shape[2]), int(input.shape[3])
+    IH, IW = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] or IW / W
+    step_h = steps[1] or IH / H
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    for h in range(H):
+        for w in range(W):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                if min_max_aspect_ratios_order:
+                    cell.append((cx, cy, ms, ms))
+                    if max_sizes:
+                        bs = math.sqrt(ms * max_sizes[k])
+                        cell.append((cx, cy, bs, bs))
+                    for ar in ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        cell.append((cx, cy, ms * math.sqrt(ar),
+                                     ms / math.sqrt(ar)))
+                else:
+                    for ar in ars:
+                        cell.append((cx, cy, ms * math.sqrt(ar),
+                                     ms / math.sqrt(ar)))
+                    if max_sizes:
+                        bs = math.sqrt(ms * max_sizes[k])
+                        cell.append((cx, cy, bs, bs))
+            boxes.extend(cell)
+    arr = np.asarray(boxes, np.float32).reshape(H, W, -1, 4)
+    out = np.stack([
+        (arr[..., 0] - arr[..., 2] / 2) / IW,
+        (arr[..., 1] - arr[..., 3] / 2) / IH,
+        (arr[..., 0] + arr[..., 2] / 2) / IW,
+        (arr[..., 1] + arr[..., 3] / 2) / IH], axis=-1)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return (Tensor(jnp.asarray(out), stop_gradient=True),
+            Tensor(jnp.asarray(var), stop_gradient=True))
+
+
+@op("yolo_box")
+def _yolo_box_op(x, img_size, *, anchors, class_num, conf_thresh,
+                 downsample_ratio, clip_bbox, scale_x_y, iou_aware,
+                 iou_aware_factor):
+    N, _, H, W = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    if iou_aware:
+        ioup = jax.nn.sigmoid(x[:, :na])
+        x = x[:, na:]
+    x = x.reshape(N, na, 5 + class_num, H, W)
+    gx = (jnp.arange(W))[None, None, None, :]
+    gy = (jnp.arange(H))[None, None, :, None]
+    sx = jax.nn.sigmoid(x[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2
+    sy = jax.nn.sigmoid(x[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+    bx = (gx + sx) / W
+    by = (gy + sy) / H
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / \
+        (W * downsample_ratio)
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / \
+        (H * downsample_ratio)
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    if iou_aware:
+        conf = conf ** (1 - iou_aware_factor) * ioup ** iou_aware_factor
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    iw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    ih = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw / 2) * iw
+    y1 = (by - bh / 2) * ih
+    x2 = (bx + bw / 2) * iw
+    y2 = (by + bh / 2) * ih
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0)
+        y1 = jnp.clip(y1, 0)
+        x2 = jnp.minimum(x2, iw - 1)
+        y2 = jnp.minimum(y2, ih - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(N, -1, 4)
+    mask = (conf > conf_thresh).reshape(N, -1)
+    boxes = jnp.where(mask[..., None], boxes, 0.0)
+    scores = jnp.where(mask[..., None],
+                       probs.transpose(0, 1, 3, 4, 2).reshape(
+                           N, -1, class_num), 0.0)
+    return boxes, scores
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """reference vision/ops.py:277 — decode YOLOv3 head to boxes+scores."""
+    return _yolo_box_op(x, img_size, anchors=tuple(anchors),
+                        class_num=int(class_num),
+                        conf_thresh=float(conf_thresh),
+                        downsample_ratio=int(downsample_ratio),
+                        clip_bbox=bool(clip_bbox),
+                        scale_x_y=float(scale_x_y),
+                        iou_aware=bool(iou_aware),
+                        iou_aware_factor=float(iou_aware_factor))
+
+
+@op("yolo_loss")
+def _yolo_loss_op(x, gt_box, gt_label, *, anchors, anchor_mask, class_num,
+                  ignore_thresh, downsample_ratio, use_label_smooth,
+                  scale_x_y):
+    """Simplified-but-faithful YOLOv3 loss: coordinate (sx/sy BCE + wh L2),
+    objectness BCE with ignore region, class BCE. reference
+    vision/ops.py:69 / phi yolov3_loss kernel."""
+    N, _, H, W = x.shape
+    na = len(anchor_mask)
+    an_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    an = an_all[jnp.asarray(anchor_mask)]
+    x = x.reshape(N, na, 5 + class_num, H, W)
+    px, py = x[:, :, 0], x[:, :, 1]
+    pw, ph = x[:, :, 2], x[:, :, 3]
+    pobj = x[:, :, 4]
+    pcls = x[:, :, 5:]
+
+    inp_w = W * downsample_ratio
+    inp_h = H * downsample_ratio
+    B = gt_box.shape[1]
+
+    gx = gt_box[..., 0] * W          # [N, B] in grid units
+    gy = gt_box[..., 1] * H
+    gw = gt_box[..., 2] * inp_w      # pixels
+    gh = gt_box[..., 3] * inp_h
+    valid = (gt_box[..., 2] > 0) & (gt_box[..., 3] > 0)
+
+    # best anchor per gt (IoU of centered wh boxes, all anchors)
+    awh = an_all[None, None]          # [1,1,A,2]
+    inter = jnp.minimum(gw[..., None], awh[..., 0]) * \
+        jnp.minimum(gh[..., None], awh[..., 1])
+    union = gw[..., None] * gh[..., None] + awh[..., 0] * awh[..., 1] - inter
+    an_iou = inter / jnp.maximum(union, 1e-9)
+    best = jnp.argmax(an_iou, axis=-1)                    # [N, B]
+
+    amask = jnp.asarray(anchor_mask)
+    # local anchor slot of the best anchor (or -1)
+    slot = jnp.argmax(best[..., None] == amask[None, None], axis=-1)
+    has = jnp.any(best[..., None] == amask[None, None], axis=-1) & valid
+
+    gi = jnp.clip(gx.astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip(gy.astype(jnp.int32), 0, H - 1)
+
+    # build dense targets via scatter
+    tobj = jnp.zeros((N, na, H, W))
+    tx = jnp.zeros((N, na, H, W))
+    ty = jnp.zeros((N, na, H, W))
+    tw = jnp.zeros((N, na, H, W))
+    th = jnp.zeros((N, na, H, W))
+    tscale = jnp.zeros((N, na, H, W))
+    tcls = jnp.zeros((N, na, class_num, H, W))
+    bidx = jnp.broadcast_to(jnp.arange(N)[:, None], (N, B))
+    w_sel = jnp.where(has, 1.0, 0.0)
+    tobj = tobj.at[bidx, slot, gj, gi].max(w_sel)
+    tx = tx.at[bidx, slot, gj, gi].set(gx - gi)
+    ty = ty.at[bidx, slot, gj, gi].set(gy - gj)
+    aw = an[slot]
+    tw = tw.at[bidx, slot, gj, gi].set(
+        jnp.log(jnp.maximum(gw / jnp.maximum(aw[..., 0], 1e-9), 1e-9)))
+    th = th.at[bidx, slot, gj, gi].set(
+        jnp.log(jnp.maximum(gh / jnp.maximum(aw[..., 1], 1e-9), 1e-9)))
+    tscale = tscale.at[bidx, slot, gj, gi].set(
+        (2.0 - gt_box[..., 2] * gt_box[..., 3]) * w_sel)
+    tcls = tcls.at[bidx, slot, gt_label, gj, gi].set(w_sel)
+
+    bce = lambda p, t: jnp.maximum(p, 0) - p * t + jnp.log1p(
+        jnp.exp(-jnp.abs(p)))
+    obj_mask = tobj > 0
+    loss_xy = (tscale * (bce(px, tx) + bce(py, ty))).sum(axis=(1, 2, 3))
+    loss_wh = (tscale * 0.5 * ((pw - tw) ** 2 + (ph - th) ** 2)).sum(
+        axis=(1, 2, 3))
+    # ignore mask: predicted boxes overlapping any gt above thresh
+    sxp = (jax.nn.sigmoid(px) + jnp.arange(W)[None, None, None]) / W
+    syp = (jax.nn.sigmoid(py) + jnp.arange(H)[None, None, :, None]) / H
+    swp = jnp.exp(pw) * an[None, :, 0, None, None] / inp_w
+    shp = jnp.exp(ph) * an[None, :, 1, None, None] / inp_h
+    pb = jnp.stack([sxp - swp / 2, syp - shp / 2, sxp + swp / 2,
+                    syp + shp / 2], -1).reshape(N, -1, 4)
+    gb = jnp.stack([gt_box[..., 0] - gt_box[..., 2] / 2,
+                    gt_box[..., 1] - gt_box[..., 3] / 2,
+                    gt_box[..., 0] + gt_box[..., 2] / 2,
+                    gt_box[..., 1] + gt_box[..., 3] / 2], -1)
+    lt = jnp.maximum(pb[:, :, None, :2], gb[:, None, :, :2])
+    rb = jnp.minimum(pb[:, :, None, 2:], gb[:, None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter2 = wh[..., 0] * wh[..., 1]
+    pa = jnp.maximum(pb[..., 2] - pb[..., 0], 0) * \
+        jnp.maximum(pb[..., 3] - pb[..., 1], 0)
+    ga = jnp.maximum(gb[..., 2] - gb[..., 0], 0) * \
+        jnp.maximum(gb[..., 3] - gb[..., 1], 0)
+    iou = inter2 / jnp.maximum(pa[:, :, None] + ga[:, None] - inter2, 1e-9)
+    iou = jnp.where(valid[:, None, :], iou, 0.0)
+    ignore = (iou.max(-1) > ignore_thresh).reshape(N, na, H, W)
+    noobj = (~obj_mask) & (~ignore)
+    loss_obj = (jnp.where(obj_mask, bce(pobj, jnp.ones_like(pobj)), 0)
+                + jnp.where(noobj, bce(pobj, jnp.zeros_like(pobj)), 0)
+                ).sum(axis=(1, 2, 3))
+    smooth = 1.0 / class_num if use_label_smooth else 0.0
+    tcls_s = tcls * (1 - 2 * smooth) + smooth if use_label_smooth else tcls
+    loss_cls = (obj_mask[:, :, None] * bce(pcls, tcls_s)).sum(
+        axis=(1, 2, 3, 4))
+    return loss_xy + loss_wh + loss_obj + loss_cls
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """reference vision/ops.py:69 — YOLOv3 training loss per image."""
+    return _yolo_loss_op(x, gt_box, gt_label, anchors=tuple(anchors),
+                         anchor_mask=tuple(anchor_mask),
+                         class_num=int(class_num),
+                         ignore_thresh=float(ignore_thresh),
+                         downsample_ratio=int(downsample_ratio),
+                         use_label_smooth=bool(use_label_smooth),
+                         scale_x_y=float(scale_x_y))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """reference vision/ops.py:1175 — route each RoI to its FPN level by
+    sqrt(area) scale rule. Host-side (selection output is dynamic)."""
+    rois = np.asarray(fpn_rois.numpy() if isinstance(fpn_rois, Tensor)
+                      else fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.maximum(rois[:, 2] - rois[:, 0] + off, 0)
+                    * np.maximum(rois[:, 3] - rois[:, 1] + off, 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, idxs = [], []
+    order = []
+    for L in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl == L)[0]
+        outs.append(Tensor(jnp.asarray(rois[sel]), stop_gradient=True))
+        order.append(sel)
+    restore = np.argsort(np.concatenate(order), kind="stable")
+    ret_num = None
+    if rois_num is not None:
+        rn = np.asarray(rois_num.numpy() if isinstance(rois_num, Tensor)
+                        else rois_num)
+        bounds = np.cumsum(rn)
+        img_of = np.searchsorted(bounds, np.arange(rois.shape[0]),
+                                 side="right")
+        ret_num = [Tensor(jnp.asarray(np.asarray(
+            [(img_of[o] == i).sum() for i in range(len(rn))], np.int32)),
+            stop_gradient=True) for o in order]
+    restore_t = Tensor(jnp.asarray(restore[:, None].astype(np.int32)),
+                       stop_gradient=True)
+    if rois_num is not None:
+        return outs, restore_t, ret_num
+    return outs, restore_t
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """reference vision/ops.py:2106 — RPN proposal generation: decode
+    deltas against anchors, clip, filter small, NMS. Host-driven with
+    device math."""
+    N = scores.shape[0]
+    s = np.asarray(scores.numpy() if isinstance(scores, Tensor) else scores)
+    d = np.asarray(bbox_deltas.numpy() if isinstance(bbox_deltas, Tensor)
+                   else bbox_deltas)
+    ims = np.asarray(img_size.numpy() if isinstance(img_size, Tensor)
+                     else img_size)
+    an = np.asarray(anchors.numpy() if isinstance(anchors, Tensor)
+                    else anchors).reshape(-1, 4)
+    var = np.asarray(variances.numpy() if isinstance(variances, Tensor)
+                     else variances).reshape(-1, 4)
+    off = 1.0 if pixel_offset else 0.0
+    all_rois, all_num = [], []
+    for n in range(N):
+        sc = s[n].transpose(1, 2, 0).reshape(-1)
+        dl = d[n].transpose(1, 2, 0).reshape(-1, 4)
+        order = np.argsort(-sc, kind="stable")[:pre_nms_top_n]
+        sc, dl, a, v = sc[order], dl[order], an[order], var[order]
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        ax = a[:, 0] + aw * 0.5
+        ay = a[:, 1] + ah * 0.5
+        cx = v[:, 0] * dl[:, 0] * aw + ax
+        cy = v[:, 1] * dl[:, 1] * ah + ay
+        w = np.exp(np.minimum(v[:, 2] * dl[:, 2], np.log(1000 / 16))) * aw
+        h = np.exp(np.minimum(v[:, 3] * dl[:, 3], np.log(1000 / 16))) * ah
+        props = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - off, cy + h / 2 - off], axis=1)
+        H_im, W_im = ims[n][0], ims[n][1]
+        props[:, 0] = np.clip(props[:, 0], 0, W_im - off)
+        props[:, 1] = np.clip(props[:, 1], 0, H_im - off)
+        props[:, 2] = np.clip(props[:, 2], 0, W_im - off)
+        props[:, 3] = np.clip(props[:, 3], 0, H_im - off)
+        keep = ((props[:, 2] - props[:, 0] + off >= min_size)
+                & (props[:, 3] - props[:, 1] + off >= min_size))
+        props, sc = props[keep], sc[keep]
+        if props.shape[0]:
+            ki = np.asarray(nms(jnp.asarray(props), nms_thresh,
+                                scores=jnp.asarray(sc)).numpy())
+            ki = ki[:post_nms_top_n]
+            props, sc = props[ki], sc[ki]
+        all_rois.append(props)
+        all_num.append(props.shape[0])
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois).astype(np.float32)),
+                  stop_gradient=True)
+    nums = Tensor(jnp.asarray(np.asarray(all_num, np.int32)),
+                  stop_gradient=True)
+    if return_rois_num:
+        return rois, nums
+    return rois
+
+
+# ---------------------------------------------------------------------------
+# deformable conv
+# ---------------------------------------------------------------------------
+
+@op("deform_conv2d")
+def _deform_conv2d_op(x, offset, weight, bias, mask, *, stride, padding,
+                      dilation, deformable_groups, groups):
+    N, C, H, W = x.shape
+    Co, Cg, kh, kw = weight.shape
+    sh, sw = stride
+    ph, pw = padding
+    dh, dw = dilation
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+
+    oy = jnp.arange(Ho) * sh
+    ox = jnp.arange(Wo) * sw
+    # per-tap kernel coordinates, flattened tap index t = ky*kw + kx
+    ky = jnp.repeat(jnp.arange(kh), kw)            # [kh*kw]
+    kx = jnp.tile(jnp.arange(kw), kh)              # [kh*kw]
+    # offset: [N, dg*2*kh*kw, Ho, Wo]
+    offs = offset.reshape(N, deformable_groups, 2, kh * kw, Ho, Wo)
+    msk = (jnp.ones((N, deformable_groups, kh * kw, Ho, Wo))
+           if mask is None else
+           mask.reshape(N, deformable_groups, kh * kw, Ho, Wo))
+    cg_per_dg = C // deformable_groups
+
+    def sample_one(xp_n, off_n, msk_n):
+        def per_dg(feat, off_dg, m_dg):
+            # feat [cg, H+2ph, W+2pw]; off_dg [2, khkw, Ho, Wo]
+            yy = (oy[None, :, None] + (ky * dh)[:, None, None]
+                  + off_dg[0])
+            xx = (ox[None, None, :] + (kx * dw)[:, None, None]
+                  + off_dg[1])
+            vals = _bilinear_gather(feat, yy, xx)       # [cg, khkw, Ho, Wo]
+            return vals * m_dg[None]
+
+        feats = xp_n.reshape(deformable_groups, cg_per_dg, *xp_n.shape[1:])
+        vals = jax.vmap(per_dg)(feats, off_n, msk_n)
+        return vals.reshape(C, kh * kw, Ho, Wo)
+
+    sampled = jax.vmap(sample_one)(xp, offs, msk)       # [N, C, khkw, Ho, Wo]
+    wmat = weight.reshape(groups, Co // groups, Cg * kh * kw)
+    sampled = sampled.reshape(N, groups, Cg, kh * kw, Ho, Wo) \
+        .reshape(N, groups, Cg * kh * kw, Ho * Wo)
+    out = jnp.einsum("ngkp,gok->ngop", sampled, wmat,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(N, Co, Ho, Wo).astype(x.dtype)
+    if bias is not None:
+        out = out + bias[None, :, None, None]
+    return out
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """reference vision/ops.py:766 (deformable_conv kernel): bilinear
+    sampling at offset-shifted taps, then a grouped matmul — v2 when
+    ``mask`` given, v1 otherwise."""
+    pair = lambda v: (v, v) if isinstance(v, int) else tuple(v)
+    return _deform_conv2d_op(x, offset, weight, bias, mask,
+                             stride=pair(stride), padding=pair(padding),
+                             dilation=pair(dilation),
+                             deformable_groups=int(deformable_groups),
+                             groups=int(groups))
+
+
+# ---------------------------------------------------------------------------
+# Layer wrappers
+# ---------------------------------------------------------------------------
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale, aligned=aligned)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+class DeformConv2D(Layer):
+    """reference vision/ops.py:973."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn import initializer as I
+
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._attrs = dict(stride=stride, padding=padding, dilation=dilation,
+                           deformable_groups=deformable_groups, groups=groups)
+        fan_in = in_channels * ks[0] * ks[1] / groups
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, ks[0], ks[1]],
+            attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                [out_channels], attr=bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             mask=mask, **self._attrs)
+
+
+class ConvNormActivation(Layer):
+    """Conv2D + Norm + Activation block (reference vision/ops.py:1877)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size=3, stride=1,
+                 padding=None, groups=1, norm_layer=None,
+                 activation_layer=None, dilation=1, bias=None):
+        super().__init__()
+        from ..nn import BatchNorm2D, Conv2D, ReLU
+
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        if norm_layer is None:
+            norm_layer = BatchNorm2D
+        if activation_layer is None:
+            activation_layer = ReLU
+        if bias is None:
+            bias = norm_layer is None
+        layers = [Conv2D(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation=dilation, groups=groups,
+                         bias_attr=None if bias else False)]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        self._layers = layers
+        for i, l in enumerate(layers):
+            self.add_sublayer(str(i), l)
+
+    def forward(self, x):
+        for l in self._layers:
+            x = l(x)
+        return x

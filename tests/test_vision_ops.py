@@ -1,0 +1,214 @@
+"""Detection/vision op tests vs NumPy references (reference test files:
+test/legacy_test/test_roi_align_op.py, test_nms_op.py, test_box_coder_op.py,
+test_yolo_box_op.py, test_grid_sampler_op.py — same numeric-reference
+strategy, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops as vops
+
+
+def test_roi_align_unit_box():
+    # a 1x1-bin aligned RoI over a linear ramp: value at box center
+    H = W = 8
+    feat = np.arange(H * W, dtype=np.float32).reshape(1, 1, H, W)
+    boxes = np.array([[1.0, 1.0, 5.0, 5.0]], np.float32)
+    out = vops.roi_align(pt.to_tensor(feat), pt.to_tensor(boxes),
+                         pt.to_tensor(np.array([1], np.int32)),
+                         output_size=1, sampling_ratio=1, aligned=True)
+    # center of box = (3.0, 3.0) -> bilinear at (2.5, 2.5) after -0.5 offset
+    y = x = 2.5
+    v = (feat[0, 0, 2, 2] * 0.25 + feat[0, 0, 2, 3] * 0.25
+         + feat[0, 0, 3, 2] * 0.25 + feat[0, 0, 3, 3] * 0.25)
+    np.testing.assert_allclose(np.asarray(out.numpy())[0, 0, 0, 0], v,
+                               rtol=1e-5)
+
+
+def test_roi_pool_max_semantics():
+    H = W = 6
+    feat = np.random.RandomState(0).randn(1, 2, H, W).astype(np.float32)
+    boxes = np.array([[0.0, 0.0, 6.0, 6.0]], np.float32)
+    out = vops.roi_pool(pt.to_tensor(feat), pt.to_tensor(boxes),
+                        pt.to_tensor(np.array([1], np.int32)),
+                        output_size=2)
+    got = np.asarray(out.numpy())
+    ref = feat.reshape(2, 2, 3, 2, 3).max(axis=(2, 4))
+    np.testing.assert_allclose(got[0], ref, rtol=1e-5)
+
+
+def test_nms_matches_greedy_numpy():
+    rng = np.random.RandomState(3)
+    centers = rng.rand(40, 2) * 10
+    wh = rng.rand(40, 2) * 4 + 1
+    boxes = np.concatenate([centers - wh / 2, centers + wh / 2],
+                           axis=1).astype(np.float32)
+    scores = rng.rand(40).astype(np.float32)
+
+    def np_nms(b, s, thr):
+        order = np.argsort(-s)
+        keep = []
+        while order.size:
+            i = order[0]
+            keep.append(i)
+            xx1 = np.maximum(b[i, 0], b[order[1:], 0])
+            yy1 = np.maximum(b[i, 1], b[order[1:], 1])
+            xx2 = np.minimum(b[i, 2], b[order[1:], 2])
+            yy2 = np.minimum(b[i, 3], b[order[1:], 3])
+            inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+            a1 = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+            a2 = (b[order[1:], 2] - b[order[1:], 0]) * \
+                (b[order[1:], 3] - b[order[1:], 1])
+            iou = inter / (a1 + a2 - inter)
+            order = order[1:][iou <= thr]
+        return np.asarray(keep)
+
+    got = np.asarray(vops.nms(pt.to_tensor(boxes), 0.4,
+                              scores=pt.to_tensor(scores)).numpy())
+    ref = np_nms(boxes, scores, 0.4)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_box_coder_roundtrip():
+    rng = np.random.RandomState(1)
+    priors = np.abs(rng.rand(5, 4).astype(np.float32))
+    priors[:, 2:] = priors[:, :2] + 1.0 + priors[:, 2:]
+    gt = priors + 0.3
+    var = np.full((5, 4), 0.5, np.float32)
+    enc = vops.box_coder(pt.to_tensor(priors), pt.to_tensor(var),
+                         pt.to_tensor(gt), code_type="encode_center_size")
+    # decode expects [N, M, 4] deltas
+    dec = vops.box_coder(pt.to_tensor(priors), pt.to_tensor(var),
+                         pt.to_tensor(np.asarray(enc.numpy())),
+                         code_type="decode_center_size", axis=1)
+    d = np.asarray(dec.numpy())
+    np.testing.assert_allclose(np.diagonal(d[..., 0]), gt[:, 0], rtol=1e-4)
+    np.testing.assert_allclose(np.diagonal(d[..., 3]), gt[:, 3], rtol=1e-4)
+
+
+def test_prior_box_shapes_and_range():
+    x = pt.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+    img = pt.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+    boxes, var = vops.prior_box(x, img, min_sizes=[4.0], max_sizes=[8.0],
+                                aspect_ratios=[2.0], clip=True)
+    assert boxes.shape[:2] == [4, 4] if isinstance(boxes.shape, list) else \
+        tuple(boxes.shape)[:2] == (4, 4)
+    b = np.asarray(boxes.numpy())
+    assert b.min() >= 0.0 and b.max() <= 1.0
+    assert np.asarray(var.numpy()).shape == b.shape
+
+
+def test_yolo_box_decode_center():
+    # zero logits: sigmoid=0.5 -> box center at cell center
+    na, cls, H = 1, 2, 2
+    x = np.zeros((1, na * (5 + cls), H, H), np.float32)
+    img = np.array([[64, 64]], np.int32)
+    boxes, scores = vops.yolo_box(pt.to_tensor(x), pt.to_tensor(img),
+                                  anchors=[16, 16], class_num=cls,
+                                  conf_thresh=0.0, downsample_ratio=32)
+    b = np.asarray(boxes.numpy()).reshape(H, H, 4)
+    # cell (0,0): center (0.5/2, 0.5/2)*64 = 16; w=h=16/64*64=16
+    np.testing.assert_allclose(b[0, 0], [16 - 8, 16 - 8, 16 + 8, 16 + 8],
+                               atol=1e-4)
+
+
+def test_yolo_loss_finite_and_grad():
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.randn(2, 1 * 7, 4, 4).astype(np.float32) * 0.1,
+                     stop_gradient=False)
+    gt_box = pt.to_tensor(np.array(
+        [[[0.5, 0.5, 0.3, 0.4]], [[0.25, 0.25, 0.2, 0.2]]], np.float32))
+    gt_label = pt.to_tensor(np.zeros((2, 1), np.int32))
+    loss = vops.yolo_loss(x, gt_box, gt_label, anchors=[32, 32],
+                          anchor_mask=[0], class_num=2, ignore_thresh=0.7,
+                          downsample_ratio=32)
+    total = loss.sum()
+    total.backward()
+    assert np.isfinite(float(total.numpy()))
+    assert np.isfinite(np.asarray(x.grad.numpy())).all()
+
+
+def test_grid_sample_identity_and_grad():
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 2, 5, 5).astype(np.float32)
+    theta = np.array([[[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]], np.float32)
+    grid = F.affine_grid(pt.to_tensor(theta), [1, 2, 5, 5],
+                         align_corners=True)
+    xt = pt.to_tensor(x, stop_gradient=False)
+    out = F.grid_sample(xt, grid, align_corners=True)
+    np.testing.assert_allclose(np.asarray(out.numpy()), x, atol=1e-5)
+    out.sum().backward()
+    assert np.asarray(xt.grad.numpy()).shape == x.shape
+
+
+def test_grid_sample_nearest_border():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    # sample far outside with border padding: clamps to edge
+    grid = np.full((1, 1, 1, 2), 5.0, np.float32)
+    out = F.grid_sample(pt.to_tensor(x), pt.to_tensor(grid), mode="nearest",
+                        padding_mode="border")
+    assert float(out.numpy()[0, 0, 0, 0]) == 15.0
+
+
+def test_psroi_pool_channel_routing():
+    # constant per-channel features: output bin (i,j) of channel c equals
+    # the constant of input channel c*ph*pw + i*pw + j
+    C, ph, pw = 8, 2, 2
+    feat = np.zeros((1, C, 6, 6), np.float32)
+    for c in range(C):
+        feat[0, c] = c
+    boxes = np.array([[0.0, 0.0, 6.0, 6.0]], np.float32)
+    out = vops.psroi_pool(pt.to_tensor(feat), pt.to_tensor(boxes),
+                          pt.to_tensor(np.array([1], np.int32)), (ph, pw))
+    got = np.asarray(out.numpy())[0]
+    for c in range(C // (ph * pw)):
+        for i in range(ph):
+            for j in range(pw):
+                assert got[c, i, j] == (c * ph + i) * pw + j
+
+
+def test_deform_conv2d_zero_offset_matches_conv():
+    import paddle_tpu.nn as nn
+
+    rng = np.random.RandomState(4)
+    x = rng.randn(1, 3, 6, 6).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32) * 0.2
+    offset = np.zeros((1, 2 * 9, 4, 4), np.float32)
+    out = vops.deform_conv2d(pt.to_tensor(x), pt.to_tensor(offset),
+                             pt.to_tensor(w))
+    ref = F.conv2d(pt.to_tensor(x), pt.to_tensor(w))
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(ref.numpy()), rtol=1e-3, atol=1e-4)
+
+
+def test_distribute_fpn_proposals_routing():
+    rois = np.array([
+        [0, 0, 10, 10],      # small -> low level
+        [0, 0, 224, 224],    # refer scale -> refer level
+        [0, 0, 500, 500],    # large -> high level
+    ], np.float32)
+    outs, restore = vops.distribute_fpn_proposals(
+        pt.to_tensor(rois), min_level=2, max_level=5, refer_level=4,
+        refer_scale=224)
+    sizes = [np.asarray(o.numpy()).shape[0] for o in outs]
+    assert sum(sizes) == 3 and sizes[0] == 1 and sizes[2] == 1
+    r = np.asarray(restore.numpy()).ravel()
+    cat = np.concatenate([np.asarray(o.numpy()) for o in outs])
+    np.testing.assert_allclose(cat[r], rois)
+
+
+def test_matrix_nms_runs():
+    rng = np.random.RandomState(5)
+    boxes = np.array([[[0, 0, 4, 4], [0.2, 0.2, 4.2, 4.2],
+                       [8, 8, 12, 12]]], np.float32)
+    scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)  # [N, cls, M]
+    scores = np.concatenate([scores, scores * 0.5], axis=1)
+    out, idx, num = vops.matrix_nms(pt.to_tensor(boxes),
+                                    pt.to_tensor(scores),
+                                    score_threshold=0.1, post_threshold=0.0,
+                                    background_label=-1, return_index=True)
+    o = np.asarray(out.numpy())
+    assert o.shape[1] == 6
+    assert int(np.asarray(num.numpy()).sum()) == o.shape[0] > 0
