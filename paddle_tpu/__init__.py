@@ -81,6 +81,8 @@ _LAZY_SUBMODULES = (
     "geometric",
     "quantization",
     "onnx",
+    "signal",
+    "inference",
 )
 
 

@@ -23,6 +23,7 @@ __all__ = [
     "triplet_margin_with_distance_loss", "soft_margin_loss",
     "multi_label_soft_margin_loss", "poisson_nll_loss", "gaussian_nll_loss",
     "dice_loss", "npair_loss", "ctc_loss", "rnnt_loss",
+    "margin_cross_entropy", "hsigmoid_loss",
 ]
 
 
@@ -411,3 +412,73 @@ def rnnt_loss(*args, **kwargs):
         "rnnt_loss: transducer loss planned; reference binds warprnnt "
         "(python/paddle/nn/functional/loss.py 'rnnt_loss')"
     )
+
+
+@op("margin_cross_entropy", amp="keep_fp32")
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace/CosFace-family margin softmax CE (reference
+    phi margin_cross_entropy kernel / nn/functional/common.py). Applies
+    cos(m1*theta + m2) - m3 to the target logit then scaled softmax CE.
+    The mp-sharded-class case rides GSPMD (logits sharded over classes)."""
+    x = logits.astype(jnp.float32)
+    N, C = x.shape
+    onehot = jax.nn.one_hot(label.reshape(-1), C, dtype=jnp.float32)
+    target = jnp.sum(x * onehot, axis=-1)
+    theta = jnp.arccos(jnp.clip(target, -1.0, 1.0))
+    target_m = jnp.cos(margin1 * theta + margin2) - margin3
+    adj = x + onehot * (target_m - target)[:, None]
+    adj = adj * scale
+    lse = jax.scipy.special.logsumexp(adj, axis=-1)
+    loss = lse - jnp.sum(adj * onehot, axis=-1)
+    if reduction == "mean":
+        loss = loss.mean()
+    elif reduction == "sum":
+        loss = loss.sum()
+    if return_softmax:
+        return loss, jax.nn.softmax(adj, axis=-1)
+    return loss
+
+
+@op("hsigmoid_loss", amp="keep_fp32")
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False):
+    """Hierarchical sigmoid loss, default complete-binary-tree coding
+    (reference phi hsigmoid_loss kernel / nn/functional/loss.py
+    hsigmoid_loss). Custom-tree mode uses path_table/path_code."""
+    x = input.astype(jnp.float32)
+    N = x.shape[0]
+    if path_table is None:
+        import math as _math
+
+        code_len = max(1, int(_math.ceil(_math.log2(max(num_classes, 2)))))
+        # complete binary tree: internal node ids along the path of `label`
+        lab = label.reshape(-1) + num_classes  # leaf position in heap order
+
+        def path(lab_i):
+            def body(c, i):
+                node = lab_i >> (i + 1)
+                bit = (lab_i >> i) & 1
+                return c, (node - 1, bit)
+
+            _, (nodes, bits) = jax.lax.scan(
+                body, 0, jnp.arange(code_len))
+            return nodes, bits
+
+        nodes, bits = jax.vmap(path)(lab)          # [N, code_len]
+        valid = nodes >= 0
+        nodes = jnp.clip(nodes, 0, weight.shape[0] - 1)
+    else:
+        nodes = path_table
+        bits = path_code
+        valid = nodes >= 0
+        nodes = jnp.clip(nodes, 0, weight.shape[0] - 1)
+    w = weight[nodes]                              # [N, L, D]
+    logit = jnp.einsum("nld,nd->nl", w.astype(jnp.float32), x)
+    if bias is not None:
+        logit = logit + bias.reshape(-1)[nodes]
+    t = bits.astype(jnp.float32)
+    bce = jnp.maximum(logit, 0) - logit * t + jnp.log1p(
+        jnp.exp(-jnp.abs(logit)))
+    return jnp.sum(jnp.where(valid, bce, 0.0), axis=-1, keepdims=True)

@@ -44,6 +44,8 @@ __all__ = [
     "one_hot",
     "tril_indices",
     "triu_indices",
+    "binomial", "poisson", "standard_gamma", "dirichlet", "exponential_",
+    "complex", "as_complex", "as_real",
 ]
 
 
@@ -263,3 +265,82 @@ def tril_indices(row, col, offset=0) -> Tensor:
 def triu_indices(row, col=None, offset=0) -> Tensor:
     r, c = np.triu_indices(row, offset, col if col is not None else row)
     return Tensor(jnp.asarray(np.stack([r, c]), dtype=jnp.int64))
+
+
+# ---------------------------------------------------------------------------
+# Random-distribution sampling tranche (reference ops.yaml: binomial,
+# poisson, dirichlet, standard_gamma, exponential_, truncated_gaussian)
+# ---------------------------------------------------------------------------
+
+def binomial(count, prob, name=None) -> Tensor:
+    """reference phi binomial kernel; sampling on device via jax.random."""
+    key = prandom.next_key()
+    c = count._data if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob._data if isinstance(prob, Tensor) else jnp.asarray(prob)
+    out = jax.random.binomial(key, c.astype(jnp.float32),
+                              p.astype(jnp.float32))
+    return Tensor(out.astype(jnp.int32), stop_gradient=True)
+
+
+def poisson(x, name=None) -> Tensor:
+    """reference phi poisson kernel: elementwise Poisson(lam=x)."""
+    key = prandom.next_key()
+    lam = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    out = jax.random.poisson(key, lam.astype(jnp.float32))
+    return Tensor(out.astype(lam.dtype), stop_gradient=True)
+
+
+def standard_gamma(x, name=None) -> Tensor:
+    """reference phi standard_gamma: elementwise Gamma(alpha=x, 1)."""
+    key = prandom.next_key()
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.gamma(key, a), stop_gradient=True)
+
+
+def dirichlet(alpha, name=None) -> Tensor:
+    """reference phi dirichlet kernel: samples over the last axis."""
+    key = prandom.next_key()
+    a = alpha._data if isinstance(alpha, Tensor) else jnp.asarray(alpha)
+    g = jax.random.gamma(key, a)
+    return Tensor(g / jnp.sum(g, axis=-1, keepdims=True),
+                  stop_gradient=True)
+
+
+def exponential_(x, lam: float = 1.0, name=None) -> Tensor:
+    """In-place exponential fill (reference Tensor.exponential_)."""
+    key = prandom.next_key()
+    out = jax.random.exponential(key, jnp.shape(x._data)) / lam
+    x._data = out.astype(x._data.dtype)
+    return x
+
+
+def _complex_home(arr):
+    """Complex results live on the CPU device on TPU backends (uploading
+    complex arrays poisons some TPU runtimes — same policy as
+    paddle_tpu.fft, ops/extra.py)."""
+    if jax.default_backend() == "tpu":
+        return jax.device_put(np.asarray(arr), jax.devices("cpu")[0])
+    return jnp.asarray(arr)
+
+
+def complex(real, imag, name=None) -> Tensor:
+    """reference phi complex kernel: real + 1j*imag."""
+    r = np.asarray(real.numpy() if isinstance(real, Tensor) else real)
+    i = np.asarray(imag.numpy() if isinstance(imag, Tensor) else imag)
+    return Tensor(_complex_home(r + 1j * i), stop_gradient=True)
+
+
+def as_complex(x, name=None) -> Tensor:
+    """[..., 2] float -> [...] complex (reference as_complex)."""
+    a = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    return Tensor(_complex_home(a[..., 0] + 1j * a[..., 1]),
+                  stop_gradient=True)
+
+
+def as_real(x, name=None) -> Tensor:
+    """[...] complex -> [..., 2] float (reference as_real)."""
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    out = jnp.stack([a.real, a.imag], axis=-1)
+    if jax.default_backend() == "tpu":
+        out = jnp.asarray(np.asarray(out).astype(np.float32))
+    return Tensor(out, stop_gradient=True)

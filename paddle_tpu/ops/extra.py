@@ -21,6 +21,7 @@ __all__ = [
     "fliplr", "flipud", "take", "unflatten", "ravel", "block_diag",
     "broadcast_tensors", "atleast_1d", "atleast_2d", "atleast_3d",
     "poisson_nll_loss", "pdist", "cdist", "fft",
+    "top_p_sampling", "gather_tree",
 ]
 
 
@@ -382,3 +383,54 @@ class fft:
     @staticmethod
     def ifftshift(x, axes=None, name=None):
         return Tensor(jnp.fft.ifftshift(fft._a(x), axes=axes))
+
+
+# ---------------------------------------------------------------------------
+# generation utilities (reference: top_p_sampling, gather_tree ops)
+# ---------------------------------------------------------------------------
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling (reference phi top_p_sampling kernel): sample from
+    the smallest prefix of the sorted distribution with cumulative
+    probability >= p. Returns (values, ids). x: [B, V] probabilities."""
+    from ..core import random as prandom
+
+    key = (jax.random.PRNGKey(seed) if seed is not None and seed >= 0
+           else prandom.next_key())
+
+    @op("top_p_sampling")
+    def _impl(x, ps, key):
+        sorted_p = jnp.sort(x, axis=-1)[:, ::-1]
+        sorted_i = jnp.argsort(-x, axis=-1)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        # keep tokens strictly before the cumulative threshold, always >= 1
+        keep = cum - sorted_p < ps[:, None]
+        probs = jnp.where(keep, sorted_p, 0.0)
+        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+        choice = jax.vmap(
+            lambda k, p: jax.random.choice(k, p.shape[-1], p=p))(
+            jax.random.split(key, x.shape[0]), probs)
+        ids = jnp.take_along_axis(sorted_i, choice[:, None], axis=-1)
+        vals = jnp.take_along_axis(x, ids, axis=-1)
+        return vals, ids.astype(jnp.int32)
+
+    return _impl(x, ps, Tensor(key))
+
+
+@op("gather_tree", differentiable=False)
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference gather_tree kernel): walk parent
+    pointers from the last step to recover full sequences.
+    ids/parents: [max_time, batch, beam]."""
+    T = ids.shape[0]
+
+    def step(carry, t):
+        beams = carry                       # [batch, beam] current beam idx
+        out_t = jnp.take_along_axis(ids[t], beams, axis=-1)
+        nxt = jnp.take_along_axis(parents[t], beams, axis=-1)
+        return nxt, out_t
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2]),
+                            ids.shape[1:]).astype(ids.dtype)
+    _, outs = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return outs[::-1]

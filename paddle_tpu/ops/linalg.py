@@ -231,3 +231,30 @@ def householder_product(x, tau):
     for i in range(n):
         q = apply_one(i, q)
     return q[..., :, :n]
+
+
+@op("lu_unpack")
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True):
+    """Unpack LU factorization (reference lu_unpack kernel): returns
+    (P, L, U) from combined LU data + 1-based pivots."""
+    m, n = x.shape[-2], x.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(x, -1)[..., :, :k] + jnp.eye(m, k, dtype=x.dtype)
+    U = jnp.triu(x)[..., :k, :]
+    # pivots -> permutation matrix
+    piv = y.astype(jnp.int32) - 1            # [..., k] row swaps
+
+    def perm_from_pivots(p):
+        perm = jnp.arange(m)
+
+        def body(i, perm):
+            j = p[i]
+            pi, pj = perm[i], perm[j]
+            return perm.at[i].set(pj).at[j].set(pi)
+
+        perm = jax.lax.fori_loop(0, p.shape[0], body, perm)
+        return jnp.eye(m, dtype=x.dtype)[perm].T
+
+    P = perm_from_pivots(piv) if piv.ndim == 1 else \
+        jnp.stack([perm_from_pivots(pp) for pp in piv.reshape(-1, piv.shape[-1])]).reshape(piv.shape[:-1] + (m, m))
+    return P, L, U

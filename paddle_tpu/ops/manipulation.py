@@ -9,6 +9,8 @@ from __future__ import annotations
 
 from builtins import slice as _pyslice
 
+import builtins
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -592,3 +594,100 @@ def _getitem_with_tensors(x, items):
 @op("setitem")
 def setitem(x, item, value):
     return x.at[item].set(value)
+
+
+# ---------------------------------------------------------------------------
+# tranche: diag_embed, unstack, sequence_mask, shard_index, temporal_shift
+# (reference ops.yaml entries of the same names)
+# ---------------------------------------------------------------------------
+
+@op("diag_embed")
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    """Batched vectors -> batched diagonal matrices (reference diag_embed)."""
+    x = input
+    n = x.shape[-1] + builtins.abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    if offset >= 0:
+        out = base.at[..., idx, idx + offset].set(x)
+    else:
+        out = base.at[..., idx - offset, idx].set(x)
+    nd = out.ndim
+    d1 = dim1 % nd   # row axis of the embedded matrices
+    d2 = dim2 % nd   # column axis
+    if (d1, d2) != (nd - 2, nd - 1):
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        # insert the matrix axes at their requested positions, lower
+        # position first so the higher index stays valid
+        if d1 < d2:
+            perm.insert(d1, nd - 2)
+            perm.insert(d2, nd - 1)
+        else:
+            perm.insert(d2, nd - 1)
+            perm.insert(d1, nd - 2)
+        out = jnp.transpose(out, tuple(perm))
+    return out
+
+
+def unstack(x, axis=0, num=None):
+    """Split along axis into list of tensors (reference unstack)."""
+    from ..core.tensor import Tensor as _T
+
+    n = num if num is not None else x.shape[axis]
+
+    @op("unstack")
+    def _unstack(x):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(x, n, axis=axis))
+
+    out = _unstack(x)
+    return list(out)
+
+
+@op("sequence_mask", differentiable=False)
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    """lengths -> [.., maxlen] 0/1 mask (reference sequence_mask)."""
+    from ..core.dtype import convert_dtype as _cd
+
+    ml = maxlen if maxlen is not None else int(jnp.max(x))
+    mask = jnp.arange(ml)[None, :] < jnp.reshape(x, (-1, 1))
+    return mask.reshape(tuple(jnp.shape(x)) + (ml,)).astype(_cd(dtype))
+
+
+@op("shard_index", differentiable=False)
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Map global ids to shard-local ids (reference shard_index — PS
+    embedding sharding)."""
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (input // shard_size) == shard_id
+    return jnp.where(in_shard, input % shard_size, ignore_value)
+
+
+@op("temporal_shift")
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """TSM temporal channel shift (reference temporal_shift kernel)."""
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    NT, C, H, W = x.shape
+    N = NT // seg_num
+    v = x.reshape(N, seg_num, C, H, W)
+    c1 = int(C * shift_ratio)
+    c2 = int(C * 2 * shift_ratio)
+    fwd = jnp.concatenate([v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])], 1)
+    bwd = jnp.concatenate([jnp.zeros_like(v[:, :1, c1:c2]),
+                           v[:, :-1, c1:c2]], 1)
+    keep = v[:, :, c2:]
+    out = jnp.concatenate([fwd, bwd, keep], axis=2).reshape(NT, C, H, W)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+@op("numel", differentiable=False)
+def numel(x):
+    return jnp.asarray(jnp.size(x), jnp.int32)
+
+
+@op("is_empty", differentiable=False)
+def is_empty(x):
+    return jnp.asarray(jnp.size(x) == 0)

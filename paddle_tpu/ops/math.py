@@ -443,3 +443,82 @@ def accuracy(input, label, k=1):
     lbl = label._data.reshape(-1, 1)
     correct = jnp.any(topk_idx == lbl, axis=-1)
     return Tensor(jnp.mean(correct.astype(jnp.float32)))
+
+
+# ---------------------------------------------------------------------------
+# Special functions / norms tranche (reference ops.yaml: gammaln, gammaincc,
+# i0e, i1e, p_norm, clip_by_norm, squared_l2_norm, l1_norm, reduce_as)
+# ---------------------------------------------------------------------------
+
+@op("gammaln")
+def gammaln(x):
+    return jax.lax.lgamma(x)
+
+
+@op("gammainc")
+def gammainc(x, y):
+    # paddle.gammainc(x, y) = P(x, y) lower regularized
+    return jax.scipy.special.gammainc(x, y)
+
+
+@op("gammaincc")
+def gammaincc(x, y):
+    return jax.scipy.special.gammaincc(x, y)
+
+
+@op("i0e")
+def i0e(x):
+    return jax.lax.bessel_i0e(x)
+
+
+@op("i1e")
+def i1e(x):
+    return jax.lax.bessel_i1e(x)
+
+
+@op("p_norm")
+def p_norm(x, porder=2.0, axis=None, epsilon=1e-12, keepdim=False,
+           as_vector=False):
+    """reference phi p_norm kernel: vector p-norm along axis."""
+    ax = _norm_axis(axis)
+    if as_vector or ax is None:
+        x = x.reshape(-1)
+        ax = 0
+    if porder == float("inf"):
+        return jnp.max(jnp.abs(x), axis=ax, keepdims=keepdim)
+    if porder == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=ax, keepdims=keepdim)
+    if porder == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=ax, keepdims=keepdim)
+    ax_t = jnp.sum(jnp.abs(x) ** porder, axis=ax, keepdims=keepdim)
+    return ax_t ** (1.0 / porder)
+
+
+@op("clip_by_norm")
+def clip_by_norm(x, max_norm):
+    """reference phi clip_by_norm kernel: x * max_norm / max(||x||, max_norm)."""
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return jnp.where(norm > max_norm, x * (max_norm / norm), x)
+
+
+@op("squared_l2_norm")
+def squared_l2_norm(x):
+    return jnp.sum(jnp.square(x)).reshape(())
+
+
+@op("l1_norm")
+def l1_norm(x):
+    return jnp.sum(jnp.abs(x)).reshape(())
+
+
+@op("reduce_as")
+def reduce_as(x, target):
+    """Sum-reduce x to target's shape (reference reduce_as op)."""
+    tshape = jnp.shape(target)
+    xshape = jnp.shape(x)
+    nd = len(xshape) - len(tshape)
+    axes = tuple(range(nd)) + tuple(
+        nd + i for i, (a, b) in enumerate(zip(xshape[nd:], tshape))
+        if b == 1 and a != 1)
+    out = jnp.sum(x, axis=axes, keepdims=False)
+    return out.reshape(tshape)

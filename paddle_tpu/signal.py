@@ -1,0 +1,218 @@
+"""paddle.signal: frame / overlap_add / stft / istft.
+
+Reference: python/paddle/signal.py (frame:42, overlap_add:167, stft:272,
+istft:449), backed by phi frame/overlap_add kernels and fft_r2c/c2c/c2r.
+
+TPU note: XLA lowers FFT natively; framing is a strided gather and
+overlap-add a segment-sum — both fuse. Complex dtypes flow through jnp.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .core.dispatch import op
+from .core.tensor import Tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+@op("frame")
+def frame(x, frame_length: int, hop_length: int, axis: int = -1):
+    """Slice overlapping frames (reference signal.py:42): out shape
+    [..., frame_length, num_frames] for axis=-1 (frame dim precedes the
+    frame index), [num_frames, frame_length, ...] for axis=0."""
+    seq_last = axis != 0 and axis in (-1, x.ndim - 1)
+    n = x.shape[-1] if seq_last else x.shape[0]
+    if frame_length > n:
+        raise ValueError(
+            f"frame_length {frame_length} > signal length {n}")
+    num = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num) * hop_length
+    offs = jnp.arange(frame_length)
+    idx = starts[:, None] + offs[None, :]              # [num, frame_length]
+    if seq_last:
+        return jnp.moveaxis(x[..., idx], -2, -1)       # [..., fl, num]
+    return x[idx]                                       # [num, fl, ...]
+
+
+@op("overlap_add")
+def overlap_add(x, hop_length: int, axis: int = -1):
+    """Inverse of frame (reference signal.py:167): adds overlapping frames.
+    axis=-1 expects [..., frame_length, num_frames]."""
+    seq_last = axis != 0 and axis in (-1, x.ndim - 1)
+    if seq_last:
+        fl, num = x.shape[-2], x.shape[-1]
+        frames = jnp.moveaxis(x, -1, -2)               # [..., num, fl]
+    else:
+        num, fl = x.shape[0], x.shape[1]
+        frames = jnp.moveaxis(x, (0, 1), (-2, -1))     # [..., num, fl]
+    n = fl + hop_length * (num - 1)
+    idx = (jnp.arange(num) * hop_length)[:, None] + jnp.arange(fl)[None, :]
+    out = jnp.zeros(frames.shape[:-2] + (n,), x.dtype)
+    out = out.at[..., idx.reshape(-1)].add(
+        frames.reshape(frames.shape[:-2] + (-1,)))
+    if seq_last:
+        return out
+    return jnp.moveaxis(out, -1, 0)
+
+
+def _window_arr(window, n_fft, dtype):
+    if window is None:
+        return jnp.ones((n_fft,), dtype)
+    w = window._data if isinstance(window, Tensor) else jnp.asarray(window)
+    if w.shape[0] != n_fft:
+        raise ValueError(f"window length {w.shape[0]} != n_fft {n_fft}")
+    return w.astype(dtype)
+
+
+def _fft_device_ok() -> bool:
+    from .ops.extra import fft as _fft
+
+    return _fft._device_ok()
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """Short-time Fourier transform (reference signal.py:272): returns
+    [..., n_fft//2 + 1 | n_fft, num_frames] complex64/128.
+
+    On TPU without FLAGS_device_fft the transform runs host-side like the
+    paddle_tpu.fft namespace (some TPU runtimes reject FFT programs) and
+    the complex result lives on the CPU device."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    w = _window_arr(window, win_length,
+                    jnp.float64 if arr.dtype == jnp.float64 else jnp.float32)
+    if win_length < n_fft:  # center-pad window to n_fft (reference behavior)
+        pad_l = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad_l, n_fft - win_length - pad_l))
+
+    @op("stft")
+    def _stft(arr, w):
+        y = arr
+        if center:
+            pads = [(0, 0)] * (y.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            y = jnp.pad(y, pads, mode=pad_mode)
+        n = y.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(num) * hop_length)[:, None] + \
+            jnp.arange(n_fft)[None, :]
+        frames = y[..., idx] * w                       # [..., num, n_fft]
+        if onesided and not jnp.iscomplexobj(frames):
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.moveaxis(spec, -2, -1)              # [..., freq, num]
+
+    if not _fft_device_ok():
+        y = np.asarray(arr)
+        wn = np.asarray(w)
+        if center:
+            pads = [(0, 0)] * (y.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            y = np.pad(y, pads, mode=pad_mode)
+        n = y.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        idx = (np.arange(num) * hop_length)[:, None] + \
+            np.arange(n_fft)[None, :]
+        frames = y[..., idx] * wn
+        spec = (np.fft.rfft(frames, axis=-1)
+                if onesided and not np.iscomplexobj(frames)
+                else np.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / np.sqrt(n_fft)
+        out = np.moveaxis(spec, -2, -1)
+        return Tensor(jax.device_put(out, jax.devices("cpu")[0]),
+                      stop_gradient=True)
+    return _stft(Tensor(arr) if not isinstance(x, Tensor) else x,
+                 Tensor(w))
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False,
+          name=None):
+    """Inverse STFT with window-envelope normalization (reference
+    signal.py:449)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    w = _window_arr(window, win_length, jnp.float32)
+    if win_length < n_fft:
+        pad_l = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad_l, n_fft - win_length - pad_l))
+
+    @op("istft")
+    def _istft(spec, w):
+        s = jnp.moveaxis(spec, -1, -2)                 # [..., num, freq]
+        if normalized:
+            s = s * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(s, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(s, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * w
+        num = frames.shape[-2]
+        n = n_fft + hop_length * (num - 1)
+        idx = (jnp.arange(num) * hop_length)[:, None] + \
+            jnp.arange(n_fft)[None, :]
+        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        out = out.at[..., idx.reshape(-1)].add(
+            frames.reshape(frames.shape[:-2] + (-1,)))
+        env = jnp.zeros((n,), jnp.float32)
+        env = env.at[idx.reshape(-1)].add(
+            jnp.broadcast_to(w * w, (num, n_fft)).reshape(-1))
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            out = out[..., n_fft // 2: n - n_fft // 2]
+        return out
+
+    if not _fft_device_ok():
+        s = np.moveaxis(np.asarray(arr), -1, -2)
+        wn = np.asarray(w)
+        if normalized:
+            s = s * np.sqrt(n_fft)
+        if onesided:
+            frames = np.fft.irfft(s, n=n_fft, axis=-1)
+        else:
+            frames = np.fft.ifft(s, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * wn
+        num = frames.shape[-2]
+        n = n_fft + hop_length * (num - 1)
+        out_np = np.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        env = np.zeros((n,), np.float64)
+        for k in range(num):
+            sl = slice(k * hop_length, k * hop_length + n_fft)
+            out_np[..., sl] += frames[..., k, :]
+            env[sl] += wn * wn
+        out_np = out_np / np.maximum(env, 1e-11)
+        if center:
+            out_np = out_np[..., n_fft // 2: n - n_fft // 2]
+        if length is not None:
+            out_np = out_np[..., :length]
+        if np.iscomplexobj(out_np):
+            return Tensor(jax.device_put(out_np, jax.devices("cpu")[0]),
+                          stop_gradient=True)
+        return Tensor(jnp.asarray(out_np.astype(np.float32)),
+                      stop_gradient=True)
+    out = _istft(Tensor(arr) if not isinstance(x, Tensor) else x, Tensor(w))
+    if length is not None:
+        out = out[..., :length]
+    return out

@@ -265,7 +265,7 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         # suppress each other (the standard batched-nms trick)
         c = category_idxs._data if isinstance(category_idxs, Tensor) \
             else jnp.asarray(category_idxs)
-        off = (c.astype(b.dtype) * (b.max() + 1.0))[:, None]
+        off = (c.astype(b.dtype) * (b.max() - b.min() + 1.0))[:, None]
         keep = _nms_keep_mask(b + off, s, iou_threshold)
     else:
         keep = _nms_keep_mask(b, s, iou_threshold)
@@ -303,7 +303,7 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
             iou = np.triu(iou, k=1)
             max_iou = iou.max(axis=0, initial=0.0)  # per column (lower rank)
             if use_gaussian:
-                decay = np.exp(-(iou ** 2 - max_iou[None, :] ** 2)
+                decay = np.exp(-(iou ** 2 - max_iou[:, None] ** 2)
                                / gaussian_sigma).min(axis=0, initial=1.0,
                                                      where=iou > 0)
             else:
@@ -506,9 +506,9 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
 
 
 @op("yolo_loss")
-def _yolo_loss_op(x, gt_box, gt_label, *, anchors, anchor_mask, class_num,
-                  ignore_thresh, downsample_ratio, use_label_smooth,
-                  scale_x_y):
+def _yolo_loss_op(x, gt_box, gt_label, gt_score, *, anchors, anchor_mask,
+                  class_num, ignore_thresh, downsample_ratio,
+                  use_label_smooth, scale_x_y):
     """Simplified-but-faithful YOLOv3 loss: coordinate (sx/sy BCE + wh L2),
     objectness BCE with ignore region, class BCE. reference
     vision/ops.py:69 / phi yolov3_loss kernel."""
@@ -557,18 +557,23 @@ def _yolo_loss_op(x, gt_box, gt_label, *, anchors, anchor_mask, class_num,
     tscale = jnp.zeros((N, na, H, W))
     tcls = jnp.zeros((N, na, class_num, H, W))
     bidx = jnp.broadcast_to(jnp.arange(N)[:, None], (N, B))
-    w_sel = jnp.where(has, 1.0, 0.0)
+    w_sel = jnp.where(has, 1.0, 0.0) * (gt_score if gt_score is not None
+                                        else 1.0)
+    # masked scatter-adds: padded gt rows (w_sel==0) must not clobber a
+    # real target landing on the same (cell, anchor) slot
     tobj = tobj.at[bidx, slot, gj, gi].max(w_sel)
-    tx = tx.at[bidx, slot, gj, gi].set(gx - gi)
-    ty = ty.at[bidx, slot, gj, gi].set(gy - gj)
+    tx = tx.at[bidx, slot, gj, gi].add((gx - gi) * w_sel)
+    ty = ty.at[bidx, slot, gj, gi].add((gy - gj) * w_sel)
     aw = an[slot]
-    tw = tw.at[bidx, slot, gj, gi].set(
-        jnp.log(jnp.maximum(gw / jnp.maximum(aw[..., 0], 1e-9), 1e-9)))
-    th = th.at[bidx, slot, gj, gi].set(
-        jnp.log(jnp.maximum(gh / jnp.maximum(aw[..., 1], 1e-9), 1e-9)))
-    tscale = tscale.at[bidx, slot, gj, gi].set(
+    tw = tw.at[bidx, slot, gj, gi].add(
+        jnp.log(jnp.maximum(gw / jnp.maximum(aw[..., 0], 1e-9), 1e-9))
+        * w_sel)
+    th = th.at[bidx, slot, gj, gi].add(
+        jnp.log(jnp.maximum(gh / jnp.maximum(aw[..., 1], 1e-9), 1e-9))
+        * w_sel)
+    tscale = tscale.at[bidx, slot, gj, gi].add(
         (2.0 - gt_box[..., 2] * gt_box[..., 3]) * w_sel)
-    tcls = tcls.at[bidx, slot, gt_label, gj, gi].set(w_sel)
+    tcls = tcls.at[bidx, slot, gt_label, gj, gi].add(w_sel)
 
     bce = lambda p, t: jnp.maximum(p, 0) - p * t + jnp.log1p(
         jnp.exp(-jnp.abs(p)))
@@ -577,8 +582,10 @@ def _yolo_loss_op(x, gt_box, gt_label, *, anchors, anchor_mask, class_num,
     loss_wh = (tscale * 0.5 * ((pw - tw) ** 2 + (ph - th) ** 2)).sum(
         axis=(1, 2, 3))
     # ignore mask: predicted boxes overlapping any gt above thresh
-    sxp = (jax.nn.sigmoid(px) + jnp.arange(W)[None, None, None]) / W
-    syp = (jax.nn.sigmoid(py) + jnp.arange(H)[None, None, :, None]) / H
+    sxv = jax.nn.sigmoid(px) * scale_x_y - (scale_x_y - 1) / 2
+    syv = jax.nn.sigmoid(py) * scale_x_y - (scale_x_y - 1) / 2
+    sxp = (sxv + jnp.arange(W)[None, None, None]) / W
+    syp = (syv + jnp.arange(H)[None, None, :, None]) / H
     swp = jnp.exp(pw) * an[None, :, 0, None, None] / inp_w
     shp = jnp.exp(ph) * an[None, :, 1, None, None] / inp_h
     pb = jnp.stack([sxp - swp / 2, syp - shp / 2, sxp + swp / 2,
@@ -613,7 +620,8 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
               ignore_thresh, downsample_ratio, gt_score=None,
               use_label_smooth=True, name=None, scale_x_y=1.0):
     """reference vision/ops.py:69 — YOLOv3 training loss per image."""
-    return _yolo_loss_op(x, gt_box, gt_label, anchors=tuple(anchors),
+    return _yolo_loss_op(x, gt_box, gt_label, gt_score,
+                         anchors=tuple(anchors),
                          anchor_mask=tuple(anchor_mask),
                          class_num=int(class_num),
                          ignore_thresh=float(ignore_thresh),
