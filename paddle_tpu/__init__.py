@@ -98,4 +98,10 @@ def __getattr__(name):
 
         globals()["Model"] = Model
         return Model
+    if name in ("summary", "flops"):
+        from .hapi.summary import flops, summary
+
+        globals()["summary"] = summary
+        globals()["flops"] = flops
+        return globals()[name]
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

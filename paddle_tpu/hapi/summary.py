@@ -1,0 +1,144 @@
+"""Model summary + FLOPs estimation.
+
+Reference: python/paddle/hapi/model_summary.py (``paddle.summary``) and
+python/paddle/hapi/dynamic_flops.py (``paddle.flops``). Implemented with
+forward post-hooks over sublayers — per-layer output shapes and parameter
+counts, plus an op-level FLOPs table for the common layer types.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["summary", "flops"]
+
+
+def _num_params(layer) -> tuple[int, int]:
+    total = trainable = 0
+    for p in layer.parameters(include_sublayers=False):
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+    return total, trainable
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table; returns {'total_params', 'trainable_params'}
+    (reference hapi/model_summary.py summary())."""
+    import paddle_tpu as pt
+
+    if input is None:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        sizes = [input_size] if isinstance(input_size, tuple) and \
+            not isinstance(input_size[0], (tuple, list)) else list(input_size)
+        dts = dtypes if dtypes else ["float32"] * len(sizes)
+        input = [pt.to_tensor(np.zeros([d if d and d > 0 else 1
+                                        for d in s],
+                                       np.dtype(dt)))
+                 for s, dt in zip(sizes, dts)]
+    elif isinstance(input, Tensor):
+        input = [input]
+
+    rows = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(l, inp, out):
+            o = out[0] if isinstance(out, (tuple, list)) else out
+            shape = list(o.shape) if isinstance(o, Tensor) else "?"
+            tp, tr = _num_params(layer)
+            rows.append((name or layer.__class__.__name__,
+                         layer.__class__.__name__, shape, tp))
+        return hook
+
+    for name, layer in net.named_sublayers():
+        if len(list(layer.children())) == 0:  # leaves only
+            hooks.append(layer.register_forward_post_hook(
+                make_hook(name, layer)))
+    was_training = getattr(net, "training", False)
+    net.eval()
+    try:
+        net(*input)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = trainable = 0
+    for p in net.parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+
+    line = "-" * 80
+    print(line)
+    print(f"{'Layer (type)':<40}{'Output Shape':<25}{'Param #':>12}")
+    print(line)
+    for name, cls, shape, npar in rows:
+        print(f"{name + ' (' + cls + ')':<40}{str(shape):<25}{npar:>12,}")
+    print(line)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops: Optional[dict] = None,
+          print_detail: bool = False) -> int:
+    """Total forward FLOPs (reference hapi/dynamic_flops.py flops())."""
+    import paddle_tpu as pt
+    from .. import nn
+
+    x = pt.to_tensor(np.zeros([d if d and d > 0 else 1 for d in input_size],
+                              np.float32))
+    total = [0]
+    hooks = []
+
+    def make_hook(layer):
+        def hook(l, inp, out):
+            if custom_ops and type(l) in custom_ops:
+                total[0] += int(custom_ops[type(l)](l, inp, out))
+                return
+            i = inp[0] if isinstance(inp, (tuple, list)) else inp
+            o = out[0] if isinstance(out, (tuple, list)) else out
+            if not isinstance(o, Tensor):
+                return
+            out_elems = int(np.prod(o.shape))
+            if isinstance(l, nn.Conv2D):
+                w = l.weight
+                total[0] += 2 * out_elems * w.shape[1] * w.shape[2] * \
+                    w.shape[3]
+            elif isinstance(l, nn.Linear):
+                total[0] += 2 * int(np.prod(o.shape[:-1])) * \
+                    l.weight.shape[0] * l.weight.shape[1]
+            elif l.__class__.__name__.startswith("BatchNorm") or \
+                    l.__class__.__name__ == "LayerNorm":
+                total[0] += 2 * out_elems
+            elif l.__class__.__name__.endswith("Pool2D"):
+                total[0] += out_elems
+        return hook
+
+    for _, layer in net.named_sublayers():
+        if len(list(layer.children())) == 0:
+            hooks.append(layer.register_forward_post_hook(make_hook(layer)))
+    was_training = getattr(net, "training", False)
+    net.eval()
+    try:
+        net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+    if print_detail:
+        print(f"Total FLOPs: {total[0]:,}")
+    return total[0]

@@ -277,9 +277,11 @@ def binomial(count, prob, name=None) -> Tensor:
     key = prandom.next_key()
     c = count._data if isinstance(count, Tensor) else jnp.asarray(count)
     p = prob._data if isinstance(prob, Tensor) else jnp.asarray(prob)
+    # sampling runs in float32 (jax.random.binomial), so counts above
+    # 2**24 lose integer precision — far beyond any practical use
     out = jax.random.binomial(key, c.astype(jnp.float32),
                               p.astype(jnp.float32))
-    return Tensor(out.astype(jnp.int32), stop_gradient=True)
+    return Tensor(out.astype(convert_dtype("int64")), stop_gradient=True)
 
 
 def poisson(x, name=None) -> Tensor:

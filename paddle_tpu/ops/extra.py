@@ -405,6 +405,11 @@ def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
         cum = jnp.cumsum(sorted_p, axis=-1)
         # keep tokens strictly before the cumulative threshold, always >= 1
         keep = cum - sorted_p < ps[:, None]
+        if threshold is not None:
+            # minimum-probability filter (reference top_p_sampling
+            # `threshold` input); the top token always stays
+            keep = keep & (sorted_p >= threshold)
+            keep = keep.at[:, 0].set(True)
         probs = jnp.where(keep, sorted_p, 0.0)
         probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
         choice = jax.vmap(

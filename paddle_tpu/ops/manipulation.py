@@ -644,14 +644,30 @@ def unstack(x, axis=0, num=None):
     return list(out)
 
 
-@op("sequence_mask", differentiable=False)
 def sequence_mask(x, maxlen=None, dtype="int64"):
-    """lengths -> [.., maxlen] 0/1 mask (reference sequence_mask)."""
-    from ..core.dtype import convert_dtype as _cd
+    """lengths -> [.., maxlen] 0/1 mask (reference sequence_mask).
 
-    ml = maxlen if maxlen is not None else int(jnp.max(x))
-    mask = jnp.arange(ml)[None, :] < jnp.reshape(x, (-1, 1))
-    return mask.reshape(tuple(jnp.shape(x)) + (ml,)).astype(_cd(dtype))
+    ``maxlen=None`` reads the max length from the (concrete) input on the
+    host — under program capture pass an explicit maxlen (shapes must be
+    static in a traced program)."""
+    if maxlen is None:
+        data = x._data if hasattr(x, "_data") else x
+        if isinstance(data, jax.core.Tracer):
+            raise ValueError(
+                "sequence_mask(maxlen=None) inside a captured program: "
+                "the mask shape would be data-dependent; pass maxlen")
+        maxlen = int(np.max(np.asarray(data))) if np.size(
+            np.asarray(data)) else 0
+
+    @op("sequence_mask", differentiable=False)
+    def _impl(x):
+        from ..core.dtype import convert_dtype as _cd
+
+        mask = jnp.arange(maxlen)[None, :] < jnp.reshape(x, (-1, 1))
+        return mask.reshape(tuple(jnp.shape(x)) + (maxlen,)).astype(
+            _cd(dtype))
+
+    return _impl(x)
 
 
 @op("shard_index", differentiable=False)

@@ -22,22 +22,48 @@ from .core.tensor import Tensor
 __all__ = ["frame", "overlap_add", "stft", "istft"]
 
 
+def _check_axis(axis, ndim):
+    """The reference restricts frame/overlap_add axis to {0, -1}."""
+    if axis not in (0, -1, ndim - 1):
+        raise ValueError(f"axis must be 0 or -1, got {axis}")
+    return axis != 0 and axis in (-1, ndim - 1)
+
+
+def _frame_last(y, frame_length: int, hop_length: int):
+    """[..., n] -> [..., num, frame_length] overlapping-frame gather (the
+    shared core of frame/stft)."""
+    n = y.shape[-1]
+    num = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(num) * hop_length)[:, None] +         jnp.arange(frame_length)[None, :]
+    return y[..., idx]
+
+
+def _ola_last(frames, hop_length: int):
+    """[..., num, fl] -> [..., n] overlap-add scatter (shared core of
+    overlap_add/istft)."""
+    num, fl = frames.shape[-2], frames.shape[-1]
+    n = fl + hop_length * (num - 1)
+    idx = (jnp.arange(num) * hop_length)[:, None] + jnp.arange(fl)[None, :]
+    out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+    return out.at[..., idx.reshape(-1)].add(
+        frames.reshape(frames.shape[:-2] + (-1,)))
+
+
 @op("frame")
 def frame(x, frame_length: int, hop_length: int, axis: int = -1):
     """Slice overlapping frames (reference signal.py:42): out shape
     [..., frame_length, num_frames] for axis=-1 (frame dim precedes the
     frame index), [num_frames, frame_length, ...] for axis=0."""
-    seq_last = axis != 0 and axis in (-1, x.ndim - 1)
+    seq_last = _check_axis(axis, x.ndim)
     n = x.shape[-1] if seq_last else x.shape[0]
     if frame_length > n:
         raise ValueError(
             f"frame_length {frame_length} > signal length {n}")
-    num = 1 + (n - frame_length) // hop_length
-    starts = jnp.arange(num) * hop_length
-    offs = jnp.arange(frame_length)
-    idx = starts[:, None] + offs[None, :]              # [num, frame_length]
     if seq_last:
-        return jnp.moveaxis(x[..., idx], -2, -1)       # [..., fl, num]
+        return jnp.moveaxis(_frame_last(x, frame_length, hop_length),
+                            -2, -1)                    # [..., fl, num]
+    num = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(num) * hop_length)[:, None] +         jnp.arange(frame_length)[None, :]
     return x[idx]                                       # [num, fl, ...]
 
 
@@ -45,18 +71,12 @@ def frame(x, frame_length: int, hop_length: int, axis: int = -1):
 def overlap_add(x, hop_length: int, axis: int = -1):
     """Inverse of frame (reference signal.py:167): adds overlapping frames.
     axis=-1 expects [..., frame_length, num_frames]."""
-    seq_last = axis != 0 and axis in (-1, x.ndim - 1)
+    seq_last = _check_axis(axis, x.ndim)
     if seq_last:
-        fl, num = x.shape[-2], x.shape[-1]
         frames = jnp.moveaxis(x, -1, -2)               # [..., num, fl]
     else:
-        num, fl = x.shape[0], x.shape[1]
         frames = jnp.moveaxis(x, (0, 1), (-2, -1))     # [..., num, fl]
-    n = fl + hop_length * (num - 1)
-    idx = (jnp.arange(num) * hop_length)[:, None] + jnp.arange(fl)[None, :]
-    out = jnp.zeros(frames.shape[:-2] + (n,), x.dtype)
-    out = out.at[..., idx.reshape(-1)].add(
-        frames.reshape(frames.shape[:-2] + (-1,)))
+    out = _ola_last(frames, hop_length)
     if seq_last:
         return out
     return jnp.moveaxis(out, -1, 0)
@@ -103,11 +123,7 @@ def stft(x, n_fft: int, hop_length: Optional[int] = None,
         if center:
             pads = [(0, 0)] * (y.ndim - 1) + [(n_fft // 2, n_fft // 2)]
             y = jnp.pad(y, pads, mode=pad_mode)
-        n = y.shape[-1]
-        num = 1 + (n - n_fft) // hop_length
-        idx = (jnp.arange(num) * hop_length)[:, None] + \
-            jnp.arange(n_fft)[None, :]
-        frames = y[..., idx] * w                       # [..., num, n_fft]
+        frames = _frame_last(y, n_fft, hop_length) * w  # [..., num, n_fft]
         if onesided and not jnp.iscomplexobj(frames):
             spec = jnp.fft.rfft(frames, axis=-1)
         else:
@@ -169,14 +185,8 @@ def istft(x, n_fft: int, hop_length: Optional[int] = None,
         frames = frames * w
         num = frames.shape[-2]
         n = n_fft + hop_length * (num - 1)
-        idx = (jnp.arange(num) * hop_length)[:, None] + \
-            jnp.arange(n_fft)[None, :]
-        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
-        out = out.at[..., idx.reshape(-1)].add(
-            frames.reshape(frames.shape[:-2] + (-1,)))
-        env = jnp.zeros((n,), jnp.float32)
-        env = env.at[idx.reshape(-1)].add(
-            jnp.broadcast_to(w * w, (num, n_fft)).reshape(-1))
+        out = _ola_last(frames, hop_length)
+        env = _ola_last(jnp.broadcast_to(w * w, (num, n_fft)), hop_length)
         out = out / jnp.maximum(env, 1e-11)
         if center:
             out = out[..., n_fft // 2: n - n_fft // 2]

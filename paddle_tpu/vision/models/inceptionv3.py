@@ -1,0 +1,165 @@
+"""InceptionV3 (reference: python/paddle/vision/models/inceptionv3.py)."""
+
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+class ConvBNLayer(nn.Sequential):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0):
+        super().__init__(
+            nn.Conv2D(in_c, out_c, kernel, stride, padding, bias_attr=False),
+            nn.BatchNorm2D(out_c),
+            nn.ReLU(),
+        )
+
+
+def _cat(xs):
+    import paddle_tpu as pt
+
+    return pt.concat(xs, axis=1)
+
+
+class InceptionA(nn.Layer):
+    def __init__(self, in_c, pool_features):
+        super().__init__()
+        self.b1 = ConvBNLayer(in_c, 64, 1)
+        self.b5 = nn.Sequential(ConvBNLayer(in_c, 48, 1),
+                                ConvBNLayer(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(ConvBNLayer(in_c, 64, 1),
+                                ConvBNLayer(64, 96, 3, padding=1),
+                                ConvBNLayer(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                ConvBNLayer(in_c, pool_features, 1))
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)])
+
+
+class InceptionB(nn.Layer):
+    """Grid reduction 35->17."""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = ConvBNLayer(in_c, 384, 3, stride=2)
+        self.b3d = nn.Sequential(ConvBNLayer(in_c, 64, 1),
+                                 ConvBNLayer(64, 96, 3, padding=1),
+                                 ConvBNLayer(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _cat([self.b3(x), self.b3d(x), self.pool(x)])
+
+
+class InceptionC(nn.Layer):
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = ConvBNLayer(in_c, 192, 1)
+        self.b7 = nn.Sequential(
+            ConvBNLayer(in_c, c7, 1),
+            ConvBNLayer(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBNLayer(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            ConvBNLayer(in_c, c7, 1),
+            ConvBNLayer(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBNLayer(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBNLayer(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBNLayer(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                ConvBNLayer(in_c, 192, 1))
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)])
+
+
+class InceptionD(nn.Layer):
+    """Grid reduction 17->8."""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = nn.Sequential(ConvBNLayer(in_c, 192, 1),
+                                ConvBNLayer(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            ConvBNLayer(in_c, 192, 1),
+            ConvBNLayer(192, 192, (1, 7), padding=(0, 3)),
+            ConvBNLayer(192, 192, (7, 1), padding=(3, 0)),
+            ConvBNLayer(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _cat([self.b3(x), self.b7(x), self.pool(x)])
+
+
+class InceptionE(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = ConvBNLayer(in_c, 320, 1)
+        self.b3_stem = ConvBNLayer(in_c, 384, 1)
+        self.b3_a = ConvBNLayer(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = ConvBNLayer(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = nn.Sequential(ConvBNLayer(in_c, 448, 1),
+                                      ConvBNLayer(448, 384, 3, padding=1))
+        self.b3d_a = ConvBNLayer(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = ConvBNLayer(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                ConvBNLayer(in_c, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return _cat([self.b1(x),
+                     _cat([self.b3_a(s), self.b3_b(s)]),
+                     _cat([self.b3d_a(d), self.b3d_b(d)]),
+                     self.bp(x)])
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            ConvBNLayer(3, 32, 3, stride=2),
+            ConvBNLayer(32, 32, 3),
+            ConvBNLayer(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, stride=2),
+            ConvBNLayer(64, 80, 1),
+            ConvBNLayer(80, 192, 3),
+            nn.MaxPool2D(3, stride=2),
+        )
+        self.blocks = nn.Sequential(
+            InceptionA(192, 32),
+            InceptionA(256, 64),
+            InceptionA(288, 64),
+            InceptionB(288),
+            InceptionC(768, 128),
+            InceptionC(768, 160),
+            InceptionC(768, 160),
+            InceptionC(768, 192),
+            InceptionD(768),
+            InceptionE(1280),
+            InceptionE(2048),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.blocks(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.dropout(x.flatten(1))
+            x = self.fc(x)
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights not bundled")
+    return InceptionV3(**kwargs)

@@ -1,0 +1,153 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py)."""
+
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+
+def channel_shuffle(x, groups):
+    import paddle_tpu.nn.functional as F
+
+    return F.channel_shuffle(x, groups)
+
+
+def _act(name):
+    return nn.Swish() if name == "swish" else nn.ReLU()
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), _act(act),
+                nn.Conv2D(branch_c, branch_c, 3, stride, 1, groups=branch_c,
+                          bias_attr=False),
+                nn.BatchNorm2D(branch_c),
+                nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), _act(act),
+            )
+        else:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride, 1, groups=in_c,
+                          bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), _act(act),
+            )
+            self.branch2 = nn.Sequential(
+                nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), _act(act),
+                nn.Conv2D(branch_c, branch_c, 3, stride, 1, groups=branch_c,
+                          bias_attr=False),
+                nn.BatchNorm2D(branch_c),
+                nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), _act(act),
+            )
+
+    def forward(self, x):
+        import paddle_tpu as pt
+
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1 = x[:, :c]
+            x2 = x[:, c:]
+            out = pt.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = pt.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+_STAGE_REPEATS = [4, 8, 4]
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512],
+    0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024],
+    1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024],
+    2.0: [24, 244, 488, 976, 2048],
+}
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        out_c = _STAGE_OUT[scale]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, out_c[0], 3, 2, 1, bias_attr=False),
+            nn.BatchNorm2D(out_c[0]), _act(act),
+        )
+        self.max_pool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_c = out_c[0]
+        for i, repeats in enumerate(_STAGE_REPEATS):
+            oc = out_c[i + 1]
+            stages.append(InvertedResidual(in_c, oc, 2, act))
+            for _ in range(repeats - 1):
+                stages.append(InvertedResidual(oc, oc, 1, act))
+            in_c = oc
+        self.stages = nn.Sequential(*stages)
+        self.conv5 = nn.Sequential(
+            nn.Conv2D(in_c, out_c[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(out_c[-1]), _act(act),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(out_c[-1], num_classes)
+
+    def forward(self, x):
+        x = self.conv1(x)
+        x = self.max_pool(x)
+        x = self.stages(x)
+        x = self.conv5(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def _make(scale, act="relu", pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights not bundled")
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return _make(0.25, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return _make(0.33, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return _make(0.5, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return _make(1.0, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return _make(1.5, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return _make(2.0, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return _make(1.0, act="swish", pretrained=pretrained, **kw)

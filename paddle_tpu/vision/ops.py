@@ -557,23 +557,31 @@ def _yolo_loss_op(x, gt_box, gt_label, gt_score, *, anchors, anchor_mask,
     tscale = jnp.zeros((N, na, H, W))
     tcls = jnp.zeros((N, na, class_num, H, W))
     bidx = jnp.broadcast_to(jnp.arange(N)[:, None], (N, B))
-    w_sel = jnp.where(has, 1.0, 0.0) * (gt_score if gt_score is not None
-                                        else 1.0)
+    w_sel = jnp.where(has, 1.0, 0.0)
+    score = w_sel * (gt_score if gt_score is not None else 1.0)
     # masked scatter-adds: padded gt rows (w_sel==0) must not clobber a
-    # real target landing on the same (cell, anchor) slot
-    tobj = tobj.at[bidx, slot, gj, gi].max(w_sel)
-    tx = tx.at[bidx, slot, gj, gi].add((gx - gi) * w_sel)
-    ty = ty.at[bidx, slot, gj, gi].add((gy - gj) * w_sel)
+    # real target landing on the same (cell, anchor) slot. Colliding real
+    # gts average their targets (cnt division below); the reference's
+    # sequential kernel lets the last gt win — averaging is the
+    # order-independent equivalent.
+    cnt = jnp.zeros((N, na, H, W)).at[bidx, slot, gj, gi].add(w_sel)
+    norm = jnp.maximum(cnt, 1.0)
+    # gt_score weights the objectness target (mixup semantics), NOT the
+    # regression/class targets
+    tobj = tobj.at[bidx, slot, gj, gi].max(score)
+    tx = tx.at[bidx, slot, gj, gi].add((gx - gi) * w_sel) / norm
+    ty = ty.at[bidx, slot, gj, gi].add((gy - gj) * w_sel) / norm
     aw = an[slot]
     tw = tw.at[bidx, slot, gj, gi].add(
         jnp.log(jnp.maximum(gw / jnp.maximum(aw[..., 0], 1e-9), 1e-9))
-        * w_sel)
+        * w_sel) / norm
     th = th.at[bidx, slot, gj, gi].add(
         jnp.log(jnp.maximum(gh / jnp.maximum(aw[..., 1], 1e-9), 1e-9))
-        * w_sel)
+        * w_sel) / norm
     tscale = tscale.at[bidx, slot, gj, gi].add(
-        (2.0 - gt_box[..., 2] * gt_box[..., 3]) * w_sel)
+        (2.0 - gt_box[..., 2] * gt_box[..., 3]) * w_sel) / norm
     tcls = tcls.at[bidx, slot, gt_label, gj, gi].add(w_sel)
+    tcls = jnp.minimum(tcls, 1.0)
 
     bce = lambda p, t: jnp.maximum(p, 0) - p * t + jnp.log1p(
         jnp.exp(-jnp.abs(p)))

@@ -1,0 +1,75 @@
+"""New vision model families + summary/flops (reference:
+test/legacy_test/test_vision_models.py, test_model_summary)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision import models as M
+
+
+def _run(net, size=64, multi_out=False):
+    x = pt.to_tensor(np.random.RandomState(0).randn(
+        1, 3, size, size).astype(np.float32))
+    net.eval()
+    out = net(x)
+    if multi_out:
+        out = out[0]
+    assert tuple(out.shape) == (1, 10)
+    assert np.isfinite(np.asarray(out.numpy())).all()
+
+
+@pytest.mark.parametrize("ctor,kwargs,size,multi", [
+    (M.mobilenet_v1, dict(scale=0.25), 64, False),
+    (M.mobilenet_v3_small, dict(scale=0.5), 64, False),
+    (M.mobilenet_v3_large, dict(scale=0.35), 64, False),
+    (M.densenet121, dict(), 64, False),
+    (M.squeezenet1_0, dict(), 96, False),
+    (M.squeezenet1_1, dict(), 96, False),
+    (M.shufflenet_v2_x0_25, dict(), 64, False),
+    (M.shufflenet_v2_swish, dict(), 64, False),
+    (M.googlenet, dict(), 64, True),
+    (M.inception_v3, dict(), 128, False),
+])
+def test_model_families_forward(ctor, kwargs, size, multi):
+    pt.seed(1)
+    net = ctor(num_classes=10, **kwargs)
+    _run(net, size, multi)
+
+
+def test_densenet_trains():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.optimizer import SGD
+
+    pt.seed(2)
+    net = M.densenet121(num_classes=4)
+    opt = SGD(learning_rate=0.05, parameters=net.parameters())
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.randn(4, 3, 32, 32).astype(np.float32))
+    y = pt.to_tensor(rng.randint(0, 4, size=(4,)))
+    net.train()
+    loss = nn.functional.cross_entropy(net(x), y)
+    loss.backward()
+    grads = [p.grad for p in net.parameters() if not p.stop_gradient]
+    assert all(g is not None for g in grads)
+    assert all(np.isfinite(np.asarray(g.numpy())).all() for g in grads[:8])
+    before = np.asarray(net.parameters()[0].numpy()).copy()
+    opt.step()
+    opt.clear_grad()
+    after = np.asarray(net.parameters()[0].numpy())
+    assert not np.allclose(before, after)  # update applied through BN stacks
+
+
+def test_summary_and_flops():
+    pt.seed(3)
+    net = M.mobilenet_v1(scale=0.25, num_classes=10)
+    info = pt.summary(net, (1, 3, 64, 64))
+    ref = sum(int(np.prod(p.shape)) for p in net.parameters())
+    assert info["total_params"] == ref
+    assert info["trainable_params"] <= info["total_params"]
+
+    fl = pt.flops(net, (1, 3, 64, 64))
+    assert fl > 1e6  # conv-dominated; sanity lower bound
+    # scale quadratically-ish with resolution
+    fl2 = pt.flops(net, (1, 3, 128, 128))
+    assert 3.0 < fl2 / fl < 4.5
