@@ -35,8 +35,11 @@ def summary(net, input_size=None, dtypes=None, input=None):
     if input is None:
         if input_size is None:
             raise ValueError("summary needs input_size or input")
-        sizes = [input_size] if isinstance(input_size, tuple) and \
-            not isinstance(input_size[0], (tuple, list)) else list(input_size)
+        if isinstance(input_size, (tuple, list)) and input_size and \
+                not isinstance(input_size[0], (tuple, list)):
+            sizes = [tuple(input_size)]   # one shape given as tuple/list
+        else:
+            sizes = [tuple(s) for s in input_size]
         dts = dtypes if dtypes else ["float32"] * len(sizes)
         input = [pt.to_tensor(np.zeros([d if d and d > 0 else 1
                                         for d in s],

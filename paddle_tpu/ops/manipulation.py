@@ -644,6 +644,14 @@ def unstack(x, axis=0, num=None):
     return list(out)
 
 
+@op("sequence_mask", differentiable=False)
+def _sequence_mask_impl(x, maxlen: int, dtype: str):
+    from ..core.dtype import convert_dtype as _cd
+
+    mask = jnp.arange(maxlen)[None, :] < jnp.reshape(x, (-1, 1))
+    return mask.reshape(tuple(jnp.shape(x)) + (maxlen,)).astype(_cd(dtype))
+
+
 def sequence_mask(x, maxlen=None, dtype="int64"):
     """lengths -> [.., maxlen] 0/1 mask (reference sequence_mask).
 
@@ -658,16 +666,7 @@ def sequence_mask(x, maxlen=None, dtype="int64"):
                 "the mask shape would be data-dependent; pass maxlen")
         maxlen = int(np.max(np.asarray(data))) if np.size(
             np.asarray(data)) else 0
-
-    @op("sequence_mask", differentiable=False)
-    def _impl(x):
-        from ..core.dtype import convert_dtype as _cd
-
-        mask = jnp.arange(maxlen)[None, :] < jnp.reshape(x, (-1, 1))
-        return mask.reshape(tuple(jnp.shape(x)) + (maxlen,)).astype(
-            _cd(dtype))
-
-    return _impl(x)
+    return _sequence_mask_impl(x, maxlen=int(maxlen), dtype=dtype)
 
 
 @op("shard_index", differentiable=False)

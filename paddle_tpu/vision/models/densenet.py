@@ -61,11 +61,15 @@ _CFG = {
 
 
 class DenseNet(nn.Layer):
-    def __init__(self, layers=121, growth_rate=32, num_init_features=64,
+    def __init__(self, layers=121, growth_rate=None, num_init_features=None,
                  bn_size=4, dropout=0.0, num_classes=1000, with_pool=True):
         super().__init__()
-        if layers == 161:
-            growth_rate, num_init_features = 48, 96
+        # per-depth defaults (DenseNet-161 uses k=48, 96 stem channels);
+        # explicit caller values always win
+        if growth_rate is None:
+            growth_rate = 48 if layers == 161 else 32
+        if num_init_features is None:
+            num_init_features = 96 if layers == 161 else 64
         block_config = _CFG[layers]
         self.num_classes = num_classes
         self.with_pool = with_pool

@@ -76,7 +76,9 @@ class SqueezeNet(nn.Layer):
             x = self.classifier(x)
         if self.with_pool:
             x = self.pool(x)
-        return x.flatten(1)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+        return x
 
 
 def squeezenet1_0(pretrained=False, **kwargs):

@@ -1,0 +1,87 @@
+"""Custom C++ op build + dispatch tests (reference: custom-op JIT build,
+python/paddle/utils/cpp_extension/cpp_extension.py and test/custom_op/)."""
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.utils import cpp_extension as cpp
+
+
+SRC = textwrap.dedent("""
+    #include <cstdint>
+    extern "C" {
+    // softsign: x / (1 + |x|)
+    void softsign_forward(const float* x, float* out, int64_t n) {
+        for (int64_t i = 0; i < n; ++i) {
+            float a = x[i] < 0 ? -x[i] : x[i];
+            out[i] = x[i] / (1.0f + a);
+        }
+    }
+    // d/dx softsign = 1 / (1 + |x|)^2
+    void softsign_backward(const float* x, float* out, int64_t n) {
+        for (int64_t i = 0; i < n; ++i) {
+            float a = x[i] < 0 ? -x[i] : x[i];
+            float d = 1.0f + a;
+            out[i] = 1.0f / (d * d);
+        }
+    }
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ext")
+    src = d / "softsign.cc"
+    src.write_text(SRC)
+    return cpp.load("softsign_ext", [str(src)],
+                    build_directory=str(d))
+
+
+def test_build_is_cached(ext, tmp_path_factory):
+    d = os.path.dirname(ext._so_path)
+    before = set(os.listdir(d))
+    src = [f for f in os.listdir(d) if f.endswith(".cc")]
+    # rebuilding with identical sources reuses the cached .so
+    mod2 = cpp.load("softsign_ext",
+                    [os.path.join(d, s) for s in src] or
+                    [os.path.join(d, "softsign.cc")],
+                    build_directory=d)
+    assert mod2._so_path == ext._so_path
+    assert set(os.listdir(d)) == before
+
+
+def test_custom_op_forward_backward(ext):
+    my_softsign = cpp.custom_op("my_softsign", ext.softsign_forward,
+                                ext.softsign_backward)
+    x = np.linspace(-3, 3, 12).astype(np.float32).reshape(3, 4)
+    t = pt.to_tensor(x, stop_gradient=False)
+    y = my_softsign(t)
+    np.testing.assert_allclose(np.asarray(y.numpy()), x / (1 + np.abs(x)),
+                               rtol=1e-6)
+    y.sum().backward()
+    np.testing.assert_allclose(np.asarray(t.grad.numpy()),
+                               1.0 / (1 + np.abs(x)) ** 2, rtol=1e-6)
+
+
+def test_custom_op_under_capture(ext):
+    my_softsign2 = cpp.custom_op("my_softsign2", ext.softsign_forward,
+                                 ext.softsign_backward)
+
+    @pt.jit.to_static
+    def f(x):
+        return (my_softsign2(x) * 2.0).sum()
+
+    x = np.linspace(-2, 2, 8).astype(np.float32)
+    out = float(f(pt.to_tensor(x)).numpy())
+    ref = float((x / (1 + np.abs(x)) * 2).sum())
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_cuda_extension_rejected():
+    with pytest.raises(RuntimeError, match="XLA/Pallas"):
+        cpp.CUDAExtension(sources=["x.cu"])
