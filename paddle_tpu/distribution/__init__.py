@@ -22,6 +22,11 @@ from ..core.tensor import Tensor
 __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
            "Beta", "Dirichlet", "Exponential", "Gamma", "Geometric",
            "Laplace", "LogNormal", "Multinomial", "Poisson", "StudentT",
+           "Cauchy", "Chi2", "ContinuousBernoulli", "ExponentialFamily",
+           "Gumbel", "MultivariateNormal", "Binomial",
+           "TransformedDistribution", "Transform", "AffineTransform",
+           "ChainTransform", "ExpTransform", "PowerTransform",
+           "SigmoidTransform", "TanhTransform", "AbsTransform",
            "kl_divergence", "register_kl"]
 
 
@@ -496,3 +501,341 @@ def _kl_bernoulli(p: Bernoulli, q: Bernoulli):
     b = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
     return _wrap(a * (jnp.log(a) - jnp.log(b))
                  + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+
+
+# ---------------------------------------------------------------------------
+# tranche 2 (reference python/paddle/distribution/: cauchy.py, chi2.py,
+# continuous_bernoulli.py, exponential_family.py, gumbel.py,
+# multivariate_normal.py, binomial.py, transformed_distribution.py)
+# ---------------------------------------------------------------------------
+
+from .transform import (AbsTransform, AffineTransform, ChainTransform,
+                        ExpTransform, PowerTransform, SigmoidTransform,
+                        TanhTransform, Transform)
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference
+    exponential_family.py): entropy via the Bregman identity when
+    subclasses provide natural params + log-normalizer."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy has no variance")
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        u = jax.random.uniform(key, tuple(shape) + self.batch_shape,
+                               minval=1e-7, maxval=1 - 1e-7)
+        return _wrap(self.loc + self.scale * jnp.tan(math.pi * (u - 0.5)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        z = (v - self.loc) / self.scale
+        return _wrap(-math.log(math.pi) - jnp.log(self.scale)
+                     - jnp.log1p(z ** 2))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(
+            math.log(4 * math.pi) + jnp.log(self.scale), self.batch_shape))
+
+    def cdf(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return _wrap(jnp.arctan(z) / math.pi + 0.5)
+
+
+class Chi2(Gamma):
+    """Chi-squared = Gamma(df/2, rate=1/2) (reference chi2.py)."""
+
+    def __init__(self, df, name=None):
+        self.df = _arr(df)
+        super().__init__(self.df / 2.0, jnp.full_like(self.df / 2.0, 0.5))
+
+
+class ContinuousBernoulli(Distribution):
+    """CB(lam) (reference continuous_bernoulli.py): density
+    C(lam) lam^x (1-lam)^(1-x) on [0, 1]."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = jnp.clip(_arr(probs), 1e-6, 1 - 1e-6)
+        self._lims = lims
+        super().__init__(self.probs.shape)
+
+    def _outside(self):
+        lo, hi = self._lims
+        return (self.probs < lo) | (self.probs > hi)
+
+    def _log_norm(self):
+        # log C(lam); Taylor-stabilized near lam=0.5
+        lam = self.probs
+        safe = jnp.where(self._outside(), lam, 0.4)
+        out = jnp.log(jnp.abs(2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+                      / jnp.abs(1.0 - 2.0 * safe))
+        taylor = math.log(2.0) + 4.0 / 3.0 * (lam - 0.5) ** 2 \
+            + 104.0 / 45.0 * (lam - 0.5) ** 4
+        return jnp.where(self._outside(), out, taylor)
+
+    @property
+    def mean(self):
+        lam = self.probs
+        safe = jnp.where(self._outside(), lam, 0.4)
+        m = safe / (2.0 * safe - 1.0) + 1.0 / (
+            2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+        taylor = 0.5 + (lam - 0.5) / 3.0 + 16.0 / 45.0 * (lam - 0.5) ** 3
+        return _wrap(jnp.where(self._outside(), m, taylor))
+
+    @property
+    def variance(self):
+        lam = self.probs
+        safe = jnp.where(self._outside(), lam, 0.4)
+        v = safe * (safe - 1.0) / (1.0 - 2.0 * safe) ** 2 + 1.0 / (
+            2.0 * jnp.arctanh(1.0 - 2.0 * safe)) ** 2
+        taylor = 1.0 / 12.0 - (lam - 0.5) ** 2 / 15.0
+        return _wrap(jnp.where(self._outside(), v, taylor))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        u = jax.random.uniform(key, tuple(shape) + self.batch_shape,
+                               minval=1e-7, maxval=1 - 1e-7)
+        return self.icdf(_wrap(u))
+
+    rsample = sample
+
+    def icdf(self, value):
+        u = _arr(value)
+        lam = self.probs
+        safe = jnp.where(self._outside(), lam, 0.4)
+        x = (jnp.log1p(u * (2.0 * safe - 1.0) / (1.0 - safe))
+             / (jnp.log(safe) - jnp.log1p(-safe)))
+        return _wrap(jnp.where(self._outside(), x, u))
+
+    def log_prob(self, value):
+        x = _arr(value)
+        return _wrap(x * jnp.log(self.probs)
+                     + (1.0 - x) * jnp.log1p(-self.probs)
+                     + self._log_norm())
+
+    def cdf(self, value):
+        x = _arr(value)
+        lam = self.probs
+        safe = jnp.where(self._outside(), lam, 0.4)
+        c = (jnp.power(safe, x) * jnp.power(1.0 - safe, 1.0 - x)
+             + safe - 1.0) / (2.0 * safe - 1.0)
+        return _wrap(jnp.clip(jnp.where(self._outside(), c, x), 0.0, 1.0))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(
+            self.loc + self.scale * 0.57721566490153286, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(
+            (math.pi ** 2 / 6.0) * self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.sqrt(_arr(self.variance)))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _wrap(self.loc + self.scale * jax.random.gumbel(
+            key, tuple(shape) + self.batch_shape))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return _wrap(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(
+            jnp.log(self.scale) + 1.0 + 0.57721566490153286,
+            self.batch_shape))
+
+    def cdf(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return _wrap(jnp.exp(-jnp.exp(-z)))
+
+
+class MultivariateNormal(Distribution):
+    """MVN(loc, covariance_matrix) (reference multivariate_normal.py;
+    also accepts precision_matrix or scale_tril)."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _arr(loc)
+        if sum(x is not None for x in
+               (covariance_matrix, precision_matrix, scale_tril)) != 1:
+            raise ValueError("give exactly one of covariance_matrix / "
+                             "precision_matrix / scale_tril")
+        if scale_tril is not None:
+            self._tril = _arr(scale_tril)
+        elif covariance_matrix is not None:
+            self._tril = jnp.linalg.cholesky(_arr(covariance_matrix))
+        else:
+            prec = _arr(precision_matrix)
+            self._tril = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        d = self.loc.shape[-1]
+        super().__init__(jnp.broadcast_shapes(
+            self.loc.shape[:-1], self._tril.shape[:-2]), (d,))
+
+    @property
+    def mean(self):
+        return _wrap(self.loc)
+
+    @property
+    def covariance_matrix(self):
+        return _wrap(self._tril @ jnp.swapaxes(self._tril, -1, -2))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.sum(self._tril ** 2, axis=-1))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        d = self.event_shape[0]
+        z = jax.random.normal(key, tuple(shape) + self.batch_shape + (d,))
+        return _wrap(self.loc + jnp.einsum("...ij,...j->...i", self._tril,
+                                           z))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value) - self.loc
+        d = self.event_shape[0]
+        # solve L y = v
+        y = jax.scipy.linalg.solve_triangular(self._tril, v[..., None],
+                                              lower=True)[..., 0]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(
+            self._tril, axis1=-2, axis2=-1)), -1)
+        return _wrap(-0.5 * jnp.sum(y ** 2, -1) - half_logdet
+                     - 0.5 * d * math.log(2 * math.pi))
+
+    def entropy(self):
+        d = self.event_shape[0]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(
+            self._tril, axis1=-2, axis2=-1)), -1)
+        return _wrap(jnp.broadcast_to(
+            0.5 * d * (1.0 + math.log(2 * math.pi)) + half_logdet,
+            self.batch_shape))
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _arr(total_count)
+        self.probs = _arr(probs)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        n = jnp.broadcast_to(self.total_count,
+                             tuple(shape) + self.batch_shape)
+        p = jnp.broadcast_to(self.probs, tuple(shape) + self.batch_shape)
+        return _wrap(jax.random.binomial(key, n, p))
+
+    def log_prob(self, value):
+        k = _arr(value)
+        n, p = self.total_count, jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        logc = (jax.lax.lgamma(n + 1.0) - jax.lax.lgamma(k + 1.0)
+                - jax.lax.lgamma(n - k + 1.0))
+        return _wrap(logc + k * jnp.log(p) + (n - k) * jnp.log1p(-p))
+
+    def entropy(self):
+        # exact sum over support (reference computes the same closed sum);
+        # needs a concrete total_count (support size fixes the shape)
+        if isinstance(self.total_count, jax.core.Tracer):
+            raise ValueError(
+                "Binomial.entropy needs a concrete total_count (the "
+                "support size is a shape); compute it outside the trace")
+        n = int(np.max(np.asarray(self.total_count)))
+        ks = jnp.arange(n + 1, dtype=jnp.float32)
+        shape = (n + 1,) + tuple(1 for _ in self.batch_shape)
+        lp = _arr(self.log_prob(_wrap(ks.reshape(shape))))
+        valid = ks.reshape(shape) <= self.total_count
+        return _wrap(-jnp.sum(jnp.where(valid, jnp.exp(lp) * lp, 0.0),
+                              axis=0))
+
+
+class TransformedDistribution(Distribution):
+    """Push a base distribution through transforms (reference
+    transformed_distribution.py)."""
+
+    def __init__(self, base, transforms, name=None):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        transforms = list(transforms)   # materialize once (generators)
+        if not transforms:
+            raise ValueError("need at least one transform")
+        self.transforms = ChainTransform(transforms) if \
+            len(transforms) > 1 else transforms[0]
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self.transforms.forward(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return self.transforms.forward(x)
+
+    def log_prob(self, value):
+        y = _arr(value)
+        x = self.transforms._inverse(y)
+        base_lp = _arr(self.base.log_prob(_wrap(x)))
+        ldj = self.transforms._fldj(x)
+        # elementwise transforms: sum the Jacobian terms over event dims
+        for _ in self.base.event_shape:
+            ldj = ldj.sum(-1)
+        return _wrap(base_lp - ldj)
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel(p: Gumbel, q: Gumbel):
+    """Closed form: KL = log(bq/bp) + g*(bp/bq - 1) + (mp - mq)/bq
+    + exp((mq - mp)/bq + lgamma(1 + bp/bq)) - 1 (Euler-Mascheroni g)."""
+    ratio = p.scale / q.scale
+    g = 0.57721566490153286
+    return _wrap(jnp.log(q.scale / p.scale) + g * (ratio - 1.0)
+                 + (p.loc - q.loc) / q.scale
+                 + jnp.exp((q.loc - p.loc) / q.scale
+                           + jax.lax.lgamma(1.0 + ratio)) - 1.0)

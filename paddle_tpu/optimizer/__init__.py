@@ -5,6 +5,11 @@ from .optimizer import Optimizer  # noqa: F401
 from .optimizers import (  # noqa: F401
     SGD,
     AdaDelta,
+    Adadelta,
+    ASGD,
+    NAdam,
+    RAdam,
+    Rprop,
     Adagrad,
     Adam,
     Adamax,

@@ -15,7 +15,8 @@ import jax.numpy as jnp
 from .optimizer import Optimizer
 
 __all__ = ["SGD", "Momentum", "Adagrad", "Adam", "AdamW", "Adamax",
-           "AdaDelta", "RMSProp", "Lamb", "LBFGS"]
+           "AdaDelta", "Adadelta", "RMSProp", "Lamb", "LBFGS",
+           "Rprop", "ASGD", "NAdam", "RAdam"]
 
 
 def _f32(x):
@@ -376,3 +377,161 @@ class LBFGS(Optimizer):
                 return new_loss
             loss, flat_grad = new_loss, new_grad
         return loss
+
+
+Adadelta = AdaDelta  # reference spells it Adadelta (optimizer/adadelta.py)
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference optimizer/rprop.py / phi rprop_
+    kernel): per-element step sizes grown/shrunk by gradient sign
+    agreement; gradients' magnitudes are ignored."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def _init_slot(self, p):
+        # initial per-element step = the optimizer's configured lr (the
+        # base _lr_value is capture-aware; slots are created eagerly on
+        # the first step, where get_lr() is concrete)
+        return {"prev_grad": jnp.zeros_like(_f32(p._data)),
+                "step_size": jnp.full_like(_f32(p._data),
+                                           float(self.get_lr()))}
+
+    def _update(self, g, p, state, lr, ctx):
+        g = _f32(g)
+        sign = jnp.sign(g * state["prev_grad"])
+        factor = jnp.where(sign > 0, self._eta_pos,
+                           jnp.where(sign < 0, self._eta_neg, 1.0))
+        step = jnp.clip(state["step_size"] * factor, self._lr_min,
+                        self._lr_max)
+        # on sign change the gradient is zeroed (no step this round)
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        new_p = p - (step * jnp.sign(g_eff)).astype(p.dtype)
+        return new_p, {"prev_grad": g_eff, "step_size": step}
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference optimizer/asgd.py / phi asgd_ kernel):
+    plain SGD step plus a running (Polyak) average of the iterates;
+    :meth:`finalize` swaps the averages into the parameters, or read
+    them via :meth:`averaged_params`."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        # accepted for reference-API parity; this implementation keeps the
+        # full Polyak average rather than the reference's batch_num window
+        self._batch_num = batch_num
+
+    def _init_slot(self, p):
+        # copy: the slot must not alias the (donated) parameter buffer
+        return {"avg": _f32(p._data).copy(),
+                "n": jnp.zeros((), jnp.float32)}
+
+    def _update(self, g, p, state, lr, ctx):
+        g = _f32(g) + ctx["wd"] * _f32(p)
+        new_p32 = _f32(p) - lr * g
+        n = state["n"] + 1.0
+        avg = state["avg"] + (new_p32 - state["avg"]) / n
+        return new_p32.astype(p.dtype), {"avg": avg, "n": n}
+
+    def averaged_params(self):
+        from ..core.tensor import Tensor
+
+        return [Tensor(self._get_state(p)["avg"].astype(p._data.dtype),
+                       stop_gradient=True) for p in self._parameter_list]
+
+    def finalize(self):
+        """Copy the running averages into the live parameters (deployment
+        step of averaged SGD)."""
+        for p in self._parameter_list:
+            state = self._get_state(p)
+            p._bump(state["avg"].astype(p._data.dtype))
+
+
+class NAdam(Optimizer):
+    """Nesterov Adam (reference optimizer/nadam.py / phi nadam_ kernel)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._psi = momentum_decay
+
+    def _init_slot(self, p):
+        return {"m": jnp.zeros_like(_f32(p._data)),
+                "v": jnp.zeros_like(_f32(p._data)),
+                "mu_prod": jnp.ones((), jnp.float32)}
+
+    def _ctx(self):
+        t = self._step_value
+        mu_t = self._beta1 * (1.0 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = self._beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        return {"mu_t": mu_t, "mu_t1": mu_t1,
+                "bias2": 1.0 - self._beta2 ** self._step_value}
+
+    def _update(self, g, p, state, lr, ctx):
+        g = _f32(g) + ctx["wd"] * _f32(p)
+        mu_prod = state["mu_prod"] * ctx["mu_t"]
+        m = self._beta1 * state["m"] + (1 - self._beta1) * g
+        v = self._beta2 * state["v"] + (1 - self._beta2) * g * g
+        m_hat = (ctx["mu_t1"] * m / (1 - mu_prod * ctx["mu_t1"])
+                 + (1 - ctx["mu_t"]) * g / (1 - mu_prod))
+        v_hat = v / ctx["bias2"]
+        step = m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        return (p - (lr * step).astype(p.dtype)), {
+            "m": m, "v": v, "mu_prod": mu_prod}
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (reference optimizer/radam.py / phi radam_ kernel):
+    variance-rectification term gates between SGD-with-momentum and Adam."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_slot(self, p):
+        return {"m": jnp.zeros_like(_f32(p._data)),
+                "v": jnp.zeros_like(_f32(p._data))}
+
+    def _ctx(self):
+        # all jnp ops: _step_value is a tracer under whole-step capture
+        t = jnp.asarray(self._step_value, jnp.float32)
+        rho_inf = 2.0 / (1.0 - self._beta2) - 1.0
+        b2t = self._beta2 ** t
+        rho_t = rho_inf - 2.0 * t * b2t / (1.0 - b2t)
+        r = (((rho_t - 4.0) * (rho_t - 2.0) * rho_inf)
+             / ((rho_inf - 4.0) * (rho_inf - 2.0)
+                * jnp.maximum(rho_t, 1e-6)))
+        rect = jnp.sqrt(jnp.maximum(r, 0.0))
+        return {"bias1": 1.0 - self._beta1 ** t,
+                "bias2": 1.0 - b2t, "rho_t": rho_t, "rect": rect}
+
+    def _update(self, g, p, state, lr, ctx):
+        g = _f32(g) + ctx["wd"] * _f32(p)
+        m = self._beta1 * state["m"] + (1 - self._beta1) * g
+        v = self._beta2 * state["v"] + (1 - self._beta2) * g * g
+        m_hat = m / ctx["bias1"]
+        v_hat = jnp.sqrt(v / ctx["bias2"])
+        adam_step = ctx["rect"] * m_hat / (v_hat + self._epsilon)
+        step = jnp.where(ctx["rho_t"] > 5.0, adam_step, m_hat)
+        return (p - (lr * step).astype(p.dtype)), {"m": m, "v": v}

@@ -111,13 +111,21 @@ def _compile(sources, extra_cflags=None, include_dirs=None,
     so = os.path.join(build_dir, f"ext_{h.hexdigest()[:16]}.so")
     if os.path.exists(so):
         return so
-    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-o", so]
+    # build to a temp name then rename: a killed/concurrent g++ must not
+    # leave a half-written .so that existence-checking would trust forever
+    tmp = f"{so}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-o", tmp]
     for d in include_dirs or []:
         cmd += ["-I", d]
     cmd += list(extra_cflags or []) + list(sources)
     if verbose:
         print("cpp_extension:", " ".join(cmd))
-    subprocess.run(cmd, check=True, capture_output=not verbose)
+    try:
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+        os.replace(tmp, so)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
     return so
 
 

@@ -1,0 +1,211 @@
+"""New optimizers (Rprop/ASGD/NAdam/RAdam) + distribution tranche 2
+(reference: test/legacy_test/test_rprop_op.py, test_asgd_op.py,
+test_distribution_*.py — statistics + scipy-reference strategy)."""
+
+import math
+
+import numpy as np
+import pytest
+from scipy import stats as sps
+
+import paddle_tpu as pt
+import paddle_tpu.distribution as D
+from paddle_tpu import optimizer as O
+
+
+@pytest.mark.parametrize("cls,kw", [
+    (O.Rprop, dict(learning_rate=0.1)),
+    (O.ASGD, dict(learning_rate=0.1)),
+    (O.NAdam, dict(learning_rate=0.1)),
+    (O.RAdam, dict(learning_rate=0.1)),
+    (O.Adadelta, dict(learning_rate=1.0)),
+])
+def test_optimizer_converges_quadratic(cls, kw):
+    pt.seed(4)
+    w = pt.to_tensor(np.array([3.0, -2.0], np.float32), stop_gradient=False)
+    opt = cls(parameters=[w], **kw)
+    first = None
+    for _ in range(80):
+        loss = (w * w).sum()
+        loss.backward()
+        if first is None:
+            first = float(loss.numpy())
+        opt.step()
+        opt.clear_grad()
+    assert float((w * w).sum().numpy()) < first * 0.9
+
+
+def test_asgd_average_trails_iterate():
+    pt.seed(5)
+    w = pt.to_tensor(np.array([4.0], np.float32), stop_gradient=False)
+    opt = O.ASGD(learning_rate=0.05, parameters=[w])
+    for _ in range(20):
+        (w * w).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    avg = float(opt.averaged_params()[0].numpy())
+    cur = float(w.numpy())
+    assert cur < avg < 4.0  # average lags the decreasing iterate
+
+
+def test_cauchy_chi2():
+    c = D.Cauchy(1.0, 2.0)
+    for v in (0.0, 1.0, 3.5):
+        np.testing.assert_allclose(float(c.log_prob(pt.to_tensor(v)).numpy()),
+                                   sps.cauchy(1.0, 2.0).logpdf(v), rtol=1e-5)
+    np.testing.assert_allclose(float(c.cdf(pt.to_tensor(3.0)).numpy()),
+                               sps.cauchy(1.0, 2.0).cdf(3.0), rtol=1e-5)
+    with pytest.raises(ValueError):
+        c.mean
+
+    chi = D.Chi2(5.0)
+    np.testing.assert_allclose(float(chi.log_prob(pt.to_tensor(2.0)).numpy()),
+                               sps.chi2(5.0).logpdf(2.0), rtol=1e-4)
+    np.testing.assert_allclose(float(chi.mean.numpy()), 5.0, rtol=1e-6)
+
+
+def test_gumbel_stats_and_kl():
+    g = D.Gumbel(1.0, 2.0)
+    np.testing.assert_allclose(float(g.log_prob(pt.to_tensor(2.0)).numpy()),
+                               sps.gumbel_r(1.0, 2.0).logpdf(2.0), rtol=1e-5)
+    np.testing.assert_allclose(float(g.mean.numpy()),
+                               sps.gumbel_r(1.0, 2.0).mean(), rtol=1e-5)
+    np.testing.assert_allclose(float(g.entropy().numpy()),
+                               sps.gumbel_r(1.0, 2.0).entropy(), rtol=1e-5)
+    assert float(D.kl_divergence(g, g).numpy()) == pytest.approx(0.0,
+                                                                 abs=1e-6)
+    assert float(D.kl_divergence(g, D.Gumbel(0.0, 1.0)).numpy()) > 0
+
+
+def test_multivariate_normal():
+    cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+    mvn = D.MultivariateNormal(np.zeros(2, np.float32),
+                               covariance_matrix=cov)
+    ref = sps.multivariate_normal(np.zeros(2), cov)
+    for v in ([0.0, 0.0], [1.0, -1.0]):
+        np.testing.assert_allclose(
+            float(mvn.log_prob(pt.to_tensor(np.asarray(v, np.float32)))
+                  .numpy()), ref.logpdf(v), rtol=1e-4)
+    np.testing.assert_allclose(float(mvn.entropy().numpy()), ref.entropy(),
+                               rtol=1e-5)
+    pt.seed(0)
+    s = np.asarray(mvn.sample((20000,)).numpy())
+    np.testing.assert_allclose(np.cov(s.T), cov, atol=0.1)
+
+
+def test_binomial_continuous_bernoulli():
+    b = D.Binomial(10.0, 0.3)
+    ref = sps.binom(10, 0.3)
+    for k in (0.0, 3.0, 10.0):
+        np.testing.assert_allclose(float(b.log_prob(pt.to_tensor(k)).numpy()),
+                                   ref.logpmf(k), rtol=1e-4)
+    np.testing.assert_allclose(float(b.entropy().numpy()), ref.entropy(),
+                               rtol=1e-4)
+
+    cb = D.ContinuousBernoulli(0.3)
+    # density integrates to ~1 over [0, 1]
+    xs = np.linspace(1e-4, 1 - 1e-4, 2001).astype(np.float32)
+    dens = np.asarray(cb.prob(pt.to_tensor(xs)).numpy())
+    np.testing.assert_allclose(np.trapezoid(dens, xs), 1.0, rtol=1e-3)
+    # lam=0.5 limit: uniform
+    cb5 = D.ContinuousBernoulli(0.5)
+    np.testing.assert_allclose(float(cb5.mean.numpy()), 0.5, atol=1e-4)
+
+
+def test_transforms_and_transformed_distribution():
+    t = D.AffineTransform(1.0, 2.0)
+    x = pt.to_tensor(np.array([0.5], np.float32))
+    y = t.forward(x)
+    np.testing.assert_allclose(np.asarray(y.numpy()), [2.0])
+    np.testing.assert_allclose(np.asarray(t.inverse(y).numpy()), [0.5])
+    np.testing.assert_allclose(
+        float(t.forward_log_det_jacobian(x).numpy()), math.log(2.0))
+
+    chain = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                              D.ExpTransform()])
+    np.testing.assert_allclose(float(chain.forward(x).numpy()),
+                               math.exp(1.0), rtol=1e-6)
+
+    # TransformedDistribution(Normal, exp) == LogNormal
+    td = D.TransformedDistribution(D.Normal(0.0, 1.0), [D.ExpTransform()])
+    for v in (0.5, 2.0):
+        np.testing.assert_allclose(
+            float(td.log_prob(pt.to_tensor(v)).numpy()),
+            sps.lognorm(1.0).logpdf(v), rtol=1e-5)
+    pt.seed(1)
+    s = np.asarray(td.sample((20000,)).numpy())
+    np.testing.assert_allclose(np.log(s).mean(), 0.0, atol=0.05)
+
+    th = D.TanhTransform()
+    xx = pt.to_tensor(np.array([0.3], np.float32))
+    np.testing.assert_allclose(
+        float(th.forward_log_det_jacobian(xx).numpy()),
+        math.log(1 - math.tanh(0.3) ** 2), rtol=1e-5)
+
+
+def test_gumbel_kl_closed_form_vs_mc():
+    # reviewer counterexample: differing locs
+    np.testing.assert_allclose(
+        float(D.kl_divergence(D.Gumbel(0.0, 1.0),
+                              D.Gumbel(1.0, 1.0)).numpy()),
+        math.e - 2.0, rtol=1e-5)
+    pt.seed(0)
+    p, q = D.Gumbel(0.5, 1.5), D.Gumbel(-0.3, 0.8)
+    s = p.sample((100000,))
+    mc = float(np.mean(np.asarray(p.log_prob(s).numpy())
+                       - np.asarray(q.log_prob(s).numpy())))
+    np.testing.assert_allclose(float(D.kl_divergence(p, q).numpy()), mc,
+                               rtol=0.05)
+
+
+def test_radam_under_capture_and_rprop_int_lr():
+    import paddle_tpu.nn as nn
+
+    pt.seed(1)
+    m = nn.Linear(4, 4)
+    opt = O.RAdam(learning_rate=0.01, parameters=m.parameters())
+
+    @pt.jit.to_static
+    def step(x):
+        loss = (m(x) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    x = pt.to_tensor(np.random.RandomState(0).randn(2, 4).astype(np.float32))
+    first = float(step(x).numpy())
+    for _ in range(6):
+        last = float(step(x).numpy())
+    assert last < first
+
+    # Rprop must accept an int learning rate (base _lr_value handles it)
+    w = pt.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    ro = O.Rprop(learning_rate=1, parameters=[w])
+    (w * w).sum().backward()
+    ro.step()
+
+
+def test_asgd_finalize_swaps_average():
+    pt.seed(6)
+    w = pt.to_tensor(np.array([4.0], np.float32), stop_gradient=False)
+    opt = O.ASGD(learning_rate=0.05, parameters=[w])
+    for _ in range(10):
+        (w * w).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    avg = float(opt.averaged_params()[0].numpy())
+    opt.finalize()
+    np.testing.assert_allclose(float(w.numpy()), avg, rtol=1e-6)
+
+
+def test_transformed_event_shape_sums_jacobian():
+    cov = np.eye(2, dtype=np.float32)
+    base = D.MultivariateNormal(np.zeros(2, np.float32),
+                                covariance_matrix=cov)
+    td = D.TransformedDistribution(base, [D.ExpTransform()])
+    v = np.array([1.5, 0.7], np.float32)
+    got = float(td.log_prob(pt.to_tensor(v)).numpy())
+    ref = (sps.multivariate_normal(np.zeros(2), cov).logpdf(np.log(v))
+           - np.log(v).sum())
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
