@@ -516,8 +516,12 @@ from .transform import (AbsTransform, AffineTransform, ChainTransform,
 
 class ExponentialFamily(Distribution):
     """Base for exponential-family distributions (reference
-    exponential_family.py): entropy via the Bregman identity when
-    subclasses provide natural params + log-normalizer."""
+    exponential_family.py): entropy via the Bregman identity
+    H = F(eta) - <eta, grad F(eta)> - E[log h(x)], with
+    E[log h] supplied by ``_mean_carrier_measure`` (0 by default) —
+    subclasses provide natural params + the log-normalizer F."""
+
+    _mean_carrier_measure = 0.0
 
     @property
     def _natural_parameters(self):
@@ -525,6 +529,15 @@ class ExponentialFamily(Distribution):
 
     def _log_normalizer(self, *natural_params):
         raise NotImplementedError
+
+    def entropy(self):
+        etas = [jnp.asarray(e, jnp.float32) for e in
+                self._natural_parameters]
+        F_val = self._log_normalizer(*etas)
+        grads = jax.grad(lambda *es: jnp.sum(self._log_normalizer(*es)),
+                         argnums=tuple(range(len(etas))))(*etas)
+        inner = sum(e * g for e, g in zip(etas, grads))
+        return _wrap(F_val - inner - self._mean_carrier_measure)
 
 
 class Cauchy(Distribution):
@@ -733,8 +746,10 @@ class MultivariateNormal(Distribution):
     def log_prob(self, value):
         v = _arr(value) - self.loc
         d = self.event_shape[0]
-        # solve L y = v
-        y = jax.scipy.linalg.solve_triangular(self._tril, v[..., None],
+        # solve L y = v (tril broadcast to the value's batch shape)
+        tril = jnp.broadcast_to(self._tril,
+                                v.shape[:-1] + self._tril.shape[-2:])
+        y = jax.scipy.linalg.solve_triangular(tril, v[..., None],
                                               lower=True)[..., 0]
         half_logdet = jnp.sum(jnp.log(jnp.diagonal(
             self._tril, axis1=-2, axis2=-1)), -1)

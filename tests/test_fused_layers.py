@@ -1,0 +1,93 @@
+"""Fused layer classes (reference: incubate/nn/layer/fused_transformer.py;
+tests: test/legacy_test/test_fused_attention_op.py etc. — here checked
+against the unfused nn composition)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate.nn import (
+    FusedBiasDropoutResidualLayerNorm, FusedDropoutAdd, FusedFeedForward,
+    FusedLinear, FusedMultiHeadAttention, FusedMultiTransformer,
+    FusedTransformerEncoderLayer)
+
+
+def test_fused_linear_matches_linear():
+    pt.seed(1)
+    fl = FusedLinear(8, 4)
+    x = pt.to_tensor(np.random.RandomState(0).randn(3, 8).astype(np.float32))
+    ref = nn.functional.linear(x, fl.weight, fl.bias)
+    np.testing.assert_allclose(np.asarray(fl(x).numpy()),
+                               np.asarray(ref.numpy()), rtol=1e-6)
+    # transpose_weight stores [out, in]
+    ft = FusedLinear(8, 4, transpose_weight=True)
+    assert tuple(ft.weight.shape) == (4, 8)
+    out = ft(x)
+    assert tuple(out.shape) == (3, 4)
+
+
+def test_fused_dropout_add_eval_is_add():
+    fd = FusedDropoutAdd(p=0.5)
+    fd.eval()
+    x = pt.to_tensor(np.ones((2, 3), np.float32))
+    y = pt.to_tensor(np.full((2, 3), 2.0, np.float32))
+    np.testing.assert_allclose(np.asarray(fd(x, y).numpy()), 3.0)
+
+
+def test_fused_bias_dropout_residual_ln():
+    pt.seed(2)
+    m = FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+    m.eval()
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.randn(2, 5, 8).astype(np.float32))
+    res = pt.to_tensor(rng.randn(2, 5, 8).astype(np.float32))
+    out = m(x, res)
+    ref = nn.functional.layer_norm(res + x + m.linear_bias, [8],
+                                   weight=m.ln_scale, bias=m.ln_bias)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(ref.numpy()), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_fused_mha_shape_and_grad():
+    pt.seed(3)
+    m = FusedMultiHeadAttention(16, 4, dropout_rate=0.0,
+                                attn_dropout_rate=0.0)
+    m.eval()
+    x = pt.to_tensor(np.random.RandomState(1).randn(2, 6, 16)
+                     .astype(np.float32), stop_gradient=False)
+    out = m(x)
+    assert tuple(out.shape) == (2, 6, 16)
+    out.sum().backward()
+    assert x.grad is not None
+    assert np.isfinite(np.asarray(m.qkv_weight.grad.numpy())).all()
+
+
+def test_fused_ffn_and_encoder_layer():
+    pt.seed(4)
+    ffn = FusedFeedForward(16, 32, dropout_rate=0.0)
+    ffn.eval()
+    x = pt.to_tensor(np.random.RandomState(2).randn(2, 5, 16)
+                     .astype(np.float32))
+    out = ffn(x)
+    assert tuple(out.shape) == (2, 5, 16)
+
+    enc = FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0)
+    enc.eval()
+    out = enc(x)
+    assert tuple(out.shape) == (2, 5, 16)
+    assert np.isfinite(np.asarray(out.numpy())).all()
+
+
+def test_fused_multi_transformer_stacks():
+    pt.seed(5)
+    mt = FusedMultiTransformer(16, 4, 32, num_layers=3)
+    mt.eval()
+    x = pt.to_tensor(np.random.RandomState(3).randn(2, 4, 16)
+                     .astype(np.float32))
+    out = mt(x)
+    assert tuple(out.shape) == (2, 4, 16)
+    # stacking != identity and more layers change the output
+    one = FusedMultiTransformer(16, 4, 32, num_layers=1)
+    assert len(mt.layers) == 3 and len(one.layers) == 1

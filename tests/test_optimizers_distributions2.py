@@ -209,3 +209,36 @@ def test_transformed_event_shape_sums_jacobian():
     ref = (sps.multivariate_normal(np.zeros(2), cov).logpdf(np.log(v))
            - np.log(v).sum())
     np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_mvn_batched_log_prob():
+    cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+    mvn = D.MultivariateNormal(np.zeros(2, np.float32),
+                               covariance_matrix=cov)
+    vals = np.random.RandomState(0).randn(5, 2).astype(np.float32)
+    lp = np.asarray(mvn.log_prob(pt.to_tensor(vals)).numpy())
+    ref = sps.multivariate_normal(np.zeros(2), cov).logpdf(vals)
+    np.testing.assert_allclose(lp, ref, rtol=1e-3)
+
+
+def test_exponential_family_bregman_entropy():
+    import jax.numpy as jnp
+
+    class NormalEF(D.ExponentialFamily):
+        _mean_carrier_measure = -0.5 * np.log(2 * np.pi)
+
+        def __init__(self, loc, scale):
+            self.loc = jnp.asarray(loc)
+            self.scale = jnp.asarray(scale)
+            super().__init__(())
+
+        @property
+        def _natural_parameters(self):
+            return (self.loc / self.scale ** 2, -0.5 / self.scale ** 2)
+
+        def _log_normalizer(self, e1, e2):
+            return -e1 ** 2 / (4 * e2) - 0.5 * jnp.log(-2 * e2)
+
+    ef = NormalEF(1.0, 2.0)
+    np.testing.assert_allclose(float(ef.entropy().numpy()),
+                               sps.norm(1.0, 2.0).entropy(), rtol=1e-5)
