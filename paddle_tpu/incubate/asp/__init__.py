@@ -22,6 +22,8 @@ import numpy as np
 
 import jax.numpy as jnp
 
+import weakref
+
 from ...core.tensor import Tensor
 
 __all__ = [
@@ -33,7 +35,9 @@ __all__ = [
 
 # layer-name exclusions per model id (reference ASPHelper MASK maps)
 _EXCLUDED: dict[int, set] = {}
-# id(param) -> (param, mask Tensor)
+# id(param) -> (weakref(param), mask Tensor): weak so registered models can
+# be garbage collected (a strong ref here would leak every pruned net into
+# the whole-step capture state registry for the process lifetime)
 _MASKS: dict[int, tuple] = {}
 
 
@@ -138,7 +142,7 @@ def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
         mask_t = Tensor(jnp.asarray(mask_np), stop_gradient=True)
         masks[name] = mask_t
         if with_mask:
-            _MASKS[id(w)] = (w, mask_t)
+            _MASKS[id(w)] = (weakref.ref(w), mask_t)
     return masks
 
 
@@ -152,7 +156,11 @@ class OptimizerWithSparsityGuarantee:
 
     def step(self):
         self._optimizer.step()
-        for w, mask in list(_MASKS.values()):
+        for key, (wref, mask) in list(_MASKS.items()):
+            w = wref()
+            if w is None:
+                del _MASKS[key]
+                continue
             w.set_value(Tensor(w._data * mask._data))
 
     def __getattr__(self, name):
