@@ -142,7 +142,9 @@ def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
         mask_t = Tensor(jnp.asarray(mask_np), stop_gradient=True)
         masks[name] = mask_t
         if with_mask:
-            _MASKS[id(w)] = (weakref.ref(w), mask_t)
+            key = id(w)
+            _MASKS[key] = (weakref.ref(
+                w, lambda _, k=key: _MASKS.pop(k, None)), mask_t)
     return masks
 
 

@@ -17,7 +17,8 @@ from ..core.dispatch import op
 from ..core.tensor import Tensor
 from ..io.dataset import Dataset
 
-__all__ = ["ViterbiDecoder", "viterbi_decode", "UCIHousing", "Imdb"]
+__all__ = ["ViterbiDecoder", "viterbi_decode", "UCIHousing", "Imdb",
+           "Imikolov", "Movielens", "Conll05st", "WMT14", "WMT16"]
 
 
 @op("viterbi_decode")
@@ -98,8 +99,5 @@ class UCIHousing(Dataset):
         return self.features[i], self.labels[i]
 
 
-class Imdb(Dataset):
-    def __init__(self, data_file=None, mode="train", cutoff=150):
-        raise NotImplementedError(
-            "zero-egress build: construct from a local aclImdb tar via a "
-            "custom Dataset")
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens,  # noqa: E402
+                       WMT14, WMT16)

@@ -1,0 +1,394 @@
+"""Text datasets parsed from local archives.
+
+Reference: python/paddle/text/datasets/{imdb,imikolov,movielens,conll05,
+wmt14,wmt16}.py. The reference downloads the archives on first use; this
+is a zero-egress build, so every dataset takes ``data_file=`` pointing at
+the same archive the reference would have downloaded (aclImdb_v1.tar.gz,
+simple-examples.tgz, ml-1m.zip, the WMT tars, ...) and parses it with the
+same tokenization/dict-building behavior.
+"""
+
+from __future__ import annotations
+
+import collections
+import io
+import re
+import tarfile
+import zipfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "Conll05st", "WMT14", "WMT16"]
+
+
+def _require(data_file, hint):
+    if data_file is None:
+        raise ValueError(
+            f"zero-egress build: pass data_file= pointing at {hint}")
+    return data_file
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (reference text/datasets/imdb.py): tokenized docs ->
+    word-id sequences + 0/1 label (pos=0, neg=1), word dict built from the
+    train split with a frequency ``cutoff``."""
+
+    def __init__(self, data_file=None, mode="train", cutoff: int = 150):
+        data_file = _require(data_file, "aclImdb_v1.tar.gz")
+        self._pat = re.compile(r"aclImdb/" + mode + r"/(pos|neg)/.*\.txt$")
+        docs, labels = [], []
+        freq = collections.Counter()
+        token_cache = {}   # train-mode docs tokenized once, reused below
+        with tarfile.open(data_file) as tf:
+            train_pat = re.compile(r"aclImdb/train/(pos|neg)/.*\.txt$")
+            names = tf.getnames()
+            # dict from train split (reference builds word_idx from train)
+            for n in names:
+                if train_pat.match(n):
+                    toks = self._tokenize(tf.extractfile(n).read())
+                    freq.update(toks)
+                    if self._pat.match(n):
+                        token_cache[n] = toks
+            self.word_idx = self._build_dict(freq, cutoff)
+            unk = self.word_idx["<unk>"]
+            for n in names:
+                m = self._pat.match(n)
+                if m:
+                    toks = token_cache.get(n)
+                    if toks is None:
+                        toks = self._tokenize(tf.extractfile(n).read())
+                    docs.append(np.asarray(
+                        [self.word_idx.get(t, unk) for t in toks],
+                        np.int64))
+                    labels.append(0 if m.group(1) == "pos" else 1)
+        self.docs = docs
+        self.labels = np.asarray(labels, np.int64)
+
+    @staticmethod
+    def _tokenize(raw: bytes):
+        s = raw.decode("utf-8", "ignore").lower().replace("<br />", " ")
+        return re.findall(r"[a-z0-9']+", s)
+
+    @staticmethod
+    def _build_dict(freq, cutoff):
+        kept = sorted((w for w, c in freq.items() if c >= cutoff),
+                      key=lambda w: (-freq[w], w))
+        word_idx = {w: i for i, w in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+
+class Imikolov(Dataset):
+    """PTB language-model dataset (reference imikolov.py): NGRAM mode
+    yields window_size-grams, SEQ mode yields <s> ... <e> id sequences;
+    dict built from train with ``min_word_freq``."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50):
+        data_file = _require(data_file, "simple-examples.tgz")
+        assert data_type in ("NGRAM", "SEQ")
+        if data_type == "NGRAM" and window_size < 2:
+            raise ValueError("NGRAM mode needs window_size >= 2")
+        path = {"train": "./simple-examples/data/ptb.train.txt",
+                "test": "./simple-examples/data/ptb.valid.txt"}[mode]
+        train_path = "./simple-examples/data/ptb.train.txt"
+        with tarfile.open(data_file) as tf:
+            names = {n.lstrip("./"): n for n in tf.getnames()}
+            train_lines = tf.extractfile(
+                names[train_path.lstrip("./")]).read().decode().splitlines()
+            lines = tf.extractfile(
+                names[path.lstrip("./")]).read().decode().splitlines()
+        freq = collections.Counter()
+        for ln in train_lines:
+            freq.update(ln.split())
+        kept = sorted((w for w, c in freq.items()
+                       if c >= min_word_freq and w != "<unk>"),
+                      key=lambda w: (-freq[w], w))
+        self.word_idx = {w: i for i, w in enumerate(kept)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        self.word_idx.setdefault("<s>", len(self.word_idx))
+        self.word_idx.setdefault("<e>", len(self.word_idx))
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        for ln in lines:
+            ids = [self.word_idx["<s>"]] + \
+                [self.word_idx.get(w, unk) for w in ln.split()] + \
+                [self.word_idx["<e>"]]
+            if data_type == "SEQ":
+                self.data.append(np.asarray(ids, np.int64))
+            else:
+                for k in range(len(ids) - window_size + 1):
+                    self.data.append(np.asarray(ids[k:k + window_size],
+                                                np.int64))
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings (reference movielens.py): each sample is
+    (user_id, gender, age, occupation, movie_id, category_ids, title_ids,
+    rating), parsed from ml-1m.zip; 9:1 train/test hash split."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0):
+        data_file = _require(data_file, "ml-1m.zip")
+        rng = np.random.RandomState(rand_seed)
+        with zipfile.ZipFile(data_file) as zf:
+            movies = self._read(zf, "ml-1m/movies.dat")
+            users = self._read(zf, "ml-1m/users.dat")
+            ratings = self._read(zf, "ml-1m/ratings.dat")
+        cats, titles = {}, {}
+        self.movie_info = {}
+        for ln in movies:
+            mid, title, genres = ln.split("::")
+            gids = []
+            for g in genres.split("|"):
+                gids.append(cats.setdefault(g, len(cats)))
+            tids = []
+            for w in re.findall(r"[a-z0-9']+", title.lower()):
+                tids.append(titles.setdefault(w, len(titles)))
+            self.movie_info[int(mid)] = (gids, tids)
+        self.categories_dict = cats
+        self.movie_title_dict = titles
+        genders = {"M": 0, "F": 1}
+        ages = {a: i for i, a in enumerate([1, 18, 25, 35, 45, 50, 56])}
+        self.user_info = {}
+        for ln in users:
+            uid, gender, age, job, _zip = ln.split("::")
+            self.user_info[int(uid)] = (genders[gender], ages[int(age)],
+                                        int(job))
+        self.data = []
+        for ln in ratings:
+            uid, mid, rating, _ts = ln.split("::")
+            uid, mid = int(uid), int(mid)
+            is_test = rng.rand() < test_ratio
+            if (mode == "test") != is_test:
+                continue
+            g, a, j = self.user_info[uid]
+            gids, tids = self.movie_info[mid]
+            self.data.append((
+                np.asarray([uid], np.int64), np.asarray([g], np.int64),
+                np.asarray([a], np.int64), np.asarray([j], np.int64),
+                np.asarray([mid], np.int64),
+                np.asarray(gids, np.int64), np.asarray(tids, np.int64),
+                np.asarray([float(rating)], np.float32)))
+
+    @staticmethod
+    def _read(zf, name):
+        return zf.read(name).decode("latin1").splitlines()
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL test split (reference conll05.py): each sample is
+    (words, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, verb, mark, labels) as
+    id arrays over the provided dictionaries."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="test"):
+        data_file = _require(data_file, "conll05st-tests.tar.gz")
+        self.word_dict = self._load_dict(word_dict_file)
+        self.verb_dict = self._load_dict(verb_dict_file)
+        self.label_dict = self._load_dict(target_dict_file)
+        sentences = self._parse(data_file)
+        unk = self.word_dict.get("<unk>", 0)
+        self.data = []
+        for words, verb, vi, labels in sentences:
+            w = np.asarray([self.word_dict.get(x, unk) for x in words],
+                           np.int64)
+            n = len(words)
+
+            def ctx(off):
+                # predicate-relative context (reference conll05.py): the
+                # word at verb_index+off, replicated across the sentence
+                word = words[min(max(vi + off, 0), n - 1)]
+                return np.full(n, self.word_dict.get(word, unk), np.int64)
+
+            mark = np.zeros(n, np.int64)
+            mark[vi] = 1
+            self.data.append((
+                w, ctx(-2), ctx(-1), ctx(0), ctx(1), ctx(2),
+                np.full(n, self.verb_dict.get(verb, 0), np.int64), mark,
+                np.asarray([self.label_dict.get(l, 0) for l in labels],
+                           np.int64)))
+
+    @staticmethod
+    def _load_dict(path):
+        if path is None:
+            return {}
+        with open(path) as f:
+            return {ln.strip(): i for i, ln in enumerate(f) if ln.strip()}
+
+    @staticmethod
+    def _parse(data_file):
+        """words/props files: one token per line, blank line = sentence
+        boundary; props column 0 is the verb, column k the k-th prop's
+        tags."""
+        with tarfile.open(data_file) as tf:
+            words_name = next(n for n in tf.getnames()
+                              if n.endswith("words.gz") or
+                              n.endswith("words.txt"))
+            props_name = next(n for n in tf.getnames()
+                              if n.endswith("props.gz") or
+                              n.endswith("props.txt"))
+            words_raw = Conll05st._maybe_gz(tf, words_name)
+            props_raw = Conll05st._maybe_gz(tf, props_name)
+        sentences = []
+        wlines = words_raw.splitlines()
+        plines = props_raw.splitlines()
+        sent_w, sent_p = [], []
+        for wl, pl in zip(wlines, plines):
+            if not wl.strip():
+                if sent_w:
+                    sentences.extend(Conll05st._expand(sent_w, sent_p))
+                sent_w, sent_p = [], []
+                continue
+            sent_w.append(wl.strip())
+            sent_p.append(pl.strip().split())
+        if sent_w:
+            sentences.extend(Conll05st._expand(sent_w, sent_p))
+        return sentences
+
+    @staticmethod
+    def _maybe_gz(tf, name):
+        import gzip
+
+        raw = tf.extractfile(name).read()
+        if name.endswith(".gz"):
+            raw = gzip.decompress(raw)
+        return raw.decode()
+
+    @staticmethod
+    def _expand(words, props):
+        """One sample per predicate column (IOB tags from the bracket
+        notation); the predicate row is the one whose column k+1 carries
+        the (V tag."""
+        out = []
+        n_props = max(len(p) for p in props) - 1 if props else 0
+        for k in range(n_props):
+            vi = next((i for i, p in enumerate(props)
+                       if len(p) > k + 1 and "(V" in p[k + 1]), 0)
+            verb = props[vi][0] if props[vi][0] != "-" else words[vi]
+            labels = []
+            current = None
+            for p in props:
+                tag = p[k + 1] if len(p) > k + 1 else "*"
+                if "(" in tag:
+                    current = tag[tag.index("(") + 1:].split("*")[0] \
+                        .rstrip(")")
+                    labels.append("B-" + current)
+                elif current is not None:
+                    labels.append("I-" + current)
+                else:
+                    labels.append("O")
+                if ")" in tag:
+                    current = None
+            out.append((list(words), verb, vi, labels))
+        return out
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+
+class _WMTBase(Dataset):
+    START = "<s>"
+    END = "<e>"
+    UNK = "<unk>"
+
+    def _build(self, pairs, src_dict_size, trg_dict_size=None):
+        trg_dict_size = src_dict_size if trg_dict_size is None else \
+            trg_dict_size
+        freq_src = collections.Counter()
+        freq_trg = collections.Counter()
+        for s, t in pairs:
+            freq_src.update(s)
+            freq_trg.update(t)
+
+        def mk(freq, dict_size):
+            kept = [w for w, _ in freq.most_common(max(dict_size - 3, 0))]
+            d = {self.START: 0, self.END: 1, self.UNK: 2}
+            for w in kept:
+                d.setdefault(w, len(d))
+            return d
+
+        self.src_ids = mk(freq_src, src_dict_size)
+        self.trg_ids = mk(freq_trg, trg_dict_size)
+        unk = 2
+        self.data = []
+        for s, t in pairs:
+            src = [self.src_ids.get(w, unk) for w in s]
+            trg_in = [0] + [self.trg_ids.get(w, unk) for w in t]
+            trg_out = [self.trg_ids.get(w, unk) for w in t] + [1]
+            self.data.append((np.asarray(src, np.int64),
+                              np.asarray(trg_in, np.int64),
+                              np.asarray(trg_out, np.int64)))
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+
+class WMT14(_WMTBase):
+    """WMT14 en-fr (reference wmt14.py): parallel corpus from the
+    wmt14 tgz (train/test dirs of \\t-separated src/trg lines)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000):
+        data_file = _require(data_file, "wmt14 tgz (dev+test or train)")
+        pairs = []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                if m.isfile() and f"/{mode}/" in f"/{m.name}":
+                    for ln in tf.extractfile(m).read().decode(
+                            "utf-8", "ignore").splitlines():
+                        if "\t" in ln:
+                            s, t = ln.split("\t")[:2]
+                            pairs.append((s.split(), t.split()))
+        self._build(pairs, dict_size)
+
+
+class WMT16(_WMTBase):
+    """WMT16 en-de (reference wmt16.py): train/val/test .en/.de file
+    pairs inside the tar; ``lang`` picks the source side."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en"):
+        data_file = _require(data_file, "wmt16.tar.gz")
+        other = "de" if lang == "en" else "en"
+        name = {"train": "train", "val": "val", "test": "test"}[mode]
+        with tarfile.open(data_file) as tf:
+            names = tf.getnames()
+            src_name = next(n for n in names
+                            if n.endswith(f"{name}.tok.{lang}")
+                            or n.endswith(f"{name}.{lang}"))
+            trg_name = next(n for n in names
+                            if n.endswith(f"{name}.tok.{other}")
+                            or n.endswith(f"{name}.{other}"))
+            src_lines = tf.extractfile(src_name).read().decode(
+                "utf-8", "ignore").splitlines()
+            trg_lines = tf.extractfile(trg_name).read().decode(
+                "utf-8", "ignore").splitlines()
+        pairs = [(s.split(), t.split())
+                 for s, t in zip(src_lines, trg_lines) if s and t]
+        self._build(pairs, src_dict_size, trg_dict_size)

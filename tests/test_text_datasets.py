@@ -1,0 +1,165 @@
+"""Text dataset parsers over synthetic local archives (reference:
+text/datasets/* — the same archive layouts the reference downloads)."""
+
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text import WMT16, Conll05st, Imdb, Imikolov, Movielens
+
+
+def _add(tf, name, content: str):
+    data = content.encode()
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+@pytest.fixture(scope="module")
+def imdb_tar(tmp_path_factory):
+    p = tmp_path_factory.mktemp("imdb") / "aclImdb_v1.tar.gz"
+    with tarfile.open(p, "w:gz") as tf:
+        docs = {
+            "aclImdb/train/pos/0_9.txt": "a great great movie , great fun",
+            "aclImdb/train/pos/1_8.txt": "great acting and a great plot",
+            "aclImdb/train/neg/0_2.txt": "a terrible movie terrible acting",
+            "aclImdb/train/neg/1_1.txt": "terrible terrible plot",
+            "aclImdb/test/pos/0_9.txt": "great movie",
+            "aclImdb/test/neg/0_3.txt": "terrible movie",
+        }
+        for n, c in docs.items():
+            _add(tf, n, c)
+    return str(p)
+
+
+def test_imdb_parsing(imdb_tar):
+    train = Imdb(imdb_tar, mode="train", cutoff=2)
+    assert len(train) == 4
+    # labels: pos=0, neg=1
+    labels = sorted(int(l) for _, l in [train[i] for i in range(4)])
+    assert labels == [0, 0, 1, 1]
+    # dict keeps words with freq >= 2, most-frequent first
+    assert "great" in train.word_idx and "terrible" in train.word_idx
+    assert train.word_idx["great"] == 0  # 5 occurrences, highest
+    assert "<unk>" in train.word_idx
+    assert "fun" not in train.word_idx   # freq 1 < cutoff
+
+    test = Imdb(imdb_tar, mode="test", cutoff=2)
+    assert len(test) == 2
+    ids, lab = test[0]
+    assert ids.dtype == np.int64
+
+
+@pytest.fixture(scope="module")
+def ptb_tar(tmp_path_factory):
+    p = tmp_path_factory.mktemp("ptb") / "simple-examples.tgz"
+    train = "the cat sat\nthe dog sat\nthe cat ran\n" * 5
+    valid = "the cat sat\n"
+    with tarfile.open(p, "w:gz") as tf:
+        _add(tf, "./simple-examples/data/ptb.train.txt", train)
+        _add(tf, "./simple-examples/data/ptb.valid.txt", valid)
+    return str(p)
+
+
+def test_imikolov_ngram_and_seq(ptb_tar):
+    ds = Imikolov(ptb_tar, data_type="NGRAM", window_size=2, mode="train",
+                  min_word_freq=5)
+    # "the" appears 15x, "cat"/"sat" 10x, "dog"/"ran" 5x -> all kept
+    assert "the" in ds.word_idx and ds.word_idx["the"] == 0
+    grams = ds[0]
+    assert grams.shape == (2,)
+    seq = Imikolov(ptb_tar, data_type="SEQ", mode="test", min_word_freq=5)
+    s = seq[0]
+    assert s[0] == seq.word_idx["<s>"] and s[-1] == seq.word_idx["<e>"]
+    assert len(s) == 5  # <s> the cat sat <e>
+
+
+@pytest.fixture(scope="module")
+def ml_zip(tmp_path_factory):
+    p = tmp_path_factory.mktemp("ml") / "ml-1m.zip"
+    with zipfile.ZipFile(p, "w") as zf:
+        zf.writestr("ml-1m/movies.dat",
+                    "1::Toy Story (1995)::Animation|Children's\n"
+                    "2::Jumanji (1995)::Adventure\n")
+        zf.writestr("ml-1m/users.dat",
+                    "1::M::25::6::12345\n2::F::35::3::54321\n")
+        zf.writestr("ml-1m/ratings.dat",
+                    "1::1::5::978300760\n1::2::3::978302109\n"
+                    "2::1::4::978301968\n")
+    return str(p)
+
+
+def test_movielens(ml_zip):
+    train = Movielens(ml_zip, mode="train", test_ratio=0.0)
+    assert len(train) == 3
+    uid, gender, age, job, mid, cats, title, rating = train[0]
+    assert int(uid[0]) == 1 and int(mid[0]) == 1
+    assert float(rating[0]) == 5.0
+    assert len(train.categories_dict) == 3  # Animation, Children's, Adventure
+    assert "toy" in train.movie_title_dict
+    # gender coding M=0/F=1; age bucket 25 -> 2
+    assert int(gender[0]) == 0 and int(age[0]) == 2
+
+
+@pytest.fixture(scope="module")
+def conll_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("conll")
+    tar = d / "conll05st-tests.tar.gz"
+    words = "The\ncat\nsat\n\n"
+    props = "-\t*\n-\t(A0*)\nsat\t(V*)\n\n".replace("\t", " ")
+    with tarfile.open(tar, "w:gz") as tf:
+        _add(tf, "conll05st-release/test.wsj/words/test.wsj.words.txt",
+             words)
+        _add(tf, "conll05st-release/test.wsj/props/test.wsj.props.txt",
+             props)
+    wd = d / "words.dict"
+    wd.write_text("<unk>\nThe\ncat\nsat\n")
+    vd = d / "verbs.dict"
+    vd.write_text("sat\n")
+    td = d / "targets.dict"
+    td.write_text("O\nB-A0\nI-A0\nB-V\n")
+    return str(tar), str(wd), str(vd), str(td)
+
+
+def test_conll05(conll_files):
+    tar, wd, vd, td = conll_files
+    ds = Conll05st(tar, word_dict_file=wd, verb_dict_file=vd,
+                   target_dict_file=td)
+    assert len(ds) == 1
+    w, c_n2, c_n1, c0, c1, c2, verb, mark, labels = ds[0]
+    assert w.tolist() == [1, 2, 3]       # The cat sat
+    # predicate-relative context, replicated across the sentence:
+    # predicate 'sat' at index 2 -> ctx_0 = sat, ctx_-1 = cat everywhere
+    assert c0.tolist() == [3, 3, 3]
+    assert c_n1.tolist() == [2, 2, 2]
+    assert mark.tolist() == [0, 0, 1]    # predicate position
+    assert labels.tolist() == [0, 1, 3]  # O B-A0 B-V
+
+
+@pytest.fixture(scope="module")
+def wmt16_tar(tmp_path_factory):
+    p = tmp_path_factory.mktemp("wmt") / "wmt16.tar.gz"
+    en = "a cat sat\nthe dog ran\n"
+    de = "eine katze sass\nder hund lief\n"
+    with tarfile.open(p, "w:gz") as tf:
+        _add(tf, "wmt16/train.tok.en", en)
+        _add(tf, "wmt16/train.tok.de", de)
+        _add(tf, "wmt16/val.tok.en", "a cat ran\n")
+        _add(tf, "wmt16/val.tok.de", "eine katze lief\n")
+    return str(p)
+
+
+def test_wmt16(wmt16_tar):
+    ds = WMT16(wmt16_tar, mode="train", src_dict_size=50, trg_dict_size=50)
+    assert len(ds) == 2
+    src, trg_in, trg_out = ds[0]
+    # special tokens: <s>=0 <e>=1 <unk>=2
+    assert trg_in[0] == 0 and trg_out[-1] == 1
+    assert len(trg_in) == len(trg_out)
+    assert ds.src_ids["<s>"] == 0 and ds.trg_ids["<unk>"] == 2
+    val = WMT16(wmt16_tar, mode="val")
+    assert len(val) == 1
