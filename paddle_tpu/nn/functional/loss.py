@@ -407,11 +407,78 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     return _reduce(loss, reduction)
 
 
-def rnnt_loss(*args, **kwargs):
-    raise NotImplementedError(
-        "rnnt_loss: transducer loss planned; reference binds warprnnt "
-        "(python/paddle/nn/functional/loss.py 'rnnt_loss')"
-    )
+@op("rnnt_loss", amp="keep_fp32")
+def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean"):
+    """RNN-Transducer loss (reference binds warprnnt,
+    python/paddle/nn/functional/loss.py rnnt_loss; here the forward
+    algorithm runs natively as a lax.scan dynamic program over the (T, U)
+    lattice — the TPU-friendly formulation, no external library).
+
+    logits: [B, T, U+1, V]; labels: [B, U] int; lengths per sample.
+    """
+    x = logits.astype(jnp.float32)
+    B, T, U1, V = x.shape
+    U = U1 - 1
+    lp = jax.nn.log_softmax(x, axis=-1)
+    lab = labels.astype(jnp.int32)
+    # per-(t,u) blank and label-emission log-probs
+    blank_lp = lp[..., blank]                                 # [B, T, U+1]
+    lab_ids = jnp.concatenate([lab, jnp.zeros((B, 1), jnp.int32)], 1)
+    emit_lp = jnp.take_along_axis(
+        lp, jnp.broadcast_to(lab_ids[:, None, :, None], (B, T, U1, 1)),
+        axis=-1)[..., 0]                                      # [B, T, U+1]
+    if fastemit_lambda:
+        # FastEmit (Yu et al. 2021): scale the label-emission gradient by
+        # (1 + lambda). Forward value unchanged; backward sees the scaled
+        # path — exactly warprnnt's fastemit_lambda semantics.
+        emit_lp = (1.0 + fastemit_lambda) * emit_lp - \
+            fastemit_lambda * jax.lax.stop_gradient(emit_lp)
+
+    # initialize alpha at t=0: alpha[0,0]=0; alpha[0,u]=sum emit along u
+    def init_row(b_emit0):
+        def body(c, e):
+            c = c + e
+            return c, c
+
+        _, rest = jax.lax.scan(body, 0.0, b_emit0[:-1])
+        return jnp.concatenate([jnp.zeros((1,)), rest])
+
+    alpha0 = jax.vmap(init_row)(emit_lp[:, 0])                # [B, U+1]
+
+    def step(alpha_prev, t):
+        blank_t1 = lp[..., blank][:, t - 1]                   # [B, U+1]
+        emit_t = emit_lp[:, t]
+        horiz = alpha_prev + blank_t1
+
+        def scan_u(b_h, b_e):
+            def per_u(c, inp):
+                h_u, e_prev = inp
+                v = jnp.logaddexp(h_u, c + e_prev)
+                return v, v
+
+            a0 = b_h[0]
+            _, rest = jax.lax.scan(per_u, a0, (b_h[1:], b_e[:-1]))
+            return jnp.concatenate([a0[None], rest])
+
+        alpha_t = jax.vmap(scan_u)(horiz, emit_t)
+        return alpha_t, alpha_t
+
+    alpha_last, alphas = jax.lax.scan(step, alpha0,
+                                      jnp.arange(1, T))
+    all_alphas = jnp.concatenate([alpha0[None], alphas], 0)   # [T, B, U+1]
+    all_alphas = jnp.moveaxis(all_alphas, 0, 1)               # [B, T, U+1]
+    tl = logit_lengths.astype(jnp.int32).reshape(-1)
+    ul = label_lengths.astype(jnp.int32).reshape(-1)
+    # total log-prob = alpha[T-1, U] + blank(T-1, U) per the true lengths
+    a_final = all_alphas[jnp.arange(B), tl - 1, ul]
+    b_final = blank_lp[jnp.arange(B), tl - 1, ul]
+    nll = -(a_final + b_final)
+    if reduction == "mean":
+        return nll.mean()
+    if reduction == "sum":
+        return nll.sum()
+    return nll
 
 
 @op("margin_cross_entropy", amp="keep_fp32")

@@ -32,21 +32,23 @@ def _require(data_file, hint):
 
 class Imdb(Dataset):
     """IMDB sentiment (reference text/datasets/imdb.py): tokenized docs ->
-    word-id sequences + 0/1 label (pos=0, neg=1), word dict built from the
-    train split with a frequency ``cutoff``."""
+    word-id sequences + 0/1 label (pos=0, neg=1). Matching the reference:
+    the word dict is built from train AND test docs, keeps words with
+    frequency strictly greater than ``cutoff``, and tokenizes by stripping
+    punctuation then splitting on whitespace."""
 
     def __init__(self, data_file=None, mode="train", cutoff: int = 150):
         data_file = _require(data_file, "aclImdb_v1.tar.gz")
         self._pat = re.compile(r"aclImdb/" + mode + r"/(pos|neg)/.*\.txt$")
         docs, labels = [], []
         freq = collections.Counter()
-        token_cache = {}   # train-mode docs tokenized once, reused below
+        token_cache = {}   # this mode's docs tokenized once, reused below
         with tarfile.open(data_file) as tf:
-            train_pat = re.compile(r"aclImdb/train/(pos|neg)/.*\.txt$")
+            dict_pat = re.compile(
+                r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
             names = tf.getnames()
-            # dict from train split (reference builds word_idx from train)
             for n in names:
-                if train_pat.match(n):
+                if dict_pat.match(n):
                     toks = self._tokenize(tf.extractfile(n).read())
                     freq.update(toks)
                     if self._pat.match(n):
@@ -66,14 +68,18 @@ class Imdb(Dataset):
         self.docs = docs
         self.labels = np.asarray(labels, np.int64)
 
-    @staticmethod
-    def _tokenize(raw: bytes):
+    _PUNCT = str.maketrans("", "", "!\"#$%&'()*+,-./:;<=>?@[\\]^_`{|}~")
+
+    @classmethod
+    def _tokenize(cls, raw: bytes):
         s = raw.decode("utf-8", "ignore").lower().replace("<br />", " ")
-        return re.findall(r"[a-z0-9']+", s)
+        return s.translate(cls._PUNCT).split()
 
     @staticmethod
     def _build_dict(freq, cutoff):
-        kept = sorted((w for w, c in freq.items() if c >= cutoff),
+        # strictly greater than cutoff, frequency-sorted (reference
+        # build_dict semantics)
+        kept = sorted((w for w, c in freq.items() if c > cutoff),
                       key=lambda w: (-freq[w], w))
         word_idx = {w: i for i, w in enumerate(kept)}
         word_idx["<unk>"] = len(word_idx)
@@ -98,7 +104,8 @@ class Imikolov(Dataset):
         if data_type == "NGRAM" and window_size < 2:
             raise ValueError("NGRAM mode needs window_size >= 2")
         path = {"train": "./simple-examples/data/ptb.train.txt",
-                "test": "./simple-examples/data/ptb.valid.txt"}[mode]
+                "valid": "./simple-examples/data/ptb.valid.txt",
+                "test": "./simple-examples/data/ptb.test.txt"}[mode]
         train_path = "./simple-examples/data/ptb.train.txt"
         with tarfile.open(data_file) as tf:
             names = {n.lstrip("./"): n for n in tf.getnames()}
@@ -106,9 +113,11 @@ class Imikolov(Dataset):
                 names[train_path.lstrip("./")]).read().decode().splitlines()
             lines = tf.extractfile(
                 names[path.lstrip("./")]).read().decode().splitlines()
+        # <s>/<e> are counted once per line and frequency-sorted into the
+        # dict like ordinary words (reference build_dict over tagged lines)
         freq = collections.Counter()
         for ln in train_lines:
-            freq.update(ln.split())
+            freq.update(["<s>"] + ln.split() + ["<e>"])
         kept = sorted((w for w, c in freq.items()
                        if c >= min_word_freq and w != "<unk>"),
                       key=lambda w: (-freq[w], w))
@@ -123,7 +132,9 @@ class Imikolov(Dataset):
                 [self.word_idx.get(w, unk) for w in ln.split()] + \
                 [self.word_idx["<e>"]]
             if data_type == "SEQ":
-                self.data.append(np.asarray(ids, np.int64))
+                # (source, target) shifted pair (reference SEQ mode)
+                self.data.append((np.asarray(ids[:-1], np.int64),
+                                  np.asarray(ids[1:], np.int64)))
             else:
                 for k in range(len(ids) - window_size + 1):
                     self.data.append(np.asarray(ids[k:k + window_size],
@@ -156,8 +167,10 @@ class Movielens(Dataset):
             gids = []
             for g in genres.split("|"):
                 gids.append(cats.setdefault(g, len(cats)))
+            # reference strips the trailing "(year)" before tokenizing
+            title = re.sub(r"\(\d{4}\)\s*$", "", title).strip()
             tids = []
-            for w in re.findall(r"[a-z0-9']+", title.lower()):
+            for w in title.lower().split():
                 tids.append(titles.setdefault(w, len(titles)))
             self.movie_info[int(mid)] = (gids, tids)
         self.categories_dict = cats
@@ -183,7 +196,8 @@ class Movielens(Dataset):
                 np.asarray([a], np.int64), np.asarray([j], np.int64),
                 np.asarray([mid], np.int64),
                 np.asarray(gids, np.int64), np.asarray(tids, np.int64),
-                np.asarray([float(rating)], np.float32)))
+                # reference maps the 1-5 stars to rating*2 - 5 (-3..5)
+                np.asarray([float(rating) * 2.0 - 5.0], np.float32)))
 
     @staticmethod
     def _read(zf, name):
@@ -315,27 +329,34 @@ class _WMTBase(Dataset):
     END = "<e>"
     UNK = "<unk>"
 
-    def _build(self, pairs, src_dict_size, trg_dict_size=None):
+    def _build(self, pairs, src_dict_size, trg_dict_size=None,
+               encode_pairs=None, dicts=None):
+        """Build (or adopt) the vocabularies from ``pairs`` and encode
+        ``encode_pairs`` (defaults to the same corpus)."""
         trg_dict_size = src_dict_size if trg_dict_size is None else \
             trg_dict_size
-        freq_src = collections.Counter()
-        freq_trg = collections.Counter()
-        for s, t in pairs:
-            freq_src.update(s)
-            freq_trg.update(t)
+        if dicts is not None:
+            self.src_ids, self.trg_ids = dicts
+        else:
+            freq_src = collections.Counter()
+            freq_trg = collections.Counter()
+            for s, t in pairs:
+                freq_src.update(s)
+                freq_trg.update(t)
 
-        def mk(freq, dict_size):
-            kept = [w for w, _ in freq.most_common(max(dict_size - 3, 0))]
-            d = {self.START: 0, self.END: 1, self.UNK: 2}
-            for w in kept:
-                d.setdefault(w, len(d))
-            return d
+            def mk(freq, dict_size):
+                kept = [w for w, _ in
+                        freq.most_common(max(dict_size - 3, 0))]
+                d = {self.START: 0, self.END: 1, self.UNK: 2}
+                for w in kept:
+                    d.setdefault(w, len(d))
+                return d
 
-        self.src_ids = mk(freq_src, src_dict_size)
-        self.trg_ids = mk(freq_trg, trg_dict_size)
+            self.src_ids = mk(freq_src, src_dict_size)
+            self.trg_ids = mk(freq_trg, trg_dict_size)
         unk = 2
         self.data = []
-        for s, t in pairs:
+        for s, t in (encode_pairs if encode_pairs is not None else pairs):
             src = [self.src_ids.get(w, unk) for w in s]
             trg_in = [0] + [self.trg_ids.get(w, unk) for w in t]
             trg_out = [self.trg_ids.get(w, unk) for w in t] + [1]
@@ -357,38 +378,92 @@ class WMT14(_WMTBase):
     def __init__(self, data_file=None, mode="train", dict_size=30000):
         data_file = _require(data_file, "wmt14 tgz (dev+test or train)")
         pairs = []
+        train_pairs = []
+        dicts = None
         with tarfile.open(data_file) as tf:
+            src_dict = trg_dict = None
             for m in tf.getmembers():
-                if m.isfile() and f"/{mode}/" in f"/{m.name}":
+                if not m.isfile():
+                    continue
+                if m.name.endswith("src.dict"):
+                    src_dict = self._read_dict(tf, m)
+                elif m.name.endswith("trg.dict"):
+                    trg_dict = self._read_dict(tf, m)
+                elif f"/{mode}/" in f"/{m.name}" or \
+                        f"/train/" in f"/{m.name}":
+                    split_pairs = []
                     for ln in tf.extractfile(m).read().decode(
                             "utf-8", "ignore").splitlines():
                         if "\t" in ln:
                             s, t = ln.split("\t")[:2]
-                            pairs.append((s.split(), t.split()))
-        self._build(pairs, dict_size)
+                            split_pairs.append((s.split(), t.split()))
+                    if f"/train/" in f"/{m.name}":
+                        train_pairs.extend(split_pairs)
+                    if f"/{mode}/" in f"/{m.name}":
+                        pairs.extend(split_pairs)
+            if src_dict is not None and trg_dict is not None:
+                dicts = (src_dict, trg_dict)
+        # dict preference: shipped dict files > train corpus > own corpus
+        dict_corpus = train_pairs if train_pairs else pairs
+        self._build(dict_corpus, dict_size, encode_pairs=pairs,
+                    dicts=dicts)
+
+    @staticmethod
+    def _read_dict(tf, member):
+        d = {}
+        for ln in tf.extractfile(member).read().decode(
+                "utf-8", "ignore").splitlines():
+            w = ln.strip()
+            if w:
+                d[w] = len(d)
+        return d
 
 
 class WMT16(_WMTBase):
-    """WMT16 en-de (reference wmt16.py): train/val/test .en/.de file
-    pairs inside the tar; ``lang`` picks the source side."""
+    """WMT16 en-de (reference wmt16.py). The real archive ships single
+    tab-separated members ``wmt16/{train,val,test}`` (src\ttrg per line,
+    the layout the reference reads); per-side ``.en``/``.de`` file pairs
+    are also accepted. Dictionaries always come from the train split so
+    train/val/test ids are consistent; ``lang`` picks the source side."""
 
     def __init__(self, data_file=None, mode="train", src_dict_size=30000,
                  trg_dict_size=30000, lang="en"):
         data_file = _require(data_file, "wmt16.tar.gz")
-        other = "de" if lang == "en" else "en"
-        name = {"train": "train", "val": "val", "test": "test"}[mode]
         with tarfile.open(data_file) as tf:
             names = tf.getnames()
-            src_name = next(n for n in names
-                            if n.endswith(f"{name}.tok.{lang}")
-                            or n.endswith(f"{name}.{lang}"))
-            trg_name = next(n for n in names
-                            if n.endswith(f"{name}.tok.{other}")
-                            or n.endswith(f"{name}.{other}"))
-            src_lines = tf.extractfile(src_name).read().decode(
-                "utf-8", "ignore").splitlines()
-            trg_lines = tf.extractfile(trg_name).read().decode(
-                "utf-8", "ignore").splitlines()
-        pairs = [(s.split(), t.split())
-                 for s, t in zip(src_lines, trg_lines) if s and t]
-        self._build(pairs, src_dict_size, trg_dict_size)
+            train_pairs = self._read_split(tf, names, "train", lang)
+            pairs = train_pairs if mode == "train" else \
+                self._read_split(tf, names, mode, lang)
+        # dict from TRAIN (reference builds both dicts from wmt16/train)
+        self._build(train_pairs, src_dict_size, trg_dict_size,
+                    encode_pairs=pairs)
+
+    @staticmethod
+    def _read_split(tf, names, split, lang):
+        other = "de" if lang == "en" else "en"
+        tab_name = next((n for n in names
+                         if n.rstrip("/").endswith(f"/{split}")
+                         or n == split), None)
+        if tab_name is not None:
+            pairs = []
+            for ln in tf.extractfile(tab_name).read().decode(
+                    "utf-8", "ignore").splitlines():
+                if "\t" in ln:
+                    s, t = ln.split("\t")[:2]
+                    if lang != "en":
+                        s, t = t, s
+                    if s and t:
+                        pairs.append((s.split(), t.split()))
+            return pairs
+        src_name = next(n for n in names
+                        if n.endswith(f"{split}.tok.{lang}")
+                        or n.endswith(f"{split}.{lang}"))
+        trg_name = next(n for n in names
+                        if n.endswith(f"{split}.tok.{other}")
+                        or n.endswith(f"{split}.{other}"))
+        src_lines = tf.extractfile(src_name).read().decode(
+            "utf-8", "ignore").splitlines()
+        trg_lines = tf.extractfile(trg_name).read().decode(
+            "utf-8", "ignore").splitlines()
+        return [(s.split(), t.split())
+                for s, t in zip(src_lines, trg_lines) if s and t]

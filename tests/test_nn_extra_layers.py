@@ -1,0 +1,230 @@
+"""Remaining nn layer surface (reference: test/legacy_test/test_unflatten,
+test_zeropad, test_lp_pool, test_unpool_op, test_warprnnt_op,
+test_adaptive_log_softmax_with_loss, ...)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_softmax2d_unflatten_zeropad():
+    x = pt.to_tensor(np.random.RandomState(0).randn(2, 3, 4, 4)
+                     .astype(np.float32))
+    s = nn.Softmax2D()(x)
+    np.testing.assert_allclose(np.asarray(s.numpy()).sum(1), 1.0, rtol=1e-5)
+
+    u = nn.Unflatten(1, [1, 3])(x)
+    assert tuple(u.shape) == (2, 1, 3, 4, 4)
+
+    z1 = nn.ZeroPad1D(2)(pt.to_tensor(np.ones((1, 2, 5), np.float32)))
+    assert tuple(z1.shape) == (1, 2, 9)
+    assert float(z1.numpy()[0, 0, 0]) == 0.0
+    z3 = nn.ZeroPad3D(1)(pt.to_tensor(np.ones((1, 1, 2, 2, 2), np.float32)))
+    assert tuple(z3.shape) == (1, 1, 4, 4, 4)
+
+
+def test_pairwise_distance():
+    rng = np.random.RandomState(1)
+    a, b = rng.randn(4, 8).astype(np.float32), rng.randn(4, 8).astype(np.float32)
+    d = nn.PairwiseDistance(p=2.0)(pt.to_tensor(a), pt.to_tensor(b))
+    ref = np.linalg.norm(a - b + 1e-6, axis=-1)
+    np.testing.assert_allclose(np.asarray(d.numpy()), ref, rtol=1e-5)
+
+
+def test_multi_margin_loss():
+    x = np.array([[0.1, 0.2, 0.9], [0.8, 0.1, 0.0]], np.float32)
+    y = np.array([2, 0])
+    loss = nn.MultiMarginLoss()(pt.to_tensor(x), pt.to_tensor(y))
+    # manual: mean over samples of sum_j!=y max(0, 1 - x_y + x_j)/C
+    ref = np.mean([
+        (max(0, 1 - 0.9 + 0.1) + max(0, 1 - 0.9 + 0.2)) / 3,
+        (max(0, 1 - 0.8 + 0.1) + max(0, 1 - 0.8 + 0.0)) / 3])
+    np.testing.assert_allclose(float(loss.numpy()), ref, rtol=1e-5)
+
+
+def test_hsigmoid_loss_layer_trains():
+    from paddle_tpu.optimizer import SGD
+
+    pt.seed(2)
+    layer = nn.HSigmoidLoss(16, 8)
+    opt = SGD(learning_rate=0.3, parameters=layer.parameters())
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.randn(32, 16).astype(np.float32))
+    y = pt.to_tensor(rng.randint(0, 8, size=(32,)))
+    first = last = None
+    for _ in range(15):
+        loss = layer(x, y).mean()
+        if first is None:
+            first = float(loss.numpy())
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        last = float(loss.numpy())
+    assert last < first
+
+
+def test_lp_pool2d_matches_manual():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = nn.LPPool2D(norm_type=2, kernel_size=2)(pt.to_tensor(x))
+    ref = np.zeros((1, 1, 2, 2), np.float32)
+    for i in range(2):
+        for j in range(2):
+            blk = x[0, 0, 2*i:2*i+2, 2*j:2*j+2]
+            ref[0, 0, i, j] = np.sqrt((blk ** 2).sum())
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-5)
+
+
+def test_max_unpool2d_roundtrip():
+    rng = np.random.RandomState(3)
+    x = pt.to_tensor(rng.randn(1, 2, 4, 4).astype(np.float32))
+    pooled, idx = F.max_pool2d(x, 2, return_mask=True)
+    un = nn.MaxUnPool2D(2)(pooled, idx)
+    assert tuple(un.shape) == (1, 2, 4, 4)
+    # unpooled keeps exactly the max values at their positions
+    ref = np.zeros((1, 2, 16), np.float32)
+    pv = np.asarray(pooled.numpy()).reshape(1, 2, -1)
+    iv = np.asarray(idx.numpy()).reshape(1, 2, -1)
+    for c in range(2):
+        ref[0, c, iv[0, c]] = pv[0, c]
+    np.testing.assert_allclose(np.asarray(un.numpy()).reshape(1, 2, 16),
+                               ref, rtol=1e-6)
+    assert (np.asarray(un.numpy()) != 0).sum() == 8
+
+
+def test_fractional_max_pool2d():
+    x = pt.to_tensor(np.arange(49, dtype=np.float32).reshape(1, 1, 7, 7))
+    out = nn.FractionalMaxPool2D(output_size=3, random_u=0.3)(x)
+    assert tuple(out.shape) == (1, 1, 3, 3)
+    # maxima are monotone along rows/cols for a ramp input
+    o = np.asarray(out.numpy())[0, 0]
+    assert (np.diff(o, axis=0) > 0).all() and (np.diff(o, axis=1) > 0).all()
+    assert float(o[-1, -1]) == 48.0
+
+
+def test_adaptive_log_softmax_with_loss():
+    from paddle_tpu.optimizer import SGD
+
+    pt.seed(4)
+    m = nn.AdaptiveLogSoftmaxWithLoss(16, 12, cutoffs=[4, 8])
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.randn(24, 16).astype(np.float32))
+    y = pt.to_tensor(rng.randint(0, 12, size=(24,)))
+    lp_full = np.asarray(m.log_prob(x).numpy())
+    assert lp_full.shape == (24, 12)
+    # log_prob is a distribution over all classes
+    np.testing.assert_allclose(np.exp(lp_full).sum(-1), 1.0, rtol=1e-4)
+    out, loss = m(x, y)
+    # gathered target log-prob equals the full-distribution gather
+    np.testing.assert_allclose(
+        np.asarray(out.numpy()),
+        lp_full[np.arange(24), np.asarray(y.numpy())], rtol=1e-4)
+    opt = SGD(learning_rate=0.5, parameters=m.parameters())
+    first = float(loss.numpy())
+    for _ in range(10):
+        _, loss = m(x, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss.numpy()) < first
+
+
+def test_rnnt_loss_matches_numpy_dp():
+    from scipy.special import log_softmax
+
+    def np_rnnt(logits, labels, T, U, blank=0):
+        lp = log_softmax(logits, axis=-1)
+        alpha = np.full((T, U + 1), -1e30)
+        alpha[0, 0] = 0.0
+        for u in range(1, U + 1):
+            alpha[0, u] = alpha[0, u - 1] + lp[0, u - 1, labels[u - 1]]
+        for t in range(1, T):
+            alpha[t, 0] = alpha[t - 1, 0] + lp[t - 1, 0, blank]
+            for u in range(1, U + 1):
+                alpha[t, u] = np.logaddexp(
+                    alpha[t - 1, u] + lp[t - 1, u, blank],
+                    alpha[t, u - 1] + lp[t, u - 1, labels[u - 1]])
+        return -(alpha[T - 1, U] + lp[T - 1, U, blank])
+
+    rng = np.random.RandomState(0)
+    B, T, U, V = 2, 5, 3, 7
+    logits = rng.randn(B, T, U + 1, V).astype(np.float32)
+    labels = rng.randint(1, V, size=(B, U)).astype(np.int32)
+    tl = np.array([5, 4], np.int32)
+    ul = np.array([3, 2], np.int32)
+    got = np.asarray(F.rnnt_loss(
+        pt.to_tensor(logits), pt.to_tensor(labels), pt.to_tensor(tl),
+        pt.to_tensor(ul), reduction="none").numpy())
+    ref = np.array([np_rnnt(logits[b], labels[b], tl[b], ul[b])
+                    for b in range(B)])
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+    # layer wrapper + grads flow
+    lt = pt.to_tensor(logits, stop_gradient=False)
+    loss = nn.RNNTLoss()(lt, pt.to_tensor(labels), pt.to_tensor(tl),
+                         pt.to_tensor(ul))
+    loss.backward()
+    assert np.isfinite(np.asarray(lt.grad.numpy())).all()
+
+
+def test_beam_search_decoder():
+    # deterministic toy "cell": next-token logits depend only on the input
+    # token, strongly preferring token (input + 1) mod V, with <eos>=3
+    V = 4
+
+    class ToyCell:
+        def __call__(self, tok, states):
+            t = np.asarray(tok.numpy()).reshape(-1).astype(int)
+            logits = np.full((len(t), V), -5.0, np.float32)
+            for i, ti in enumerate(t):
+                logits[i, (ti + 1) % V] = 5.0
+            return pt.to_tensor(logits), states
+
+    dec = nn.BeamSearchDecoder(ToyCell(), start_token=0, end_token=3,
+                               beam_size=2)
+    ids, scores = nn.dynamic_decode(dec, max_step_num=6, batch_size=1)
+    seq = np.asarray(ids.numpy())[0, 0].tolist()
+    # greedy path: 1, 2, 3(<eos>) then stays at eos
+    assert seq[:3] == [1, 2, 3]
+    assert np.asarray(scores.numpy()).shape == (1, 2)
+
+
+def test_lp_pool2d_with_padding_partial_windows():
+    x = pt.to_tensor(np.ones((1, 1, 4, 4), np.float32))
+    out = np.asarray(nn.LPPool2D(norm_type=2, kernel_size=2, stride=2,
+                                 padding=1).numpy() if False else
+                     nn.LPPool2D(norm_type=2, kernel_size=2, stride=2,
+                                 padding=1)(x).numpy())
+    # corner window holds 1 real element -> norm 1; edge windows 2 -> sqrt2
+    np.testing.assert_allclose(out[0, 0, 0, 0], 1.0, rtol=1e-5)
+    np.testing.assert_allclose(out[0, 0, 0, 1], np.sqrt(2), rtol=1e-5)
+    np.testing.assert_allclose(out[0, 0, 1, 1], 2.0, rtol=1e-5)
+
+
+def test_beam_search_decoder_batched_stateful():
+    """Batch>1 with a stateful cell: each sample's beams must continue
+    from that sample's own chosen parent state."""
+    V = 5
+
+    class CounterCell:
+        # state counts steps per sample; sample b prefers token (state+b+1)%V
+        def __call__(self, tok, states):
+            s = states if states is not None else pt.to_tensor(
+                np.zeros((tok.shape[0],), np.float32))
+            sv = np.asarray(s.numpy())
+            B = tok.shape[0]
+            logits = np.full((B, V), -5.0, np.float32)
+            for b in range(B):
+                logits[b, int(sv[b] + b + 1) % V] = 5.0
+            return pt.to_tensor(logits), pt.to_tensor(sv + 1.0)
+
+    dec = nn.BeamSearchDecoder(CounterCell(), start_token=0, end_token=4,
+                               beam_size=2)
+    ids, scores = nn.dynamic_decode(
+        dec, inits=pt.to_tensor(np.zeros((2,), np.float32)),
+        max_step_num=4, batch_size=2)
+    seqs = np.asarray(ids.numpy())
+    # sample 0 best path: 1, 2, 3, 4; sample 1: 2, 3, 4 (eos) ...
+    assert seqs[0, 0, :3].tolist() == [1, 2, 3]
+    assert seqs[1, 0, :3].tolist() == [2, 3, 4]

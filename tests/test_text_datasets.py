@@ -68,14 +68,17 @@ def ptb_tar(tmp_path_factory):
 def test_imikolov_ngram_and_seq(ptb_tar):
     ds = Imikolov(ptb_tar, data_type="NGRAM", window_size=2, mode="train",
                   min_word_freq=5)
-    # "the" appears 15x, "cat"/"sat" 10x, "dog"/"ran" 5x -> all kept
-    assert "the" in ds.word_idx and ds.word_idx["the"] == 0
+    # <s>/<e> counted per line (15 each) rank above "the" (15, tie broken
+    # lexically); all words appear >= 5 times so all are kept
+    assert "the" in ds.word_idx and "<s>" in ds.word_idx
+    assert ds.word_idx["<e>"] == 0   # freq 15, lexically first among ties
     grams = ds[0]
     assert grams.shape == (2,)
-    seq = Imikolov(ptb_tar, data_type="SEQ", mode="test", min_word_freq=5)
-    s = seq[0]
-    assert s[0] == seq.word_idx["<s>"] and s[-1] == seq.word_idx["<e>"]
-    assert len(s) == 5  # <s> the cat sat <e>
+    seq = Imikolov(ptb_tar, data_type="SEQ", mode="valid", min_word_freq=5)
+    src_ids, trg_ids = seq[0]        # shifted (source, target) pair
+    assert src_ids[0] == seq.word_idx["<s>"]
+    assert trg_ids[-1] == seq.word_idx["<e>"]
+    assert len(src_ids) == len(trg_ids) == 4  # <s> the cat sat / the cat sat <e>
 
 
 @pytest.fixture(scope="module")
