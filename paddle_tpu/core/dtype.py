@@ -58,8 +58,20 @@ FLOATING_DTYPES = (float16, bfloat16, float32, float64)
 INTEGER_DTYPES = (int8, int16, int32, int64, uint8, uint16, uint32, uint64)
 
 
+_FLOAT8 = {}
+try:
+    import ml_dtypes as _mld
+
+    _FLOAT8 = {"float8_e4m3fn": _mld.float8_e4m3fn,
+               "float8_e5m2": _mld.float8_e5m2}
+except Exception:
+    pass
+
+
 def convert_dtype(dtype) -> np.dtype:
     """Normalize a string / numpy / jnp dtype spec to a numpy dtype object."""
+    if isinstance(dtype, str) and dtype in _FLOAT8:
+        return _FLOAT8[dtype]
     if dtype is None:
         return None
     if isinstance(dtype, str):

@@ -107,12 +107,15 @@ class PairwiseDistance(Layer):
 
 
 @op("multi_margin_loss", amp="keep_fp32")
-def _multi_margin_loss(input, label, *, p, margin, reduction):
+def _multi_margin_loss(input, label, weight, *, p, margin, reduction):
     x = input.astype(jnp.float32)
     N, C = x.shape
     gold = jnp.take_along_axis(x, label.reshape(-1, 1), axis=1)
     viol = jnp.maximum(margin - gold + x, 0.0) ** p
     mask = 1.0 - jax.nn.one_hot(label.reshape(-1), C)
+    if weight is not None:
+        # reference weights each sample's terms by weight[label]
+        mask = mask * weight.reshape(-1)[label.reshape(-1)][:, None]
     loss = (viol * mask).sum(-1) / C
     if reduction == "mean":
         return loss.mean()
@@ -130,10 +133,11 @@ class MultiMarginLoss(Layer):
         super().__init__()
         self.p = p
         self.margin = margin
+        self.weight = weight
         self.reduction = reduction
 
     def forward(self, input, label):
-        return _multi_margin_loss(input, label, p=self.p,
+        return _multi_margin_loss(input, label, self.weight, p=self.p,
                                   margin=self.margin,
                                   reduction=self.reduction)
 
@@ -334,13 +338,20 @@ class _FractionalMaxPoolND(Layer):
 
     def _edges(self, n_in, n_out, u):
         # pseudo-random increment sequence: alpha = n_in/n_out,
-        # edge_i = ceil(alpha * (i + u)) (Graham's pseudorandom variant)
+        # edge_i = ceil(alpha * (i + u)) (Graham's pseudorandom variant);
+        # monotone repair keeps every segment non-empty even when a large
+        # u saturates the ceil at n_in before the last bin
         alpha = n_in / n_out
         idx = np.arange(n_out + 1, dtype=np.float64)
         edges = np.ceil(alpha * (idx + u)).astype(np.int64)
         edges[0] = 0
         edges[-1] = n_in
-        return np.clip(edges, 0, n_in)
+        edges = np.clip(edges, 0, n_in)
+        for i in range(1, n_out):                 # forward: strictly grow
+            edges[i] = max(edges[i], edges[i - 1] + 1)
+        for i in range(n_out - 1, 0, -1):         # backward: leave room
+            edges[i] = min(edges[i], edges[i + 1] - 1)
+        return edges
 
     def forward(self, x):
         u = self.random_u

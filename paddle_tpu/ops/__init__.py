@@ -11,10 +11,11 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
-from . import creation, extra, linalg, manipulation, math
+from . import creation, extra, extra2, linalg, manipulation, math
 
 from .creation import *  # noqa: F401,F403
 from .extra import *  # noqa: F401,F403
+from .extra2 import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
@@ -189,3 +190,7 @@ def _install_tensor_methods():
 
 
 _install_tensor_methods()
+
+
+# in-place op variants (x.add_(y) family) need the paddle_tpu namespace
+# fully built, so they install lazily on first access from __init__

@@ -1,0 +1,499 @@
+"""Top-level surface tranche 3: stacking/splitting, scatter-into views,
+predicates, remaining math, in-place variants.
+
+Reference: python/paddle/tensor/manipulation.py (tensor_split, *_stack,
+*split, *_scatter, view...), math.py (frexp, ldexp, sinc, sgn, vander,
+multigammaln, isin, nanquantile, polar...), and the generated inplace
+APIs (``x.add_(y)`` family — reference autogenerates them from ops.yaml
+``inplace:`` entries; here a factory wraps the functional op and rebinds
+the tensor to the op's output so autograd still flows through the new
+tape node).
+"""
+
+from __future__ import annotations
+
+import math as _math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import OP_REGISTRY, op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "add_n", "broadcast_shape", "cartesian_prod", "combinations",
+    "column_stack", "row_stack", "dstack", "hsplit", "vsplit", "dsplit",
+    "tensor_split", "diagonal_scatter", "select_scatter", "slice_scatter",
+    "frexp", "ldexp", "histogram_bin_edges", "histogramdd", "isin",
+    "isneginf", "isposinf", "is_complex", "is_floating_point",
+    "is_integer", "is_tensor", "log_normal", "multigammaln", "nanquantile",
+    "polar", "randint_like", "rank", "reverse", "sgn", "sinc", "shape",
+    "tolist", "vander", "view", "view_as", "unfold",
+]
+
+
+@op("add_n")
+def add_n(inputs):
+    """Sum a list of tensors (reference add_n op)."""
+    arrs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    out = arrs[0]
+    for a in arrs[1:]:
+        out = out + a
+    return out
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@op("cartesian_prod")
+def cartesian_prod(xs):
+    arrs = [jnp.reshape(a, (-1,)) for a in xs]
+    grids = jnp.meshgrid(*arrs, indexing="ij")
+    return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+
+@op("combinations")
+def combinations(x, r: int = 2, with_replacement: bool = False):
+    import itertools
+
+    n = x.shape[0]
+    combo = itertools.combinations_with_replacement if with_replacement \
+        else itertools.combinations
+    idx = np.asarray(list(combo(range(n), r)), np.int32).reshape(-1, r)
+    return x[idx]
+
+
+@op("column_stack")
+def column_stack(xs):
+    arrs = [a if jnp.ndim(a) > 1 else jnp.reshape(a, (-1, 1)) for a in xs]
+    return jnp.concatenate(arrs, axis=1)
+
+
+@op("row_stack")
+def row_stack(xs):
+    return jnp.vstack(xs)
+
+
+@op("dstack")
+def dstack(xs):
+    return jnp.dstack(xs)
+
+
+def _split_list(fn):
+    def wrap(x, num_or_indices, name=None):
+        @op(fn.__name__)
+        def _impl(x):
+            return tuple(fn(x, num_or_indices))
+
+        return list(_impl(x))
+
+    wrap.__name__ = fn.__name__
+    return wrap
+
+
+hsplit = _split_list(lambda x, n: jnp.split(
+    x, n if isinstance(n, int) else list(n),
+    axis=1 if jnp.ndim(x) > 1 else 0))
+hsplit.__name__ = "hsplit"
+vsplit = _split_list(lambda x, n: jnp.split(
+    x, n if isinstance(n, int) else list(n), axis=0))
+vsplit.__name__ = "vsplit"
+dsplit = _split_list(lambda x, n: jnp.split(
+    x, n if isinstance(n, int) else list(n), axis=2))
+dsplit.__name__ = "dsplit"
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    """reference manipulation.py tensor_split: uneven splits allowed."""
+    @op("tensor_split")
+    def _impl(x):
+        return tuple(jnp.array_split(
+            x, num_or_indices if isinstance(num_or_indices, int)
+            else list(num_or_indices), axis=axis))
+
+    return list(_impl(x))
+
+
+@op("diagonal_scatter")
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    nd = x.ndim
+    a1, a2 = axis1 % nd, axis2 % nd
+    perm = [i for i in range(nd) if i not in (a1, a2)] + [a1, a2]
+    inv = np.argsort(perm)
+    xt = jnp.transpose(x, perm)
+    if offset >= 0:
+        ii = jnp.arange(min(xt.shape[-2], xt.shape[-1] - offset))
+        jj = ii + offset
+    else:
+        jj = jnp.arange(min(xt.shape[-1], xt.shape[-2] + offset))
+        ii = jj - offset
+    xt = xt.at[..., ii, jj].set(y)
+    return jnp.transpose(xt, inv)
+
+
+@op("select_scatter")
+def select_scatter(x, values, axis, index):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(values)
+
+
+@op("slice_scatter")
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(st, en, sd)
+    return x.at[tuple(idx)].set(value)
+
+
+@op("frexp")
+def frexp(x):
+    m, e = jnp.frexp(x)
+    return m, e.astype(jnp.int32)
+
+
+@op("ldexp")
+def ldexp(x, y):
+    return jnp.ldexp(x, y.astype(jnp.int32))
+
+
+@op("histogram_bin_edges", differentiable=False)
+def histogram_bin_edges(input, bins=100, min=0.0, max=0.0, name=None):
+    lo, hi = (None, None) if (min == 0 and max == 0) else (min, max)
+    if lo is None:
+        lo = jnp.min(input)
+        hi = jnp.max(input)
+    return jnp.linspace(lo, hi, bins + 1).astype(jnp.float32)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """reference histogramdd (host computation; selection output)."""
+    xv = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    wv = None if weights is None else np.asarray(
+        weights.numpy() if isinstance(weights, Tensor) else weights)
+    if isinstance(bins, (list, tuple)) and len(bins) and \
+            not np.isscalar(bins[0]):
+        bins = [np.asarray(b.numpy() if isinstance(b, Tensor) else b)
+                for b in bins]
+    hist, edges = np.histogramdd(xv, bins=bins, range=ranges,
+                                 density=density, weights=wv)
+    return (Tensor(jnp.asarray(hist.astype(np.float32))),
+            [Tensor(jnp.asarray(e.astype(np.float32))) for e in edges])
+
+
+@op("isin", differentiable=False)
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return jnp.isin(x, test_x, invert=invert)
+
+
+@op("isneginf", differentiable=False)
+def isneginf(x):
+    return jnp.isneginf(x)
+
+
+@op("isposinf", differentiable=False)
+def isposinf(x):
+    return jnp.isposinf(x)
+
+
+def is_complex(x) -> bool:
+    d = x._data.dtype if isinstance(x, Tensor) else np.asarray(x).dtype
+    return jnp.issubdtype(d, jnp.complexfloating)
+
+
+def is_floating_point(x) -> bool:
+    d = x._data.dtype if isinstance(x, Tensor) else np.asarray(x).dtype
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer(x) -> bool:
+    d = x._data.dtype if isinstance(x, Tensor) else np.asarray(x).dtype
+    return jnp.issubdtype(d, jnp.integer)
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype="float32", name=None):
+    """Sample exp(Normal(mean, std)) (reference log_normal)."""
+    from ..core import random as prandom
+    from ..core.dtype import convert_dtype
+
+    key = prandom.next_key()
+    z = jax.random.normal(key, tuple(shape or ()),
+                          convert_dtype(dtype))
+    return Tensor(jnp.exp(mean + std * z), stop_gradient=True)
+
+
+@op("multigammaln")
+def multigammaln(x, p: int):
+    i = jnp.arange(p, dtype=jnp.float32)
+    return (p * (p - 1) / 4.0) * _math.log(_math.pi) + \
+        jnp.sum(jax.lax.lgamma(x[..., None] - i / 2.0), axis=-1)
+
+
+@op("nanquantile")
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    from .math import _norm_axis
+
+    return jnp.nanquantile(x, q, axis=_norm_axis(axis), keepdims=keepdim,
+                           method=interpolation)
+
+
+@op("polar")
+def polar(abs, angle, name=None):  # noqa: A002
+    r = abs * jnp.cos(angle)
+    i = abs * jnp.sin(angle)
+    return jax.lax.complex(r, i)
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    from .creation import randint
+
+    return randint(low, high, shape=tuple(x.shape),
+                   dtype=dtype or str(x.dtype))
+
+
+def rank(input):
+    from .creation import to_tensor
+
+    return Tensor(jnp.asarray(input.ndim, jnp.int32), stop_gradient=True)
+
+
+@op("reverse")
+def reverse(x, axis):
+    axes = [axis] if isinstance(axis, int) else list(axis)
+    return jnp.flip(x, axis=tuple(axes))
+
+
+@op("sgn")
+def sgn(x):
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0.0 + 0.0j, x / mag)
+    return jnp.sign(x)
+
+
+@op("sinc")
+def sinc(x):
+    return jnp.sinc(x)
+
+
+def shape(input):
+    """reference shape op: runtime shape as an int32 tensor."""
+    return Tensor(jnp.asarray(np.asarray(input.shape, np.int32)),
+                  stop_gradient=True)
+
+
+def tolist(x):
+    return np.asarray(x.numpy() if isinstance(x, Tensor) else x).tolist()
+
+
+@op("vander")
+def vander(x, n=None, increasing=False, name=None):
+    m = x.shape[0] if n is None else n
+    powers = jnp.arange(m)
+    if not increasing:
+        powers = powers[::-1]
+    return x[:, None] ** powers[None, :]
+
+
+def view(x, shape_or_dtype, name=None):
+    """reference view: reshape (shape) or bitcast (dtype) without copy —
+    XLA has no aliasing views, so this is the same lazy reshape/bitcast."""
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return x.reshape(list(shape_or_dtype))
+    from ..core.dtype import convert_dtype
+
+    @op("view_dtype")
+    def _impl(x):
+        out = jax.lax.bitcast_convert_type(x, convert_dtype(shape_or_dtype))
+        if out.ndim == x.ndim + 1:
+            # narrowing cast appends a dim: merge it into the last axis
+            # (reference view(dtype) returns [..., last * ratio])
+            out = out.reshape(out.shape[:-2] + (-1,))
+        return out
+
+    return _impl(x)
+
+
+def view_as(x, other, name=None):
+    return x.reshape(list(other.shape))
+
+
+@op("tensor_unfold")
+def unfold(x, axis, size, step, name=None):
+    """reference Tensor.unfold: sliding windows along ``axis``."""
+    axis = axis % x.ndim                   # normalize before rank changes
+    n = x.shape[axis]
+    num = (n - size) // step + 1
+    starts = jnp.arange(num) * step
+    offs = jnp.arange(size)
+    idx = starts[:, None] + offs[None, :]
+    xt = jnp.moveaxis(x, axis, -1)
+    win = xt[..., idx]                     # [..., num, size]
+    return jnp.moveaxis(win, -2, axis)     # window dim back at axis
+
+
+# ---------------------------------------------------------------------------
+# in-place variants: x.op_(...) == x rebound to op(x, ...)'s output
+# (reference autogenerates these from ops.yaml `inplace:` entries)
+# ---------------------------------------------------------------------------
+
+_INPLACE_SOURCES = [
+    "abs", "acos", "asin", "atan", "atanh", "cast", "ceil", "clip",
+    "copysign", "cos", "cosh", "cumprod", "cumsum", "digamma", "divide",
+    "equal", "erf", "erfinv", "exp", "expm1", "fill", "flatten", "floor",
+    "floor_divide", "floor_mod", "frac", "gammainc", "gammaincc",
+    "gammaln", "gcd", "greater_equal", "greater_than", "hypot", "i0",
+    "lcm", "ldexp", "less_equal", "less_than", "lgamma", "log", "log10",
+    "log1p", "log2", "logical_and", "logical_not", "logical_or",
+    "logical_xor", "logit", "masked_fill", "masked_scatter", "maximum",
+    "minimum", "mod", "multiply", "nan_to_num", "neg", "pow", "reciprocal",
+    "remainder", "renorm", "reshape", "round", "rsqrt", "scale", "scatter",
+    "sigmoid", "sign", "sin", "sinh", "sqrt", "square", "squeeze",
+    "subtract", "t", "tan", "tanh", "transpose", "tril", "triu", "trunc",
+    "unsqueeze", "where", "add", "bitwise_and", "bitwise_not",
+    "bitwise_or", "bitwise_xor", "polygamma", "multigammaln", "sinc",
+    "addmm", "bitwise_left_shift", "bitwise_right_shift",
+]
+
+
+def _shadow_of(x: Tensor) -> Tensor:
+    """A detached stand-in carrying x's pre-mutation tape identity, so the
+    recorded node's input edge survives x being rebound to the output."""
+    s = Tensor(x._data, stop_gradient=x.stop_gradient)
+    s._grad_node = x._grad_node
+    s._out_slot = x._out_slot
+    s._hooks = list(x._hooks)
+    s._retain_grads = x._retain_grads
+    return s
+
+
+def _make_inplace(base_name):
+    def inplace(x, *args, **kwargs):
+        import paddle_tpu as pt
+        from ..core import autograd as _ag
+
+        fn = getattr(pt, base_name, None)
+        if fn is None:
+            raise AttributeError(f"no base op {base_name} for inplace")
+        if (not x.stop_gradient and x._grad_node is None
+                and _ag.is_grad_enabled()):
+            # reference semantics: in-place on a grad-requiring leaf is an
+            # error (it would detach the leaf from its own history)
+            raise RuntimeError(
+                f"{base_name}_(): a leaf Tensor that requires grad cannot "
+                "be used in an in-place operation")
+        out = fn(x, *args, **kwargs)
+        node = out._grad_node
+        if node is not None:
+            # the node recorded x itself as an input; point that edge at a
+            # shadow of the pre-mutation tensor or the rebind below would
+            # make the node its own upstream
+            shadow = _shadow_of(x)
+            node.inputs = [shadow if t is x else t for t in node.inputs]
+        # rebind: x now refers to the op output (autograd flows through
+        # the recorded node, matching reference inplace semantics)
+        x._data = out._data
+        x._grad_node = node
+        x._out_slot = out._out_slot
+        x.stop_gradient = out.stop_gradient
+        return x
+
+    inplace.__name__ = base_name + "_"
+    return inplace
+
+
+def install_inplace_variants(namespace: dict):
+    names = []
+    import paddle_tpu as pt
+
+    for base in _INPLACE_SOURCES:
+        if hasattr(pt, base):
+            fn = _make_inplace(base)
+            namespace[fn.__name__] = fn
+            names.append(fn.__name__)
+    return names
+
+
+# ---------------------------------------------------------------------------
+# in-place random fills (reference: Tensor.normal_/uniform_/... generated
+# from the *_inplace ops)
+# ---------------------------------------------------------------------------
+
+def _fill_inplace(x, arr):
+    x._data = arr.astype(x._data.dtype)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    from ..core import random as prandom
+
+    key = prandom.next_key()
+    return _fill_inplace(x, mean + std * jax.random.normal(
+        key, tuple(x.shape), jnp.float32))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    from ..core import random as prandom
+
+    key = prandom.next_key()
+    return _fill_inplace(x, jax.random.bernoulli(
+        key, p, tuple(x.shape)).astype(jnp.float32))
+
+
+def cauchy_(x, loc=0.0, scale=1.0, name=None):
+    from ..core import random as prandom
+
+    key = prandom.next_key()
+    u = jax.random.uniform(key, tuple(x.shape), minval=1e-7,
+                           maxval=1 - 1e-7)
+    return _fill_inplace(x, loc + scale * jnp.tan(jnp.pi * (u - 0.5)))
+
+
+def geometric_(x, probs=0.5, name=None):
+    from ..core import random as prandom
+
+    key = prandom.next_key()
+    u = jax.random.uniform(key, tuple(x.shape), minval=1e-7,
+                           maxval=1 - 1e-7)
+    return _fill_inplace(x, jnp.floor(jnp.log(u) / jnp.log1p(-probs)) + 1)
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    from ..core import random as prandom
+
+    key = prandom.next_key()
+    return _fill_inplace(x, jnp.exp(
+        mean + std * jax.random.normal(key, tuple(x.shape), jnp.float32)))
+
+
+__all__ += ["normal_", "bernoulli_", "cauchy_", "geometric_",
+            "log_normal_", "bitwise_left_shift", "bitwise_right_shift",
+            "check_shape"]
+
+
+@op("bitwise_left_shift", differentiable=False)
+def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
+    return jnp.left_shift(x, y)
+
+
+@op("bitwise_right_shift", differentiable=False)
+def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
+    return jnp.right_shift(x, y) if is_arithmetic else \
+        jax.lax.shift_right_logical(x, y)
+
+
+def check_shape(shape):
+    """reference check_shape: validate a shape spec."""
+    for d in shape:
+        if not isinstance(d, (int, np.integer)) or (d < -1):
+            raise ValueError(f"invalid shape entry {d}")
+    return True

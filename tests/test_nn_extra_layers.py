@@ -193,8 +193,6 @@ def test_beam_search_decoder():
 def test_lp_pool2d_with_padding_partial_windows():
     x = pt.to_tensor(np.ones((1, 1, 4, 4), np.float32))
     out = np.asarray(nn.LPPool2D(norm_type=2, kernel_size=2, stride=2,
-                                 padding=1).numpy() if False else
-                     nn.LPPool2D(norm_type=2, kernel_size=2, stride=2,
                                  padding=1)(x).numpy())
     # corner window holds 1 real element -> norm 1; edge windows 2 -> sqrt2
     np.testing.assert_allclose(out[0, 0, 0, 0], 1.0, rtol=1e-5)
