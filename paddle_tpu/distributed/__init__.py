@@ -60,7 +60,30 @@ from .engine import (
     shard_dataloader,
     to_static,
 )
-from . import collective, fleet, topology
+from .checkpoint import load_state_dict, save_state_dict
+from .compat import (
+    CountFilterEntry,
+    DistAttr,
+    InMemoryDataset,
+    ProbabilityEntry,
+    QueueDataset,
+    ReduceType,
+    ShowClickEntry,
+    broadcast_object_list,
+    destroy_process_group,
+    gather,
+    get_backend,
+    gloo_barrier,
+    gloo_init_parallel_env,
+    gloo_release,
+    is_available,
+    scatter_object_list,
+    shard_scaler,
+    split,
+    wait,
+)
+from .fleet import ParallelMode
+from . import collective, fleet, io, topology
 
 __all__ = [
     # collectives
@@ -79,6 +102,13 @@ __all__ = [
     "group_sharded_parallel",
     "Strategy", "DistModel", "to_static", "ShardDataloader",
     "shard_dataloader", "Engine",
+    "gather", "split", "wait", "get_backend", "is_available",
+    "destroy_process_group", "broadcast_object_list", "scatter_object_list",
+    "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+    "ReduceType", "DistAttr", "shard_scaler", "ParallelMode",
+    "CountFilterEntry", "ProbabilityEntry", "ShowClickEntry",
+    "InMemoryDataset", "QueueDataset", "save_state_dict",
+    "load_state_dict", "io",
     "fleet",
 ]
 

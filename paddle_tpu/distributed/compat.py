@@ -1,0 +1,266 @@
+"""Remaining paddle.distributed surface: gather/split/object collectives,
+backend queries, PS dataset configs.
+
+Reference: python/paddle/distributed/__init__.py exports —
+communication (gather, split, wait, get_backend, destroy_process_group,
+broadcast_object_list, scatter_object_list, gloo_*), fleet dataset entry
+configs (CountFilterEntry, ProbabilityEntry, ShowClickEntry,
+InMemoryDataset, QueueDataset — fleet/dataset/), ReduceType/DistAttr
+(auto-parallel aliases), shard_scaler.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import collective as C
+
+__all__ = [
+    "gather", "split", "wait", "get_backend", "is_available",
+    "destroy_process_group", "broadcast_object_list",
+    "scatter_object_list", "gloo_init_parallel_env", "gloo_barrier",
+    "gloo_release", "ReduceType", "DistAttr", "shard_scaler",
+    "CountFilterEntry", "ProbabilityEntry", "ShowClickEntry",
+    "InMemoryDataset", "QueueDataset",
+]
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather tensors to rank ``dst`` (reference communication/gather.py).
+    Single-controller translation: all_gather then keep the list on the
+    dst rank (every rank holds the data on one controller anyway)."""
+    out: list = []
+    C.all_gather(out, tensor, group=group)
+    rank = C.get_rank(group) if hasattr(C, "get_rank") else 0
+    if gather_list is not None and (dst is None or rank == dst or True):
+        gather_list.clear()
+        gather_list.extend(out)
+    return out
+
+
+def split(x, num_or_sections, axis=0, group=None, name=None):
+    """reference distributed.split: partition a weight across the model-
+    parallel group. On the GSPMD runtime this is a sharding annotation:
+    the tensor is resharded over the group's mesh axis."""
+    from .auto_parallel import sharding_constraint
+    from .placement import Shard
+
+    g = group or C._default_group() if hasattr(C, "_default_group") else None
+    mesh = getattr(g, "mesh", None) if g is not None else None
+    if mesh is None:
+        # no mesh context: plain local split (degenerate 1-rank group)
+        import paddle_tpu as pt
+
+        return pt.split(x, num_or_sections, axis=axis)
+    return sharding_constraint(x, mesh, [Shard(axis)])
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """reference communication/wait.py: block until the async collective
+    producing ``tensor`` is done. XLA dispatch is ordered per device, so a
+    value fetch is the synchronization."""
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    jax.block_until_ready(arr)
+    return tensor
+
+
+def get_backend(group=None) -> str:
+    """reference: the comm backend name — XLA collectives here."""
+    return "XCCL" if jax.default_backend() == "tpu" else "GLOO"
+
+
+def is_available() -> bool:
+    return True
+
+
+def destroy_process_group(group=None):
+    """reference communication/group.py destroy_process_group."""
+    if hasattr(C, "_GROUPS"):
+        if group is None:
+            C._GROUPS.clear()
+        else:
+            C._GROUPS.pop(getattr(group, "id", None), None)
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """reference: pickle-based object broadcast. Single-controller: the
+    list is already consistent; serialize/deserialize for semantic parity
+    (objects must be picklable, mutations don't alias)."""
+    object_list[:] = [pickle.loads(pickle.dumps(o)) for o in object_list]
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """reference: scatter python objects; rank r receives element r."""
+    if in_object_list is None:
+        raise ValueError("scatter_object_list needs in_object_list on src")
+    rank = 0
+    try:
+        from . import get_rank as _gr
+
+        rank = _gr()
+    except Exception:
+        pass
+    n = max(1, len(in_object_list))
+    out_object_list.clear()
+    out_object_list.append(pickle.loads(pickle.dumps(
+        in_object_list[rank % n])))
+    return out_object_list
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """reference gloo_* trio: CPU barrier infrastructure. The TCPStore
+    takes gloo's place here."""
+    from .store import TCPStore
+
+    host, port = server_endpoint.split(":")
+    return TCPStore(host, int(port), is_master=(rank_id == 0),
+                    world_size=rank_num)
+
+
+def gloo_barrier():
+    C.barrier()
+
+
+def gloo_release():
+    pass  # store sockets close with the process
+
+
+class ReduceType:
+    """reference auto_parallel placement_type ReduceType."""
+
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class DistAttr:
+    """reference TensorDistAttr surface (mesh + dims_mapping view over
+    our placement API)."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs or []
+
+
+def shard_scaler(scaler):
+    """reference auto_parallel/api.py:1646 shard_scaler: make GradScaler's
+    found-inf reduction span the mesh. GSPMD already reduces the unscale
+    check globally inside compiled steps, so the scaler is returned as-is
+    (documented no-op on this runtime)."""
+    return scaler
+
+
+# -- PS dataset configs (reference fleet/dataset/) --------------------------
+
+class _Entry:
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+class CountFilterEntry(_Entry):
+    """reference entry_attr CountFilterEntry(threshold): sparse feature
+    admitted after `threshold` occurrences."""
+
+    def __init__(self, threshold: int):
+        super().__init__(threshold=int(threshold))
+
+    def __str__(self):
+        return f"count_filter_entry:{self.threshold}"
+
+
+class ProbabilityEntry(_Entry):
+    def __init__(self, probability: float):
+        super().__init__(probability=float(probability))
+
+    def __str__(self):
+        return f"probability_entry:{self.probability}"
+
+
+class ShowClickEntry(_Entry):
+    def __init__(self, show_name: str, click_name: str):
+        super().__init__(show_name=show_name, click_name=click_name)
+
+    def __str__(self):
+        return f"show_click_entry:{self.show_name}:{self.click_name}"
+
+
+class InMemoryDataset:
+    """reference fleet/dataset InMemoryDataset: file-list dataset loaded
+    into host memory with shuffle, served batch-wise (the PS trainer
+    ingestion path; the native TokenDataFeed covers the C++ role)."""
+
+    def __init__(self):
+        self._files: list[str] = []
+        self._records: list = []
+        self._parse_fn = None
+        self.batch_size = 1
+        self.thread_num = 1
+
+    def init(self, batch_size=1, thread_num=1, use_var=None, pipe_command=None,
+             input_type=0, fs_name="", fs_ugi="", **kw):
+        self.batch_size = batch_size
+        self.thread_num = thread_num
+
+    def set_filelist(self, files):
+        self._files = list(files)
+
+    def set_parse_func(self, fn):
+        self._parse_fn = fn
+
+    def load_into_memory(self):
+        self._records = []
+        for f in self._files:
+            with open(f) as fh:
+                for ln in fh:
+                    ln = ln.rstrip("\n")
+                    self._records.append(
+                        self._parse_fn(ln) if self._parse_fn else ln)
+
+    def local_shuffle(self, seed=0):
+        rng = np.random.RandomState(seed)
+        rng.shuffle(self._records)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._records)
+
+    def release_memory(self):
+        self._records = []
+
+    def __iter__(self):
+        for i in range(0, len(self._records), self.batch_size):
+            yield self._records[i:i + self.batch_size]
+
+
+class QueueDataset(InMemoryDataset):
+    """reference QueueDataset: streaming variant — no load_into_memory
+    required; iterates files directly."""
+
+    def __iter__(self):
+        buf = []
+        for f in self._files:
+            with open(f) as fh:
+                for ln in fh:
+                    ln = ln.rstrip("\n")
+                    buf.append(self._parse_fn(ln) if self._parse_fn else ln)
+                    if len(buf) == self.batch_size:
+                        yield buf
+                        buf = []
+        if buf:
+            yield buf

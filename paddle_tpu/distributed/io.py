@@ -1,0 +1,21 @@
+"""paddle.distributed.io (reference distributed/io.py): persistables
+save/load for distributed training — maps to the distributed checkpoint."""
+
+from .checkpoint import load_state_dict, save_state_dict
+
+__all__ = ["save_state_dict", "load_state_dict", "save_persistables",
+           "load_persistables"]
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None, program=None):
+    raise NotImplementedError(
+        "static persistables IO: use paddle_tpu.distributed.checkpoint "
+        "(save_state_dict/load_state_dict) — the dygraph+capture runtime "
+        "has no ProgramDesc scope to scrape")
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None, program=None):
+    raise NotImplementedError(
+        "static persistables IO: use paddle_tpu.distributed.checkpoint")

@@ -25,6 +25,37 @@ from .math import sum, max, min, all, any, abs, pow  # noqa: F401,A004
 from .manipulation import slice  # noqa: F401,A004
 
 
+def make_inplace_wrapper(fn, name=None):
+    """In-place variant of ``fn``: rebinds the tensor handle to the op
+    result. To keep the tape acyclic, the op consumes an alias of the
+    pre-mutation tensor (same buffer + same producing node), never the
+    mutated handle itself. Shared by the Tensor methods (x.add_()) and
+    the module-level family (paddle.add_)."""
+
+    def inplace(s, *a, **k):
+        from ..core import autograd as _ag
+
+        if (s._grad_node is None and not s.stop_gradient
+                and _ag.is_grad_enabled()):
+            raise RuntimeError(
+                "in-place operation on a leaf tensor that requires grad "
+                "is not allowed; wrap it in paddle_tpu.no_grad() or use "
+                "the out-of-place op")
+        prev = Tensor(s._data, stop_gradient=s.stop_gradient)
+        prev._grad_node = s._grad_node
+        prev._out_slot = s._out_slot
+        out = fn(prev, *a, **k)
+        s._data = out._data
+        s._grad_node = out._grad_node
+        s._out_slot = out._out_slot
+        if out._grad_node is not None:
+            s.stop_gradient = False
+        return s
+
+    inplace.__name__ = name or (getattr(fn, "__name__", "op") + "_")
+    return inplace
+
+
 def _install_tensor_methods():
     T = Tensor
 
@@ -136,35 +167,7 @@ def _install_tensor_methods():
     T.reshape = _reshape
     T.reshape_ = lambda s, *shape: s.set_value(_reshape(s, *shape)._data)
 
-    # In-place variants rebind the handle to the op result. To keep the tape
-    # acyclic, the op consumes an alias of the pre-mutation tensor (same
-    # buffer + same producing node), never the mutated handle itself.
-    def _make_inplace(fn):
-        def inplace(s, *a, **k):
-            from ..core import autograd as _ag
-
-            if (
-                s._grad_node is None
-                and not s.stop_gradient
-                and _ag.is_grad_enabled()
-            ):
-                raise RuntimeError(
-                    "in-place operation on a leaf tensor that requires grad "
-                    "is not allowed; wrap it in paddle_tpu.no_grad() or use "
-                    "the out-of-place op"
-                )
-            prev = Tensor(s._data, stop_gradient=s.stop_gradient)
-            prev._grad_node = s._grad_node
-            prev._out_slot = s._out_slot
-            out = fn(prev, *a, **k)
-            s._data = out._data
-            s._grad_node = out._grad_node
-            s._out_slot = out._out_slot
-            if out._grad_node is not None:
-                s.stop_gradient = False
-            return s
-
-        return inplace
+    _make_inplace = make_inplace_wrapper
 
     for name in ("add", "subtract", "multiply", "scale", "clip"):
         setattr(T, name + "_", _make_inplace(method_table[name]))

@@ -68,6 +68,16 @@ def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tenso
         probe = np.asarray(arr)
         if probe.dtype == np.float64:
             dtype = jnp.float32
+    if not isinstance(arr, (jax.Array, jax.core.Tracer)):
+        probe = np.asarray(arr)
+        if np.issubdtype(probe.dtype, np.complexfloating) and \
+                jax.default_backend() == "tpu":
+            # complex arrays live on the CPU device on TPU backends
+            # (uploading complex poisons some TPU runtimes — same policy
+            # as paddle_tpu.fft / ops.creation.complex)
+            return Tensor(jax.device_put(
+                probe if dtype is None else probe.astype(dtype),
+                jax.devices("cpu")[0]), stop_gradient=stop_gradient)
     arr = jnp.asarray(arr, dtype=dtype)
     return Tensor(arr, stop_gradient=stop_gradient)
 

@@ -313,8 +313,8 @@ class fft:
         import numpy as np
 
         if isinstance(x, Tensor):
-            host = x.numpy()
-            arr = x._data
+            host = np.asarray(x.numpy())   # numpy proper: pocketfft's
+            arr = x._data                  # ufuncs reject foreign arrays
         else:
             host = np.asarray(x)
             arr = None
@@ -367,6 +367,80 @@ class fft:
     @staticmethod
     def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
         return fft._run("ifft2", x, s=s, axes=axes, norm=norm)
+
+    @staticmethod
+    def fftn(x, s=None, axes=None, norm="backward", name=None):
+        return fft._run("fftn", x, s=s, axes=axes, norm=norm)
+
+    @staticmethod
+    def ifftn(x, s=None, axes=None, norm="backward", name=None):
+        return fft._run("ifftn", x, s=s, axes=axes, norm=norm)
+
+    @staticmethod
+    def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return fft._run("rfft2", x, s=s, axes=axes, norm=norm)
+
+    @staticmethod
+    def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return fft._run("irfft2", x, s=s, axes=axes, norm=norm)
+
+    @staticmethod
+    def rfftn(x, s=None, axes=None, norm="backward", name=None):
+        return fft._run("rfftn", x, s=s, axes=axes, norm=norm)
+
+    @staticmethod
+    def irfftn(x, s=None, axes=None, norm="backward", name=None):
+        return fft._run("irfftn", x, s=s, axes=axes, norm=norm)
+
+    @staticmethod
+    def hfft(x, n=None, axis=-1, norm="backward", name=None):
+        return fft._run("hfft", x, n=n, axis=axis, norm=norm)
+
+    @staticmethod
+    def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+        return fft._run("ihfft", x, n=n, axis=axis, norm=norm)
+
+    @staticmethod
+    def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return fft.hfftn(x, s=s, axes=axes, norm=norm)
+
+    @staticmethod
+    def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return fft.ihfftn(x, s=s, axes=axes, norm=norm)
+
+    @staticmethod
+    def hfftn(x, s=None, axes=None, norm="backward", name=None):
+        # hermitian-input FFT, real output (numpy ships only 1-D hfft):
+        # complex fft over the leading axes, hfft (complex -> real) last.
+        # axes default: the last len(s) axes when s is given (numpy/paddle
+        # convention), else all axes
+        if axes is None:
+            nd = len(s) if s is not None else x.ndim
+            axes = tuple(range(-nd, 0))
+        else:
+            axes = tuple(axes)
+        y = x
+        for i, ax in enumerate(axes[:-1]):
+            y = fft._run("fft", y, n=None if s is None else s[i],
+                         axis=ax, norm=norm)
+        return fft._run("hfft", y, n=None if s is None else s[-1],
+                        axis=axes[-1], norm=norm)
+
+    @staticmethod
+    def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+        # inverse: ihfft consumes the REAL input first (real -> hermitian
+        # complex), then complex ifft over the remaining axes
+        if axes is None:
+            nd = len(s) if s is not None else x.ndim
+            axes = tuple(range(-nd, 0))
+        else:
+            axes = tuple(axes)
+        y = fft._run("ihfft", x, n=None if s is None else s[-1],
+                     axis=axes[-1], norm=norm)
+        for i, ax in enumerate(axes[:-1]):
+            y = fft._run("ifft", y, n=None if s is None else s[i],
+                         axis=ax, norm=norm)
+        return y
 
     @staticmethod
     def fftfreq(n, d=1.0, dtype=None, name=None):

@@ -82,39 +82,49 @@ def dstack(xs):
     return jnp.dstack(xs)
 
 
-def _split_list(fn):
-    def wrap(x, num_or_indices, name=None):
-        @op(fn.__name__)
-        def _impl(x):
-            return tuple(fn(x, num_or_indices))
-
-        return list(_impl(x))
-
-    wrap.__name__ = fn.__name__
-    return wrap
+@op("hsplit")
+def _hsplit_impl(x, num_or_indices):
+    n = num_or_indices if isinstance(num_or_indices, int) \
+        else list(num_or_indices)
+    return tuple(jnp.split(x, n, axis=1 if jnp.ndim(x) > 1 else 0))
 
 
-hsplit = _split_list(lambda x, n: jnp.split(
-    x, n if isinstance(n, int) else list(n),
-    axis=1 if jnp.ndim(x) > 1 else 0))
-hsplit.__name__ = "hsplit"
-vsplit = _split_list(lambda x, n: jnp.split(
-    x, n if isinstance(n, int) else list(n), axis=0))
-vsplit.__name__ = "vsplit"
-dsplit = _split_list(lambda x, n: jnp.split(
-    x, n if isinstance(n, int) else list(n), axis=2))
-dsplit.__name__ = "dsplit"
+@op("vsplit")
+def _vsplit_impl(x, num_or_indices):
+    n = num_or_indices if isinstance(num_or_indices, int) \
+        else list(num_or_indices)
+    return tuple(jnp.split(x, n, axis=0))
+
+
+@op("dsplit")
+def _dsplit_impl(x, num_or_indices):
+    n = num_or_indices if isinstance(num_or_indices, int) \
+        else list(num_or_indices)
+    return tuple(jnp.split(x, n, axis=2))
+
+
+@op("tensor_split")
+def _tensor_split_impl(x, num_or_indices, axis):
+    n = num_or_indices if isinstance(num_or_indices, int) \
+        else list(num_or_indices)
+    return tuple(jnp.array_split(x, n, axis=axis))
+
+
+def hsplit(x, num_or_indices, name=None):
+    return list(_hsplit_impl(x, num_or_indices))
+
+
+def vsplit(x, num_or_indices, name=None):
+    return list(_vsplit_impl(x, num_or_indices))
+
+
+def dsplit(x, num_or_indices, name=None):
+    return list(_dsplit_impl(x, num_or_indices))
 
 
 def tensor_split(x, num_or_indices, axis=0, name=None):
     """reference manipulation.py tensor_split: uneven splits allowed."""
-    @op("tensor_split")
-    def _impl(x):
-        return tuple(jnp.array_split(
-            x, num_or_indices if isinstance(num_or_indices, int)
-            else list(num_or_indices), axis=axis))
-
-    return list(_impl(x))
+    return list(_tensor_split_impl(x, num_or_indices, axis))
 
 
 @op("diagonal_scatter")
@@ -313,10 +323,20 @@ def view(x, shape_or_dtype, name=None):
 
     @op("view_dtype")
     def _impl(x):
-        out = jax.lax.bitcast_convert_type(x, convert_dtype(shape_or_dtype))
-        if out.ndim == x.ndim + 1:
-            # narrowing cast appends a dim: merge it into the last axis
-            # (reference view(dtype) returns [..., last * ratio])
+        target = convert_dtype(shape_or_dtype)
+        from_w = np.dtype(x.dtype).itemsize
+        to_w = np.dtype(target).itemsize
+        if to_w > from_w:
+            # widening: group the last dim into ratio-sized packs first
+            r = to_w // from_w
+            if x.shape[-1] % r:
+                raise ValueError(
+                    f"view: last dim {x.shape[-1]} not divisible by the "
+                    f"width ratio {r}")
+            x = x.reshape(x.shape[:-1] + (x.shape[-1] // r, r))
+        out = jax.lax.bitcast_convert_type(x, target)
+        if to_w < from_w:
+            # narrowing appends a dim: merge it into the last axis
             out = out.reshape(out.shape[:-2] + (-1,))
         return out
 
@@ -359,56 +379,39 @@ _INPLACE_SOURCES = [
     "remainder", "renorm", "reshape", "round", "rsqrt", "scale", "scatter",
     "sigmoid", "sign", "sin", "sinh", "sqrt", "square", "squeeze",
     "subtract", "t", "tan", "tanh", "transpose", "tril", "triu", "trunc",
-    "unsqueeze", "where", "add", "bitwise_and", "bitwise_not",
+    "unsqueeze", "add", "bitwise_and", "bitwise_not",
     "bitwise_or", "bitwise_xor", "polygamma", "multigammaln", "sinc",
     "addmm", "bitwise_left_shift", "bitwise_right_shift",
 ]
 
 
-def _shadow_of(x: Tensor) -> Tensor:
-    """A detached stand-in carrying x's pre-mutation tape identity, so the
-    recorded node's input edge survives x being rebound to the output."""
-    s = Tensor(x._data, stop_gradient=x.stop_gradient)
-    s._grad_node = x._grad_node
-    s._out_slot = x._out_slot
-    s._hooks = list(x._hooks)
-    s._retain_grads = x._retain_grads
-    return s
-
-
 def _make_inplace(base_name):
-    def inplace(x, *args, **kwargs):
+    """Module-level in-place variant over the shared alias-based wrapper
+    (see ops/__init__.py make_inplace_wrapper — one tape invariant, one
+    implementation)."""
+
+    def resolver(x, *args, **kwargs):
         import paddle_tpu as pt
-        from ..core import autograd as _ag
 
         fn = getattr(pt, base_name, None)
         if fn is None:
             raise AttributeError(f"no base op {base_name} for inplace")
-        if (not x.stop_gradient and x._grad_node is None
-                and _ag.is_grad_enabled()):
-            # reference semantics: in-place on a grad-requiring leaf is an
-            # error (it would detach the leaf from its own history)
-            raise RuntimeError(
-                f"{base_name}_(): a leaf Tensor that requires grad cannot "
-                "be used in an in-place operation")
-        out = fn(x, *args, **kwargs)
-        node = out._grad_node
-        if node is not None:
-            # the node recorded x itself as an input; point that edge at a
-            # shadow of the pre-mutation tensor or the rebind below would
-            # make the node its own upstream
-            shadow = _shadow_of(x)
-            node.inputs = [shadow if t is x else t for t in node.inputs]
-        # rebind: x now refers to the op output (autograd flows through
-        # the recorded node, matching reference inplace semantics)
-        x._data = out._data
-        x._grad_node = node
-        x._out_slot = out._out_slot
-        x.stop_gradient = out.stop_gradient
-        return x
+        return fn(x, *args, **kwargs)
 
-    inplace.__name__ = base_name + "_"
-    return inplace
+    from . import make_inplace_wrapper
+
+    return make_inplace_wrapper(resolver, name=base_name + "_")
+
+
+def where_(condition, x, y, name=None):
+    """paddle.where_: in-place on ``x`` (NOT the condition — the first
+    argument of the functional form)."""
+    import paddle_tpu as pt
+
+    from . import make_inplace_wrapper
+
+    return make_inplace_wrapper(
+        lambda xx: pt.where(condition, xx, y), name="where_")(x)
 
 
 def install_inplace_variants(namespace: dict):
@@ -420,6 +423,8 @@ def install_inplace_variants(namespace: dict):
             fn = _make_inplace(base)
             namespace[fn.__name__] = fn
             names.append(fn.__name__)
+    namespace["where_"] = where_
+    names.append("where_")
     return names
 
 
@@ -430,6 +435,10 @@ def install_inplace_variants(namespace: dict):
 
 def _fill_inplace(x, arr):
     x._data = arr.astype(x._data.dtype)
+    # the previous computation no longer produces this value: drop the
+    # stale tape identity or backward would differentiate dead history
+    x._grad_node = None
+    x._out_slot = 0
     return x
 
 

@@ -258,3 +258,101 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True):
     P = perm_from_pivots(piv) if piv.ndim == 1 else \
         jnp.stack([perm_from_pivots(pp) for pp in piv.reshape(-1, piv.shape[-1])]).reshape(piv.shape[:-1] + (m, m))
     return P, L, U
+
+
+@op("cholesky_inverse")
+def cholesky_inverse(x, upper=False):
+    """(L L^T)^-1 from its Cholesky factor (reference cholesky_inverse)."""
+    ident = jnp.eye(x.shape[-1], dtype=x.dtype)
+    l = jnp.swapaxes(x, -1, -2) if upper else x
+    y = jax.scipy.linalg.solve_triangular(l, ident, lower=True)
+    return jnp.swapaxes(y, -1, -2) @ y
+
+
+@op("matrix_exp")
+def matrix_exp(x):
+    return jax.scipy.linalg.expm(x)
+
+
+@op("ormqr")
+def ormqr(x, tau, y, left=True, transpose=False):
+    """Multiply by Q from a householder QR (reference ormqr). Q is
+    materialized from the householder vectors — O(n^3) like the kernel;
+    leading batch dims handled via vmap."""
+    m, k = x.shape[-2], x.shape[-1]
+
+    def one(x2, tau1, y2):
+        q = jnp.eye(m, dtype=x2.dtype)
+        for i in range(k):
+            v = jnp.concatenate([jnp.zeros((i,), x2.dtype),
+                                 jnp.ones((1,), x2.dtype),
+                                 x2[i + 1:, i]])
+            h = jnp.eye(m, dtype=x2.dtype) - tau1[i] * jnp.outer(v, v)
+            q = q @ h
+        if transpose:
+            q = jnp.swapaxes(q, -1, -2)
+        return q @ y2 if left else y2 @ q
+
+    if x.ndim == 2:
+        return one(x, tau, y)
+    fn = one
+    for _ in range(x.ndim - 2):
+        fn = jax.vmap(fn)
+    return fn(x, tau, y)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference svd_lowrank; Halko et al.)."""
+    from ..core import random as prandom
+    from ..core.tensor import Tensor as _T
+
+    a = x._data if hasattr(x, "_data") else jnp.asarray(x)
+    if M is not None:
+        a = a - (M._data if hasattr(M, "_data") else jnp.asarray(M))
+    m, n = a.shape[-2], a.shape[-1]
+    q = min(q, m, n)
+    key = prandom.next_key()
+    omega = jax.random.normal(key, a.shape[:-2] + (n, q), a.dtype)
+    y = a @ omega
+    for _ in range(niter):
+        y = a @ (jnp.swapaxes(a, -1, -2) @ y)
+    qmat, _ = jnp.linalg.qr(y)
+    b = jnp.swapaxes(qmat, -1, -2) @ a
+    u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
+    u = qmat @ u_b
+    return _T(u), _T(s), _T(jnp.swapaxes(vh, -1, -2))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA (reference pca_lowrank): returns (U, S, V) of the
+    (centered) data matrix."""
+    a = x._data if hasattr(x, "_data") else jnp.asarray(x)
+    m, n = a.shape[-2], a.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        a = a - a.mean(axis=-2, keepdims=True)
+    from ..core.tensor import Tensor as _T
+
+    return svd_lowrank(_T(a), q=q, niter=niter)
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, output_dtype="float16",
+                            scale=1.0, name=None):
+    """fp8 x fp8 -> half gemm (reference cutlass fp8 kernel; here the MXU
+    path: upcast-matmul with fp32 accumulation, output in half/bf16)."""
+    from ..core.dtype import convert_dtype
+    from ..core.tensor import Tensor as _T
+
+    a = x._data if hasattr(x, "_data") else jnp.asarray(x)
+    b = y._data if hasattr(y, "_data") else jnp.asarray(y)
+    if transpose_x:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_y:
+        b = jnp.swapaxes(b, -1, -2)
+    out = jnp.matmul(a.astype(jnp.float32), b.astype(jnp.float32)) * scale
+    if bias is not None:
+        out = out + (bias._data if hasattr(bias, "_data")
+                     else jnp.asarray(bias)).astype(jnp.float32)
+    return _T(out.astype(convert_dtype(output_dtype)))
