@@ -240,6 +240,34 @@ from .ops.extra2 import install_inplace_variants as _iiv  # noqa: E402
 _INPLACE_NAMES = _iiv(globals())
 
 
+def _install_reference_method_surface():
+    """Bind every reference Tensor-method name to its module function
+    (tensor-first convention) unless a hand-written method already
+    exists."""
+    from .core.tensor import Tensor as _T
+    from .ops.method_table import TENSOR_METHODS
+
+    g = globals()
+    installed = []
+    for name in TENSOR_METHODS:
+        if hasattr(_T, name):
+            continue
+        fn = g.get(name)
+        if fn is None or not callable(fn):
+            continue
+
+        def method(s, *a, _fn=fn, **k):
+            return _fn(s, *a, **k)
+
+        method.__name__ = name
+        setattr(_T, name, method)
+        installed.append(name)
+    return installed
+
+
+_install_reference_method_surface()
+
+
 def __getattr__(name):
     if name == "DataParallel":
         from .distributed.parallel import DataParallel
