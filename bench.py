@@ -13,6 +13,7 @@ attention term 6*L*S*H), peak from the device kind table.
 
 from __future__ import annotations
 
+import functools
 import json
 import time
 
@@ -42,6 +43,15 @@ def _peak_flops() -> float:
     return PEAK_FLOPS.get(kind, 1e12)
 
 
+def _flops_per_token(cfg) -> float:
+    """Standard matmul-only MFU accounting: 6*P_dense + causal attention."""
+    H, L, S, V, F = (cfg.hidden, cfg.n_layers, cfg.seq_len, cfg.vocab_size,
+                     cfg.ffn_mult * cfg.hidden)
+    p_dense = V * H + L * (4 * H * H + 2 * H * F) + (
+        0 if cfg.tie_embeddings else H * V)
+    return 6 * p_dense + 6 * L * S * H
+
+
 def main():
     from paddle_tpu.models.gpt import GPTConfig, gpt_presets
     from paddle_tpu.parallel import make_sharded_train_step
@@ -50,7 +60,13 @@ def main():
     on_tpu = "tpu" in jax.devices()[0].platform.lower() or \
         "TPU" in jax.devices()[0].device_kind
     if on_tpu:
-        cfg = gpt_presets("gpt3-350m")
+        import dataclasses
+
+        # Tuned single-chip flagship config (v5e, 16G HBM): unrolled layer
+        # loop, no remat (activations fit at b8 with bf16 saves), fp32
+        # master weights live in the optimizer state.
+        cfg = dataclasses.replace(gpt_presets("gpt3-350m"),
+                                  unroll=True, remat=False)
         batch, steps, warmup = 8, 20, 8
     else:  # CI / CPU smoke: tiny model, still exercises the full path
         cfg = GPTConfig(vocab_size=1024, hidden=256, n_layers=4, n_heads=4,
@@ -84,15 +100,12 @@ def main():
     tokens = batch * cfg.seq_len * steps
     tok_per_sec_chip = tokens / dt / n_dev
 
-    # dense params (matmul-visible): embeddings + blocks
-    H, L, S, V, F = (cfg.hidden, cfg.n_layers, cfg.seq_len, cfg.vocab_size,
-                     cfg.ffn_mult * cfg.hidden)
-    p_dense = V * H + L * (4 * H * H + 2 * H * F) + (0 if cfg.tie_embeddings
-                                                    else H * V)
-    flops_per_token = 6 * p_dense + 6 * L * S * H  # + causal attention
-    mfu = flops_per_token * tok_per_sec_chip / _peak_flops()
+    mfu = _flops_per_token(cfg) * tok_per_sec_chip / _peak_flops()
 
-    print(json.dumps({
+    # free the 350m state before the 1.3B measurement below allocates
+    del step, params, opt_state, toks, labs
+
+    result = {
         "metric": "gpt3_350m_train_tokens_per_sec_per_chip" if on_tpu
         else "gpt_tiny_cpu_tokens_per_sec",
         "value": round(tok_per_sec_chip, 1),
@@ -103,7 +116,64 @@ def main():
         "loss": round(float(loss), 4),
         "device": jax.devices()[0].device_kind,
         "n_devices": n_dev,
-    }))
+    }
+    if on_tpu:
+        # fault-isolated: a failure in the secondary measurement must not
+        # discard the already-measured flagship result (the driver contract
+        # is one JSON line).
+        try:
+            result["extra"] = _bench_13b()
+        except Exception as e:  # noqa: BLE001
+            result["extra"] = {"gpt3_1p3b_error": str(e)[:200]}
+    print(json.dumps(result))
+
+
+def _bench_13b():
+    """GPT-3 1.3B single-chip fwd+bwd+SGD-touch (BASELINE.md config 3).
+
+    Full AdamW state for 1.3B (5.2G master + 10.4G fp32 moments) exceeds one
+    v5e's 16G HBM — the reference runs this config tensor-parallel across
+    chips (mp_layers.py), which the multichip dryrun exercises. Here we
+    measure the compute path a TP shard runs: forward+backward+param touch,
+    bf16 params, remat. MFU uses the same 6N accounting.
+    """
+    import dataclasses
+    import time
+
+    from paddle_tpu.models.gpt import gpt_presets, init_params, loss_fn
+
+    cfg = dataclasses.replace(gpt_presets("gpt3-1.3b"), unroll=False)
+    batch, steps = 4, 10
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    params = jax.tree.map(lambda a: a.astype(cfg.dtype), params)
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, cfg.vocab_size,
+                                   size=(batch, cfg.seq_len)))
+    labs = jnp.asarray(rng.randint(0, cfg.vocab_size,
+                                   size=(batch, cfg.seq_len)))
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(p):
+        loss, g = jax.value_and_grad(
+            lambda q: loss_fn(q, toks, labs, cfg))(p)
+        # touch-update keeps grads live and mimics an optimizer's
+        # param-write pass without the fp32 state that cannot fit
+        return loss, jax.tree.map(lambda a, b: a - 1e-6 * b, p, g)
+
+    loss, params = step(params)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, params = step(params)
+    float(loss)
+    dt = time.perf_counter() - t0
+    tok_s = batch * cfg.seq_len * steps / dt
+    fpt = _flops_per_token(cfg)
+    return {
+        "gpt3_1p3b_fwdbwd_tokens_per_sec_per_chip": round(tok_s, 1),
+        "gpt3_1p3b_mfu": round(fpt * tok_s / _peak_flops(), 4),
+        "gpt3_1p3b_step_ms": round(dt / steps * 1000, 2),
+    }
 
 
 if __name__ == "__main__":

@@ -58,6 +58,11 @@ class GPTConfig:
     tie_embeddings: bool = True
     use_flash: bool = True
     remat: bool = True
+    # Unroll the layer loop instead of lax.scan: straight-line XLA code has
+    # no dynamic-update-slice stacking of saves/grads and schedules ~10%
+    # faster on v5e; costs compile time linear in depth (use for the
+    # single-program bench/train path, keep scan for quick iteration).
+    unroll: bool = False
     eps: float = 1e-5
 
     @property
@@ -168,26 +173,27 @@ def block_apply(bp: dict, x, cfg: GPTConfig, sp_constraint=None):
     (a single layer's slice). ``sp_constraint`` optionally reshards the
     activation (Megatron-SP: token dim over 'mp') between sublayers."""
     B, T, H = x.shape
+    # Matmuls take and produce cfg.dtype (bf16 on TPU): the MXU accumulates
+    # in fp32 internally either way, and emitting bf16 halves the HBM
+    # traffic of the residuals the remat policy saves per layer (measured
+    # ~40ms/step of dynamic-update-slice fusions at 350M/b8 with fp32
+    # dot outputs).
     h = _layer_norm(x, bp["ln1_g"], bp["ln1_b"], cfg.eps)
-    qkv = jnp.einsum("bth,hk->btk", h, bp["qkv_w"].astype(cfg.dtype),
-                     preferred_element_type=jnp.float32).astype(cfg.dtype)
+    qkv = jnp.einsum("bth,hk->btk", h, bp["qkv_w"].astype(cfg.dtype))
     qkv = qkv + bp["qkv_b"].astype(cfg.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, T, cfg.n_heads, cfg.head_dim)
     k = k.reshape(B, T, cfg.n_heads, cfg.head_dim)
     v = v.reshape(B, T, cfg.n_heads, cfg.head_dim)
     o = _attention(q, k, v, cfg).reshape(B, T, H)
-    o = jnp.einsum("bth,hk->btk", o, bp["proj_w"].astype(cfg.dtype),
-                   preferred_element_type=jnp.float32).astype(cfg.dtype)
+    o = jnp.einsum("bth,hk->btk", o, bp["proj_w"].astype(cfg.dtype))
     x = x + o + bp["proj_b"].astype(cfg.dtype)
     if sp_constraint is not None:
         x = sp_constraint(x)
     h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"], cfg.eps)
-    h = jnp.einsum("bth,hf->btf", h, bp["fc_w"].astype(cfg.dtype),
-                   preferred_element_type=jnp.float32).astype(cfg.dtype)
+    h = jnp.einsum("bth,hf->btf", h, bp["fc_w"].astype(cfg.dtype))
     h = jax.nn.gelu(h + bp["fc_b"].astype(cfg.dtype), approximate=True)
-    h = jnp.einsum("btf,fh->bth", h, bp["fc2_w"].astype(cfg.dtype),
-                   preferred_element_type=jnp.float32).astype(cfg.dtype)
+    h = jnp.einsum("btf,fh->bth", h, bp["fc2_w"].astype(cfg.dtype))
     x = x + h + bp["fc2_b"].astype(cfg.dtype)
     if sp_constraint is not None:
         x = sp_constraint(x)
@@ -258,15 +264,25 @@ def model_apply(params: dict, tokens, cfg: GPTConfig, sp_constraint=None,
         fn = functools.partial(block_apply, cfg=cfg,
                                sp_constraint=sp_constraint)
         if cfg.remat:
-            # save matmul outputs, recompute elementwise: cheaper backward
-            # than full-block remat at slightly higher memory
-            fn = jax.checkpoint(
-                fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+            # save matmul outputs AND the flash-attention outputs (named in
+            # ops/pallas/flash_attention.py — pallas calls are not dots, so
+            # without the names the whole flash forward would run again in
+            # backward); recompute elementwise only.
+            pol = jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names(
+                    "flash_o", "flash_lse"))
+            fn = jax.checkpoint(fn, policy=pol)
 
-        def body(carry, bp):
-            return fn(bp, carry), None
+        if cfg.unroll:
+            for i in range(cfg.n_layers):
+                bp = jax.tree.map(lambda a, i=i: a[i], params["blocks"])
+                x = fn(bp, x)
+        else:
+            def body(carry, bp):
+                return fn(bp, carry), None
 
-        x, _ = lax.scan(body, x, params["blocks"])
+            x, _ = lax.scan(body, x, params["blocks"])
 
     # MoE layers run after the dense stack in BOTH paths (so the pipeline
     # blocks_fn override cannot silently drop expert compute).
@@ -318,7 +334,7 @@ def _chunked_ce(x, head, labels, chunk: int):
 
 
 def loss_fn(params, tokens, labels, cfg: GPTConfig, sp_constraint=None,
-            blocks_fn=None, loss_chunk: int = 256):
+            blocks_fn=None, loss_chunk: int = 512):
     """Causal LM cross-entropy in fp32 (the reference's
     ParallelCrossEntropy semantics for mp-sharded logits come from GSPMD
     partitioning the log-sum-exp). ``loss_chunk`` > 0 streams the vocab

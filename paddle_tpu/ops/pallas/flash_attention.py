@@ -29,13 +29,31 @@ def _interpret_mode() -> bool:
     return _INTERPRET
 
 
+def _tpu_params(n_parallel: int):
+    """CompilerParams marking leading grid dims parallel so Mosaic pipelines
+    across grid steps (the kernels are otherwise latency-bound per program:
+    measured ~60us/program on v5e regardless of block size)."""
+    if _interpret_mode():
+        return None
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel",) * n_parallel + ("arbitrary",))
+
+
 # Default tile-size caps. Measured on v5e at GPT-350M shapes (B8 S1024 H16
-# D64): 128x128 runs at ~60% the speed of 512x1024 — bigger q tiles amortize
-# the K/V VMEM residency and keep the MXU fed. _block_sizes() picks the
-# largest 128-multiple divisor of the sequence length under these caps, so
-# any seq divisible by 128 gets the Pallas path.
+# D64): 128x128 runs at ~60% the speed of big tiles — bigger q tiles
+# amortize the K/V VMEM residency and keep the MXU fed. block_k == block_q
+# so causal skipping works at block granularity: with block_k = S every q
+# tile would process the full K range and the causal loop cap saves
+# nothing. _block_sizes() picks the largest 128-multiple divisor of the
+# sequence length under these caps, so any seq divisible by 128 gets the
+# Pallas path.
 BLOCK_Q = 512
-BLOCK_K = 1024
+BLOCK_K = 512
+# Heads processed per grid program (static unrolled loop in the kernels):
+# amortizes the per-grid-step latency and enlarges DMAs.
+HEAD_BLOCK = 4
 
 _MIN_BLOCK = 128
 
@@ -63,50 +81,74 @@ def supported(shape, dtype) -> bool:
     return bq >= _MIN_BLOCK and bk >= _MIN_BLOCK and d in (64, 128, 256)
 
 
+def _head_block(h: int) -> int:
+    """Largest divisor of ``h`` that is <= HEAD_BLOCK."""
+    hb = min(HEAD_BLOCK, h)
+    while h % hb:
+        hb -= 1
+    return hb
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, causal,
-                      sm_scale, block_k, seq_len):
+                      sm_scale, block_k, seq_len, head_block):
     import jax.experimental.pallas as pl
 
     q_idx = pl.program_id(2)
-    q = q_ref[...].astype(jnp.float32) * sm_scale  # [block_q, d]
-
-    m_i = jnp.full((q.shape[0],), -1e30, jnp.float32)
-    l_i = jnp.zeros((q.shape[0],), jnp.float32)
-    acc = jnp.zeros((q.shape[0], v_ref.shape[-1]), jnp.float32)
-
-    q_offs = q_idx * q.shape[0] + jax.lax.iota(jnp.int32, q.shape[0])
+    bq = q_ref.shape[1]
+    q_offs = q_idx * bq + jax.lax.iota(jnp.int32, bq)
 
     num_k_blocks = seq_len // block_k
+    # Causal split: blocks entirely below the diagonal need no mask (and no
+    # per-element select); only blocks crossing it do. Blocks entirely above
+    # the diagonal are skipped outright.
+    num_full_blocks = num_k_blocks
     if causal:
-        # only blocks at or before the diagonal contribute
-        num_k_blocks = jax.lax.div(
-            (q_idx + 1) * q.shape[0] + block_k - 1, block_k
-        )
+        num_full_blocks = jax.lax.div(q_idx * bq, block_k)
+        num_k_blocks = jax.lax.div((q_idx + 1) * bq + block_k - 1, block_k)
 
-    def body(kb, carry):
-        m_i, l_i, acc = carry
-        k = k_ref[pl.dslice(kb * block_k, block_k), :]
-        v = v_ref[pl.dslice(kb * block_k, block_k), :]
-        s = jnp.dot(q, k.T.astype(jnp.float32),
-                    preferred_element_type=jnp.float32)  # [bq, bk]
-        if causal:
-            k_offs = kb * block_k + jax.lax.iota(jnp.int32, block_k)
-            mask = q_offs[:, None] >= k_offs[None, :]
-            s = jnp.where(mask, s, -1e30)
-        m_new = jnp.maximum(m_i, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m_i - m_new)
-        l_new = alpha * l_i + jnp.sum(p, axis=1)
-        acc_new = acc * alpha[:, None] + jnp.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32
-        )
-        return m_new, l_new, acc_new
+    # Static python loop over the head block: one grid program handles
+    # head_block heads, amortizing the per-program grid-step latency
+    # (measured ~60us/program on v5e regardless of block size).
+    for i in range(head_block):
+        # Keep q/k in their input dtype (bf16 on TPU): the MXU runs bf16
+        # inputs with fp32 accumulation at full rate, while fp32xfp32 dots
+        # run ~8x slower.
+        q = q_ref[i]  # [block_q, d]
 
-    m_i, l_i, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m_i, l_i, acc))
-    o_ref[...] = (acc / l_i[:, None]).astype(o_ref.dtype)
-    if lse_ref is not None:
-        lse_ref[...] = jnp.broadcast_to((m_i + jnp.log(l_i))[None, :],
-                                        lse_ref.shape)
+        m_i = jnp.full((bq,), -1e30, jnp.float32)
+        l_i = jnp.zeros((bq,), jnp.float32)
+        acc = jnp.zeros((bq, v_ref.shape[-1]), jnp.float32)
+
+        def body(kb, carry, *, masked, i=i):
+            m_i, l_i, acc = carry
+            k = k_ref[i, pl.dslice(kb * block_k, block_k), :]
+            v = v_ref[i, pl.dslice(kb * block_k, block_k), :]
+            s = jnp.dot(q, k.T,
+                        preferred_element_type=jnp.float32) * sm_scale
+            if masked:
+                k_offs = kb * block_k + jax.lax.iota(jnp.int32, block_k)
+                mask = q_offs[:, None] >= k_offs[None, :]
+                s = jnp.where(mask, s, -1e30)
+            m_new = jnp.maximum(m_i, jnp.max(s, axis=1))
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m_i - m_new)
+            l_new = alpha * l_i + jnp.sum(p, axis=1)
+            acc_new = acc * alpha[:, None] + jnp.dot(
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32
+            )
+            return m_new, l_new, acc_new
+
+        carry = jax.lax.fori_loop(0, num_full_blocks,
+                                  functools.partial(body, masked=False),
+                                  (m_i, l_i, acc))
+        m_i, l_i, acc = jax.lax.fori_loop(num_full_blocks, num_k_blocks,
+                                          functools.partial(body,
+                                                            masked=causal),
+                                          carry)
+        o_ref[i] = (acc / l_i[:, None]).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse_ref[i] = jnp.broadcast_to((m_i + jnp.log(l_i))[None, :],
+                                          lse_ref.shape[1:])
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale",
@@ -121,33 +163,35 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, with_lse: bool = False):
     vt = jnp.swapaxes(v, 1, 2)
 
     block_q, block_k = _block_sizes(s)
+    hb = _head_block(h)
 
-    grid = (b, h, s // block_q)
+    grid = (b, h // hb, s // block_q)
     out_shapes = [jax.ShapeDtypeStruct((b, h, s, d), q.dtype)]
-    out_specs = [pl.BlockSpec((None, None, block_q, d),
+    out_specs = [pl.BlockSpec((None, hb, block_q, d),
                               lambda ib, ih, iq: (ib, ih, iq, 0))]
     if with_lse:
         # rank-4 with an 8-row broadcast dim: Pallas TPU requires the last
         # two block dims divisible by (8, 128), ruling out rank-1 blocks
         out_shapes.append(jax.ShapeDtypeStruct((b, h, 8, s), jnp.float32))
-        out_specs.append(pl.BlockSpec((None, None, 8, block_q),
+        out_specs.append(pl.BlockSpec((None, hb, 8, block_q),
                                       lambda ib, ih, iq: (ib, ih, 0, iq)))
     kern = functools.partial(
         _flash_fwd_kernel, causal=causal, sm_scale=sm_scale,
-        block_k=block_k, seq_len=s)
+        block_k=block_k, seq_len=s, head_block=hb)
     if not with_lse:
         kern = functools.partial(kern, lse_ref=None)
     res = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, None, block_q, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
-            pl.BlockSpec((None, None, s, d), lambda ib, ih, iq: (ib, ih, 0, 0)),
-            pl.BlockSpec((None, None, s, d), lambda ib, ih, iq: (ib, ih, 0, 0)),
+            pl.BlockSpec((None, hb, block_q, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
+            pl.BlockSpec((None, hb, s, d), lambda ib, ih, iq: (ib, ih, 0, 0)),
+            pl.BlockSpec((None, hb, s, d), lambda ib, ih, iq: (ib, ih, 0, 0)),
         ],
         out_specs=out_specs if with_lse else out_specs[0],
         out_shape=out_shapes if with_lse else out_shapes[0],
         interpret=_interpret_mode(),
+        compiler_params=_tpu_params(2),
     )(qt, kt, vt)
     if with_lse:
         out, lse = res
@@ -156,76 +200,106 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, with_lse: bool = False):
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, causal, sm_scale, block_k, seq_len):
+                         dq_ref, *, causal, sm_scale, block_k, seq_len,
+                         head_block):
     import jax.experimental.pallas as pl
 
     q_idx = pl.program_id(2)
-    q = q_ref[...].astype(jnp.float32) * sm_scale      # [bq, d]
-    do = do_ref[...].astype(jnp.float32)               # [bq, d]
-    lse = lse_ref[0, :]                                # [bq] (8-row packed)
-    delta = delta_ref[0, :]
-    bq = q.shape[0]
+    bq = q_ref.shape[1]
+    d = q_ref.shape[-1]
     q_offs = q_idx * bq + jax.lax.iota(jnp.int32, bq)
 
     num_k_blocks = seq_len // block_k
+    num_full_blocks = num_k_blocks
     if causal:
+        num_full_blocks = jax.lax.div(q_idx * bq, block_k)
         num_k_blocks = jax.lax.div((q_idx + 1) * bq + block_k - 1, block_k)
 
-    def body(kb, dq):
-        k = k_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-        p = jnp.exp(s - lse[:, None])
-        if causal:
-            k_offs = kb * block_k + jax.lax.iota(jnp.int32, block_k)
-            p = jnp.where(q_offs[:, None] >= k_offs[None, :], p, 0.0)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
-        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+    # All dots stay in the input dtype (bf16 on TPU) with fp32 accumulation;
+    # softmax math (exp, ds) stays fp32. Static head-block loop as in fwd.
+    for i in range(head_block):
+        q = q_ref[i]                                   # [bq, d]
+        do = do_ref[i]                                 # [bq, d]
+        lse = lse_ref[i, 0, :]                         # [bq] (8-row packed)
+        delta = delta_ref[i, 0, :]
 
-    dq = jax.lax.fori_loop(0, num_k_blocks, body,
-                           jnp.zeros_like(q))
-    dq_ref[...] = (dq * sm_scale).astype(dq_ref.dtype)
+        def body(kb, dq, *, masked, i=i, q=q, do=do, lse=lse, delta=delta):
+            k = k_ref[i, pl.dslice(kb * block_k, block_k), :]
+            v = v_ref[i, pl.dslice(kb * block_k, block_k), :]
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+            p = jnp.exp(s - lse[:, None])
+            if masked:
+                k_offs = kb * block_k + jax.lax.iota(jnp.int32, block_k)
+                p = jnp.where(q_offs[:, None] >= k_offs[None, :], p, 0.0)
+            dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta[:, None])).astype(k.dtype)
+            return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+        dq = jax.lax.fori_loop(0, num_full_blocks,
+                               functools.partial(body, masked=False),
+                               jnp.zeros((bq, d), jnp.float32))
+        dq = jax.lax.fori_loop(num_full_blocks, num_k_blocks,
+                               functools.partial(body, masked=causal), dq)
+        dq_ref[i] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, *, causal, sm_scale, block_q,
-                          seq_len):
+                          seq_len, head_block):
     import jax.experimental.pallas as pl
 
     k_idx = pl.program_id(2)
-    k = k_ref[...].astype(jnp.float32)                 # [bk, d]
-    v = v_ref[...].astype(jnp.float32)
-    bk = k.shape[0]
+    bk = k_ref.shape[1]
+    d = k_ref.shape[-1]
     k_offs = k_idx * bk + jax.lax.iota(jnp.int32, bk)
 
     num_q_blocks = seq_len // block_q
     start_q = 0
+    # q blocks from start_q up to end_masked cross the diagonal (need the
+    # mask); from end_masked on, every q in the tile sees every k.
+    end_masked = 0
     if causal:
         start_q = jax.lax.div(k_idx * bk, block_q)
+        end_masked = jax.lax.min(
+            jax.lax.div((k_idx + 1) * bk + block_q - 1, block_q),
+            num_q_blocks)
 
-    def body(qb, carry):
-        dk, dv = carry
-        q = q_ref[pl.dslice(qb * block_q, block_q), :].astype(jnp.float32) \
-            * sm_scale
-        do = do_ref[pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.dslice(qb * block_q, block_q)]
-        delta = delta_ref[0, pl.dslice(qb * block_q, block_q)]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-        p = jnp.exp(s - lse[:, None])
-        if causal:
-            q_offs = qb * block_q + jax.lax.iota(jnp.int32, block_q)
-            p = jnp.where(q_offs[:, None] >= k_offs[None, :], p, 0.0)
-        dv_new = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
-        dk_new = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-        return dk_new, dv_new
+    # bf16 dots / fp32 accumulators; static head-block loop as in fwd.
+    for i in range(head_block):
+        k = k_ref[i]                                   # [bk, d]
+        v = v_ref[i]
 
-    dk, dv = jax.lax.fori_loop(start_q, num_q_blocks, body,
-                               (jnp.zeros_like(k), jnp.zeros_like(v)))
-    dk_ref[...] = dk.astype(dk_ref.dtype)
-    dv_ref[...] = dv.astype(dv_ref.dtype)
+        def body(qb, carry, *, masked, i=i, k=k, v=v):
+            dk, dv = carry
+            q = q_ref[i, pl.dslice(qb * block_q, block_q), :]
+            do = do_ref[i, pl.dslice(qb * block_q, block_q), :]
+            lse = lse_ref[i, 0, pl.dslice(qb * block_q, block_q)]
+            delta = delta_ref[i, 0, pl.dslice(qb * block_q, block_q)]
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+            p = jnp.exp(s - lse[:, None])
+            if masked:
+                q_offs = qb * block_q + jax.lax.iota(jnp.int32, block_q)
+                p = jnp.where(q_offs[:, None] >= k_offs[None, :], p, 0.0)
+            p_lo = p.astype(do.dtype)
+            dv_new = dv + jnp.dot(p_lo.T, do,
+                                  preferred_element_type=jnp.float32)
+            dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta[:, None])).astype(q.dtype)
+            dk_new = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+            return dk_new, dv_new
+
+        zero = (jnp.zeros((bk, d), jnp.float32),
+                jnp.zeros((bk, d), jnp.float32))
+        dk, dv = jax.lax.fori_loop(start_q, end_masked,
+                                   functools.partial(body, masked=causal),
+                                   zero)
+        dk, dv = jax.lax.fori_loop(jax.lax.max(start_q, end_masked),
+                                   num_q_blocks,
+                                   functools.partial(body, masked=False),
+                                   (dk, dv))
+        # s was scaled but dk accumulated against unscaled q: scale once.
+        dk_ref[i] = (dk * sm_scale).astype(dk_ref.dtype)
+        dv_ref[i] = dv.astype(dv_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale"))
@@ -239,12 +313,18 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float):
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    ot = jnp.swapaxes(o, 1, 2)
-    dot_ = jnp.swapaxes(do, 1, 2).astype(jnp.float32)
-    delta = jnp.sum(dot_ * ot.astype(jnp.float32), axis=-1)   # [b, h, s]
+    # do stays in the compute dtype for the kernel dots; delta (a reduction)
+    # is computed in the ORIGINAL [b, s, h, d] layout so o never needs the
+    # 16MB-per-layer [b,h,s,d] transpose — only the tiny [b,s,h] reduction
+    # result gets permuted.
+    dot_ = jnp.swapaxes(do, 1, 2).astype(q.dtype)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                   # [b, s, h]
+    delta = jnp.transpose(delta, (0, 2, 1))                    # [b, h, s]
     delta = jnp.broadcast_to(delta[:, :, None, :], (b, h, 8, s))
 
     block_q, block_k = _block_sizes(s)
+    hb = _head_block(h)
 
     full = lambda ib, ih, i: (ib, ih, 0, 0)
     blk_q4 = lambda ib, ih, iq: (ib, ih, iq, 0)
@@ -252,39 +332,43 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float):
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, causal=causal,
-                          sm_scale=sm_scale, block_k=block_k, seq_len=s),
-        grid=(b, h, s // block_q),
+                          sm_scale=sm_scale, block_k=block_k, seq_len=s,
+                          head_block=hb),
+        grid=(b, h // hb, s // block_q),
         in_specs=[
-            pl.BlockSpec((None, None, block_q, d), blk_q4),
-            pl.BlockSpec((None, None, s, d), full),
-            pl.BlockSpec((None, None, s, d), full),
-            pl.BlockSpec((None, None, block_q, d), blk_q4),
-            pl.BlockSpec((None, None, 8, block_q), pack_q),
-            pl.BlockSpec((None, None, 8, block_q), pack_q),
+            pl.BlockSpec((None, hb, block_q, d), blk_q4),
+            pl.BlockSpec((None, hb, s, d), full),
+            pl.BlockSpec((None, hb, s, d), full),
+            pl.BlockSpec((None, hb, block_q, d), blk_q4),
+            pl.BlockSpec((None, hb, 8, block_q), pack_q),
+            pl.BlockSpec((None, hb, 8, block_q), pack_q),
         ],
-        out_specs=pl.BlockSpec((None, None, block_q, d), blk_q4),
+        out_specs=pl.BlockSpec((None, hb, block_q, d), blk_q4),
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         interpret=_interpret_mode(),
+        compiler_params=_tpu_params(2),
     )(qt, kt, vt, dot_, lse, delta)
 
     full_pack = lambda ib, ih, ik: (ib, ih, 0, 0)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, causal=causal,
-                          sm_scale=sm_scale, block_q=block_q, seq_len=s),
-        grid=(b, h, s // block_k),
+                          sm_scale=sm_scale, block_q=block_q, seq_len=s,
+                          head_block=hb),
+        grid=(b, h // hb, s // block_k),
         in_specs=[
-            pl.BlockSpec((None, None, s, d), full),
-            pl.BlockSpec((None, None, block_k, d), blk_q4),
-            pl.BlockSpec((None, None, block_k, d), blk_q4),
-            pl.BlockSpec((None, None, s, d), full),
-            pl.BlockSpec((None, None, 8, s), full_pack),
-            pl.BlockSpec((None, None, 8, s), full_pack),
+            pl.BlockSpec((None, hb, s, d), full),
+            pl.BlockSpec((None, hb, block_k, d), blk_q4),
+            pl.BlockSpec((None, hb, block_k, d), blk_q4),
+            pl.BlockSpec((None, hb, s, d), full),
+            pl.BlockSpec((None, hb, 8, s), full_pack),
+            pl.BlockSpec((None, hb, 8, s), full_pack),
         ],
-        out_specs=[pl.BlockSpec((None, None, block_k, d), blk_q4),
-                   pl.BlockSpec((None, None, block_k, d), blk_q4)],
+        out_specs=[pl.BlockSpec((None, hb, block_k, d), blk_q4),
+                   pl.BlockSpec((None, hb, block_k, d), blk_q4)],
         out_shape=[jax.ShapeDtypeStruct((b, h, s, d), k.dtype),
                    jax.ShapeDtypeStruct((b, h, s, d), v.dtype)],
         interpret=_interpret_mode(),
+        compiler_params=_tpu_params(2),
     )(qt, kt, vt, dot_, lse, delta)
 
     return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
@@ -375,7 +459,15 @@ def flash_attention_raw(q, k, v, causal: bool = False, sm_scale: float | None = 
 
     if use_kernel_bwd:
         def fwd(q, k, v):
+            from jax.ad_checkpoint import checkpoint_name
+
             o, lse = _flash_fwd(q, k, v, causal, scale, with_lse=True)
+            # Under jax.checkpoint, pallas outputs are not "dots", so a
+            # dots-saveable policy would recompute the whole flash forward
+            # in backward. Naming them lets the model's remat policy save
+            # them (models/gpt.py pairs this with save_only_these_names).
+            o = checkpoint_name(o, "flash_o")
+            lse = checkpoint_name(lse, "flash_lse")
             return o, (q, k, v, o, lse)
 
         def bwd(res, g):

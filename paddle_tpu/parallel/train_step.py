@@ -83,13 +83,33 @@ def shard_gpt_params(params: dict, cfg: GPTConfig, mesh: Mesh) -> dict:
 # -- functional AdamW (the compiled-path optimizer; the dygraph Optimizer
 #    classes serve the eager API) ------------------------------------------
 
-def adamw_init(params: dict) -> dict:
+_NO_MASTER = None  # sentinel factory below
+
+
+def _master_leaf(a):
+    """fp32 master for leaves that live in low precision; 1-D leaves
+    (LN gains/biases, bias vectors) stay fp32 in params themselves
+    (AMP-O2 keeps norm params out of the low-precision cast), so a master
+    would be a redundant alias — store a size-0 sentinel to keep the
+    pytree structure without duplicating (or aliasing) the buffer."""
+    if a.ndim >= 2:
+        return a.astype(jnp.float32)
+    return jnp.zeros((0,), jnp.float32)
+
+
+def adamw_init(params: dict, master_weights: bool = False) -> dict:
+    """``master_weights``: keep an fp32 master copy in the state (reference
+    AMP-O2 semantics, amp/grad_scaler + master_grad) so ``params`` itself can
+    live in the compute dtype — no per-use fp32->bf16 casts in the hot loop."""
     zeros = lambda a: jnp.zeros_like(a, dtype=jnp.float32)
-    return {
+    state = {
         "m": jax.tree.map(zeros, params),
         "v": jax.tree.map(zeros, params),
         "t": jnp.zeros((), jnp.int32),
     }
+    if master_weights:
+        state["master"] = jax.tree.map(_master_leaf, params)
+    return state
 
 
 def adamw_update(params, grads, state, lr, wd=0.1, b1=0.9, b2=0.95,
@@ -97,31 +117,42 @@ def adamw_update(params, grads, state, lr, wd=0.1, b1=0.9, b2=0.95,
     t = state["t"] + 1
     bc1 = 1.0 - b1 ** t.astype(jnp.float32)
     bc2 = 1.0 - b2 ** t.astype(jnp.float32)
+    masters = state.get("master")
 
-    def upd(p, g, m, v):
+    def upd(p, g, m, v, mw):
+        has_master = mw is not None and mw.size
         g32 = g.astype(jnp.float32)
         m = b1 * m + (1 - b1) * g32
         v = b2 * v + (1 - b2) * jnp.square(g32)
         step = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
-        p32 = p.astype(jnp.float32)
+        p32 = mw if has_master else p.astype(jnp.float32)
         p32 = p32 - lr * (step + wd * p32)
-        return p32.astype(p.dtype), m, v
+        new_mw = p32 if has_master else (
+            None if mw is None else jnp.zeros((0,), jnp.float32))
+        return p32.astype(p.dtype), m, v, new_mw
 
     flat_p, tree = jax.tree.flatten(params)
     flat_g = jax.tree.leaves(grads)
     flat_m = jax.tree.leaves(state["m"])
     flat_v = jax.tree.leaves(state["v"])
-    out = [upd(p, g, m, v) for p, g, m, v in
-           zip(flat_p, flat_g, flat_m, flat_v)]
+    flat_mw = (jax.tree.leaves(masters) if masters is not None
+               else [None] * len(flat_p))
+    out = [upd(p, g, m, v, mw) for p, g, m, v, mw in
+           zip(flat_p, flat_g, flat_m, flat_v, flat_mw)]
     new_p = jax.tree.unflatten(tree, [o[0] for o in out])
     new_m = jax.tree.unflatten(tree, [o[1] for o in out])
     new_v = jax.tree.unflatten(tree, [o[2] for o in out])
-    return new_p, {"m": new_m, "v": new_v, "t": t}
+    new_state = {"m": new_m, "v": new_v, "t": t}
+    if masters is not None:
+        new_state["master"] = jax.tree.unflatten(tree,
+                                                 [o[3] for o in out])
+    return new_p, new_state
 
 
 def zero_shard_opt_state(state: dict, mesh: Mesh, axis: str = "dp") -> dict:
-    """ZeRO-1: spread AdamW moments over the dp axis
-    (reference DygraphShardingOptimizer, dygraph_sharding_optimizer.py:49)."""
+    """ZeRO-1: spread AdamW moments (and fp32 masters, when present) over
+    the dp axis (reference DygraphShardingOptimizer,
+    dygraph_sharding_optimizer.py:49)."""
     if axis not in mesh.axis_names or mesh.shape[axis] == 1:
         return state
     from ..distributed.sharding import shard_array_over
@@ -129,8 +160,11 @@ def zero_shard_opt_state(state: dict, mesh: Mesh, axis: str = "dp") -> dict:
     def put(a):
         return shard_array_over(a, mesh, axis) if a.ndim > 0 else a
 
-    return {"m": jax.tree.map(put, state["m"]),
-            "v": jax.tree.map(put, state["v"]), "t": state["t"]}
+    out = {"m": jax.tree.map(put, state["m"]),
+           "v": jax.tree.map(put, state["v"]), "t": state["t"]}
+    if "master" in state:
+        out["master"] = jax.tree.map(put, state["master"])
+    return out
 
 
 def make_sharded_train_step(cfg: GPTConfig, mesh: Mesh, lr: float = 1e-4,
@@ -141,7 +175,18 @@ def make_sharded_train_step(cfg: GPTConfig, mesh: Mesh, lr: float = 1e-4,
     (loss, params, opt_state)``."""
     params = init_params(cfg, jax.random.PRNGKey(seed))
     params = shard_gpt_params(params, cfg, mesh)
-    opt_state = adamw_init(params)
+    # Master-weight mode when params would be cast per-use anyway: keep the
+    # fp32 master in the optimizer state and the live MATMUL weights in the
+    # compute dtype (matmuls consumed them bf16 either way; the update
+    # always accumulates in fp32), shedding every weight-cast and halving
+    # grad HBM traffic in the hot loop. 1-D params (LayerNorm gains/biases,
+    # bias vectors) stay fp32, matching reference AMP-O2 which excludes
+    # norm params from the low-precision cast (amp/auto_cast black list).
+    master = jnp.dtype(cfg.param_dtype) != jnp.dtype(cfg.dtype)
+    opt_state = adamw_init(params, master_weights=master)
+    if master:
+        params = jax.tree.map(
+            lambda a: a.astype(cfg.dtype) if a.ndim >= 2 else a, params)
     if zero1:
         opt_state = zero_shard_opt_state(opt_state, mesh)
 
