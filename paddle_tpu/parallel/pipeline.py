@@ -28,15 +28,35 @@ __all__ = ["pipeline_blocks_fn"]
 
 
 def pipeline_blocks_fn(stage_fn: Callable, mesh: Mesh, n_microbatches: int,
-                       pp_axis: str = "pp"):
+                       pp_axis: str = "pp", schedule: str = "1f1b"):
     """Build a ``blocks_fn(stacked_params, x)`` running the stacked layers
-    as a GPipe-style pipeline over ``pp_axis``.
+    as a compiled pipeline over ``pp_axis``.
 
     ``stage_fn(stage_params, x) -> y`` applies one stage's slice of the
     stack (itself typically a lax.scan over layers_per_stage).
     ``stacked_params`` leaves are ``[L, ...]`` with L divisible by the pp
     degree; x is the full activation ``[B, T, H]`` with B divisible by
     ``n_microbatches``.
+
+    ``schedule``:
+
+    - ``"1f1b"`` (default): hand-written forward/backward streaming with a
+      ``jax.custom_vjp`` — the forward scan stashes exactly the M real
+      per-rank stage inputs and the backward scan replays+VJPs each
+      microbatch in 1F1B reverse-stream order (reference semantics:
+      fleet/meta_parallel/pipeline_parallel.py:565 1F1B,
+      passes/pipeline_scheduler_pass). Vs ``jax.grad`` of the GPipe scan
+      this avoids stashing the (M+S-1) tick inputs (garbage warmup ticks
+      included) and differentiating the per-tick inject/collect muxing.
+    - ``"gpipe"``: forward-only scan; backward is AD of the scan.
+
+    Note on schedule theory under SPMD: in one lockstep compiled program
+    every stage executes every tick, so a host-driven 1F1B's idle-slot
+    advantage does not map — phase-separated streaming (all fwd ticks,
+    then all bwd ticks) is tick-optimal, and interleaving fwd+bwd in one
+    tick would double per-tick work (both halves execute, one masked).
+    What 1F1B ordering buys in the compiled setting is the stash/memory
+    profile and a cheaper backward program, which is what this implements.
     """
     n_stages = mesh.shape[pp_axis]
 
@@ -61,10 +81,14 @@ def pipeline_blocks_fn(stage_fn: Callable, mesh: Mesh, n_microbatches: int,
         if local is None:
             in_specs = (jax.tree.map(lambda _: P(pp_axis), stacked_params),
                         P())
+            if schedule == "1f1b":
+                body = _make_1f1b_local(stage_fn, n_stages, M, pp_axis)
+            else:
+                body = functools.partial(_pipeline_local, stage_fn=stage_fn,
+                                         n_stages=n_stages, n_micro=M,
+                                         pp_axis=pp_axis)
             run = jax.shard_map(
-                functools.partial(_pipeline_local, stage_fn=stage_fn,
-                                  n_stages=n_stages, n_micro=M,
-                                  pp_axis=pp_axis),
+                body,
                 in_specs=in_specs,
                 # each stage returns its output buffer stacked on a leading
                 # pp dim; only the last stage's slice is the model output
@@ -111,3 +135,92 @@ def _pipeline_local(stage_params, xs, *, stage_fn, n_stages, n_micro,
     (_, outputs), _ = lax.scan(tick, (state, outputs), jnp.arange(total))
     # stacked over pp by out_specs; caller keeps the last stage's slice
     return outputs[None]
+
+
+def _make_1f1b_local(stage_fn, n_stages, n_micro, pp_axis):
+    """Per-pp-rank pipeline with a hand-written 1F1B backward.
+
+    Forward: stream microbatches (stage s runs microbatch j at tick
+    t = j + s), stashing each REAL stage input (M slots per rank).
+    Backward (custom_vjp): reverse-stream the output cotangent (stage s
+    runs microbatch j's backward at tick u = j + (S-1-s)), replaying the
+    stage from its stash and applying ``jax.vjp`` per tick; grads ride the
+    reverse ``ppermute`` ring. Invalid warmup/cooldown ticks are handled
+    by zeroing the incoming cotangent (VJPs are linear, so their param
+    grads vanish exactly).
+    """
+    M, S = n_micro, n_stages
+    T = M + S - 1
+
+    def _fwd_scan(stage_params, xs):
+        stage = lax.axis_index(pp_axis)
+        state = jnp.zeros(xs.shape[1:], xs.dtype)
+        # One extra garbage slot so invalid-tick writes are unconditional
+        # in-place dynamic-update-slices (a masked `where(valid, DUS, buf)`
+        # copies the whole buffer per tick).
+        pad = (M + 1,) + xs.shape[1:]
+        outputs = jnp.zeros(pad, xs.dtype)
+        stash = jnp.zeros(pad, xs.dtype)    # [M+1, mb, T, H] stage inputs
+
+        def tick(carry, t):
+            state, outputs, stash = carry
+            inject = xs[jnp.minimum(t, M - 1)]
+            x_in = jnp.where(stage == 0, inject, state)
+            j = t - stage
+            valid = jnp.logical_and(j >= 0, j < M)
+            slot = jnp.where(valid, jnp.clip(j, 0, M - 1), M)
+            stash = lax.dynamic_update_index_in_dim(stash, x_in, slot, 0)
+            y = stage_fn(stage_params, x_in)
+            nxt = lax.ppermute(y, pp_axis,
+                               [(i, i + 1) for i in range(S - 1)])
+            out_slot = t - (S - 1)
+            v_out = jnp.logical_and(stage == S - 1, out_slot >= 0)
+            w = jnp.where(v_out, jnp.maximum(out_slot, 0), M)
+            outputs = lax.dynamic_update_index_in_dim(outputs, y, w, 0)
+            return (nxt, outputs, stash), None
+
+        (_, outputs, stash), _ = lax.scan(
+            tick, (state, outputs, stash), jnp.arange(T))
+        return outputs[:M], stash
+
+    @jax.custom_vjp
+    def run(stage_params, xs):
+        outputs, _ = _fwd_scan(stage_params, xs)
+        return outputs[None]
+
+    def fwd(stage_params, xs):
+        outputs, stash = _fwd_scan(stage_params, xs)
+        return outputs[None], (stage_params, stash)
+
+    def bwd(res, g_out_stacked):
+        stage_params, stash = res
+        g_out = g_out_stacked[0]            # [M, mb, T, H] cotangent
+        stage = lax.axis_index(pp_axis)
+        g_state = jnp.zeros(stash.shape[1:], g_out.dtype)
+        g_params0 = jax.tree.map(jnp.zeros_like, stage_params)
+        g_xs0 = jnp.zeros(stash.shape, g_out.dtype)  # [M+1,...], pad slot
+
+        def tick(carry, u):
+            g_state, g_params, g_xs = carry
+            j = u - (S - 1 - stage)
+            valid = jnp.logical_and(j >= 0, j < M)
+            slot = jnp.clip(j, 0, M - 1)
+            g_in = jnp.where(stage == S - 1, g_out[slot], g_state)
+            g_in = jnp.where(valid, g_in, jnp.zeros_like(g_in))
+            x_in = stash[slot]
+            _, vjp_fn = jax.vjp(stage_fn, stage_params, x_in)
+            g_p_tick, g_x = vjp_fn(g_in)
+            g_params = jax.tree.map(jnp.add, g_params, g_p_tick)
+            coll = jnp.logical_and(stage == 0, valid)
+            w = jnp.where(coll, slot, M)
+            g_xs = lax.dynamic_update_index_in_dim(g_xs, g_x, w, 0)
+            g_prev = lax.ppermute(g_x, pp_axis,
+                                  [(i, i - 1) for i in range(1, S)])
+            return (g_prev, g_params, g_xs), None
+
+        (_, g_params, g_xs), _ = lax.scan(
+            tick, (g_state, g_params0, g_xs0), jnp.arange(T))
+        return g_params, g_xs[:M]
+
+    run.defvjp(fwd, bwd)
+    return run
