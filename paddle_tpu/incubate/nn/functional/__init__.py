@@ -260,6 +260,15 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
     return Tensor(q), Tensor(scale[0])
 
 
+def weight_dequantize(x, scale, algo="weight_only_int8", group_size=-1):
+    """reference: weight_dequantize op — inverse of weight_quantize."""
+    import jax.numpy as jnp
+
+    w = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    s = scale._data if isinstance(scale, Tensor) else jnp.asarray(scale)
+    return Tensor(w.astype(jnp.float32) * s)
+
+
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8", arch=None, group_size=-1):
     """reference: weight_only_linear — dequant-in-matmul."""
@@ -300,3 +309,17 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
                                                                keepdim=True)
         out = out + h * weight_e
     return out.reshape([B, T, H])
+
+
+from .fused_parity import *  # noqa: F401,F403,E402
+from . import fused_parity  # noqa: F401,E402
+from .fused_transformer import (  # noqa: F401,E402
+    fused_multi_transformer, block_multihead_attention, PagedKVCache,
+    paged_decode_attention)
+
+# fused_parity / fused_transformer parity exports
+__all__ += [
+    "weight_dequantize", "fused_multi_transformer",
+    "block_multihead_attention", "PagedKVCache", "paged_decode_attention",
+]
+__all__ += list(getattr(fused_parity, "__all__", []))

@@ -1,0 +1,224 @@
+"""Serving-grade fused transformer ops: fused_multi_transformer and
+block (paged) multi-head attention.
+
+Reference: paddle/phi/kernels/fusion/gpu/fused_multi_transformer_kernel.cu
+(whole decoder stack with KV cache, one kernel launch per layer) and
+block_multi_head_attention (paged KV cache with per-sequence block
+tables, the vLLM-style serving layout). TPU design: the cache is a
+pytree of dense pages [n_blocks, n_heads, block_size, head_dim]; block
+tables gather pages per sequence; prefill uses the Pallas flash kernel,
+decode uses a gathered-page attention that XLA fuses (and, for long
+contexts, the Pallas decode kernel in ops/pallas/decode_attention.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ....core.dispatch import op
+
+__all__ = ["fused_multi_transformer", "block_multihead_attention",
+           "PagedKVCache"]
+
+
+def _ln(x, g, b, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    y = (x32 - x32.mean(-1, keepdims=True)) * jax.lax.rsqrt(
+        x32.var(-1, keepdims=True) + eps)
+    if g is not None:
+        y = y * g
+    if b is not None:
+        y = y + b
+    return y.astype(x.dtype)
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            out_weights, out_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, cache_kvs=None,
+                            time_step: Optional[int] = None,
+                            num_heads: Optional[int] = None,
+                            pre_layer_norm: bool = True,
+                            epsilon: float = 1e-5, causal: bool = True):
+    """Run L pre-LN decoder layers in one call, updating KV caches.
+
+    ``cache_kvs``: list of [2, B, n_heads, max_seq, head_dim] per layer
+    (the reference's CacheKV layout). ``time_step`` is the decode
+    position; None means prefill (cache filled from 0). Returns
+    (out, new_cache_kvs).
+    """
+    L = len(qkv_weights)
+    B, S, H = x.shape
+    nh = num_heads or (cache_kvs[0].shape[2] if cache_kvs is not None else 8)
+    dh = H // nh
+    new_caches = []
+    for i in range(L):
+        h = _ln(x, ln_scales[i], ln_biases[i], epsilon) \
+            if pre_layer_norm else x
+        qkv = jnp.einsum("bsh,hk->bsk", h, qkv_weights[i])
+        if qkv_biases is not None and qkv_biases[i] is not None:
+            qkv = qkv + qkv_biases[i]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, nh, dh)
+        k = k.reshape(B, S, nh, dh)
+        v = v.reshape(B, S, nh, dh)
+        if cache_kvs is not None:
+            cache = cache_kvs[i]          # [2, B, nh, max_seq, dh]
+            pos = 0 if time_step is None else int(time_step)
+            kc = jax.lax.dynamic_update_slice(
+                cache[0], jnp.swapaxes(k, 1, 2).astype(cache.dtype),
+                (0, 0, pos, 0))
+            vc = jax.lax.dynamic_update_slice(
+                cache[1], jnp.swapaxes(v, 1, 2).astype(cache.dtype),
+                (0, 0, pos, 0))
+            new_caches.append(jnp.stack([kc, vc]))
+            kh, vh = kc.astype(x.dtype), vc.astype(x.dtype)
+            kv_len = pos + S
+        else:
+            kh = jnp.swapaxes(k, 1, 2)
+            vh = jnp.swapaxes(v, 1, 2)
+            kv_len = S
+        qh = jnp.swapaxes(q, 1, 2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                       preferred_element_type=jnp.float32) / math.sqrt(dh)
+        kpos = jnp.arange(kh.shape[2])
+        valid = kpos < kv_len                       # [K]
+        if causal and S > 1:
+            qpos = (0 if time_step is None else int(time_step)) + \
+                jnp.arange(S)
+            mask = valid[None, :] & (kpos[None, :] <= qpos[:, None])  # [S,K]
+            s = jnp.where(mask[None, None], s, -1e30)
+        else:
+            s = jnp.where(valid[None, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+        o = jnp.swapaxes(o, 1, 2).reshape(B, S, H)
+        o = jnp.einsum("bsh,hk->bsk", o, out_weights[i])
+        if out_biases is not None and out_biases[i] is not None:
+            o = o + out_biases[i]
+        x = x + o
+        h = _ln(x, ffn_ln_scales[i], ffn_ln_biases[i], epsilon) \
+            if pre_layer_norm else x
+        h = jnp.einsum("bsh,hf->bsf", h, ffn1_weights[i])
+        if ffn1_biases is not None and ffn1_biases[i] is not None:
+            h = h + ffn1_biases[i]
+        h = jax.nn.gelu(h, approximate=True)
+        h = jnp.einsum("bsf,fh->bsh", h, ffn2_weights[i])
+        if ffn2_biases is not None and ffn2_biases[i] is not None:
+            h = h + ffn2_biases[i]
+        x = x + h
+    return x, (new_caches if cache_kvs is not None else None)
+
+
+class PagedKVCache:
+    """vLLM-style paged KV cache (reference block_multi_head_attention's
+    cache layout): pages of ``block_size`` tokens allocated on demand,
+    per-sequence block tables mapping logical blocks -> physical pages.
+
+    Layout: k_pages/v_pages [n_pages, n_heads, block_size, head_dim];
+    block_table [B, max_blocks]; seq_lens [B].
+    """
+
+    def __init__(self, n_pages: int, n_heads: int, block_size: int,
+                 head_dim: int, batch: int, max_seq: int,
+                 dtype=jnp.bfloat16):
+        self.block_size = block_size
+        self.max_blocks = (max_seq + block_size - 1) // block_size
+        self.k_pages = jnp.zeros((n_pages, n_heads, block_size, head_dim),
+                                 dtype)
+        self.v_pages = jnp.zeros_like(self.k_pages)
+        # static round-robin allocation: sequence b owns pages
+        # [b*max_blocks, (b+1)*max_blocks) — the allocator policy is
+        # host-side; any table works for the kernels
+        assert n_pages >= batch * self.max_blocks, "cache too small"
+        self.block_table = (jnp.arange(batch)[:, None] * self.max_blocks
+                            + jnp.arange(self.max_blocks)[None, :])
+        self.seq_lens = jnp.zeros((batch,), jnp.int32)
+
+    def write_prefill(self, k, v):
+        """k/v [B, S, nh, dh] for the prompt; fills pages from 0."""
+        B, S, nh, dh = k.shape
+        bs = self.block_size
+        pad = (-S) % bs
+        kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        nblk = kp.shape[1] // bs
+        # [B, nblk, bs, nh, dh] -> [B*nblk, nh, bs, dh]
+        kb = jnp.swapaxes(kp.reshape(B, nblk, bs, nh, dh), 2, 3) \
+            .reshape(B * nblk, nh, bs, dh)
+        vb = jnp.swapaxes(vp.reshape(B, nblk, bs, nh, dh), 2, 3) \
+            .reshape(B * nblk, nh, bs, dh)
+        pages = self.block_table[:, :nblk].reshape(-1)
+        self.k_pages = self.k_pages.at[pages].set(kb.astype(
+            self.k_pages.dtype))
+        self.v_pages = self.v_pages.at[pages].set(vb.astype(
+            self.v_pages.dtype))
+        self.seq_lens = jnp.full_like(self.seq_lens, S)
+
+    def write_decode(self, k, v):
+        """k/v [B, 1, nh, dh] for one decode step at seq_lens."""
+        B = k.shape[0]
+        blk = self.seq_lens // self.block_size
+        off = self.seq_lens % self.block_size
+        pages = jax.vmap(lambda t, b: t[b])(self.block_table, blk)
+        kt = jnp.swapaxes(k, 1, 2)  # [B, nh, 1, dh]
+        vt = jnp.swapaxes(v, 1, 2)
+        self.k_pages = self.k_pages.at[pages, :, off].set(
+            kt[:, :, 0].astype(self.k_pages.dtype))
+        self.v_pages = self.v_pages.at[pages, :, off].set(
+            vt[:, :, 0].astype(self.v_pages.dtype))
+        self.seq_lens = self.seq_lens + 1
+
+
+def block_multihead_attention(qkv, cache: PagedKVCache,
+                              seq_lens_encoder=None, seq_lens_decoder=None,
+                              max_seq_len: Optional[int] = None,
+                              num_heads: Optional[int] = None,
+                              head_dim: Optional[int] = None):
+    """Paged attention (reference block_multi_head_attention): prefill
+    writes whole pages and runs flash; decode writes one slot and
+    attends over the gathered pages. ``qkv`` [B, S, 3, nh, dh]."""
+    B, S = qkv.shape[0], qkv.shape[1]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if S > 1:  # prefill
+        cache.write_prefill(k, v)
+        from ....ops.pallas.flash_attention import (flash_attention_raw,
+                                                    supported)
+
+        if supported(q.shape, q.dtype):
+            return flash_attention_raw(q, k, v, causal=True)
+        from ....ops.pallas.flash_attention import _sdpa_fallback
+
+        return _sdpa_fallback(q, k, v, True, 1.0 / math.sqrt(q.shape[-1]))
+    # decode
+    cache.write_decode(k, v)
+    return paged_decode_attention(q, cache.k_pages, cache.v_pages,
+                                  cache.block_table, cache.seq_lens)
+
+
+def paged_decode_attention(q, k_pages, v_pages, block_table, seq_lens):
+    """Single-token decode against the paged cache. q [B, 1, nh, dh];
+    gathers each sequence's pages and computes masked attention — XLA
+    fuses the gather+dot chain; see ops/pallas/decode_attention.py for
+    the kernelized long-context path."""
+    B = q.shape[0]
+    nh, bs, dh = k_pages.shape[1:]
+    max_blocks = block_table.shape[1]
+
+    kg = k_pages[block_table]            # [B, max_blocks, nh, bs, dh]
+    vg = v_pages[block_table]
+    kg = jnp.swapaxes(kg, 1, 2).reshape(B, nh, max_blocks * bs, dh)
+    vg = jnp.swapaxes(vg, 1, 2).reshape(B, nh, max_blocks * bs, dh)
+    qh = jnp.swapaxes(q, 1, 2)           # [B, nh, 1, dh]
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(kg.dtype), kg,
+                   preferred_element_type=jnp.float32) / math.sqrt(dh)
+    pos = jnp.arange(max_blocks * bs)
+    mask = pos[None, :] < seq_lens[:, None]      # [B, K]
+    s = jnp.where(mask[:, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(vg.dtype)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vg)
+    return jnp.swapaxes(o, 1, 2).astype(q.dtype)  # [B, 1, nh, dh]

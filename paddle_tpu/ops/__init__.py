@@ -11,11 +11,12 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
-from . import creation, extra, extra2, linalg, manipulation, math
+from . import creation, extra, extra2, linalg, manipulation, math, parity
 
 from .creation import *  # noqa: F401,F403
 from .extra import *  # noqa: F401,F403
 from .extra2 import *  # noqa: F401,F403
+from .parity import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403

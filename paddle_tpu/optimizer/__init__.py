@@ -18,4 +18,7 @@ from .optimizers import (  # noqa: F401
     LBFGS,
     Momentum,
     RMSProp,
+    Ftrl,
+    DecayedAdagrad,
+    DpSGD,
 )

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 
 from .optimizer import Optimizer
 
-__all__ = ["SGD", "Momentum", "Adagrad", "Adam", "AdamW", "Adamax",
+__all__ = ["SGD", "Momentum", "Adagrad", "Adam", "AdamW", "Adamax", "Ftrl", "DecayedAdagrad", "DpSGD",
            "AdaDelta", "Adadelta", "RMSProp", "Lamb", "LBFGS",
            "Rprop", "ASGD", "NAdam", "RAdam"]
 
@@ -535,3 +535,88 @@ class RAdam(Optimizer):
         adam_step = ctx["rect"] * m_hat / (v_hat + self._epsilon)
         step = jnp.where(ctx["rho_t"] > 5.0, adam_step, m_hat)
         return (p - (lr * step).astype(p.dtype)), {"m": m, "v": v}
+
+
+class Ftrl(Optimizer):
+    """FTRL-proximal (reference ftrl op, phi/kernels/ftrl_kernel):
+    z/n accumulator pair with L1/L2 shrinkage."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, False)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _init_slot(self, p):
+        # NB: two distinct buffers — the step donates slot state, and a
+        # shared array would be donated twice (backend InvalidArgument).
+        return {"squared": jnp.zeros_like(_f32(p._data)),
+                "linear": jnp.zeros_like(_f32(p._data))}
+
+    def _update(self, g, p, state, lr, ctx):
+        g = _f32(g) + ctx["wd"] * _f32(p)
+        n, z = state["squared"], state["linear"]
+        n_new = n + jnp.square(g)
+        sigma = (n_new ** -self._lr_power - n ** -self._lr_power) / lr
+        z_new = z + g - sigma * _f32(p)
+        quad = n_new ** -self._lr_power / lr + 2 * self._l2
+        pruned = jnp.abs(z_new) > self._l1
+        p_new = jnp.where(pruned,
+                          (jnp.sign(z_new) * self._l1 - z_new) / quad, 0.0)
+        return p_new.astype(p.dtype), {"squared": n_new, "linear": z_new}
+
+
+class DecayedAdagrad(Optimizer):
+    """decayed_adagrad op: Adagrad with accumulator decay."""
+
+    def __init__(self, learning_rate=0.001, decay=0.95, epsilon=1e-6,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, False)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _init_slot(self, p):
+        return {"moment": jnp.zeros_like(_f32(p._data))}
+
+    def _update(self, g, p, state, lr, ctx):
+        g = _f32(g) + ctx["wd"] * _f32(p)
+        m = self._decay * state["moment"] + (1 - self._decay) * jnp.square(g)
+        step = g / (jnp.sqrt(m) + self._epsilon)
+        return (p - (lr * step).astype(p.dtype)), {"moment": m}
+
+
+class DpSGD(Optimizer):
+    """dpsgd op: per-update clipped + noised SGD (differential privacy;
+    phi/kernels/dpsgd_kernel)."""
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, False)
+        self._clip, self._batch, self._sigma = clip, batch_size, sigma
+        from ..core.random import next_key
+
+        # base key drawn eagerly; per-step keys fold in the step counter
+        # inside the (once-traced) jitted update so noise is fresh every
+        # step (next_key() inside _update would be baked in at trace time)
+        self._base_key = next_key()
+
+    def _init_slot(self, p):
+        return {"t": jnp.zeros((), jnp.int32)}
+
+    def _update(self, g, p, state, lr, ctx):
+        import jax as _jax
+
+        g = _f32(g) + ctx["wd"] * _f32(p)
+        norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        scale = jnp.minimum(1.0, self._clip / jnp.maximum(norm, 1e-12))
+        key = _jax.random.fold_in(self._base_key, state["t"])
+        # reference dpsgd_kernel adds ONE gaussian scalar with stddev
+        # sigma, scaled by 1/batch_size, shared across elements
+        noise = _jax.random.normal(key, ()) * self._sigma / self._batch
+        step = g * scale + noise
+        return ((p - (lr * step).astype(p.dtype)),
+                {"t": state["t"] + 1})

@@ -1,0 +1,189 @@
+"""Numerics for the op-coverage parity tranche (ops/parity.py,
+incubate fused_parity/fused_transformer)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.ops.parity as P
+import paddle_tpu.incubate.nn.functional as IF
+
+
+def _np(x):
+    return np.asarray(getattr(x, "_data", x))
+
+
+@pytest.mark.smoke
+def test_fake_quantize_roundtrip():
+    x = jnp.asarray(np.random.RandomState(0).randn(16, 8).astype(np.float32))
+    q, scale = P.fake_quantize_abs_max(x)
+    deq = _np(q) * _np(scale) / 127.0
+    assert np.abs(deq - np.asarray(x)).max() <= float(scale) / 127.0 + 1e-6
+    qd, s2 = P.fake_quantize_dequantize_abs_max(x)
+    assert np.abs(_np(qd) - np.asarray(x)).max() <= float(s2) / 127.0 + 1e-6
+
+
+def test_fake_quant_dequant_ste_gradient():
+    # straight-through: grad of sum(quant_dequant(x)) == ones
+    def f(x):
+        y, _ = P.fake_quantize_dequantize_abs_max.__wrapped__(x)
+        return y.sum()
+
+    g = jax.grad(f)(jnp.ones((4, 4)) * 0.3)
+    np.testing.assert_allclose(np.asarray(g), np.ones((4, 4)), rtol=1e-6)
+
+
+@pytest.mark.smoke
+def test_edit_distance():
+    h = jnp.asarray([1, 2, 3, 4])
+    r = jnp.asarray([1, 3, 3, 5, 6])
+    d = P.edit_distance(h, r, normalized=False)
+    assert float(_np(d)) == 3.0  # sub(2->3 is free? no: 2!=3) classic check
+
+
+def test_edit_distance_vs_reference_dp():
+    rng = np.random.RandomState(1)
+    for _ in range(3):
+        a = rng.randint(0, 5, size=rng.randint(2, 8))
+        b = rng.randint(0, 5, size=rng.randint(2, 8))
+        # python reference DP
+        m, n = len(a), len(b)
+        dp = np.zeros((m + 1, n + 1))
+        dp[:, 0] = np.arange(m + 1)
+        dp[0, :] = np.arange(n + 1)
+        for i in range(1, m + 1):
+            for j in range(1, n + 1):
+                dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                               dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+        got = float(_np(P.edit_distance(jnp.asarray(a), jnp.asarray(b),
+                                        normalized=False)))
+        assert got == dp[m, n], (a, b, got, dp[m, n])
+
+
+def test_bipartite_match_greedy():
+    dist = jnp.asarray([[0.9, 0.1], [0.2, 0.8]])
+    idx, d = P.bipartite_match(dist)
+    np.testing.assert_array_equal(_np(idx), [0, 1])
+    np.testing.assert_allclose(_np(d), [0.9, 0.8], rtol=1e-6)
+
+
+def test_moe_aux_ops():
+    ids = jnp.asarray([0, 2, 1, 2, 2, 0])
+    cnt = P.number_count(ids, 3)
+    np.testing.assert_array_equal(_np(cnt), [2, 1, 3])
+    pruned = P.prune_gate_by_capacity(ids, jnp.asarray([1, 1, 2]), 3)
+    # expert0 keeps first token only, expert2 keeps first two
+    np.testing.assert_array_equal(_np(pruned), [0, 2, 1, 2, -1, -1])
+
+
+def test_kl_div_matches_formula():
+    x = jax.nn.log_softmax(jnp.asarray(np.random.RandomState(0)
+                                       .randn(4, 5).astype(np.float32)))
+    t = jax.nn.softmax(jnp.asarray(np.random.RandomState(1)
+                                   .randn(4, 5).astype(np.float32)))
+    got = float(_np(P.kl_div(x, t, reduction="sum")))
+    want = float((np.asarray(t) * (np.log(np.asarray(t))
+                                   - np.asarray(x))).sum())
+    assert abs(got - want) < 1e-4
+
+
+def test_crf_decoding_viterbi():
+    T, N = 4, 3
+    rng = np.random.RandomState(0)
+    emission = jnp.asarray(rng.randn(T, N).astype(np.float32))
+    trans = jnp.asarray(rng.randn(N + 2, N).astype(np.float32))
+    path = _np(P.crf_decoding(emission, trans))
+    # brute force
+    import itertools
+
+    best, best_s = None, -1e30
+    e, tr = np.asarray(emission), np.asarray(trans)
+    for cand in itertools.product(range(N), repeat=T):
+        s = tr[0, cand[0]] + e[0, cand[0]] + tr[1, cand[-1]]
+        for i in range(1, T):
+            s += tr[2 + cand[i - 1], cand[i]] + e[i, cand[i]]
+        if s > best_s:
+            best, best_s = cand, s
+    np.testing.assert_array_equal(path, best)
+
+
+@pytest.mark.smoke
+def test_skip_layernorm_and_fc():
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 8)
+                    .astype(np.float32))
+    y = jnp.asarray(np.random.RandomState(1).randn(2, 3, 8)
+                    .astype(np.float32))
+    out = _np(IF.skip_layernorm(x, y))
+    h = np.asarray(x) + np.asarray(y)
+    mu = h.mean(-1, keepdims=True)
+    sd = np.sqrt(h.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out, (h - mu) / sd, rtol=1e-4, atol=1e-5)
+
+    w = jnp.asarray(np.random.RandomState(2).randn(8, 4).astype(np.float32))
+    got = _np(IF.fc(x, w, activation_type="relu"))
+    want = np.maximum(np.asarray(x) @ np.asarray(w), 0)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-5)
+
+
+def test_fused_multi_transformer_prefill_decode_consistency():
+    """Prefill S tokens at once == prefill S-1 then decode 1."""
+    rng = np.random.RandomState(0)
+    B, S, H, nh, L = 2, 6, 16, 4, 2
+    mk = lambda *sh: jnp.asarray(rng.randn(*sh).astype(np.float32) * 0.1)
+    weights = dict(
+        ln_scales=[jnp.ones(H)] * L, ln_biases=[jnp.zeros(H)] * L,
+        qkv_weights=[mk(H, 3 * H) for _ in range(L)],
+        qkv_biases=[jnp.zeros(3 * H)] * L,
+        out_weights=[mk(H, H) for _ in range(L)],
+        out_biases=[jnp.zeros(H)] * L,
+        ffn_ln_scales=[jnp.ones(H)] * L, ffn_ln_biases=[jnp.zeros(H)] * L,
+        ffn1_weights=[mk(H, 2 * H) for _ in range(L)],
+        ffn1_biases=[jnp.zeros(2 * H)] * L,
+        ffn2_weights=[mk(2 * H, H) for _ in range(L)],
+        ffn2_biases=[jnp.zeros(H)] * L,
+    )
+    x = mk(B, S, H)
+    caches = [jnp.zeros((2, B, nh, S + 4, H // nh)) for _ in range(L)]
+    full, _ = IF.fused_multi_transformer(x, cache_kvs=caches, num_heads=nh,
+                                         **weights)
+    pre, c1 = IF.fused_multi_transformer(x[:, :S - 1], cache_kvs=caches,
+                                         num_heads=nh, **weights)
+    last, _ = IF.fused_multi_transformer(x[:, S - 1:], cache_kvs=c1,
+                                         time_step=S - 1, num_heads=nh,
+                                         **weights)
+    np.testing.assert_allclose(_np(full)[:, -1], _np(last)[:, 0],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_paged_attention_matches_dense():
+    rng = np.random.RandomState(0)
+    B, nh, dh, bs = 2, 4, 8, 4
+    S = 10  # prompt
+    from paddle_tpu.incubate.nn.functional import PagedKVCache, \
+        paged_decode_attention
+
+    cache = PagedKVCache(n_pages=B * 8, n_heads=nh, block_size=bs,
+                         head_dim=dh, batch=B, max_seq=32,
+                         dtype=jnp.float32)
+    k = jnp.asarray(rng.randn(B, S, nh, dh).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, S, nh, dh).astype(np.float32))
+    cache.write_prefill(k, v)
+    q1 = jnp.asarray(rng.randn(B, 1, nh, dh).astype(np.float32))
+    k1 = jnp.asarray(rng.randn(B, 1, nh, dh).astype(np.float32))
+    v1 = jnp.asarray(rng.randn(B, 1, nh, dh).astype(np.float32))
+    cache.write_decode(k1, v1)
+    out = paged_decode_attention(q1, cache.k_pages, cache.v_pages,
+                                 cache.block_table, cache.seq_lens)
+    # dense reference over the full (S+1)-token history
+    kk = np.concatenate([np.asarray(k), np.asarray(k1)], axis=1)
+    vv = np.concatenate([np.asarray(v), np.asarray(v1)], axis=1)
+    qh = np.swapaxes(np.asarray(q1), 1, 2)
+    kh = np.swapaxes(kk, 1, 2)
+    vh = np.swapaxes(vv, 1, 2)
+    s = np.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(dh)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    want = np.swapaxes(np.einsum("bhqk,bhkd->bhqd", p, vh), 1, 2)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=2e-4, atol=2e-4)
