@@ -1,0 +1,60 @@
+"""Worker for test_launch_multiproc: data-parallel GPT-tiny training.
+
+Launched as N processes by paddle_tpu.distributed.launch; each process
+owns ONE virtual CPU device, jax.distributed glues them into a global
+2-device "dp" mesh (the reference analog: one trainer process per
+device, NCCL data parallel — test/legacy_test/test_dist_base.py).
+Prints `FINAL_LOSS <value>` which the test compares against a serial
+run of the same global batch.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.distributed.launch import init_from_env
+
+assert init_from_env(), "launcher env not detected"
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.process_mesh import build_mesh
+from paddle_tpu.models.gpt import GPTConfig
+from paddle_tpu.parallel import make_sharded_train_step
+
+rank = jax.process_index()
+nproc = jax.process_count()
+assert len(jax.devices()) == nproc, jax.devices()
+
+cfg = GPTConfig(vocab_size=128, hidden=64, n_layers=2, n_heads=2, seq_len=16,
+                dtype=jnp.float32, use_flash=False, remat=False)
+mesh = build_mesh((nproc, 1, 1), ("dp", "pp", "mp"))
+step, params, opt_state = make_sharded_train_step(cfg, mesh, lr=1e-2,
+                                                  n_microbatches=1,
+                                                  zero1=False)
+
+GLOBAL_BATCH = 8
+rng = np.random.RandomState(0)  # same seed everywhere: global batch
+toks = rng.randint(0, cfg.vocab_size, size=(GLOBAL_BATCH, cfg.seq_len))
+labs = rng.randint(0, cfg.vocab_size, size=(GLOBAL_BATCH, cfg.seq_len))
+
+shard = GLOBAL_BATCH // nproc
+sl = slice(rank * shard, (rank + 1) * shard)
+sharding = NamedSharding(mesh, P("dp"))
+toks_g = jax.make_array_from_process_local_data(sharding, toks[sl])
+labs_g = jax.make_array_from_process_local_data(sharding, labs[sl])
+
+for i in range(5):
+    loss, params, opt_state = step(params, opt_state, toks_g, labs_g)
+print(f"FINAL_LOSS {float(loss):.8f}", flush=True)
