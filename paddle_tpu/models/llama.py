@@ -7,7 +7,8 @@ multihead attention for decode, fused_rope / fused_rms_norm, and weight-only
 quant gemm. TPU translation: prefill and decode are two jitted programs over
 a stacked-layer param pytree; decode attends against a static-shape KV cache
 updated with ``lax.dynamic_update_slice`` (the masked-MHA kernel becomes a
-batched dot against the cache, fused by XLA); rope/rmsnorm/swiglu fuse into
+Pallas decode kernel over the kv-head-major cache, with an
+XLA masked-dot fallback for unsupported shapes); rope/rmsnorm/swiglu fuse into
 the surrounding matmuls. Weight-only int8 keeps weights quantized in HBM
 and dequantizes in-register at each matmul (halves the HBM traffic that
 bounds decode).
@@ -242,9 +243,9 @@ def llama_loss(params, tokens, labels, cfg: LlamaConfig):
 # ---------------------------------------------------------------------------
 
 def _decode_block(bp, x, cache_k, cache_v, pos, cfg: LlamaConfig, cos, sin):
-    """One decode step for one block: x [B, 1, H]; cache [B, S, nKV, dH].
-    The reference's masked_multihead_attention kernel: q·cache dot with a
-    position mask, fused by XLA."""
+    """One decode step for one block: x [B, 1, H]; cache [B, nKV, S, dH]
+    (kv-head-major so the Pallas decode kernel reads it with no per-step
+    transpose). The reference's masked_multihead_attention kernel."""
     B = x.shape[0]
     nH, nKV, dH = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     h = rms_norm(x, bp["attn_norm"], cfg.rms_eps)
@@ -253,19 +254,32 @@ def _decode_block(bp, x, cache_k, cache_v, pos, cfg: LlamaConfig, cos, sin):
     v = _mm(h, bp["wv"], cfg).reshape(B, 1, nKV, dH)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    cache_k = lax.dynamic_update_slice(cache_k, k.astype(cache_k.dtype),
-                                       (0, pos, 0, 0))
-    cache_v = lax.dynamic_update_slice(cache_v, v.astype(cache_v.dtype),
-                                       (0, pos, 0, 0))
-    S = cache_k.shape[1]
-    kf = _repeat_kv(cache_k, nH // nKV)     # [B, S, nH, dH]
-    vf = _repeat_kv(cache_v, nH // nKV)
-    logits = jnp.einsum("bqhd,bshd->bhqs", q, kf.astype(q.dtype),
-                        preferred_element_type=jnp.float32) / math.sqrt(dH)
-    mask = (jnp.arange(S) <= pos)[None, None, None, :]
-    logits = jnp.where(mask, logits, -1e30)
-    p = jax.nn.softmax(logits, -1).astype(q.dtype)
-    o = jnp.einsum("bhqs,bshd->bqhd", p, vf.astype(q.dtype))
+    cache_k = lax.dynamic_update_slice(
+        cache_k, jnp.swapaxes(k, 1, 2).astype(cache_k.dtype),
+        (0, 0, pos, 0))
+    cache_v = lax.dynamic_update_slice(
+        cache_v, jnp.swapaxes(v, 1, 2).astype(cache_v.dtype),
+        (0, 0, pos, 0))
+    S = cache_k.shape[2]
+    from ..ops.pallas.decode_attention import (decode_attention,
+                                               decode_attention_supported)
+
+    if decode_attention_supported(cache_k.shape, dH):
+        # Pallas serving kernel: no GQA repeat materialization, k-loop
+        # bounded by pos (ops/pallas/decode_attention.py)
+        o = decode_attention(q[:, 0], cache_k, cache_v, pos,
+                             1.0 / math.sqrt(dH))[:, None]
+    else:
+        G = nH // nKV
+        kf = jnp.repeat(cache_k, G, axis=1)     # [B, nH, S, dH]
+        vf = jnp.repeat(cache_v, G, axis=1)
+        logits = jnp.einsum("bqhd,bhsd->bhqs", q, kf.astype(q.dtype),
+                            preferred_element_type=jnp.float32) \
+            / math.sqrt(dH)
+        mask = (jnp.arange(S) <= pos)[None, None, None, :]
+        logits = jnp.where(mask, logits, -1e30)
+        p = jax.nn.softmax(logits, -1).astype(q.dtype)
+        o = jnp.einsum("bhqs,bhsd->bqhd", p, vf.astype(q.dtype))
     x = x + _mm(o.reshape(B, 1, nH * dH), bp["wo"], cfg)
     h = rms_norm(x, bp["ffn_norm"], cfg.rms_eps)
     x = x + _mm(jax.nn.silu(_mm(h, bp["w_gate"], cfg).astype(jnp.float32)
@@ -302,9 +316,11 @@ class LlamaForCausalLM:
                                  static_argnames=("n", "greedy"))
 
     def _empty_cache(self, B):
+        # kv-head-major [L, B, nKV, S, dH]: the decode kernel's native
+        # layout (see _decode_block)
         L, S = self.cfg.n_layers, self.max_seq
         nKV, dH = self.cfg.n_kv_heads, self.cfg.head_dim
-        z = jnp.zeros((L, B, S, nKV, dH), self.cfg.dtype)
+        z = jnp.zeros((L, B, nKV, S, dH), self.cfg.dtype)
         return {"k": z, "v": z}
 
     def _prefill_impl(self, params, tokens, cache):
@@ -320,8 +336,10 @@ class LlamaForCausalLM:
             x = carry
             bp, ck, cv = inp
             x, k, v = block_apply(bp, x, cfg, cos, sin, return_kv=True)
-            ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
-            cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
+            ck = lax.dynamic_update_slice(
+                ck, jnp.swapaxes(k, 1, 2).astype(ck.dtype), (0, 0, 0, 0))
+            cv = lax.dynamic_update_slice(
+                cv, jnp.swapaxes(v, 1, 2).astype(cv.dtype), (0, 0, 0, 0))
             return x, (ck, cv)
 
         x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"],

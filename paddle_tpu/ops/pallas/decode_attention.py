@@ -1,0 +1,109 @@
+"""Pallas TPU decode-attention kernel (paged/serving path).
+
+TPU replacement for the reference's masked_multihead_attention /
+block_multi_head_attention decode kernels
+(phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu,
+fused_multi_transformer_kernel.cu decode branch): single-token query
+against a KV cache. Two wins over the XLA expression path:
+
+- **No GQA inflation**: the q heads sharing one kv head are processed
+  together ([G, d] q tile against that kv head's [S, d] cache), so the
+  repeated-KV tensor ([B, S, nH, d], 4-8x the cache size for
+  LLaMA-2/3 GQA) never exists.
+- **Length-bounded reads**: the k loop runs to ceil((pos+1)/block), not
+  max_seq — decode cost tracks the actual context length (the kernel
+  gets `pos` as a prefetched scalar so the loop bound is dynamic).
+
+Cache layout matches models/llama.py: k/v [B, n_kv, S, d] per layer
+(kv-head-major; the engine stores it natively in this layout).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .flash_attention import _interpret_mode
+
+BLOCK_S = 512
+
+
+def decode_attention_supported(cache_shape, head_dim: int) -> bool:
+    _, _, S, d = cache_shape           # [B, nKV, S, d]
+    if d not in (64, 128, 256):
+        return False
+    # the kernel slices fixed BLOCK_S-wide k/v windows: S must be one
+    # block (any 128-multiple) or a whole number of blocks — otherwise
+    # dynamic-slice clamping would silently misalign the position mask
+    return (S % 128 == 0) if S <= BLOCK_S else (S % BLOCK_S == 0)
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_s,
+                   seq_len, sm_scale):
+    import jax.experimental.pallas as pl
+
+    pos = pos_ref[0]
+    q = q_ref[...]                       # [G, d] — this kv-head's q group
+    G, d = q.shape
+
+    m_i = jnp.full((G,), -1e30, jnp.float32)
+    l_i = jnp.zeros((G,), jnp.float32)
+    acc = jnp.zeros((G, d), jnp.float32)
+
+    num_blocks = jax.lax.div(pos + block_s, block_s)  # ceil((pos+1)/bs)
+
+    def body(sb, carry):
+        m_i, l_i, acc = carry
+        k = k_ref[pl.dslice(sb * block_s, block_s), :]      # [bs, d]
+        v = v_ref[pl.dslice(sb * block_s, block_s), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        offs = sb * block_s + jax.lax.iota(jnp.int32, block_s)
+        s = jnp.where((offs <= pos)[None, :], s, -1e30)
+        m_new = jnp.maximum(m_i, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_i - m_new)
+        l_new = alpha * l_i + jnp.sum(p, axis=1)
+        acc_new = acc * alpha[:, None] + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m_i, l_i, acc = jax.lax.fori_loop(0, num_blocks, body, (m_i, l_i, acc))
+    o_ref[...] = (acc / jnp.maximum(l_i, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale",))
+def decode_attention(q, cache_k, cache_v, pos, sm_scale: float):
+    """q [B, nH, d] (one token); cache_k/v [B, nKV, S, d] (kv-head-major,
+    the engine's native layout — no per-step transpose); pos scalar int32
+    (last valid cache index). Returns o [B, nH, d]."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, nKV, S, d = cache_k.shape
+    nH = q.shape[1]
+    G = nH // nKV
+    qg = q.reshape(B, nKV, G, d)
+    kt, vt = cache_k, cache_v
+    block_s = min(BLOCK_S, S)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, nKV),
+        in_specs=[
+            pl.BlockSpec((None, None, G, d), lambda ib, ih, *_: (ib, ih, 0, 0)),
+            pl.BlockSpec((None, None, S, d), lambda ib, ih, *_: (ib, ih, 0, 0)),
+            pl.BlockSpec((None, None, S, d), lambda ib, ih, *_: (ib, ih, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, G, d),
+                               lambda ib, ih, *_: (ib, ih, 0, 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, block_s=block_s, seq_len=S,
+                          sm_scale=sm_scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nKV, G, d), q.dtype),
+        interpret=_interpret_mode(),
+    )(jnp.asarray(pos, jnp.int32).reshape(1), qg, kt, vt)
+    return out.reshape(B, nH, d)
