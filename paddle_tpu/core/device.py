@@ -20,6 +20,8 @@ __all__ = [
     "get_all_devices",
     "max_memory_allocated",
     "memory_allocated",
+    "memory_reserved",
+    "reset_max_memory_allocated",
     "synchronize",
 ]
 
@@ -85,13 +87,67 @@ def _mem_stats(device=None):
         return {}
 
 
+_peak_live_bytes: dict = {}       # per-device high-water mark (fallback)
+_peak_reserved: dict = {}
+
+
+def _resolve_device(device=None):
+    """Accept a jax Device, an int index, or a 'kind:N' string."""
+    if device is None:
+        return _current_device or jax.devices()[0]
+    if isinstance(device, int):
+        return jax.devices()[device]
+    if isinstance(device, str):
+        idx = int(device.rsplit(":", 1)[1]) if ":" in device else 0
+        return jax.devices()[idx]
+    return device
+
+
+def _live_bytes(device=None) -> int:
+    """Bytes of live jax Arrays on the device — the fallback accounting
+    when the PJRT client exposes no memory_stats (e.g. remote-tunneled
+    devices). Counts framework-visible buffers, not XLA temporaries."""
+    d = _resolve_device(device)
+    total = 0
+    for a in jax.live_arrays():
+        try:
+            if d in a.devices():
+                total += a.nbytes
+        except Exception:
+            continue
+    _peak_live_bytes[d] = max(_peak_live_bytes.get(d, 0), total)
+    return total
+
+
 def memory_allocated(device=None) -> int:
-    return int(_mem_stats(device).get("bytes_in_use", 0))
+    stats = _mem_stats(_resolve_device(device))
+    if "bytes_in_use" in stats:
+        return int(stats["bytes_in_use"])
+    return _live_bytes(device)
 
 
 def max_memory_allocated(device=None) -> int:
-    return int(_mem_stats(device).get("peak_bytes_in_use", 0))
+    d = _resolve_device(device)
+    stats = _mem_stats(d)
+    if "peak_bytes_in_use" in stats:
+        return int(stats["peak_bytes_in_use"])
+    _live_bytes(d)
+    return _peak_live_bytes.get(d, 0)
+
+
+def reset_max_memory_allocated(device=None) -> None:
+    d = _resolve_device(device)
+    _peak_live_bytes[d] = 0
+    _peak_reserved[d] = 0
+
+
+def memory_reserved(device=None) -> int:
+    d = _resolve_device(device)
+    return int(_mem_stats(d).get("bytes_reserved", memory_allocated(d)))
 
 
 def max_memory_reserved(device=None) -> int:
-    return int(_mem_stats(device).get("bytes_reserved", memory_allocated(device)))
+    d = _resolve_device(device)
+    cur = memory_reserved(d)
+    _peak_reserved[d] = max(_peak_reserved.get(d, 0), cur)
+    return _peak_reserved[d]

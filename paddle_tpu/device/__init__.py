@@ -103,13 +103,44 @@ class cuda:
     def empty_cache():
         pass
 
+    # Live accelerator memory accounting (reference memory/stats.h —
+    # the parity surface keeps the cuda.* names but reads the local
+    # PJRT device's stats, i.e. HBM on TPU).
     @staticmethod
     def max_memory_allocated(device=None):
-        return 0
+        from ..core.device import max_memory_allocated as _f
+
+        return _f(device)
 
     @staticmethod
     def memory_allocated(device=None):
-        return 0
+        from ..core.device import memory_allocated as _f
+
+        return _f(device)
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        from ..core.device import max_memory_reserved as _f
+
+        return _f(device)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        from ..core.device import memory_reserved as _f
+
+        return _f(device)
+
+    @staticmethod
+    def reset_max_memory_allocated(device=None):
+        from ..core.device import reset_max_memory_allocated as _f
+
+        return _f(device)
+
+    @staticmethod
+    def memory_stats(device=None):
+        from ..core.device import _mem_stats, _resolve_device
+
+        return dict(_mem_stats(_resolve_device(device)))
 
     Stream = Stream
     Event = Event

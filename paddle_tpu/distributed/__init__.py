@@ -83,7 +83,9 @@ from .compat import (
     wait,
 )
 from .fleet import ParallelMode
-from . import collective, fleet, io, topology
+from . import collective, comm_watchdog, fleet, io, topology
+from .comm_watchdog import (CommTaskManager, comm_task_manager,
+                            start_comm_watchdog, stop_comm_watchdog)
 
 __all__ = [
     # collectives
@@ -92,6 +94,7 @@ __all__ = [
     "alltoall", "alltoall_single", "send", "recv", "isend", "irecv", "barrier",
     # env
     "init_parallel_env", "get_rank", "get_world_size", "is_initialized",
+    "CommTaskManager", "start_comm_watchdog", "stop_comm_watchdog",
     "ParallelEnv", "DataParallel", "spawn", "launch",
     # auto parallel
     "ProcessMesh", "get_mesh", "set_mesh", "Shard", "Replicate", "Partial",

@@ -72,15 +72,27 @@ class ReduceOp:
 
 class Task:
     """Async collective handle (reference: ProcessGroup::Task,
-    process_group.h:50). XLA launches are already async; wait = block."""
+    process_group.h:50). XLA launches are already async; wait = block.
+    When the comm watchdog (comm_watchdog.py, CommTaskManager parity) is
+    enabled, wait() registers for its blocking duration — a hang inside
+    the device sync is flagged with the op name; tasks that are never
+    waited on register nothing (they hold no host thread and would be
+    pure false positives)."""
 
-    def __init__(self, result):
+    def __init__(self, result, name: str = "collective"):
         self._result = result
+        self._name = name
 
     def wait(self):
-        r = self._result
-        if isinstance(r, Tensor):
-            r.block_until_ready()
+        from .comm_watchdog import comm_task_manager
+
+        tid = comm_task_manager.register(self._name)
+        try:
+            r = self._result
+            if isinstance(r, Tensor):
+                r.block_until_ready()
+        finally:
+            comm_task_manager.complete(tid)
         return r
 
     def is_completed(self):
@@ -322,8 +334,8 @@ def all_reduce(tensor, op: str = ReduceOp.SUM, group: Optional[Group] = None,
             data = arr
     if isinstance(tensor, Tensor):
         tensor._bump(data)
-        return Task(tensor)
-    return Task(Tensor(data))
+        return Task(tensor, name="all_reduce")
+    return Task(Tensor(data), name="all_reduce")
 
 
 def all_gather(tensor_list, tensor=None, group: Optional[Group] = None,
@@ -350,8 +362,8 @@ def all_gather(tensor_list, tensor=None, group: Optional[Group] = None,
         else:
             parts = [full] * g.nranks
         tensor_list.extend(Tensor(p) for p in parts)
-        return Task(tensor_list)
-    return Task(_wrap_like(tensor, full))
+        return Task(tensor_list, name="all_gather")
+    return Task(_wrap_like(tensor, full), name="all_gather")
 
 
 def all_gather_object(obj_list, obj, group=None):
@@ -389,8 +401,8 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op: str = ReduceOp.SUM,
     out = _wrap_like(tensor, sharded)
     if tensor_or_tensor_list is not None and isinstance(tensor, Tensor):
         tensor._bump(sharded)
-        return Task(tensor)
-    return Task(out)
+        return Task(tensor, name="reduce_scatter")
+    return Task(out, name="reduce_scatter")
 
 
 def reduce(tensor, dst=0, op: str = ReduceOp.SUM,
@@ -407,15 +419,15 @@ def broadcast(tensor, src=0, group: Optional[Group] = None,
     spec = _current_spec(arr, g.mesh)
     dim = _sharded_dim(spec, g.axis_names)
     if dim is None:
-        return Task(tensor if isinstance(tensor, Tensor) else Tensor(arr))
+        return Task(tensor if isinstance(tensor, Tensor) else Tensor(arr), name="broadcast")
     full = _replicate_over(tensor, g)
     nparts = _sharding_degree(spec, dim, g.axis_names, g.mesh)
     parts = jnp.split(full, nparts, axis=dim)
     data = jnp.concatenate([parts[src]] * nparts, axis=dim)
     if isinstance(tensor, Tensor):
         tensor._bump(data)
-        return Task(tensor)
-    return Task(Tensor(data))
+        return Task(tensor, name="broadcast")
+    return Task(Tensor(data), name="broadcast")
 
 
 def scatter(tensor, tensor_list=None, src=0, group: Optional[Group] = None,
@@ -430,8 +442,8 @@ def scatter(tensor, tensor_list=None, src=0, group: Optional[Group] = None,
     sharded = jax.device_put(arr, NamedSharding(g.mesh, P(axis_entry)))
     if isinstance(tensor, Tensor):
         tensor._bump(sharded)
-        return Task(tensor)
-    return Task(Tensor(sharded))
+        return Task(tensor, name="scatter")
+    return Task(Tensor(sharded), name="scatter")
 
 
 def alltoall(out_tensor_list, in_tensor_list=None,
@@ -449,8 +461,8 @@ def alltoall(out_tensor_list, in_tensor_list=None,
         outs = [Tensor(arr[i]) for i in range(arr.shape[0])]
         if out_tensor_list is not None:
             out_tensor_list.extend(outs)
-            return Task(out_tensor_list)
-        return Task(outs)
+            return Task(out_tensor_list, name="alltoall")
+        return Task(outs, name="alltoall")
     return alltoall_single(in_tensor_list, group=group)
 
 
@@ -477,8 +489,8 @@ def alltoall_single(tensor, output=None, in_split_sizes=None,
     out = fn(jax.device_put(arr, NamedSharding(g.mesh, P(axis))))
     if output is not None and isinstance(output, Tensor):
         output._bump(out)
-        return Task(output)
-    return Task(_wrap_like(tensor, out))
+        return Task(output, name="alltoall_single")
+    return Task(_wrap_like(tensor, out), name="alltoall_single")
 
 
 def _a2a_local(x, axis):
@@ -502,7 +514,7 @@ def send(tensor, dst=0, group=None, sync_op: bool = True) -> Task:
     devs = g.mesh.devices.reshape(-1)
     data = jax.device_put(_data(tensor), devs[dst])
     _P2P_BUF.setdefault(g.id, []).append((dst, data))
-    return Task(tensor)
+    return Task(tensor, name="send")
 
 
 def recv(tensor, src=0, group=None, sync_op: bool = True, dst=None) -> Task:
@@ -516,7 +528,7 @@ def recv(tensor, src=0, group=None, sync_op: bool = True, dst=None) -> Task:
             chan.pop(i)
             if isinstance(tensor, Tensor):
                 tensor._bump(data)
-            return Task(tensor)
+            return Task(tensor, name="recv")
     raise RuntimeError("recv with no matching outstanding send "
                        f"(group={g.name}, src={src}, dst={dst})")
 

@@ -1,0 +1,118 @@
+"""Communication health watchdog — the CommTaskManager analog.
+
+Reference: paddle/phi/core/distributed/comm_task_manager.h:37 +
+comm_task.h — a background loop that tracks in-flight collective tasks
+and surfaces hangs (the dreaded silent NCCL deadlock) with the op name
+and age instead of an opaque stall. Here: eager collective ``Task``s
+(distributed/collective.py) register on creation and complete on
+``wait()``; a daemon thread flags any task alive past ``timeout``.
+
+Under jit there are no per-collective tasks (XLA owns scheduling), so
+like the reference this guards the eager/process-group path — plus
+anything else registered manually via ``register()/complete()``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["CommTaskManager", "comm_task_manager", "start_comm_watchdog",
+           "stop_comm_watchdog"]
+
+logger = logging.getLogger("paddle_tpu.distributed.comm_watchdog")
+
+
+class CommTaskManager:
+    """Tracks in-flight communication tasks; a watch thread reports any
+    task older than ``timeout`` seconds via logging and the optional
+    ``on_hang(name, age_s)`` callback (once per task)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tasks: dict[int, tuple[str, float]] = {}
+        self._next_id = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._timeout = 30.0
+        self._on_hang: Optional[Callable[[str, float], None]] = None
+        self._flagged: set[int] = set()
+        self.enabled = False
+
+    # -- registration (called from collective.Task) ---------------------
+    def register(self, name: str) -> Optional[int]:
+        if not self.enabled:
+            return None
+        with self._lock:
+            tid = self._next_id
+            self._next_id += 1
+            self._tasks[tid] = (name, time.monotonic())
+        return tid
+
+    def complete(self, tid: Optional[int]) -> None:
+        if tid is None:
+            return
+        with self._lock:
+            self._tasks.pop(tid, None)
+            self._flagged.discard(tid)
+
+    # -- watch loop ------------------------------------------------------
+    def start(self, timeout: float = 30.0, poll: float = 1.0,
+              on_hang: Optional[Callable[[str, float], None]] = None):
+        self._timeout = timeout
+        self._on_hang = on_hang
+        self._stop.clear()
+        self.enabled = True
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, args=(poll,), daemon=True,
+                name="paddle-tpu-comm-watchdog")
+            self._thread.start()
+
+    def stop(self):
+        self.enabled = False
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        with self._lock:
+            self._tasks.clear()
+            self._flagged.clear()
+
+    def _loop(self, poll: float):
+        while not self._stop.wait(poll):
+            now = time.monotonic()
+            hung = []
+            with self._lock:
+                for tid, (name, t0) in self._tasks.items():
+                    if now - t0 > self._timeout and tid not in self._flagged:
+                        self._flagged.add(tid)
+                        hung.append((name, now - t0))
+            for name, age in hung:
+                logger.error(
+                    "comm watchdog: task '%s' in flight for %.1fs "
+                    "(timeout %.1fs) — possible communication hang",
+                    name, age, self._timeout)
+                if self._on_hang is not None:
+                    self._on_hang(name, age)
+
+    # -- introspection ---------------------------------------------------
+    def in_flight(self):
+        with self._lock:
+            now = time.monotonic()
+            return [(name, now - t0) for name, t0 in self._tasks.values()]
+
+
+comm_task_manager = CommTaskManager()
+
+
+def start_comm_watchdog(timeout: float = 30.0, poll: float = 1.0,
+                        on_hang=None):
+    """Enable hang detection for eager collectives (and manual tasks)."""
+    comm_task_manager.start(timeout=timeout, poll=poll, on_hang=on_hang)
+
+
+def stop_comm_watchdog():
+    comm_task_manager.stop()
