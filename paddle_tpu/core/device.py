@@ -92,14 +92,26 @@ _peak_reserved: dict = {}
 
 
 def _resolve_device(device=None):
-    """Accept a jax Device, an int index, or a 'kind:N' string."""
+    """Accept a jax Device, an int index, or a 'platform:N' string
+    (same parsing rules as set_device: platform-filtered, index
+    clamped)."""
     if device is None:
         return _current_device or jax.devices()[0]
     if isinstance(device, int):
-        return jax.devices()[device]
+        devs = jax.devices()
+        return devs[min(device, len(devs) - 1)]
     if isinstance(device, str):
-        idx = int(device.rsplit(":", 1)[1]) if ":" in device else 0
-        return jax.devices()[idx]
+        if ":" in device:
+            platform, idx = device.split(":")
+            idx = int(idx)
+        else:
+            platform, idx = device, 0
+        platform = {"gpu": "cuda", "xpu": "tpu"}.get(platform, platform)
+        devs = [d for d in jax.devices()
+                if d.platform.lower().startswith(platform[:3])]
+        if not devs:
+            devs = jax.devices()
+        return devs[min(idx, len(devs) - 1)]
     return device
 
 
@@ -119,18 +131,32 @@ def _live_bytes(device=None) -> int:
     return total
 
 
+_peak_reset: set = set()          # devices whose peak was user-reset
+
+
 def memory_allocated(device=None) -> int:
-    stats = _mem_stats(_resolve_device(device))
+    d = _resolve_device(device)
+    stats = _mem_stats(d)
     if "bytes_in_use" in stats:
-        return int(stats["bytes_in_use"])
-    return _live_bytes(device)
+        cur = int(stats["bytes_in_use"])
+        # keep the resettable sampled peak current (PJRT's own peak
+        # counter cannot be reset; see max_memory_allocated)
+        _peak_live_bytes[d] = max(_peak_live_bytes.get(d, 0), cur)
+        return cur
+    return _live_bytes(d)
 
 
 def max_memory_allocated(device=None) -> int:
     d = _resolve_device(device)
     stats = _mem_stats(d)
     if "peak_bytes_in_use" in stats:
-        return int(stats["peak_bytes_in_use"])
+        cur = int(stats["bytes_in_use"]) if "bytes_in_use" in stats else 0
+        _peak_live_bytes[d] = max(_peak_live_bytes.get(d, 0), cur)
+        if d in _peak_reset:
+            # after a reset the client's lifetime peak is stale: report
+            # the peak SAMPLED at our API calls since the reset
+            return _peak_live_bytes[d]
+        return max(int(stats["peak_bytes_in_use"]), _peak_live_bytes[d])
     _live_bytes(d)
     return _peak_live_bytes.get(d, 0)
 
@@ -139,11 +165,15 @@ def reset_max_memory_allocated(device=None) -> None:
     d = _resolve_device(device)
     _peak_live_bytes[d] = 0
     _peak_reserved[d] = 0
+    _peak_reset.add(d)
 
 
 def memory_reserved(device=None) -> int:
     d = _resolve_device(device)
-    return int(_mem_stats(d).get("bytes_reserved", memory_allocated(d)))
+    stats = _mem_stats(d)
+    if "bytes_reserved" in stats:
+        return int(stats["bytes_reserved"])
+    return memory_allocated(d)
 
 
 def max_memory_reserved(device=None) -> int:

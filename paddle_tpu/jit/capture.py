@@ -107,11 +107,26 @@ def _guard_key(args, kwargs, n_state):
             return tuple(spec(x) for x in o)
         if isinstance(o, dict):
             return tuple(sorted((k, spec(v)) for k, v in o.items()))
+        import numpy as _np
+
+        if isinstance(o, (_np.ndarray, jax.Array)):
+            # baked as a trace-time constant: guard on exact content
+            # (repr truncates large arrays — a silent mis-capture)
+            import hashlib
+
+            arr = _np.asarray(o)
+            return ("__nd__", arr.shape, str(arr.dtype),
+                    hashlib.sha1(arr.tobytes()).hexdigest())
         try:
             hash(o)
             return o
         except TypeError:
-            return str(type(o))
+            # unhashable non-tensor arg: guard on its repr — two configs
+            # that print differently must not share a compiled program
+            # (a type-only guard would silently reuse the wrong trace);
+            # reprs that embed object ids just cost a re-trace, never a
+            # mis-capture.
+            return (str(type(o)), repr(o))
 
     return (spec(list(args)), spec(kwargs), n_state)
 
@@ -148,14 +163,51 @@ class _Compiled:
         self.n_state_out = n_state_out
 
 
+# Sentinel cached for guard keys whose trace graph-broke: run eager.
+_EAGER_FALLBACK = object()
+
+# Concretization errors = data-dependent Python control flow inside the
+# captured function (the reference SOT's BreakGraphError family,
+# jit/sot/.../opcode_executor.py:1620 — e.g. `if loss.item() > x`,
+# int(tensor), tensor-driven loop bounds).
+_BREAK_ERRORS = (
+    jax.errors.ConcretizationTypeError,
+    jax.errors.TracerBoolConversionError,
+    jax.errors.TracerArrayConversionError,
+    jax.errors.TracerIntegerConversionError,
+)
+
+
+def _purge_leaked_tracers():
+    """A failed trace may have grown state (e.g. optimizer slots or
+    master weights created under tracing) that now holds tracers; drop
+    those entries so the eager fallback re-initializes them with real
+    arrays."""
+    for obj in list(_STATEFUL):
+        for attr in ("_accumulators", "_master_weights"):
+            d = getattr(obj, attr, None)
+            if isinstance(d, dict):
+                for pid in list(d):
+                    leaves = jax.tree.leaves(d[pid])
+                    if any(isinstance(a, jax.core.Tracer) for a in leaves):
+                        del d[pid]
+
+
 class StaticFunction:
     """reference: jit/dy2static/program_translator.py:377. ``__call__``
-    looks up the (guard → compiled program) cache, tracing on miss."""
+    looks up the (guard → compiled program) cache, tracing on miss.
+    Data-dependent control flow graph-breaks: the call falls back to
+    eager permanently for that guard key (SOT BreakGraphError parity)."""
 
     def __init__(self, fn: Callable, build_strategy=None, donate_states: bool = True):
         self._fn = fn
         self._cache: dict = {}
         self._donate = donate_states
+        self.graph_break_count = 0
+        # guard keys (minus the state-count component) that graph-broke:
+        # the first eager run may grow state (n_state changes), which must
+        # not trigger a second doomed trace
+        self._broken_keys: set = set()
         # Introspection handles for the most recent compile (the analogs of
         # the reference's dist_main_program / executor plan objects).
         self.last_lowered = None
@@ -164,7 +216,8 @@ class StaticFunction:
 
     @property
     def compile_count(self) -> int:
-        return len(self._cache)
+        return sum(1 for v in self._cache.values()
+                   if v is not _EAGER_FALLBACK)
 
     def __call__(self, *args, **kwargs):
         if not TO_STATIC_ENABLED[0]:
@@ -183,8 +236,26 @@ class StaticFunction:
             state_vals, state_setters = _snapshot()
             key = _guard_key(args, kwargs, len(state_vals))
             compiled = self._cache.get(key)
+        if compiled is _EAGER_FALLBACK or key[:2] in self._broken_keys:
+            return self._fn(*args, **kwargs)
         if compiled is None:
-            compiled = self._compile(args, kwargs, state_vals)
+            try:
+                compiled = self._compile(args, kwargs, state_vals)
+            except _BREAK_ERRORS as e:
+                # graph break: cache the fallback so later calls skip the
+                # doomed trace, clean up tracer-holding state, run eager
+                self._cache[key] = _EAGER_FALLBACK
+                self._broken_keys.add(key[:2])
+                self.graph_break_count += 1
+                _purge_leaked_tracers()
+                import logging
+
+                logging.getLogger("paddle_tpu.jit").warning(
+                    "to_static graph break in %s (falling back to eager "
+                    "for this input spec): %s",
+                    getattr(self._fn, "__name__", "<fn>"),
+                    str(e).split("\n")[0])
+                return self._fn(*args, **kwargs)
             self._cache[key] = compiled
             # State created during the trace (e.g. optimizer moments) holds
             # tracers until this first execution's out_setters overwrite it

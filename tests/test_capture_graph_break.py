@@ -1,0 +1,99 @@
+"""Graph-break fallback for jit capture (VERDICT weak #5 / item 8).
+
+Reference: SOT's BreakGraphError semantics
+(jit/sot/opcode_translator/executor/opcode_executor.py:1620) — data-
+dependent Python control flow must not silently bake the trace-time
+branch in; the call falls back to eager and stays correct."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.jit as pjit
+
+
+@pytest.mark.smoke
+def test_item_branch_falls_back_to_eager():
+    calls = []
+
+    @pjit.to_static
+    def step(x):
+        calls.append(1)
+        # data-dependent Python branch: uncapturable
+        if float(x.mean().numpy()) > 0:
+            return x * 2
+        return x - 1
+
+    pos = paddle.to_tensor(np.ones((4,), np.float32))
+    neg = paddle.to_tensor(-np.ones((4,), np.float32))
+    # both branches must be computed CORRECTLY (not trace-time-frozen)
+    np.testing.assert_allclose(step(pos).numpy(), np.full((4,), 2.0))
+    np.testing.assert_allclose(step(neg).numpy(), np.full((4,), -2.0))
+    np.testing.assert_allclose(step(pos).numpy(), np.full((4,), 2.0))
+    assert step.graph_break_count >= 1
+    assert step.compile_count == 0  # nothing mis-captured
+
+
+def test_graph_break_with_optimizer_state_recovers():
+    """A break AFTER optimizer state creation must not leak tracers."""
+    import paddle_tpu.nn as nn
+
+    lin = nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+
+    @pjit.to_static
+    def step(x, y):
+        pred = lin(x)
+        loss = ((pred - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if float(loss.numpy()) > 1e10:  # break after state touch
+            return loss * 0
+        return loss
+
+    X = paddle.to_tensor(np.random.RandomState(0).randn(8, 4)
+                         .astype(np.float32))
+    Y = paddle.to_tensor(np.random.RandomState(1).randn(8, 1)
+                         .astype(np.float32))
+    first = float(step(X, Y).numpy())
+    for _ in range(5):
+        last = float(step(X, Y).numpy())
+    assert last < first  # eager fallback still trains
+    assert step.graph_break_count >= 1
+
+
+@pytest.mark.smoke
+def test_clean_capture_still_compiles_once():
+    @pjit.to_static
+    def step(x):
+        return x * 2 + 1
+
+    x = paddle.to_tensor(np.ones((4,), np.float32))
+    a = step(x)
+    b = step(x)
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    assert step.compile_count >= 1
+    assert step.graph_break_count == 0
+
+
+def test_unhashable_kwarg_guards_by_value():
+    class Cfg:
+        __hash__ = None  # class-level: actually unhashable
+
+        def __init__(self, scale):
+            self.scale = scale
+
+        def __repr__(self):
+            return f"Cfg(scale={self.scale})"
+
+    @pjit.to_static
+    def step(x, cfg):
+        return x * cfg.scale
+
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    a = step(x, Cfg(2.0))
+    b = step(x, Cfg(3.0))  # different config must NOT reuse the trace
+    np.testing.assert_allclose(a.numpy(), [2.0, 2.0])
+    np.testing.assert_allclose(b.numpy(), [3.0, 3.0])
