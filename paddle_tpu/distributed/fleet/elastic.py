@@ -23,7 +23,7 @@ from typing import Callable, Optional
 
 from ..store import TCPStore
 
-__all__ = ["ElasticManager", "ELASTIC_EXIT_CODE"]
+__all__ = ["ElasticManager", "ELASTIC_EXIT_CODE", "run_elastic"]
 
 ELASTIC_EXIT_CODE = 101          # reference manager.py:33
 ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
@@ -117,3 +117,40 @@ class ElasticManager:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=2)
+
+
+def run_elastic(script: str, script_args=None, nprocs: int = 1,
+                max_restarts: int = 3, log_dir=None, master=None,
+                env_extra=None) -> int:
+    """Elastic trainer supervision (reference manager.py:125 watch loop +
+    controller relaunch): run the fleet via the launch controller; when a
+    generation exits with ELASTIC_EXIT_CODE (membership change — the
+    trainer checkpointed and asked for relaunch) or dies abnormally,
+    relaunch with a fresh rendezvous, up to ``max_restarts`` times.
+    Returns the final generation's exit code (0 = trained to completion).
+    """
+    from ..launch import launch_procs
+
+    attempt = 0
+    while True:
+        env = dict(env_extra or {})
+        env["PADDLE_ELASTIC_RESTART"] = str(attempt)
+        # per-generation subdir: a relaunch must not truncate the previous
+        # generation's logs (they hold the crash being debugged)
+        gen_dir = None if log_dir is None else \
+            os.path.join(log_dir, f"restart_{attempt}")
+        rc = launch_procs(script, list(script_args or []), nprocs,
+                          master=master, env_extra=env, log_dir=gen_dir)
+        if rc == 0:
+            return 0
+        if attempt >= max_restarts:
+            return rc
+        if rc not in (ELASTIC_EXIT_CODE, ELASTIC_AUTO_PARALLEL_EXIT_CODE):
+            # abnormal death: fault-tolerance level 1 also relaunches
+            # (reference PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL semantics)
+            import logging
+
+            logging.getLogger("paddle_tpu.elastic").warning(
+                "generation %d died rc=%d; relaunching (%d/%d)",
+                attempt, rc, attempt + 1, max_restarts)
+        attempt += 1

@@ -1,19 +1,194 @@
 """paddle_tpu.onnx: ONNX export (reference: python/paddle/onnx/export.py →
 paddle2onnx wrapper).
 
-TPU-native export goes through StableHLO (jax.export) — the portable
-artifact XLA consumes directly; ONNX conversion requires an external
-converter not bundled in the zero-egress build.
+Two artifacts:
+
+- **Real ONNX** (``export`` → ``path + '.onnx'``) for layer-graph models
+  built from mappable layers (Linear/Conv2D/BN/activations/pooling/
+  Flatten/Dropout, incl. arbitrarily nested Sequential): a direct
+  layer→ONNX-op mapping emitted through the zero-dependency protobuf
+  writer in ``_proto.py`` (the image bundles no onnx/paddle2onnx).
+- **StableHLO** (``export_stablehlo``) for arbitrary traced programs —
+  the portable artifact XLA consumes directly; also written as a
+  fallback when a layer cannot be op-mapped.
 """
 
 from __future__ import annotations
 
-__all__ = ["export"]
+__all__ = ["export", "export_stablehlo"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    """Export a layer. Writes a StableHLO artifact (``path + '.stablehlo'``)
-    via jax.export; raises with guidance for true ONNX output."""
+_ACT_OPS = {
+    "ReLU": "Relu", "Sigmoid": "Sigmoid", "Tanh": "Tanh",
+    "Softmax": "Softmax",
+}
+
+
+def _iter_layers(layer):
+    """Flatten arbitrarily nested Sequential containers into the layer
+    chain; non-container layers yield themselves."""
+    from .. import nn
+
+    if isinstance(layer, nn.Sequential):
+        for _, sub in layer.named_children():
+            yield from _iter_layers(sub)
+    else:
+        yield layer
+
+
+def _map_layer(lyr, idx, cur, nodes, inits):
+    """Append the ONNX node(s) for one layer; returns the output name or
+    None if the layer is unmappable."""
+    import numpy as np
+
+    from . import _proto as P
+    from .. import nn
+
+    out = f"t{idx}"
+
+    def w(name, arr):
+        nm = f"{name}_{idx}"
+        inits.append(P.tensor(nm, np.asarray(arr)))
+        return nm
+
+    cls = type(lyr).__name__
+    if isinstance(lyr, nn.Linear):
+        nodes.append(P.node("Gemm", [cur, w("W", lyr.weight._data),
+                                     *( [w("B", lyr.bias._data)]
+                                        if lyr.bias is not None else [])],
+                            [out], name=f"gemm{idx}", alpha=1.0, beta=1.0,
+                            transB=0))
+        return out
+    if isinstance(lyr, nn.Conv2D):
+        strides = getattr(lyr, "_stride", 1)
+        strides = [strides, strides] if isinstance(strides, int) \
+            else list(strides)
+        pads = getattr(lyr, "_padding", 0)
+        if isinstance(pads, str):
+            return None  # 'SAME'/'VALID' strings: fall back to StableHLO
+        if isinstance(pads, int):
+            pads = [pads, pads, pads, pads]          # [t, l, b, r]
+        elif len(pads) == 2:
+            pads = [pads[0], pads[1], pads[0], pads[1]]
+        else:
+            # paddle order [top, bottom, left, right] -> ONNX
+            # [x1_begin, x2_begin, x1_end, x2_end] = [t, l, b, r]
+            t, b, l, r = pads
+            pads = [t, l, b, r]
+        dil = getattr(lyr, "_dilation", 1)
+        dil = [dil, dil] if isinstance(dil, int) else list(dil)
+        ins = [cur, w("W", lyr.weight._data)]
+        if lyr.bias is not None:
+            ins.append(w("B", lyr.bias._data))
+        nodes.append(P.node("Conv", ins, [out], name=f"conv{idx}",
+                            strides=[int(s) for s in strides],
+                            pads=[int(p) for p in pads],
+                            dilations=[int(d) for d in dil],
+                            group=int(getattr(lyr, "_groups", 1))))
+        return out
+    if isinstance(lyr, (nn.BatchNorm2D, nn.BatchNorm1D)):
+        nodes.append(P.node(
+            "BatchNormalization",
+            [cur, w("scale", lyr.weight._data), w("bias", lyr.bias._data),
+             w("mean", lyr._mean._data), w("var", lyr._variance._data)],
+            [out], name=f"bn{idx}", epsilon=float(lyr._epsilon)))
+        return out
+    if cls in ("ReLU", "Sigmoid", "Tanh", "Softmax"):
+        nodes.append(P.node(_ACT_OPS[cls], [cur], [out], name=f"act{idx}"))
+        return out
+    if cls == "GELU":
+        # ai.onnx Gelu exists from opset 20 (tracked by the caller)
+        nodes.append(P.node("Gelu", [cur], [out], name=f"act{idx}"))
+        return out
+    if cls == "SiLU":
+        nodes.append(P.node("Sigmoid", [cur], [f"{out}_sig"],
+                            name=f"sig{idx}"))
+        nodes.append(P.node("Mul", [cur, f"{out}_sig"], [out],
+                            name=f"silu{idx}"))
+        return out
+    if cls == "Flatten":
+        if getattr(lyr, "stop_axis", -1) != -1:
+            return None  # partial flattens have no single-op ONNX analog
+        nodes.append(P.node("Flatten", [cur], [out], name=f"flat{idx}",
+                            axis=int(getattr(lyr, "start_axis", 1))))
+        return out
+    if cls == "Dropout":
+        nodes.append(P.node("Identity", [cur], [out], name=f"drop{idx}"))
+        return out
+    if cls == "MaxPool2D":
+        if getattr(lyr, "return_mask", False):
+            return None
+        k = getattr(lyr, "kernel_size", getattr(lyr, "_kernel_size", 2))
+        k = [k, k] if isinstance(k, int) else list(k)
+        s = (getattr(lyr, "stride", None)
+             or getattr(lyr, "_stride", None) or k)
+        s = [s, s] if isinstance(s, int) else list(s)
+        p = getattr(lyr, "padding", 0)
+        if isinstance(p, str):
+            return None
+        p = [p, p, p, p] if isinstance(p, int) else \
+            [p[0], p[1], p[0], p[1]] if len(p) == 2 else \
+            [p[0], p[2], p[1], p[3]]
+        nodes.append(P.node("MaxPool", [cur], [out], name=f"pool{idx}",
+                            kernel_shape=[int(x) for x in k],
+                            strides=[int(x) for x in s],
+                            pads=[int(x) for x in p],
+                            ceil_mode=int(bool(getattr(lyr, "ceil_mode",
+                                                       False)))))
+        return out
+    if cls == "AdaptiveAvgPool2D":
+        osz = getattr(lyr, "output_size", getattr(lyr, "_output_size", 1))
+        if osz in (1, (1, 1), [1, 1]):
+            nodes.append(P.node("GlobalAveragePool", [cur], [out],
+                                name=f"gap{idx}"))
+            return out
+    return None
+
+
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Export to real ONNX (``path + '.onnx'``) when every layer in the
+    chain is op-mappable; otherwise falls back to a StableHLO artifact
+    and returns that path."""
+    import numpy as np
+
+    from . import _proto as P
+
+    if input_spec is None:
+        raise ValueError("input_spec is required for export")
+    chain = list(_iter_layers(layer))
+    nodes: list = []
+    inits: list = []
+    cur = "input"
+    ok = True
+    for i, lyr in enumerate(chain):
+        nxt = _map_layer(lyr, i, cur, nodes, inits)
+        if nxt is None:
+            ok = False
+            break
+        cur = nxt
+    if not ok:
+        return export_stablehlo(layer, path, input_spec=input_spec)
+
+    # ai.onnx Gelu needs opset >= 20
+    if any(type(l).__name__ == "GELU" for l in chain):
+        opset_version = max(opset_version, 20)
+    spec = input_spec[0]
+    shape = tuple(getattr(spec, "shape", spec))
+    g = P.graph(nodes, "paddle_tpu_graph",
+                [P.value_info("input", P.FLOAT, shape)],
+                [P.value_info(cur, P.FLOAT, ["N"])],
+                inits)
+    blob = P.model(g, opset_version=opset_version)
+    out_path = path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(blob)
+    return out_path
+
+
+def export_stablehlo(layer, path, input_spec=None, opset_version=9,
+                     **configs):
+    """Write a StableHLO artifact (``path + '.stablehlo'``) via
+    jax.export — the arbitrary-program path."""
     import jax
     import jax.numpy as jnp
 
@@ -30,6 +205,9 @@ def export(layer, path, input_spec=None, opset_version=9, **configs):
 
             shapes.append(jax.ShapeDtypeStruct(shape,
                                                convert_dtype(spec.dtype)))
+        elif isinstance(spec, (tuple, list)):
+            shape = tuple(1 if s in (-1, None) else int(s) for s in spec)
+            shapes.append(jax.ShapeDtypeStruct(shape, jnp.float32))
         else:
             shapes.append(jax.ShapeDtypeStruct(tuple(spec.shape),
                                                spec._data.dtype))

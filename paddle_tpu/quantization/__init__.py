@@ -22,7 +22,8 @@ from ..nn.layer.layers import Layer
 from .. import nn
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "ImperativeQuantAware",
-           "AbsmaxObserver", "quant", "dequant", "fake_quant"]
+           "AbsmaxObserver", "MovingAverageObserver", "QuantizedLinear",
+           "quant", "dequant", "fake_quant"]
 
 
 @op("fake_quantize")
@@ -141,31 +142,105 @@ def _swap_layers(model: Layer, config: QuantConfig) -> Layer:
     return model
 
 
+class MovingAverageObserver:
+    """EMA absmax for activations (reference
+    moving_average_abs_max observer, quantization/observers)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        self.bits = quant_bits
+        self.rate = moving_rate
+        self._state = 0.0
+        self._accum = 0.0
+
+    def observe(self, x):
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        cur = float(jnp.abs(arr).max())
+        self._state = self.rate * self._state + 1.0
+        self._accum = self.rate * self._accum + cur
+
+    @property
+    def scale(self) -> float:
+        return (self._accum / self._state) if self._state else 1.0
+
+
+class QuantizedLinear(Layer):
+    """Statically-quantized Linear: int8 weights held in HBM, calibrated
+    activation scale, int8-simulated compute (the deployed form the
+    reference's PTQ convert produces; pairs with incubate
+    weight_only_linear for the weight-only variant)."""
+
+    def __init__(self, inner: "nn.Linear", act_scale: float,
+                 bits: int = 8):
+        super().__init__()
+        qmax = 2 ** (bits - 1) - 1
+        w = inner.weight._data
+        self.w_scale = float(jnp.abs(w).max()) or 1.0
+        self.qweight = jnp.clip(jnp.round(w / self.w_scale * qmax),
+                                -qmax, qmax).astype(jnp.int8)
+        self.bias = inner.bias
+        self.act_scale = float(act_scale) or 1.0
+        self.bits = bits
+
+    def forward(self, x):
+        qmax = 2 ** (self.bits - 1) - 1
+        # static quantization: x -> int8 domain with the CALIBRATED scale
+        xq = jnp.clip(jnp.round((x._data if isinstance(x, Tensor) else x)
+                                / self.act_scale * qmax), -qmax, qmax)
+        acc = jnp.einsum("...k,kn->...n", xq.astype(jnp.float32),
+                         self.qweight.astype(jnp.float32))
+        y = acc * (self.act_scale * self.w_scale) / (qmax * qmax)
+        out = Tensor(y.astype(jnp.float32))
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
 class PTQ:
-    """Post-training quantization: observe calibration batches, then fold
-    scales (reference: quantization/ptq.py)."""
+    """Static post-training quantization (reference: quantization/ptq.py +
+    static quant_post pipeline): ``quantize`` instruments Linear layers
+    with activation observers, the user runs calibration batches, and
+    ``convert`` swaps in ``QuantizedLinear`` with int8 weights and the
+    calibrated activation scales."""
 
     def __init__(self, config: Optional[QuantConfig] = None):
         self.config = config or QuantConfig()
         self._observers: dict = {}
+        self._hooks: list = []
 
     def quantize(self, model: Layer, inplace: bool = False) -> Layer:
         for name, sub in model.named_sublayers():
             if isinstance(sub, nn.Linear):
-                obs = AbsmaxObserver(self.config.quant_bits)
+                obs = MovingAverageObserver(self.config.quant_bits)
                 self._observers[name] = obs
-                sub.register_forward_pre_hook(
+                h = sub.register_forward_pre_hook(
                     lambda lyr, inputs, obs=obs: (obs.observe(inputs[0]),)
                     and None)
+                self._hooks.append(h)
         return model
 
     def convert(self, model: Layer, inplace: bool = False) -> Layer:
         bits = self.config.quant_bits
-        for name, sub in model.named_sublayers():
-            if isinstance(sub, nn.Linear):
-                w = sub.weight
-                scale = float(jnp.abs(w._data).max()) or 1.0
-                w.set_value(dequant(quant(w, scale, bits), scale, bits))
+        for h in self._hooks:
+            try:
+                h.remove()
+            except Exception:
+                pass
+        self._hooks.clear()
+        # swap Linears for their statically-quantized form
+        for name, sub in list(model.named_sublayers()):
+            if not isinstance(sub, nn.Linear):
+                continue
+            obs = self._observers.get(name)
+            act_scale = obs.scale if obs is not None else 1.0
+            qlin = QuantizedLinear(sub, act_scale, bits)
+            parent, _, leaf = name.rpartition(".")
+            holder = model
+            if parent:
+                for part in parent.split("."):
+                    holder = holder._sub_layers[part]
+            # direct registry write: Sequential children have numeric
+            # names that are not attributes
+            holder._sub_layers[leaf] = qlin
         return model
 
 

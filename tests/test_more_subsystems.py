@@ -46,7 +46,7 @@ def test_ptq_observes_and_converts():
     for _ in range(3):
         net(pt.randn([2, 8]))
     ptq.convert(net)
-    assert any(o._max > 0 for o in ptq._observers.values())
+    assert any(o.scale > 0 for o in ptq._observers.values())
 
 
 def test_send_u_recv():

@@ -1,0 +1,118 @@
+"""E2E tests for the deepened partials (VERDICT item 10): static PTQ,
+elastic relaunch, real ONNX emission."""
+
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+@pytest.mark.smoke
+def test_static_ptq_calibrate_convert():
+    """calibrate -> convert: int8 weights, calibrated act scales, outputs
+    close to the float model (reference quant_post pipeline)."""
+    from paddle_tpu.quantization import PTQ, QuantizedLinear
+
+    rng = np.random.RandomState(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    X = paddle.to_tensor(rng.randn(64, 16).astype(np.float32))
+    ref = model(X).numpy()
+
+    ptq = PTQ()
+    ptq.quantize(model)
+    for i in range(4):  # calibration batches
+        model(paddle.to_tensor(rng.randn(32, 16).astype(np.float32)))
+    ptq.convert(model)
+
+    # converted form: int8 weights live in the layer
+    qlayers = [s for _, s in model.named_sublayers()
+               if isinstance(s, QuantizedLinear)]
+    assert len(qlayers) == 2
+    for q in qlayers:
+        assert q.qweight.dtype == jnp.int8
+        assert q.act_scale > 0 and q.w_scale > 0
+
+    out = model(X).numpy()
+    # int8 static quant error budget: close but not exact
+    err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6)
+    assert err < 0.1, err
+
+
+@pytest.mark.slow
+def test_elastic_relaunch_recovers(tmp_path):
+    """A generation exiting with ELASTIC_EXIT_CODE is relaunched; the
+    next generation completes (reference manager.py relaunch loop)."""
+    from paddle_tpu.distributed.fleet.elastic import (ELASTIC_EXIT_CODE,
+                                                      run_elastic)
+
+    marker = tmp_path / "gen0_done"
+    worker = tmp_path / "worker.py"
+    worker.write_text(f"""
+import os, sys
+marker = {str(marker)!r}
+if not os.path.exists(marker):
+    open(marker, "w").write("x")
+    sys.exit({ELASTIC_EXIT_CODE})   # membership change: ask for relaunch
+print("GENERATION", os.environ.get("PADDLE_ELASTIC_RESTART"))
+""")
+    rc = run_elastic(str(worker), nprocs=2, max_restarts=2,
+                     log_dir=str(tmp_path / "logs"))
+    assert rc == 0
+    logs = ""
+    for f in sorted((tmp_path / "logs").rglob("*.log")):
+        logs += f.read_text()
+    assert "GENERATION 1" in logs  # second generation ran
+
+
+@pytest.mark.smoke
+def test_onnx_export_real_model():
+    """Real ONNX emission: protobuf parses (protoc --decode_raw) and
+    contains the expected ops."""
+    import tempfile
+
+    from paddle_tpu.onnx import export
+
+    model = nn.Sequential(
+        nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8), nn.ReLU(),
+        nn.MaxPool2D(2),
+        nn.Sequential(nn.Conv2D(8, 4, 3, padding=1), nn.ReLU()),
+        nn.AdaptiveAvgPool2D(1), nn.Flatten(), nn.Linear(4, 10),
+        nn.Softmax())
+    with tempfile.TemporaryDirectory() as d:
+        path = export(model, os.path.join(d, "m"),
+                      input_spec=[(1, 3, 16, 16)])
+        assert path.endswith(".onnx"), path
+        blob = open(path, "rb").read()
+        assert len(blob) > 1000
+        if shutil.which("protoc"):
+            proc = subprocess.run(["protoc", "--decode_raw"],
+                                  input=blob, capture_output=True)
+            assert proc.returncode == 0, proc.stderr[:400]
+            txt = proc.stdout.decode(errors="replace")
+            for op in ("Conv", "BatchNormalization", "Relu", "MaxPool",
+                       "GlobalAveragePool", "Flatten", "Gemm", "Softmax"):
+                assert op in txt, f"{op} missing from decoded model"
+
+
+def test_onnx_export_falls_back_to_stablehlo():
+    from paddle_tpu.onnx import export
+
+    class Custom(nn.Layer):
+        def forward(self, x):
+            return x * 2
+
+    import tempfile
+
+    m = nn.Sequential(nn.Linear(4, 4), Custom())
+    with tempfile.TemporaryDirectory() as d:
+        path = export(m, os.path.join(d, "m"),
+                      input_spec=[paddle.to_tensor(
+                          np.zeros((1, 4), np.float32))])
+        assert path.endswith(".stablehlo")
+        assert os.path.getsize(path) > 0
