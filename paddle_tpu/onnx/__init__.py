@@ -52,12 +52,19 @@ def _map_layer(lyr, idx, cur, nodes, inits):
         return nm
 
     cls = type(lyr).__name__
+    if getattr(lyr, "data_format", getattr(lyr, "_data_format",
+                                           "NCHW")) not in ("NCHW", "NCL"):
+        return None  # ONNX conv/pool ops are channel-first only
     if isinstance(lyr, nn.Linear):
-        nodes.append(P.node("Gemm", [cur, w("W", lyr.weight._data),
-                                     *( [w("B", lyr.bias._data)]
-                                        if lyr.bias is not None else [])],
-                            [out], name=f"gemm{idx}", alpha=1.0, beta=1.0,
-                            transB=0))
+        # MatMul+Add (not Gemm): supports batched N-D inputs like the
+        # framework's F.linear; W is [in, out] so no transpose needed
+        mm = f"{out}_mm"
+        nodes.append(P.node("MatMul", [cur, w("W", lyr.weight._data)],
+                            [mm if lyr.bias is not None else out],
+                            name=f"matmul{idx}"))
+        if lyr.bias is not None:
+            nodes.append(P.node("Add", [mm, w("B", lyr.bias._data)],
+                                [out], name=f"bias{idx}"))
         return out
     if isinstance(lyr, nn.Conv2D):
         strides = getattr(lyr, "_stride", 1)
@@ -68,6 +75,8 @@ def _map_layer(lyr, idx, cur, nodes, inits):
             return None  # 'SAME'/'VALID' strings: fall back to StableHLO
         if isinstance(pads, int):
             pads = [pads, pads, pads, pads]          # [t, l, b, r]
+        elif any(not isinstance(p, int) for p in pads):
+            return None  # nested per-dim pairs: fall back
         elif len(pads) == 2:
             pads = [pads[0], pads[1], pads[0], pads[1]]
         else:
@@ -97,8 +106,13 @@ def _map_layer(lyr, idx, cur, nodes, inits):
         nodes.append(P.node(_ACT_OPS[cls], [cur], [out], name=f"act{idx}"))
         return out
     if cls == "GELU":
-        # ai.onnx Gelu exists from opset 20 (tracked by the caller)
-        nodes.append(P.node("Gelu", [cur], [out], name=f"act{idx}"))
+        # ai.onnx Gelu exists from opset 20 (tracked by the caller);
+        # the approximate flag must carry over or numerics change
+        approx = "tanh" if getattr(lyr, "_approximate",
+                                   getattr(lyr, "approximate", False)) \
+            else "none"
+        nodes.append(P.node("Gelu", [cur], [out], name=f"act{idx}",
+                            approximate=approx))
         return out
     if cls == "SiLU":
         nodes.append(P.node("Sigmoid", [cur], [f"{out}_sig"],
@@ -107,10 +121,13 @@ def _map_layer(lyr, idx, cur, nodes, inits):
                             name=f"silu{idx}"))
         return out
     if cls == "Flatten":
-        if getattr(lyr, "stop_axis", -1) != -1:
-            return None  # partial flattens have no single-op ONNX analog
+        if getattr(lyr, "stop_axis", -1) != -1 or \
+                getattr(lyr, "start_axis", 1) != 1:
+            # ONNX Flatten always emits rank-2; only the
+            # start_axis=1/stop_axis=-1 form coincides with paddle's
+            return None
         nodes.append(P.node("Flatten", [cur], [out], name=f"flat{idx}",
-                            axis=int(getattr(lyr, "start_axis", 1))))
+                            axis=1))
         return out
     if cls == "Dropout":
         nodes.append(P.node("Identity", [cur], [out], name=f"drop{idx}"))
@@ -125,6 +142,9 @@ def _map_layer(lyr, idx, cur, nodes, inits):
         s = [s, s] if isinstance(s, int) else list(s)
         p = getattr(lyr, "padding", 0)
         if isinstance(p, str):
+            return None
+        if not isinstance(p, int) and any(not isinstance(x, int)
+                                          for x in p):
             return None
         p = [p, p, p, p] if isinstance(p, int) else \
             [p[0], p[1], p[0], p[1]] if len(p) == 2 else \
@@ -176,7 +196,7 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
     shape = tuple(getattr(spec, "shape", spec))
     g = P.graph(nodes, "paddle_tpu_graph",
                 [P.value_info("input", P.FLOAT, shape)],
-                [P.value_info(cur, P.FLOAT, ["N"])],
+                [P.value_info(cur, P.FLOAT, None)],  # rank inferred
                 inits)
     blob = P.model(g, opset_version=opset_version)
     out_path = path + ".onnx"

@@ -132,15 +132,18 @@ def tensor(name: str, array) -> bytes:
 def value_info(name: str, elem_type: int, shape) -> bytes:
     """ValueInfoProto{name=1, type=2}; TypeProto{tensor_type=1};
     Tensor{elem_type=1, shape=2}; TensorShapeProto{dim=1};
-    Dim{dim_value=1, dim_param=2}."""
-    dims = b""
-    for d in shape:
-        if isinstance(d, str) or d in (-1, None):
-            dim = f_string(2, str(d) if isinstance(d, str) else "N")
-        else:
-            dim = f_varint(1, int(d))
-        dims += f_bytes(1, dim)
-    tensor_type = f_varint(1, elem_type) + f_bytes(2, dims)
+    Dim{dim_value=1, dim_param=2}. ``shape=None`` omits the shape
+    submessage entirely (unknown rank, legal ONNX)."""
+    tensor_type = f_varint(1, elem_type)
+    if shape is not None:
+        dims = b""
+        for d in shape:
+            if isinstance(d, str) or d in (-1, None):
+                dim = f_string(2, str(d) if isinstance(d, str) else "N")
+            else:
+                dim = f_varint(1, int(d))
+            dims += f_bytes(1, dim)
+        tensor_type += f_bytes(2, dims)
     type_proto = f_bytes(1, tensor_type)
     return f_string(1, name) + f_bytes(2, type_proto)
 

@@ -19,6 +19,17 @@ import jax
 # conftest runs, so the env var above may be too late — force it on the live
 # config too (must happen before any backend is touched by tests).
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: repeat suite runs skip XLA compiles (the
+# dominant cost of these CPU tests). Keyed by backend+flags, safe across
+# the virtual 8-device mesh.
+import tempfile as _tf
+
+_cache_dir = os.environ.get("PADDLE_TPU_TEST_CACHE",
+                            os.path.join(_tf.gettempdir(),
+                                         "paddle_tpu_xla_cache"))
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 import numpy as np
 import pytest
 

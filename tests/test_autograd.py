@@ -6,6 +6,10 @@ import pytest
 import paddle_tpu as paddle
 
 
+
+pytestmark = pytest.mark.smoke  # core critical-path tier
+
+
 def test_simple_backward():
     x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
     y = (x * x).sum()

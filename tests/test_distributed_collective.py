@@ -12,6 +12,10 @@ import paddle_tpu as pt
 import paddle_tpu.distributed as dist
 
 
+
+pytestmark = pytest.mark.smoke  # core critical-path tier
+
+
 @pytest.fixture(autouse=True)
 def _env():
     dist.init_parallel_env({"dp": 8})

@@ -115,6 +115,7 @@ def test_hybrid_train_step_learns():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow  # duplicated by tests/test_graft_entry.py (slow tier)
 def test_graft_entry_contract():
     import importlib.util
 

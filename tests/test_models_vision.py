@@ -31,6 +31,7 @@ def test_resnet18_forward_and_train_step():
     assert float(loss.numpy()) < loss0
 
 
+@pytest.mark.slow  # 25M-param build+forward
 def test_resnet50_builds():
     from paddle_tpu.vision.models import resnet50
 
@@ -43,6 +44,7 @@ def test_resnet50_builds():
     assert y.shape == [1, 8]
 
 
+@pytest.mark.slow  # 224x224 VGG/AlexNet on one CPU core
 def test_lenet_vgg_alexnet_mobilenet_build():
     from paddle_tpu.vision.models import (LeNet, alexnet, mobilenet_v2,
                                           vgg11)
@@ -74,6 +76,7 @@ def test_fake_data_with_loader():
     assert y.shape == [4]
 
 
+@pytest.mark.slow  # BERT pretrain step, ~40s on one core
 def test_bert_pretraining_step():
     from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
                                         BertPretrainingCriterion)

@@ -11,6 +11,10 @@ from op_test_base import check_grad, check_output
 RNG = np.random.RandomState(7)
 
 
+
+pytestmark = pytest.mark.smoke  # core critical-path tier
+
+
 def rnd(*shape):
     return RNG.randn(*shape).astype(np.float32)
 

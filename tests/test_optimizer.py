@@ -8,6 +8,10 @@ import paddle_tpu.nn as nn
 import paddle_tpu.optimizer as opt
 
 
+
+pytestmark = pytest.mark.smoke  # core critical-path tier
+
+
 def make_param(val):
     p = paddle.Parameter(np.asarray(val, dtype="float32"))
     return p

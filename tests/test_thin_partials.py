@@ -96,7 +96,8 @@ def test_onnx_export_real_model():
             assert proc.returncode == 0, proc.stderr[:400]
             txt = proc.stdout.decode(errors="replace")
             for op in ("Conv", "BatchNormalization", "Relu", "MaxPool",
-                       "GlobalAveragePool", "Flatten", "Gemm", "Softmax"):
+                       "GlobalAveragePool", "Flatten", "MatMul", "Add",
+                       "Softmax"):
                 assert op in txt, f"{op} missing from decoded model"
 
 
