@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import threading
 from collections import deque
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -174,11 +174,16 @@ class GradNode:
         "multi_output",
         "released",
         "split",
+        "primal",
     )
 
-    def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence, outs):
+    def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence, outs,
+                 primal: Optional[Callable] = None):
         self.name = name
         self.vjp_fn = vjp_fn
+        # the op's pure array->array function; lets create_graph replay
+        # the vjp as a *dispatched differentiable op* (double grad)
+        self.primal = primal
         self.inputs = list(inputs)
         # Optional split-backward rule: fn(cotangents) -> (in_grads with
         # None at deferred slots, wgrad_fn) | None. Set by dispatch for ops
@@ -413,6 +418,133 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False, _sink=None)
                 _write_grad(t, g, accumulate=True)
 
 
+def _fire_node_differentiable(node, cot_tensors):
+    """Apply a node's vjp as a *dispatched op*: the returned input-grads
+    are Tensors recorded on the tape, differentiable w.r.t. both the
+    node's primal inputs (residual dependence, via jax.vjp replay of the
+    stored primal) and the incoming cotangents. This is what makes
+    ``create_graph=True`` exact to arbitrary order."""
+    from .dispatch import OpDef, op_call
+
+    if node.released:
+        raise RuntimeError(
+            f"GradNode '{node.name}' has been released; pass "
+            "retain_graph=True to the earlier backward to differentiate "
+            "through it again")
+    if node.primal is None:
+        raise NotImplementedError(
+            f"create_graph through op '{node.name}' (no stored primal; "
+            "e.g. custom PyLayer nodes) is not supported")
+    n_in = len(node.inputs)
+    # optional outputs the op didn't produce: no cotangent exists
+    none_slots = {i for i, sh in enumerate(node.out_shapes) if sh is None}
+    live = [c for i, c in enumerate(cot_tensors) if i not in none_slots]
+    out_dtypes = [d for i, d in enumerate(node.out_dtypes)
+                  if i not in none_slots]
+
+    def impl(*flat):
+        prim, cots = flat[:n_in], list(flat[n_in:])
+        # AMP boundary parity with GradNode._cotangents: cotangents cast
+        # to the primal outputs' dtypes before the vjp
+        cots = [c.astype(d) if d is not None and c.dtype != d else c
+                for c, d in zip(cots, out_dtypes)]
+        full = []
+        k = 0
+        for i in range(node.num_outputs):
+            if i in none_slots:
+                full.append(None)
+            else:
+                full.append(cots[k])
+                k += 1
+        _, vjp_fn = jax.vjp(node.primal, *prim)
+        cot = tuple(full) if node.multi_output else full[0]
+        return tuple(vjp_fn(cot))
+
+    opdef = OpDef(f"{node.name}_vjp", impl, True, "none")
+    res = op_call(opdef, tuple(node.inputs) + tuple(live), {})
+    return res if isinstance(res, tuple) else (res,)
+
+
+def _grad_tensor_mode(outputs, grad_outputs, inputs, allow_unused):
+    """The create_graph walk: same topology as :func:`backward`, but
+    cotangents are Tensors and every node fires through the dispatch
+    funnel (reference double-grad semantics, base/dygraph/base.py:656).
+    Nodes are never released (the graph must survive for the next
+    backward); gradient hooks do not fire on this path."""
+    from .tensor import Tensor
+
+    holder: dict[tuple[int, int], Any] = {}
+    target_ids = {id(t) for t in inputs}
+    sink: dict[int, Any] = {}
+    seeds = []
+
+    def acc(d, key, g):
+        prev = d.get(key)
+        d[key] = g if prev is None else prev + g
+
+    def is_float0(g):
+        return hasattr(g._data, "dtype") and g._data.dtype == \
+            jax.dtypes.float0
+
+    for t, g in zip(outputs, grad_outputs):
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar "
+                    f"outputs; got shape {t.shape}")
+            g = Tensor(jnp.ones(t.shape, t.dtype), stop_gradient=True)
+        node = t._grad_node
+        if id(t) in target_ids:
+            # d(out)/d(out) identity term: a target that is itself a
+            # seeded output receives its seed directly (plus whatever
+            # flows in from other consumers via the walk below)
+            acc(sink, id(t), g)
+        if node is None:
+            continue
+        if node not in seeds:
+            seeds.append(node)
+        acc(holder, (id(node), t._out_slot), g)
+
+    if seeds:
+        nodes, indeg = _discover(seeds)
+        ready = deque(n for n in nodes.values() if indeg[id(n)] == 0)
+        while ready:
+            node = ready.popleft()
+            cots = []
+            for slot in range(node.num_outputs):
+                g = holder.pop((id(node), slot), None)
+                if g is None and node.out_shapes[slot] is not None:
+                    g = Tensor(jnp.zeros(node.out_shapes[slot],
+                                         node.out_dtypes[slot]),
+                               stop_gradient=True)
+                cots.append(g)
+            # absent-optional-output slots stay None; _fire filters them
+            cots = [c for i, c in enumerate(cots)
+                    if node.out_shapes[i] is not None or c is not None]
+            in_grads = _fire_node_differentiable(node, cots)
+            for t, g in zip(node.inputs, in_grads):
+                usable = g is not None and not is_float0(g)
+                if id(t) in target_ids and usable:
+                    acc(sink, id(t), g)
+                up = t._grad_node
+                if up is not None:
+                    if usable:
+                        acc(holder, (id(up), t._out_slot), g)
+                    indeg[id(up)] -= 1
+                    if indeg[id(up)] == 0:
+                        ready.append(up)
+
+    results = []
+    for t in inputs:
+        g = sink.get(id(t))
+        if g is None and not allow_unused:
+            raise RuntimeError(
+                "one of the differentiated tensors appears unused in the "
+                "graph (set allow_unused=True to return None)")
+        results.append(g)
+    return results
+
+
 def _write_grad(t, g, accumulate: bool = False):
     from .tensor import Tensor
 
@@ -434,8 +566,10 @@ def grad(
     python/paddle/base/dygraph/base.py:656).
 
     Returns gradients of ``outputs`` w.r.t. ``inputs`` without touching
-    ``.grad`` on any other tensor. ``create_graph`` is not yet supported on
-    the tape path (use jit-captured jax.grad for higher-order needs).
+    ``.grad`` on any other tensor. ``create_graph=True`` returns grads
+    recorded on the tape (each node fires as a dispatched, differentiable
+    vjp replay of its stored primal), so further backward()/grad() calls
+    through them are exact to arbitrary order; it implies retain_graph.
     """
     from .tensor import Tensor
 
@@ -444,10 +578,17 @@ def grad(
     if isinstance(inputs, Tensor):
         inputs = [inputs]
     if create_graph:
-        raise NotImplementedError(
-            "create_graph=True is not supported on the eager tape; capture "
-            "the computation with paddle_tpu.jit and use functional grads."
-        )
+        # differentiable backward: grads come back ON the tape, so a
+        # further backward()/grad() through them is exact (double grad
+        # and beyond). Implies retain_graph (nodes are not released).
+        if grad_outputs is None:
+            grad_outputs_l = [None] * len(outputs)
+        elif isinstance(grad_outputs, Tensor):
+            grad_outputs_l = [grad_outputs]
+        else:
+            grad_outputs_l = list(grad_outputs)
+        return _grad_tensor_mode(outputs, grad_outputs_l, inputs,
+                                 allow_unused)
     from .tensor import Tensor as _T
 
     # Route all leaf grads into a sink so no tensor's .grad is touched;

@@ -158,7 +158,8 @@ def op_call(opdef: OpDef, args, kwargs):
             return tuple(out) if isinstance(out, list) else out
 
         outs, vjp_fn = jax.vjp(primal, *arrays)
-        node = autograd.GradNode(opdef.name, vjp_fn, leaves, outs)
+        node = autograd.GradNode(opdef.name, vjp_fn, leaves, outs,
+                                 primal=primal)
         rule = SPLIT_VJP.get(opdef.name)
         if rule is not None:
             # Deferrable slots: leaf parameters (no upstream node). The
