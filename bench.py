@@ -147,12 +147,16 @@ def _bench_decode():
     n = 128
     m.generate(prompt, max_new_tokens=n)        # compile (n is static)
     m.generate(prompt, max_new_tokens=1)        # compile prefill-only path
-    t0 = time.perf_counter()
-    m.generate(prompt, max_new_tokens=1)
-    t_prefill = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    m.generate(prompt, max_new_tokens=n)
-    dt = time.perf_counter() - t0 - t_prefill   # decode-only time
+
+    def timed(k):
+        t0 = time.perf_counter()
+        m.generate(prompt, max_new_tokens=k)
+        return time.perf_counter() - t0
+
+    # min-of-2 on both legs: the prefill-subtraction method is sensitive
+    # to per-call jitter over the remote-device tunnel
+    t_prefill = min(timed(1), timed(1))
+    dt = min(timed(n), timed(n)) - t_prefill    # decode-only time
     return {"llama1b_decode_tokens_per_sec": round((n - 1) / dt, 1),
             "llama1b_decode_ms_per_token": round(dt / (n - 1) * 1000, 2),
             "llama1b_prefill_512_ms": round(t_prefill * 1000, 2)}
