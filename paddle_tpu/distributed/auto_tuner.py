@@ -12,9 +12,12 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import json
+import os
 from typing import Callable, Optional, Sequence
 
-__all__ = ["TunerConfig", "Candidate", "AutoTuner", "tune"]
+__all__ = ["TunerConfig", "Candidate", "AutoTuner", "tune",
+           "Recorder", "virtual_mesh_runner"]
 
 
 @dataclasses.dataclass
@@ -41,14 +44,71 @@ class Candidate:
     mp: int
     pp: int
     micro_batch: int
+    zero1: bool = False
+    recompute: bool = False
     est_step_time: float = 0.0
     est_mem_bytes: float = 0.0
     measured_time: Optional[float] = None
     pruned: Optional[str] = None
+    error: Optional[str] = None
 
     @property
     def key(self):
-        return (self.dp, self.mp, self.pp, self.micro_batch)
+        return (self.dp, self.mp, self.pp, self.micro_batch, self.zero1,
+                self.recompute)
+
+
+class Recorder:
+    """Trial history with persistence (reference recorder.py: records
+    every trial's config+metric, sorts by the metric, and lets a re-run
+    resume past already-measured configs). ``fingerprint`` ties the
+    history to one TunerConfig — stale files from a different model or
+    hardware config are discarded instead of silently supplying wrong
+    measured times."""
+
+    def __init__(self, path: Optional[str] = None,
+                 fingerprint: Optional[str] = None):
+        self.path = path
+        self.fingerprint = fingerprint
+        self._rows: dict[tuple, dict] = {}
+        if path and os.path.exists(path):
+            with open(path) as f:
+                data = json.load(f)
+            if isinstance(data, dict):
+                if fingerprint is None or data.get("fingerprint") ==                         fingerprint:
+                    for row in data.get("rows", []):
+                        self._rows[tuple(row["key"])] = row
+
+    def seen(self, cand: Candidate) -> Optional[dict]:
+        return self._rows.get(tuple(cand.key))
+
+    def add(self, cand: Candidate) -> None:
+        self._rows[tuple(cand.key)] = {
+            "key": list(cand.key),
+            "dp": cand.dp, "mp": cand.mp, "pp": cand.pp,
+            "micro_batch": cand.micro_batch, "zero1": cand.zero1,
+            "recompute": cand.recompute,
+            "est_step_time": cand.est_step_time,
+            "est_mem_bytes": cand.est_mem_bytes,
+            "measured_time": cand.measured_time,
+            "pruned": cand.pruned, "error": cand.error,
+        }
+
+    def flush(self) -> None:
+        if self.path:
+            with open(self.path, "w") as f:
+                json.dump({"fingerprint": self.fingerprint,
+                           "rows": self.sorted_rows()}, f, indent=1)
+
+    def sorted_rows(self) -> list[dict]:
+        def metric(r):
+            if r["measured_time"] is not None:
+                return (0, r["measured_time"])
+            if r["pruned"] is None and r["error"] is None:
+                return (1, r["est_step_time"])
+            return (2, float("inf"))
+
+        return sorted(self._rows.values(), key=metric)
 
 
 class AutoTuner:
@@ -69,7 +129,11 @@ class AutoTuner:
                 continue
             per_dp = c.global_batch_size // dp
             for micro in [m for m in (1, 2, 4, 8, 16) if per_dp % m == 0]:
-                out.append(Candidate(dp=dp, mp=mp, pp=pp, micro_batch=micro))
+                for zero1 in ((False, True) if dp > 1 else (False,)):
+                    for rc in (False, True):
+                        out.append(Candidate(dp=dp, mp=mp, pp=pp,
+                                             micro_batch=micro,
+                                             zero1=zero1, recompute=rc))
         return out
 
     # -- prune + cost (reference prune.py / cost model) ---------------------
@@ -82,8 +146,15 @@ class AutoTuner:
         c = self.cfg
         shard = cand.mp * cand.pp  # params divided across mp*pp
         mem = self._param_bytes() / shard
+        if cand.zero1:
+            # ZeRO-1: optimizer moments (8 of the 16 bytes/param) spread
+            # over dp as well
+            mem -= (self._param_bytes() / shard) * (8 / 16) \
+                * (1 - 1 / cand.dp)
         act = (c.global_batch_size // cand.dp) * c.seq_len * c.hidden * 2 \
             * c.n_layers / cand.pp / max(1, cand.micro_batch)
+        if cand.recompute:
+            act /= max(1.0, c.n_layers / cand.pp)  # save boundaries only
         cand.est_mem_bytes = mem + act
         if cand.est_mem_bytes > c.hbm_bytes * 0.9:
             cand.pruned = "memory"
@@ -106,12 +177,20 @@ class AutoTuner:
             t_mp = c.n_layers * bytes_per_layer / c.ici_bandwidth
         bubble = (cand.pp - 1) / max(1, (c.global_batch_size //
                                          cand.dp // cand.micro_batch))
-        cand.est_step_time = (t_compute + t_mp) * (1 + bubble)
+        t = (t_compute + t_mp) * (1 + bubble)
+        if cand.recompute:
+            t *= 4 / 3  # full-block remat recomputes the forward in bwd
+        cand.est_step_time = t
         return cand
 
     # -- drive --------------------------------------------------------------
     def tune(self, runner: Optional[Callable[[Candidate], float]] = None,
-             top_k: int = 3) -> Candidate:
+             top_k: int = 3, recorder: Optional[Recorder] = None) -> Candidate:
+        """Rank candidates by the analytic model, then (with a runner)
+        measure the top-k with real trials. A failing trial is recorded
+        (error) and skipped, not fatal — the reference's failed-job
+        handling. ``recorder`` persists/restores history so a re-run
+        resumes past measured configs."""
         cands = [self.evaluate(c) for c in self.candidates()]
         self.history = cands
         valid = [c for c in cands if c.pruned is None]
@@ -119,14 +198,102 @@ class AutoTuner:
             raise RuntimeError("no feasible parallel config found "
                                f"(searched {len(cands)})")
         valid.sort(key=lambda c: c.est_step_time)
+        if recorder is not None and recorder.fingerprint is None:
+            recorder.fingerprint = self.fingerprint()
         if runner is None:
+            if recorder is not None:
+                for c in cands:
+                    recorder.add(c)
+                recorder.flush()
             return valid[0]
+        # dedup on the layout sub-key: zero1/recompute variants of one
+        # layout would otherwise crowd out genuinely different layouts
+        # from the measured top-k
+        picked, seen_layouts = [], set()
+        for c in valid:
+            layout = (c.dp, c.mp, c.pp, c.micro_batch)
+            if layout in seen_layouts:
+                continue
+            seen_layouts.add(layout)
+            picked.append(c)
+            if len(picked) >= top_k:
+                break
         best, best_t = None, float("inf")
-        for c in valid[:top_k]:
-            c.measured_time = runner(c)
-            if c.measured_time < best_t:
+        for c in picked:
+            prev = recorder.seen(c) if recorder is not None else None
+            if prev is not None and prev.get("measured_time") is not None:
+                c.measured_time = prev["measured_time"]  # resume
+            else:
+                try:
+                    c.measured_time = runner(c)
+                except Exception as e:  # noqa: BLE001 — failed trial
+                    c.error = str(e)[:200]
+                if recorder is not None:
+                    recorder.add(c)
+                    recorder.flush()
+            if c.measured_time is not None and c.measured_time < best_t:
                 best, best_t = c, c.measured_time
+        if best is None:
+            raise RuntimeError("all measured trials failed: "
+                               + "; ".join(c.error or "?" for c in picked))
         return best
+
+    def fingerprint(self) -> str:
+        return json.dumps(dataclasses.asdict(self.cfg), sort_keys=True)
+
+
+def virtual_mesh_runner(tuner_cfg: Optional[TunerConfig] = None,
+                        model_cfg=None, steps: int = 2):
+    """A real-trial runner: builds the actual sharded train step for the
+    candidate's (dp, pp, mp) over the available devices and times real
+    steps (the reference launches subprocess trials; on the virtual CPU
+    mesh the measurement is in-process). The toy model is FIXED across
+    candidates (sized so every divisor-of-n_devices mp/pp divides its
+    heads/layers) — wall-times stay comparable. ``cand.micro_batch`` is
+    a microbatch SIZE (reference convention); it converts to the
+    pipeline's microbatch COUNT here. Returns runner(cand) -> seconds.
+    """
+    import time
+
+    import numpy as np
+
+    def run(cand: Candidate) -> float:
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.gpt import GPTConfig
+        from ..parallel import make_sharded_train_step
+        from .process_mesh import build_mesh
+
+        n = cand.dp * cand.pp * cand.mp
+        if n > len(jax.devices()):
+            raise RuntimeError(f"needs {n} devices")
+        mesh = build_mesh((cand.dp, cand.pp, cand.mp), ("dp", "pp", "mp"))
+        n_dev = (tuner_cfg.n_devices if tuner_cfg is not None
+                 else len(jax.devices()))
+        heads = min(n_dev, 8)
+        cfg = model_cfg or GPTConfig(
+            vocab_size=256, hidden=8 * heads,
+            n_layers=2 * n_dev, n_heads=heads,
+            seq_len=16, dtype=jnp.float32)
+        global_batch = (tuner_cfg.global_batch_size if tuner_cfg is not None
+                        else 2 * n_dev)
+        per_dp = max(1, global_batch // cand.dp)
+        n_micro = max(1, per_dp // max(1, cand.micro_batch))             if cand.pp > 1 else 1
+        step, params, opt = make_sharded_train_step(
+            cfg, mesh, n_microbatches=n_micro, zero1=cand.zero1)
+        rng = np.random.RandomState(0)
+        toks = rng.randint(0, cfg.vocab_size, (global_batch, cfg.seq_len))
+        labs = rng.randint(0, cfg.vocab_size, (global_batch, cfg.seq_len))
+        loss, params, opt = step(params, opt, toks, labs)  # compile
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, params, opt = step(params, opt, toks, labs)
+        float(loss)
+        return (time.perf_counter() - t0) / steps
+
+    return run
 
 
 def tune(tuner_cfg: dict, runner=None) -> Candidate:

@@ -47,7 +47,7 @@ def test_auto_tuner_with_runner():
     best = tuner.tune(runner=runner, top_k=3)
     # the runner makes mp>1 configs fastest; tune must pick a measured one
     assert best.measured_time == min(
-        0.5 if mp > 1 else 1.0 for (dp, mp, pp, mb) in calls)
+        0.5 if mp > 1 else 1.0 for (dp, mp, pp, *_rest) in calls)
     assert len(calls) <= 3
 
 
@@ -136,3 +136,50 @@ def test_parameter_server_pull_push():
         assert empty.shape == (0, 4)
     finally:
         rpc.shutdown()
+
+
+def test_auto_tuner_measured_trials_virtual_mesh(tmp_path):
+    """Real measured trials over the 8-device virtual mesh with a
+    persistent recorder (reference: launched trials + recorder.py)."""
+    from paddle_tpu.distributed.auto_tuner import (AutoTuner, Recorder,
+                                                   TunerConfig,
+                                                   virtual_mesh_runner)
+
+    cfg = TunerConfig(n_devices=8, global_batch_size=16, hidden=64,
+                      n_layers=4, vocab_size=256, seq_len=16,
+                      max_mp=2, max_pp=2)
+    rec_path = str(tmp_path / "trials.json")
+    tuner = AutoTuner(cfg)
+    best = tuner.tune(runner=virtual_mesh_runner(cfg), top_k=2,
+                      recorder=Recorder(rec_path))
+    assert best.measured_time is not None and best.measured_time > 0
+    assert best.dp * best.mp * best.pp == 8
+
+    # resume: a fresh tuner with the same recorder skips re-measurement
+    calls = []
+    def counting_runner(c):
+        calls.append(c.key)
+        return 999.0
+
+    best2 = AutoTuner(cfg).tune(runner=counting_runner, top_k=2,
+                                recorder=Recorder(rec_path))
+    assert calls == []          # all top-k trials resumed from history
+    assert best2.key == best.key
+
+
+def test_auto_tuner_failed_trial_skipped():
+    from paddle_tpu.distributed.auto_tuner import (AutoTuner, TunerConfig)
+
+    cfg = TunerConfig(n_devices=8, global_batch_size=16, hidden=64,
+                      n_layers=4, vocab_size=256, seq_len=16,
+                      max_mp=2, max_pp=2)
+
+    seen = []
+    def flaky(c):
+        seen.append(c.key)
+        if len(seen) == 1:
+            raise RuntimeError("trial OOM")
+        return 1.0
+
+    best = AutoTuner(cfg).tune(runner=flaky, top_k=2)
+    assert best.measured_time == 1.0   # first trial failed, second won
