@@ -122,3 +122,30 @@ def test_zero_sharding_shrinks_per_device_state():
     zero = moment_bytes_on_dev0(opt_zero)
     # most params divide cleanly by 8; allow slack for the remainder
     assert zero < plain / 4, (plain, zero)
+
+
+def test_zero_sharding_shrinks_master_weights():
+    """The fp32 master copies (bf16 compute params) shard over dp too."""
+    import dataclasses
+
+    from paddle_tpu.distributed.process_mesh import build_mesh
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.parallel import make_sharded_train_step
+
+    cfg = GPTConfig(vocab_size=512, hidden=128, n_layers=4, n_heads=4,
+                    seq_len=32, dtype=jnp.bfloat16)  # master mode on
+    mesh = build_mesh((8, 1, 1), ("dp", "pp", "mp"))
+
+    def master_bytes_on_dev0(opt_state):
+        total = 0
+        for leaf in jax.tree.leaves(opt_state["master"]):
+            for shard in leaf.addressable_shards:
+                if shard.device == jax.devices()[0]:
+                    total += shard.data.nbytes
+        return total
+
+    _, _, opt_plain = make_sharded_train_step(cfg, mesh, zero1=False)
+    _, _, opt_zero = make_sharded_train_step(cfg, mesh, zero1=True)
+    assert "master" in opt_zero
+    assert master_bytes_on_dev0(opt_zero) < \
+        master_bytes_on_dev0(opt_plain) / 4
