@@ -63,11 +63,13 @@ def main():
         import dataclasses
 
         # Tuned single-chip flagship config (v5e, 16G HBM): unrolled layer
-        # loop, no remat (activations fit at b8 with bf16 saves), fp32
-        # master weights live in the optimizer state.
+        # loop, no remat (fused-CE killed the giant logit activations, so
+        # b16 fits uncheckpointed and amortizes the ~20 ms of fixed
+        # per-step cost — measured 0.504 MFU vs 0.484 at b8), native
+        # flash layout, bf16 AdamW moments, fp32 master weights.
         cfg = dataclasses.replace(gpt_presets("gpt3-350m"),
                                   unroll=True, remat=False)
-        batch, steps, warmup = 8, 20, 8
+        batch, steps, warmup = 16, 15, 6
     else:  # CI / CPU smoke: tiny model, still exercises the full path
         cfg = GPTConfig(vocab_size=1024, hidden=256, n_layers=4, n_heads=4,
                         seq_len=256)
@@ -76,7 +78,9 @@ def main():
     n_dev = len(jax.devices())
     mesh = build_mesh((n_dev, 1, 1), ("dp", "pp", "mp"))
     step, params, opt_state = make_sharded_train_step(
-        cfg, mesh, lr=1e-4, n_microbatches=1, zero1=n_dev > 1)
+        cfg, mesh, lr=1e-4, n_microbatches=1, zero1=n_dev > 1,
+        m_dtype="bfloat16" if on_tpu else None,
+        v_dtype="bfloat16" if on_tpu else None)
 
     rng = np.random.RandomState(0)
     # stage the batch on device once: re-uploading numpy per step costs an
@@ -122,14 +126,16 @@ def main():
         # discard the already-measured flagship result (the driver contract
         # is one JSON line).
         result["extra"] = {}
-        try:
-            result["extra"].update(_bench_13b())
-        except Exception as e:  # noqa: BLE001
-            result["extra"]["gpt3_1p3b_error"] = str(e)[:200]
+        # decode first: the 1.3B bench fills nearly all HBM, and allocator
+        # pressure after it measurably degrades the decode numbers
         try:
             result["extra"].update(_bench_decode())
         except Exception as e:  # noqa: BLE001
             result["extra"]["llama_decode_error"] = str(e)[:200]
+        try:
+            result["extra"].update(_bench_13b())
+        except Exception as e:  # noqa: BLE001
+            result["extra"]["gpt3_1p3b_error"] = str(e)[:200]
     print(json.dumps(result))
 
 
@@ -163,49 +169,53 @@ def _bench_decode():
 
 
 def _bench_13b():
-    """GPT-3 1.3B single-chip fwd+bwd+SGD-touch (BASELINE.md config 3).
+    """GPT-3 1.3B single-chip FULL AdamW training step (BASELINE.md
+    config 3 — the north-star scale).
 
-    Full AdamW state for 1.3B (5.2G master + 10.4G fp32 moments) exceeds one
-    v5e's 16G HBM — the reference runs this config tensor-parallel across
-    chips (mp_layers.py), which the multichip dryrun exercises. Here we
-    measure the compute path a TP shard runs: forward+backward+param touch,
-    bf16 params, remat. MFU uses the same 6N accounting.
-    """
+    fp32 AdamW state for 1.3B (5.2G master + 10.4G moments) exceeds one
+    v5e's 15.75G, so this uses the memory-lean modes built for exactly
+    this (parallel/train_step.py): bf16 moments and stochastic-rounded
+    bf16 weights with NO master copy — params 2.6G + m 2.6G + v 2.6G +
+    grads 2.6G + remat'd activations at b4 ≈ 15G. The update is a real
+    AdamW (fp32 math), not a parameter touch; loss-trajectory equivalence
+    of the lean state vs fp32 is validated in tests/test_lean_optimizer.py
+    and PERF.md. Reference trains this config tensor-parallel
+    (fleet/layers/mpu/mp_layers.py:334); on-chip memory modes are its
+    sharding/offload analog (group_sharded_stage3.py:85)."""
     import dataclasses
 
-    from paddle_tpu.models.gpt import gpt_presets, init_params, loss_fn
+    from paddle_tpu.models.gpt import gpt_presets
+    from paddle_tpu.parallel import make_sharded_train_step
+    from paddle_tpu.distributed.process_mesh import build_mesh
 
-    cfg = dataclasses.replace(gpt_presets("gpt3-1.3b"), unroll=False)
+    cfg = dataclasses.replace(gpt_presets("gpt3-1.3b"), unroll=True,
+                              remat=True)
     batch, steps = 4, 10
-    params = init_params(cfg, jax.random.PRNGKey(0))
-    params = jax.tree.map(lambda a: a.astype(cfg.dtype), params)
+    mesh = build_mesh((1, 1, 1), ("dp", "pp", "mp"))
+    step, params, opt_state = make_sharded_train_step(
+        cfg, mesh, lr=1e-4, zero1=False, m_dtype="bfloat16",
+        v_dtype="bfloat16", weights="sr-bf16")
     rng = np.random.RandomState(0)
-    toks = jnp.asarray(rng.randint(0, cfg.vocab_size,
-                                   size=(batch, cfg.seq_len)))
-    labs = jnp.asarray(rng.randint(0, cfg.vocab_size,
-                                   size=(batch, cfg.seq_len)))
+    toks = step.put_batch(rng.randint(0, cfg.vocab_size,
+                                      size=(batch, cfg.seq_len)))
+    labs = step.put_batch(rng.randint(0, cfg.vocab_size,
+                                      size=(batch, cfg.seq_len)))
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def step(p):
-        loss, g = jax.value_and_grad(
-            lambda q: loss_fn(q, toks, labs, cfg))(p)
-        # touch-update keeps grads live and mimics an optimizer's
-        # param-write pass without the fp32 state that cannot fit
-        return loss, jax.tree.map(lambda a, b: a - 1e-6 * b, p, g)
-
-    loss, params = step(params)
+    for _ in range(3):
+        loss, params, opt_state = step(params, opt_state, toks, labs)
     float(loss)
     t0 = time.perf_counter()
     for _ in range(steps):
-        loss, params = step(params)
-    float(loss)
+        loss, params, opt_state = step(params, opt_state, toks, labs)
+    final = float(loss)
     dt = time.perf_counter() - t0
     tok_s = batch * cfg.seq_len * steps / dt
     fpt = _flops_per_token(cfg)
     return {
-        "gpt3_1p3b_fwdbwd_tokens_per_sec_per_chip": round(tok_s, 1),
-        "gpt3_1p3b_mfu": round(fpt * tok_s / _peak_flops(), 4),
+        "gpt3_1p3b_train_tokens_per_sec_per_chip": round(tok_s, 1),
+        "gpt3_1p3b_train_mfu": round(fpt * tok_s / _peak_flops(), 4),
         "gpt3_1p3b_step_ms": round(dt / steps * 1000, 2),
+        "gpt3_1p3b_loss": round(final, 4),
     }
 
 

@@ -518,9 +518,10 @@ def _grad_tensor_mode(outputs, grad_outputs, inputs, allow_unused):
                                          node.out_dtypes[slot]),
                                stop_gradient=True)
                 cots.append(g)
-            # absent-optional-output slots stay None; _fire filters them
-            cots = [c for i, c in enumerate(cots)
-                    if node.out_shapes[i] is not None or c is not None]
+            # absent-optional-output slots stay None at their original slot
+            # index; _fire_node_differentiable's none_slots filter is the
+            # single place they are dropped (a second compaction here would
+            # mis-index any non-trailing absent slot).
             in_grads = _fire_node_differentiable(node, cots)
             for t, g in zip(node.inputs, in_grads):
                 usable = g is not None and not is_float0(g)

@@ -135,6 +135,14 @@ define_flag("flash_attention_kernel_bwd", True,
             "the XLA-expression vjp.")
 define_flag("use_library_flash_attention", False,
             "Route flash attention to jax's library TPU kernels.")
+define_flag("use_fused_ce", True,
+            "Use the Pallas fused softmax-CE kernel for the GPT loss on "
+            "TPU (single-program path); 0 falls back to the chunked XLA "
+            "scan.")
+define_flag("flash_attention_native_layout", True,
+            "Flash kernels consume the model's (b, s, h, d) layout via "
+            "lane-fused 2-D blocks (no transpose copies); 0 restores the "
+            "round-2 transpose-based kernels for A/B measurement.")
 define_flag(
     "use_pallas_attention",
     True,

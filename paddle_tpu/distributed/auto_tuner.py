@@ -72,8 +72,13 @@ class Recorder:
         self.fingerprint = fingerprint
         self._rows: dict[tuple, dict] = {}
         if path and os.path.exists(path):
-            with open(path) as f:
-                data = json.load(f)
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+            except (json.JSONDecodeError, OSError):
+                # a run killed mid-write (or a corrupt file) discards the
+                # history — same policy as a fingerprint mismatch
+                data = None
             if isinstance(data, dict):
                 if fingerprint is None or data.get("fingerprint") ==                         fingerprint:
                     for row in data.get("rows", []):
@@ -96,9 +101,13 @@ class Recorder:
 
     def flush(self) -> None:
         if self.path:
-            with open(self.path, "w") as f:
+            # temp file + atomic rename: a crash mid-flush can never leave
+            # a truncated JSON that poisons every later tuner run
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
                 json.dump({"fingerprint": self.fingerprint,
                            "rows": self.sorted_rows()}, f, indent=1)
+            os.replace(tmp, self.path)
 
     def sorted_rows(self) -> list[dict]:
         def metric(r):

@@ -68,7 +68,12 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
         v = v.reshape(B, S, nh, dh)
         if cache_kvs is not None:
             cache = cache_kvs[i]          # [2, B, nh, max_seq, dh]
-            pos = 0 if time_step is None else int(time_step)
+            # time_step may be a traced scalar (the reference passes
+            # TimeStep as a tensor; a jitted decode loop traces it):
+            # dynamic_update_slice and the masks below take it symbolically
+            # — one compiled program serves every position.
+            pos = (jnp.zeros((), jnp.int32) if time_step is None
+                   else jnp.asarray(time_step, jnp.int32))
             kc = jax.lax.dynamic_update_slice(
                 cache[0], jnp.swapaxes(k, 1, 2).astype(cache.dtype),
                 (0, 0, pos, 0))
@@ -88,8 +93,8 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
         kpos = jnp.arange(kh.shape[2])
         valid = kpos < kv_len                       # [K]
         if causal and S > 1:
-            qpos = (0 if time_step is None else int(time_step)) + \
-                jnp.arange(S)
+            qpos = (jnp.zeros((), jnp.int32) if time_step is None
+                    else jnp.asarray(time_step, jnp.int32)) + jnp.arange(S)
             mask = valid[None, :] & (kpos[None, :] <= qpos[:, None])  # [S,K]
             s = jnp.where(mask[None, None], s, -1e30)
         else:

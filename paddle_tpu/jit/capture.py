@@ -110,13 +110,24 @@ def _guard_key(args, kwargs, n_state):
         import numpy as _np
 
         if isinstance(o, (_np.ndarray, jax.Array)):
-            # baked as a trace-time constant: guard on exact content
-            # (repr truncates large arrays — a silent mis-capture)
+            if _is_traceable_array(o):
+                # raw numeric arrays are TRACED INPUTS (extracted by
+                # _extract_arrays), so the guard is shape/dtype like Tensor
+                # args — a training loop feeding fresh numpy batches reuses
+                # one compiled program instead of content-hash re-tracing
+                # every step
+                return ("__nd__", tuple(o.shape), str(o.dtype))
+            # non-numeric dtype (str/object/datetime): stays a baked
+            # trace-time constant, so guard on exact content (repr
+            # truncates large arrays — a silent mis-capture)
             import hashlib
 
             arr = _np.asarray(o)
-            return ("__nd__", arr.shape, str(arr.dtype),
-                    hashlib.sha1(arr.tobytes()).hexdigest())
+            return ("__ndconst__", arr.shape, str(arr.dtype),
+                    hashlib.sha1(arr.tobytes()
+                                 if arr.dtype != object
+                                 else repr(arr.tolist()).encode()
+                                 ).hexdigest())
         try:
             hash(o)
             return o
@@ -131,10 +142,30 @@ def _guard_key(args, kwargs, n_state):
     return (spec(list(args)), spec(kwargs), n_state)
 
 
+def _is_traceable_array(o) -> bool:
+    """jax can only take numeric/bool arrays as jit inputs; str/object/
+    datetime arrays must stay baked constants."""
+    import numpy as _np
+
+    try:
+        return (_np.issubdtype(o.dtype, _np.number)
+                or _np.issubdtype(o.dtype, _np.bool_))
+    except Exception:  # noqa: BLE001 — exotic dtype objects
+        return False
+
+
 def _extract_arrays(obj, out: list):
+    import numpy as _np
+
     if isinstance(obj, Tensor):
         out.append(obj._data)
         return ("__tensor__", len(out) - 1, obj.stop_gradient)
+    if isinstance(obj, (_np.ndarray, jax.Array)) and _is_traceable_array(obj):
+        # raw numeric arrays ride as traced inputs too (see _guard_key):
+        # content changes never re-trace, and large batches are never baked
+        # into the program as constants
+        out.append(obj)
+        return ("__array__", len(out) - 1)
     if isinstance(obj, (list, tuple)):
         return type(obj)(_extract_arrays(o, out) for o in obj)
     if isinstance(obj, dict):
@@ -146,6 +177,8 @@ def _rebuild_tensors(tpl, arrays):
     if isinstance(tpl, tuple) and len(tpl) == 3 and tpl[0] == "__tensor__":
         t = Tensor(arrays[tpl[1]], stop_gradient=tpl[2])
         return t
+    if isinstance(tpl, tuple) and len(tpl) == 2 and tpl[0] == "__array__":
+        return arrays[tpl[1]]
     if isinstance(tpl, (list, tuple)):
         return type(tpl)(_rebuild_tensors(o, arrays) for o in tpl)
     if isinstance(tpl, dict):

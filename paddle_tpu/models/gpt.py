@@ -338,11 +338,40 @@ def loss_fn(params, tokens, labels, cfg: GPTConfig, sp_constraint=None,
     """Causal LM cross-entropy in fp32 (the reference's
     ParallelCrossEntropy semantics for mp-sharded logits come from GSPMD
     partitioning the log-sum-exp). ``loss_chunk`` > 0 streams the vocab
-    projection (see _chunked_ce); 0 materializes full logits."""
+    projection (see _chunked_ce); 0 materializes full logits.
+
+    On TPU the Pallas fused softmax-CE kernel (ops/pallas/fused_ce.py)
+    replaces the chunked scan: profiling showed the scan spending
+    ~44 ms/step at 350m/b8 materializing fp32 logit chunks — the fused
+    kernel streams vocab tiles through VMEM instead (the reference's
+    c_softmax_with_cross_entropy kernel role). Single-program path only:
+    under mp-sharding GSPMD handles the chunked expression better, so the
+    fused kernel is gated to unsharded/dp-only runs via
+    FLAGS_use_fused_ce."""
     if loss_chunk:
         hidden, aux = model_apply(params, tokens, cfg, sp_constraint,
                                   blocks_fn, return_hidden=True)
         head = (params["wte"].T if cfg.tie_embeddings else params["head_w"])
+        from ..core.flags import GLOBAL_FLAGS
+        from ..ops.pallas.fused_ce import fused_ce_supported, fused_softmax_ce
+
+        B, T = tokens.shape
+        # single-device only: pallas custom calls have no GSPMD
+        # partitioning rule, so under dp>1 the kernel would force an
+        # all-gather/replication (or fail to partition) where the chunked
+        # expression shards cleanly
+        use_fused = (jax.default_backend() == "tpu"
+                     and len(jax.devices()) == 1
+                     and sp_constraint is None and blocks_fn is None
+                     and fused_ce_supported(B * T, cfg.hidden,
+                                            cfg.vocab_size)
+                     and (GLOBAL_FLAGS.get("use_fused_ce")
+                          if GLOBAL_FLAGS.has("use_fused_ce") else True))
+        if use_fused:
+            nll_tok = fused_softmax_ce(
+                hidden.reshape(B * T, cfg.hidden), head.astype(cfg.dtype),
+                labels.reshape(B * T))
+            return nll_tok.mean() + 0.01 * aux
         nll = _chunked_ce(hidden, head.astype(cfg.dtype), labels, loss_chunk)
         return nll + 0.01 * aux
     logits, aux = model_apply(params, tokens, cfg, sp_constraint, blocks_fn)

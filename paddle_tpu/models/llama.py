@@ -264,7 +264,7 @@ def _decode_block(bp, x, cache_k, cache_v, pos, cfg: LlamaConfig, cos, sin):
     from ..ops.pallas.decode_attention import (decode_attention,
                                                decode_attention_supported)
 
-    if decode_attention_supported(cache_k.shape, dH):
+    if decode_attention_supported(cache_k.shape, dH, num_heads=nH):
         # Pallas serving kernel: no GQA repeat materialization, k-loop
         # bounded by pos (ops/pallas/decode_attention.py)
         o = decode_attention(q[:, 0], cache_k, cache_v, pos,

@@ -30,10 +30,18 @@ from .flash_attention import _interpret_mode
 BLOCK_S = 512
 
 
-def decode_attention_supported(cache_shape, head_dim: int) -> bool:
-    _, _, S, d = cache_shape           # [B, nKV, S, d]
+def decode_attention_supported(cache_shape, head_dim: int,
+                               num_heads: int | None = None) -> bool:
+    _, nKV, S, d = cache_shape         # [B, nKV, S, d]
     if d not in (64, 128, 256):
         return False
+    if num_heads is not None:
+        # the q block is [G, d] with G = nH // nKV: require exact
+        # divisibility, and G >= 2 so the second-minor block dim is never
+        # a 1-row tile (a Mosaic-tiling hazard on real TPU that interpret
+        # -mode tests would not catch; MHA G=1 takes the XLA path)
+        if num_heads % nKV or num_heads // nKV < 2:
+            return False
     # the kernel slices fixed BLOCK_S-wide k/v windows: S must be one
     # block (any 128-multiple) or a whole number of blocks — otherwise
     # dynamic-slice clamping would silently misalign the position mask

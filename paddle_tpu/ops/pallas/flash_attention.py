@@ -8,6 +8,20 @@ MXU (128-lane) with fp32 running max/sum accumulators.
 
 Layout contract matches the reference flash_attn API: q/k/v are
 [batch, seq, num_heads, head_dim].
+
+Two kernel layouts (round 3):
+
+- **native** (default): kernels read/write the model's (b, s, h, d)
+  layout through a free (b, s, h*d) reshape — 2-D [block, hp*d] blocks
+  whose lane width is always a 128-multiple (hp heads per program; for
+  d=64, hp=2 and per-head access is a rank-preserving static lane
+  slice). This removes the (b,s,h,d)<->(b,h,s,d) transpose copies that
+  cost ~20 ms/step at 350m/b8 (PERF.md round-2 table). Mosaic's
+  last-two-block-dims rule (divisible by (8, 128) or equal to the array
+  dim) rules out blocking h directly in second-minor position — hence
+  the lane-fused view.
+- **transpose** (FLAGS_flash_attention_native_layout=0): the round-2
+  kernels on swapaxes'd [b, h, s, d] arrays, kept for A/B measurement.
 """
 
 from __future__ import annotations
@@ -51,8 +65,9 @@ def _tpu_params(n_parallel: int):
 # Pallas path.
 BLOCK_Q = 512
 BLOCK_K = 512
-# Heads processed per grid program (static unrolled loop in the kernels):
-# amortizes the per-grid-step latency and enlarges DMAs.
+# Heads processed per grid program in the transpose layout (static
+# unrolled loop in the kernels): amortizes the per-grid-step latency and
+# enlarges DMAs.
 HEAD_BLOCK = 4
 
 _MIN_BLOCK = 128
@@ -89,6 +104,198 @@ def _head_block(h: int) -> int:
     return hb
 
 
+def _heads_per_program(h: int, d: int) -> int:
+    """Native layout: heads fused per program so the 2-D block lane width
+    hp*d is a 128-multiple (d=64 -> 2, d>=128 -> 1)."""
+    return max(1, 128 // d)
+
+
+def _native_supported(h: int, d: int) -> bool:
+    hp = _heads_per_program(h, d)
+    return h % hp == 0 and (hp * d) % 128 == 0
+
+
+def _causal_bounds(q_idx, bq, block_k, seq_len, causal):
+    """(num_full_blocks, num_k_blocks): k blocks entirely below the
+    diagonal need no mask; blocks crossing it do; blocks above are
+    skipped outright."""
+    num_k_blocks = seq_len // block_k
+    num_full_blocks = num_k_blocks
+    if causal:
+        num_full_blocks = jax.lax.div(q_idx * bq, block_k)
+        num_k_blocks = jax.lax.div((q_idx + 1) * bq + block_k - 1, block_k)
+    return num_full_blocks, num_k_blocks
+
+
+# ---------------------------------------------------------------------------
+# native-layout kernels: (b, s, h*d) views, 2-D blocks, hp heads/program
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel_native(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *,
+                             causal, sm_scale, block_k, seq_len, hp, d):
+    import jax.experimental.pallas as pl
+
+    q_idx = pl.program_id(2)
+    bq = q_ref.shape[0]
+    q_offs = q_idx * bq + jax.lax.iota(jnp.int32, bq)
+    num_full_blocks, num_k_blocks = _causal_bounds(q_idx, bq, block_k,
+                                                   seq_len, causal)
+
+    ql = q_ref[...]                                 # [bq, hp*d]
+    outs = []
+    for j in range(hp):
+        # per-head lane slice (rank-preserving; for d>=128, hp=1 and this
+        # is the whole block). Keep q/k in their input dtype (bf16 on
+        # TPU): the MXU runs bf16 inputs with fp32 accumulation at full
+        # rate, while fp32xfp32 dots run ~8x slower.
+        q = ql[:, j * d:(j + 1) * d]                # [bq, d]
+
+        m_i = jnp.full((bq,), -1e30, jnp.float32)
+        l_i = jnp.zeros((bq,), jnp.float32)
+        acc = jnp.zeros((bq, d), jnp.float32)
+
+        def body(kb, carry, *, masked, j=j, q=q):
+            m_i, l_i, acc = carry
+            k = k_ref[pl.dslice(kb * block_k, block_k),
+                      j * d:(j + 1) * d]            # [bk, d]
+            v = v_ref[pl.dslice(kb * block_k, block_k),
+                      j * d:(j + 1) * d]
+            s = jnp.dot(q, k.T,
+                        preferred_element_type=jnp.float32) * sm_scale
+            if masked:
+                k_offs = kb * block_k + jax.lax.iota(jnp.int32, block_k)
+                s = jnp.where(q_offs[:, None] >= k_offs[None, :], s, -1e30)
+            m_new = jnp.maximum(m_i, jnp.max(s, axis=1))
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m_i - m_new)
+            l_new = alpha * l_i + jnp.sum(p, axis=1)
+            acc_new = acc * alpha[:, None] + jnp.dot(
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+
+        carry = jax.lax.fori_loop(0, num_full_blocks,
+                                  functools.partial(body, masked=False),
+                                  (m_i, l_i, acc))
+        m_i, l_i, acc = jax.lax.fori_loop(num_full_blocks, num_k_blocks,
+                                          functools.partial(body,
+                                                            masked=causal),
+                                          carry)
+        outs.append((acc / l_i[:, None]).astype(o_ref.dtype))
+        if lse_ref is not None:
+            lse_ref[j] = jnp.broadcast_to((m_i + jnp.log(l_i))[None, :],
+                                          lse_ref.shape[1:])
+    o_ref[...] = outs[0] if hp == 1 else jnp.concatenate(outs, axis=1)
+
+
+def _flash_bwd_dq_kernel_native(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                delta_ref, dq_ref, *, causal, sm_scale,
+                                block_k, seq_len, hp, d):
+    import jax.experimental.pallas as pl
+
+    q_idx = pl.program_id(2)
+    bq = q_ref.shape[0]
+    q_offs = q_idx * bq + jax.lax.iota(jnp.int32, bq)
+    num_full_blocks, num_k_blocks = _causal_bounds(q_idx, bq, block_k,
+                                                   seq_len, causal)
+
+    ql = q_ref[...]                                  # [bq, hp*d]
+    dol = do_ref[...]
+    outs = []
+    for j in range(hp):
+        q = ql[:, j * d:(j + 1) * d]
+        do = dol[:, j * d:(j + 1) * d]
+        lse = lse_ref[j, 0, :]                       # [bq] (8-row packed)
+        delta = delta_ref[j, 0, :]
+
+        def body(kb, dq, *, masked, j=j, q=q, do=do, lse=lse, delta=delta):
+            k = k_ref[pl.dslice(kb * block_k, block_k), j * d:(j + 1) * d]
+            v = v_ref[pl.dslice(kb * block_k, block_k), j * d:(j + 1) * d]
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+            p = jnp.exp(s - lse[:, None])
+            if masked:
+                k_offs = kb * block_k + jax.lax.iota(jnp.int32, block_k)
+                p = jnp.where(q_offs[:, None] >= k_offs[None, :], p, 0.0)
+            dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta[:, None])).astype(k.dtype)
+            return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+        dq = jax.lax.fori_loop(0, num_full_blocks,
+                               functools.partial(body, masked=False),
+                               jnp.zeros((bq, d), jnp.float32))
+        dq = jax.lax.fori_loop(num_full_blocks, num_k_blocks,
+                               functools.partial(body, masked=causal), dq)
+        outs.append((dq * sm_scale).astype(dq_ref.dtype))
+    dq_ref[...] = outs[0] if hp == 1 else jnp.concatenate(outs, axis=1)
+
+
+def _flash_bwd_dkv_kernel_native(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                 delta_ref, dk_ref, dv_ref, *, causal,
+                                 sm_scale, block_q, seq_len, hp, d):
+    import jax.experimental.pallas as pl
+
+    k_idx = pl.program_id(2)
+    bk = k_ref.shape[0]
+    k_offs = k_idx * bk + jax.lax.iota(jnp.int32, bk)
+
+    num_q_blocks = seq_len // block_q
+    start_q = 0
+    # q blocks from start_q up to end_masked cross the diagonal (need the
+    # mask); from end_masked on, every q in the tile sees every k.
+    end_masked = 0
+    if causal:
+        start_q = jax.lax.div(k_idx * bk, block_q)
+        end_masked = jax.lax.min(
+            jax.lax.div((k_idx + 1) * bk + block_q - 1, block_q),
+            num_q_blocks)
+
+    kl = k_ref[...]                                  # [bk, hp*d]
+    vl = v_ref[...]
+    dks, dvs = [], []
+    for j in range(hp):
+        k = kl[:, j * d:(j + 1) * d]
+        v = vl[:, j * d:(j + 1) * d]
+
+        def body(qb, carry, *, masked, j=j, k=k, v=v):
+            dk, dv = carry
+            q = q_ref[pl.dslice(qb * block_q, block_q), j * d:(j + 1) * d]
+            do = do_ref[pl.dslice(qb * block_q, block_q), j * d:(j + 1) * d]
+            lse = lse_ref[j, 0, pl.dslice(qb * block_q, block_q)]
+            delta = delta_ref[j, 0, pl.dslice(qb * block_q, block_q)]
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+            p = jnp.exp(s - lse[:, None])
+            if masked:
+                q_offs = qb * block_q + jax.lax.iota(jnp.int32, block_q)
+                p = jnp.where(q_offs[:, None] >= k_offs[None, :], p, 0.0)
+            p_lo = p.astype(do.dtype)
+            dv_new = dv + jnp.dot(p_lo.T, do,
+                                  preferred_element_type=jnp.float32)
+            dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta[:, None])).astype(q.dtype)
+            dk_new = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+            return dk_new, dv_new
+
+        zero = (jnp.zeros((bk, d), jnp.float32),
+                jnp.zeros((bk, d), jnp.float32))
+        dk, dv = jax.lax.fori_loop(start_q, end_masked,
+                                   functools.partial(body, masked=causal),
+                                   zero)
+        dk, dv = jax.lax.fori_loop(jax.lax.max(start_q, end_masked),
+                                   num_q_blocks,
+                                   functools.partial(body, masked=False),
+                                   (dk, dv))
+        # s was scaled but dk accumulated against unscaled q: scale once.
+        dks.append((dk * sm_scale).astype(dk_ref.dtype))
+        dvs.append(dv.astype(dv_ref.dtype))
+    dk_ref[...] = dks[0] if hp == 1 else jnp.concatenate(dks, axis=1)
+    dv_ref[...] = dvs[0] if hp == 1 else jnp.concatenate(dvs, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# transpose-layout kernels (round 2; FLAGS_flash_attention_native_layout=0)
+# ---------------------------------------------------------------------------
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, causal,
                       sm_scale, block_k, seq_len, head_block):
     import jax.experimental.pallas as pl
@@ -96,15 +303,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, causal,
     q_idx = pl.program_id(2)
     bq = q_ref.shape[1]
     q_offs = q_idx * bq + jax.lax.iota(jnp.int32, bq)
-
-    num_k_blocks = seq_len // block_k
-    # Causal split: blocks entirely below the diagonal need no mask (and no
-    # per-element select); only blocks crossing it do. Blocks entirely above
-    # the diagonal are skipped outright.
-    num_full_blocks = num_k_blocks
-    if causal:
-        num_full_blocks = jax.lax.div(q_idx * bq, block_k)
-        num_k_blocks = jax.lax.div((q_idx + 1) * bq + block_k - 1, block_k)
+    num_full_blocks, num_k_blocks = _causal_bounds(q_idx, bq, block_k,
+                                                   seq_len, causal)
 
     # Static python loop over the head block: one grid program handles
     # head_block heads, amortizing the per-program grid-step latency
@@ -151,54 +351,6 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, causal,
                                           lse_ref.shape[1:])
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "sm_scale",
-                                             "with_lse"))
-def _flash_fwd(q, k, v, causal: bool, sm_scale: float, with_lse: bool = False):
-    import jax.experimental.pallas as pl
-
-    b, s, h, d = q.shape
-    # kernel works on [b, h, s, d]
-    qt = jnp.swapaxes(q, 1, 2)
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
-
-    block_q, block_k = _block_sizes(s)
-    hb = _head_block(h)
-
-    grid = (b, h // hb, s // block_q)
-    out_shapes = [jax.ShapeDtypeStruct((b, h, s, d), q.dtype)]
-    out_specs = [pl.BlockSpec((None, hb, block_q, d),
-                              lambda ib, ih, iq: (ib, ih, iq, 0))]
-    if with_lse:
-        # rank-4 with an 8-row broadcast dim: Pallas TPU requires the last
-        # two block dims divisible by (8, 128), ruling out rank-1 blocks
-        out_shapes.append(jax.ShapeDtypeStruct((b, h, 8, s), jnp.float32))
-        out_specs.append(pl.BlockSpec((None, hb, 8, block_q),
-                                      lambda ib, ih, iq: (ib, ih, 0, iq)))
-    kern = functools.partial(
-        _flash_fwd_kernel, causal=causal, sm_scale=sm_scale,
-        block_k=block_k, seq_len=s, head_block=hb)
-    if not with_lse:
-        kern = functools.partial(kern, lse_ref=None)
-    res = pl.pallas_call(
-        kern,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((None, hb, block_q, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
-            pl.BlockSpec((None, hb, s, d), lambda ib, ih, iq: (ib, ih, 0, 0)),
-            pl.BlockSpec((None, hb, s, d), lambda ib, ih, iq: (ib, ih, 0, 0)),
-        ],
-        out_specs=out_specs if with_lse else out_specs[0],
-        out_shape=out_shapes if with_lse else out_shapes[0],
-        interpret=_interpret_mode(),
-        compiler_params=_tpu_params(2),
-    )(qt, kt, vt)
-    if with_lse:
-        out, lse = res
-        return jnp.swapaxes(out, 1, 2), lse
-    return jnp.swapaxes(res, 1, 2)
-
-
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, *, causal, sm_scale, block_k, seq_len,
                          head_block):
@@ -208,12 +360,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     bq = q_ref.shape[1]
     d = q_ref.shape[-1]
     q_offs = q_idx * bq + jax.lax.iota(jnp.int32, bq)
-
-    num_k_blocks = seq_len // block_k
-    num_full_blocks = num_k_blocks
-    if causal:
-        num_full_blocks = jax.lax.div(q_idx * bq, block_k)
-        num_k_blocks = jax.lax.div((q_idx + 1) * bq + block_k - 1, block_k)
+    num_full_blocks, num_k_blocks = _causal_bounds(q_idx, bq, block_k,
+                                                   seq_len, causal)
 
     # All dots stay in the input dtype (bf16 on TPU) with fp32 accumulation;
     # softmax math (exp, ds) stays fp32. Static head-block loop as in fwd.
@@ -302,28 +450,171 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[i] = dv.astype(dv_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "sm_scale"))
-def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float):
+# ---------------------------------------------------------------------------
+# jit wrappers
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "sm_scale",
+                                             "with_lse", "native"))
+def _flash_fwd(q, k, v, causal: bool, sm_scale: float, with_lse: bool = False,
+               native: bool = True):
+    import jax.experimental.pallas as pl
+
+    b, s, h, d = q.shape
+    block_q, block_k = _block_sizes(s)
+    native = native and _native_supported(h, d)
+
+    if native:
+        hp = _heads_per_program(h, d)
+        hd = hp * d
+        # free reshapes: (b, s, h, d) -> (b, s, h*d) is contiguous
+        qf = q.reshape(b, s, h * d)
+        kf = k.reshape(b, s, h * d)
+        vf = v.reshape(b, s, h * d)
+        grid = (b, h // hp, s // block_q)
+        q_spec = pl.BlockSpec((None, block_q, hd),
+                              lambda ib, ih, iq: (ib, iq, ih))
+        kv_spec = pl.BlockSpec((None, s, hd),
+                               lambda ib, ih, iq: (ib, 0, ih))
+        out_shapes = [jax.ShapeDtypeStruct((b, s, h * d), q.dtype)]
+        out_specs = [q_spec]
+        if with_lse:
+            # lse stays head-major (b, h, 8, s) in both modes — it is tiny
+            # (b*h*s fp32), so its layout never costs a large copy. Block
+            # covers this program's hp heads.
+            out_shapes.append(jax.ShapeDtypeStruct((b, h, 8, s),
+                                                   jnp.float32))
+            out_specs.append(pl.BlockSpec((None, hp, 8, block_q),
+                                          lambda ib, ih, iq: (ib, ih, 0, iq)))
+        kern = functools.partial(
+            _flash_fwd_kernel_native, causal=causal, sm_scale=sm_scale,
+            block_k=block_k, seq_len=s, hp=hp, d=d)
+        if not with_lse:
+            kern = functools.partial(kern, lse_ref=None)
+        res = pl.pallas_call(
+            kern,
+            grid=grid,
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=out_specs if with_lse else out_specs[0],
+            out_shape=out_shapes if with_lse else out_shapes[0],
+            interpret=_interpret_mode(),
+            compiler_params=_tpu_params(2),
+        )(qf, kf, vf)
+        if with_lse:
+            out, lse = res
+            return out.reshape(b, s, h, d), lse
+        return res.reshape(b, s, h, d)
+
+    # transpose layout: kernel works on [b, h, s, d]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    hb = _head_block(h)
+    grid = (b, h // hb, s // block_q)
+    out_shapes = [jax.ShapeDtypeStruct((b, h, s, d), q.dtype)]
+    out_specs = [pl.BlockSpec((None, hb, block_q, d),
+                              lambda ib, ih, iq: (ib, ih, iq, 0))]
+    if with_lse:
+        # rank-4 with an 8-row broadcast dim: Pallas TPU requires the last
+        # two block dims divisible by (8, 128), ruling out rank-1 blocks
+        out_shapes.append(jax.ShapeDtypeStruct((b, h, 8, s), jnp.float32))
+        out_specs.append(pl.BlockSpec((None, hb, 8, block_q),
+                                      lambda ib, ih, iq: (ib, ih, 0, iq)))
+    kern = functools.partial(
+        _flash_fwd_kernel, causal=causal, sm_scale=sm_scale,
+        block_k=block_k, seq_len=s, head_block=hb)
+    if not with_lse:
+        kern = functools.partial(kern, lse_ref=None)
+    res = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, hb, block_q, d),
+                         lambda ib, ih, iq: (ib, ih, iq, 0)),
+            pl.BlockSpec((None, hb, s, d), lambda ib, ih, iq: (ib, ih, 0, 0)),
+            pl.BlockSpec((None, hb, s, d), lambda ib, ih, iq: (ib, ih, 0, 0)),
+        ],
+        out_specs=out_specs if with_lse else out_specs[0],
+        out_shape=out_shapes if with_lse else out_shapes[0],
+        interpret=_interpret_mode(),
+        compiler_params=_tpu_params(2),
+    )(qt, kt, vt)
+    if with_lse:
+        out, lse = res
+        return jnp.swapaxes(out, 1, 2), lse
+    return jnp.swapaxes(res, 1, 2)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "native"))
+def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float,
+               native: bool = True):
     """Tiled backward: dq over q-blocks, dk/dv over k-blocks, never
     materializing the [S, S] score matrix (the role of the reference's
     flash_attn_bwd CUDA kernels, flash_attn_grad_kernel.cu)."""
     import jax.experimental.pallas as pl
 
     b, s, h, d = q.shape
-    qt = jnp.swapaxes(q, 1, 2)
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
-    # do stays in the compute dtype for the kernel dots; delta (a reduction)
-    # is computed in the ORIGINAL [b, s, h, d] layout so o never needs the
-    # 16MB-per-layer [b,h,s,d] transpose — only the tiny [b,s,h] reduction
-    # result gets permuted.
-    dot_ = jnp.swapaxes(do, 1, 2).astype(q.dtype)
+    native = native and _native_supported(h, d)
+    # delta (a reduction) is computed in the ORIGINAL [b, s, h, d] layout so
+    # o never needs a 16MB-per-layer transpose — only the tiny [b,s,h]
+    # reduction result gets permuted (lse/delta keep the head-major packed
+    # layout in both modes).
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                                   # [b, s, h]
     delta = jnp.transpose(delta, (0, 2, 1))                    # [b, h, s]
     delta = jnp.broadcast_to(delta[:, :, None, :], (b, h, 8, s))
 
     block_q, block_k = _block_sizes(s)
+
+    if native:
+        hp = _heads_per_program(h, d)
+        hd = hp * d
+        qf = q.reshape(b, s, h * d)
+        kf = k.reshape(b, s, h * d)
+        vf = v.reshape(b, s, h * d)
+        dof = do.astype(q.dtype).reshape(b, s, h * d)
+        blk_q = pl.BlockSpec((None, block_q, hd),
+                             lambda ib, ih, iq: (ib, iq, ih))
+        blk_k = pl.BlockSpec((None, block_k, hd),
+                             lambda ib, ih, ik: (ib, ik, ih))
+        full = pl.BlockSpec((None, s, hd), lambda ib, ih, i: (ib, 0, ih))
+        pack_q = pl.BlockSpec((None, hp, 8, block_q),
+                              lambda ib, ih, iq: (ib, ih, 0, iq))
+        full_pack = pl.BlockSpec((None, hp, 8, s),
+                                 lambda ib, ih, ik: (ib, ih, 0, 0))
+
+        dq = pl.pallas_call(
+            functools.partial(_flash_bwd_dq_kernel_native, causal=causal,
+                              sm_scale=sm_scale, block_k=block_k, seq_len=s,
+                              hp=hp, d=d),
+            grid=(b, h // hp, s // block_q),
+            in_specs=[blk_q, full, full, blk_q, pack_q, pack_q],
+            out_specs=blk_q,
+            out_shape=jax.ShapeDtypeStruct((b, s, h * d), q.dtype),
+            interpret=_interpret_mode(),
+            compiler_params=_tpu_params(2),
+        )(qf, kf, vf, dof, lse, delta)
+
+        dk, dv = pl.pallas_call(
+            functools.partial(_flash_bwd_dkv_kernel_native, causal=causal,
+                              sm_scale=sm_scale, block_q=block_q, seq_len=s,
+                              hp=hp, d=d),
+            grid=(b, h // hp, s // block_k),
+            in_specs=[full, blk_k, blk_k, full, full_pack, full_pack],
+            out_specs=[blk_k, blk_k],
+            out_shape=[jax.ShapeDtypeStruct((b, s, h * d), k.dtype),
+                       jax.ShapeDtypeStruct((b, s, h * d), v.dtype)],
+            interpret=_interpret_mode(),
+            compiler_params=_tpu_params(2),
+        )(qf, kf, vf, dof, lse, delta)
+        return (dq.reshape(b, s, h, d), dk.reshape(b, s, h, d),
+                dv.reshape(b, s, h, d))
+
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    dot_ = jnp.swapaxes(do, 1, 2).astype(q.dtype)
     hb = _head_block(h)
 
     full = lambda ib, ih, i: (ib, ih, 0, 0)
@@ -452,16 +743,24 @@ def flash_attention_raw(q, k, v, causal: bool = False, sm_scale: float | None = 
     use_kernel_bwd = (GLOBAL_FLAGS.get("flash_attention_kernel_bwd")
                       if GLOBAL_FLAGS.has("flash_attention_kernel_bwd")
                       else True)
+    # Native (b,s,h,d) kernel layout (default): kernels consume the model
+    # layout via lane-fused 2-D blocks, eliminating the head-major
+    # transpose copies. FLAGS_flash_attention_native_layout=0 restores the
+    # transpose-based path for A/B measurement.
+    native = (GLOBAL_FLAGS.get("flash_attention_native_layout")
+              if GLOBAL_FLAGS.has("flash_attention_native_layout")
+              else True)
 
     @jax.custom_vjp
     def fa(q, k, v):
-        return _flash_fwd(q, k, v, causal, scale)
+        return _flash_fwd(q, k, v, causal, scale, native=native)
 
     if use_kernel_bwd:
         def fwd(q, k, v):
             from jax.ad_checkpoint import checkpoint_name
 
-            o, lse = _flash_fwd(q, k, v, causal, scale, with_lse=True)
+            o, lse = _flash_fwd(q, k, v, causal, scale, with_lse=True,
+                                native=native)
             # Under jax.checkpoint, pallas outputs are not "dots", so a
             # dots-saveable policy would recompute the whole flash forward
             # in backward. Naming them lets the model's remat policy save
@@ -472,7 +771,8 @@ def flash_attention_raw(q, k, v, causal: bool = False, sm_scale: float | None = 
 
         def bwd(res, g):
             q, k, v, o, lse = res
-            return _flash_bwd(q, k, v, o, lse, g, causal, scale)
+            return _flash_bwd(q, k, v, o, lse, g, causal, scale,
+                              native=native)
     else:
         def fwd(q, k, v):
             return fa(q, k, v), (q, k, v)

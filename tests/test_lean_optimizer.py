@@ -1,0 +1,148 @@
+"""Memory-lean AdamW moment storage (round 3, VERDICT item 1/2).
+
+int8 (blockwise absmax) m + bf16 v must track fp32-moment AdamW closely:
+unit round-trip accuracy, a step-by-step comparison on a toy problem, and
+the end-to-end sharded train step building/running with lean moments.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.parallel.train_step import (
+    _dequantize_moment, _quantize_moment, adamw_init, adamw_update)
+
+pytestmark = pytest.mark.smoke
+
+
+def test_quant_roundtrip():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(37, 130).astype(np.float32) *
+                    rng.uniform(0.01, 10, size=(37, 1)).astype(np.float32))
+    q = _quantize_moment(x)
+    assert q["qm"].dtype == jnp.int8
+    back = _dequantize_moment(q, x)
+    # blockwise absmax: error bounded by blockmax/254 per element
+    err = np.abs(np.asarray(back - x))
+    assert err.max() <= float(jnp.abs(x).max()) / 127.0 + 1e-6
+
+
+def test_quant_zero_and_shape_preserved():
+    x = jnp.zeros((5, 7), jnp.float32)
+    q = _quantize_moment(x)
+    back = _dequantize_moment(q, x)
+    assert back.shape == (5, 7)
+    np.testing.assert_array_equal(np.asarray(back), 0.0)
+
+
+@pytest.mark.parametrize("m_dtype,v_dtype", [("int8", "bfloat16"),
+                                             ("bfloat16", "bfloat16")])
+def test_lean_adamw_tracks_fp32(m_dtype, v_dtype):
+    """30 AdamW steps on a quadratic: lean-moment trajectory must stay
+    within a small relative distance of the fp32-moment trajectory."""
+    rng = np.random.RandomState(1)
+    w0 = jnp.asarray(rng.randn(16, 64), jnp.float32)
+    target = jnp.asarray(rng.randn(16, 64), jnp.float32)
+
+    def grad_fn(w):
+        return 2 * (w - target) / w.size
+
+    def run(m_dtype=None, v_dtype=None):
+        params = {"w": w0}
+        state = adamw_init(params, m_dtype=m_dtype, v_dtype=v_dtype)
+        for _ in range(30):
+            g = {"w": grad_fn(params["w"])}
+            params, state = adamw_update(params, g, state, lr=1e-2,
+                                         m_dtype=m_dtype, v_dtype=v_dtype)
+        return params["w"]
+
+    w_ref = run()
+    w_lean = run(m_dtype, v_dtype)
+    # both must have moved toward target and stayed close to each other
+    # (int8 m uses sqrt-companded codes; its EMA drift is ~5%, vs ~0.2%
+    # for bf16 — the flagship bench uses bf16 moments, int8 is the
+    # extra-lean option)
+    assert float(jnp.linalg.norm(w_ref - w0)) > 0.1
+    rel = float(jnp.linalg.norm(w_lean - w_ref) /
+                jnp.linalg.norm(w_ref - w0))
+    assert rel < (0.08 if m_dtype == "int8" else 0.01), rel
+
+
+def test_stochastic_round_unbiased():
+    """SR fp32->bf16: mean over many draws must approach the fp32 value
+    (plain truncation/nearest would leave a systematic gap)."""
+    from paddle_tpu.parallel.train_step import _stochastic_round
+
+    x = jnp.full((2000,), 1.0 + 1.5e-3, jnp.float32)  # between bf16 codes
+    key = jax.random.PRNGKey(7)
+    out = _stochastic_round(x, jnp.bfloat16, key).astype(jnp.float32)
+    vals = np.unique(np.asarray(out))
+    assert len(vals) == 2            # straddles the two neighbors
+    mean = float(out.mean())
+    assert abs(mean - (1.0 + 1.5e-3)) < 5e-4
+    # deterministic dtype passthrough
+    same = _stochastic_round(x, jnp.float32, key)
+    np.testing.assert_array_equal(np.asarray(same), np.asarray(x))
+
+
+def test_sr_no_master_tracks_master_adamw():
+    """30 steps with bf16 params: SR-no-master must track the fp32-master
+    trajectory (the 1.3B single-chip memory mode)."""
+    rng = np.random.RandomState(4)
+    w0 = jnp.asarray(rng.randn(16, 64), jnp.float32)
+    target = jnp.asarray(rng.randn(16, 64), jnp.float32)
+
+    def grad_fn(w):
+        return (2 * (w.astype(jnp.float32) - target) / w.size)
+
+    def run(sr):
+        params = {"w": w0.astype(jnp.bfloat16)}
+        if sr:
+            state = adamw_init(params)
+        else:
+            state = adamw_init({"w": w0}, master_weights=True)
+        for _ in range(30):
+            g = {"w": grad_fn(params["w"])}
+            params, state = adamw_update(params, g, state, lr=1e-2,
+                                         stochastic_round=sr)
+        return params["w"].astype(jnp.float32)
+
+    w_master = run(False)
+    w_sr = run(True)
+    rel = float(jnp.linalg.norm(w_sr - w_master) /
+                jnp.linalg.norm(w_master - w0))
+    assert rel < 0.05, rel
+
+
+def test_1d_leaves_stay_fp32():
+    params = {"w": jnp.zeros((8, 8)), "b": jnp.zeros((8,))}
+    state = adamw_init(params, m_dtype="int8", v_dtype="bfloat16")
+    assert isinstance(state["m"]["w"], dict)          # quantized
+    assert state["m"]["b"].dtype == jnp.float32       # 1-D exempt
+    assert state["v"]["w"].dtype == jnp.bfloat16
+    assert state["v"]["b"].dtype == jnp.float32
+
+
+def test_sharded_train_step_with_lean_moments():
+    """End-to-end: the jitted sharded step runs and improves loss with
+    int8/bf16 moments (virtual CPU mesh)."""
+    from paddle_tpu.distributed.process_mesh import build_mesh
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.parallel import make_sharded_train_step
+
+    cfg = GPTConfig(vocab_size=128, hidden=64, n_layers=2, n_heads=2,
+                    seq_len=64, dtype=jnp.float32, use_flash=False,
+                    remat=False)
+    mesh = build_mesh((1, 1, 1), ("dp", "pp", "mp"))
+    step, params, opt_state = make_sharded_train_step(
+        cfg, mesh, lr=1e-3, zero1=False, m_dtype="int8", v_dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, 128, size=(2, 64))
+    labs = rng.randint(0, 128, size=(2, 64))
+    losses = []
+    for _ in range(8):
+        loss, params, opt_state = step(params, opt_state, toks, labs)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
