@@ -1,10 +1,15 @@
-"""Worker for test_launch_multiproc: data-parallel GPT-tiny training.
+"""Worker for test_launch_multiproc: hybrid-parallel GPT-tiny training.
 
 Launched as N processes by paddle_tpu.distributed.launch; each process
 owns ONE virtual CPU device, jax.distributed glues them into a global
-2-device "dp" mesh (the reference analog: one trainer process per
-device, NCCL data parallel — test/legacy_test/test_dist_base.py).
-Prints `FINAL_LOSS <value>` which the test compares against a serial
+N-device mesh (the reference analog: one trainer process per device,
+NCCL hybrid parallel — test/legacy_test/test_dist_base.py and
+test/collective/fleet/hybrid_parallel_mp_layers.py /
+hybrid_parallel_pp_transformer.py).
+
+The mesh shape comes from PT_TEST_MESH="dp,pp,mp" (default "N,1,1" =
+pure DP); PT_TEST_MICRO sets pipeline microbatches. Every process
+prints `FINAL_LOSS <value>` for the test to compare against a serial
 run of the same global batch.
 """
 
@@ -37,11 +42,16 @@ rank = jax.process_index()
 nproc = jax.process_count()
 assert len(jax.devices()) == nproc, jax.devices()
 
-cfg = GPTConfig(vocab_size=128, hidden=64, n_layers=2, n_heads=2, seq_len=16,
+mesh_shape = tuple(int(x) for x in
+                   os.environ.get("PT_TEST_MESH", f"{nproc},1,1").split(","))
+n_micro = int(os.environ.get("PT_TEST_MICRO", "1"))
+assert mesh_shape[0] * mesh_shape[1] * mesh_shape[2] == nproc, mesh_shape
+
+cfg = GPTConfig(vocab_size=128, hidden=64, n_layers=2, n_heads=4, seq_len=16,
                 dtype=jnp.float32, use_flash=False, remat=False)
-mesh = build_mesh((nproc, 1, 1), ("dp", "pp", "mp"))
+mesh = build_mesh(mesh_shape, ("dp", "pp", "mp"))
 step, params, opt_state = make_sharded_train_step(cfg, mesh, lr=1e-2,
-                                                  n_microbatches=1,
+                                                  n_microbatches=n_micro,
                                                   zero1=False)
 
 GLOBAL_BATCH = 8
@@ -49,8 +59,15 @@ rng = np.random.RandomState(0)  # same seed everywhere: global batch
 toks = rng.randint(0, cfg.vocab_size, size=(GLOBAL_BATCH, cfg.seq_len))
 labs = rng.randint(0, cfg.vocab_size, size=(GLOBAL_BATCH, cfg.seq_len))
 
-shard = GLOBAL_BATCH // nproc
-sl = slice(rank * shard, (rank + 1) * shard)
+# Each process feeds its dp shard of the global batch (replicated over
+# pp/mp). make_array_from_process_local_data assembles the global array
+# from per-process locals, so processes on the same dp row must supply
+# identical data — which they do, since the batch comes from a shared
+# seed and is sliced by dp coordinate only.
+dp = mesh_shape[0]
+shard = GLOBAL_BATCH // dp
+dp_rank = rank // (mesh_shape[1] * mesh_shape[2])
+sl = slice(dp_rank * shard, (dp_rank + 1) * shard)
 sharding = NamedSharding(mesh, P("dp"))
 toks_g = jax.make_array_from_process_local_data(sharding, toks[sl])
 labs_g = jax.make_array_from_process_local_data(sharding, labs[sl])
