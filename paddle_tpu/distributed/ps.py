@@ -2,47 +2,142 @@
 
 Re-design of the reference's parameter-server stack at the capability level
 (paddle/fluid/distributed/ps/ 35k LoC: brpc client/server, sharded
-dense/sparse tables + accessors; python/paddle/distributed/ps;
-fleet/meta_optimizers/parameter_server_optimizer.py). The reference serves
-trillion-parameter sparse embeddings from CPU parameter servers while GPU
-trainers pull/push.
+dense/sparse tables + per-table accessors with optimizer-on-server rules;
+python/paddle/distributed/ps/the_one_ps.py runtime;
+framework/hogwild_worker.cc trainer loop; communicator.cc async
+pull/push). The reference serves trillion-parameter sparse embeddings from
+CPU parameter servers while GPU trainers pull/push.
 
 TPU translation: dense model state belongs on-chip (ZeRO over the mesh
 beats a PS for dense params on ICI), so the PS niche that REMAINS is
-host-memory embedding tables too large for HBM. This module provides that:
-- ``SparseTable``: a host-RAM hash table of embedding rows with lazy init
-  and SGD/Adagrad push rules (the reference's table + accessor).
-- ``PsServer``: serves get/push for its shard of keys over distributed.rpc
-  (the brpc service role).
-- ``PsClient``: key-sharded pull/push used by trainers; pairs with the
-  on-chip model through plain numpy arrays feeding jitted steps.
+host-memory embedding tables too large for HBM, plus small dense state
+(e.g. CTR towers) whose optimizer runs server-side. This module provides:
+
+- Accessor rules (``SGDRule`` / ``AdagradRule`` / ``AdamRule``): the
+  per-table server-side optimizer (reference ps/table/sparse_sgd_rule.h,
+  accessor.h) — trainers push raw gradients, the server applies the rule.
+- ``SparseTable``: host-RAM hash table of embedding rows, lazy init
+  (reference memory_sparse_table's unbounded id space).
+- ``DenseTable``: fixed-shape dense parameter block with a server-side
+  rule (reference memory_dense_table).
+- ``PsServer`` + ``serve_forever``: table registry + a blocking serve
+  loop with rpc-triggered shutdown (the brpc service + the_one_ps
+  run_server role).
+- ``PsClient``: key-sharded sync/async pull/push; dense pull/push.
+- ``PsTrainer``: prefetch-pipelined trainer loop — the next batch's
+  embedding pull rides RPC while the current device step computes (the
+  async communicator + hogwild_worker role).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Optional
+import time
+from typing import Callable, Optional
 
 import numpy as np
 
 from . import rpc
 
-__all__ = ["SparseTable", "PsServer", "PsClient"]
+__all__ = ["SGDRule", "AdagradRule", "AdamRule", "make_rule",
+           "SparseTable", "DenseTable", "PsServer", "PsClient",
+           "PsTrainer", "serve_forever", "stop_servers", "signal_ready",
+           "wait_servers_ready"]
+
+
+# ---------------------------------------------------------------------------
+# accessor rules: optimizer-on-server (reference ps/table/sparse_sgd_rule.h)
+# ---------------------------------------------------------------------------
+
+
+class SGDRule:
+    """Plain SGD; state-free."""
+
+    n_state = 0
+
+    def __init__(self, lr: float = 0.05):
+        self.lr = lr
+
+    def init_state(self, dim: int):
+        return None
+
+    def update(self, row: np.ndarray, state, grad: np.ndarray):
+        row -= self.lr * grad
+        return state
+
+
+class AdagradRule:
+    """Per-element Adagrad (reference SparseAdaGradSGDRule)."""
+
+    n_state = 1
+
+    def __init__(self, lr: float = 0.05, eps: float = 1e-8,
+                 init_acc: float = 0.0):
+        self.lr = lr
+        self.eps = eps
+        self.init_acc = init_acc
+
+    def init_state(self, dim: int):
+        return np.full(dim, self.init_acc, np.float32)
+
+    def update(self, row, acc, grad):
+        acc += grad * grad
+        row -= self.lr * grad / (np.sqrt(acc) + self.eps)
+        return acc
+
+
+class AdamRule:
+    """Per-row Adam (reference SparseAdamSGDRule): state = (m, v, t)."""
+
+    n_state = 3
+
+    def __init__(self, lr: float = 0.01, b1: float = 0.9, b2: float = 0.999,
+                 eps: float = 1e-8):
+        self.lr, self.b1, self.b2, self.eps = lr, b1, b2, eps
+
+    def init_state(self, dim: int):
+        return [np.zeros(dim, np.float32), np.zeros(dim, np.float32), 0]
+
+    def update(self, row, state, grad):
+        m, v, t = state
+        t += 1
+        m[:] = self.b1 * m + (1 - self.b1) * grad
+        v[:] = self.b2 * v + (1 - self.b2) * grad * grad
+        mh = m / (1 - self.b1 ** t)
+        vh = v / (1 - self.b2 ** t)
+        row -= self.lr * mh / (np.sqrt(vh) + self.eps)
+        state[2] = t
+        return state
+
+
+_RULES = {"sgd": SGDRule, "adagrad": AdagradRule, "adam": AdamRule}
+
+
+def make_rule(name: str, **kw):
+    if name not in _RULES:
+        raise ValueError(f"unknown accessor rule {name!r}; "
+                         f"choose from {sorted(_RULES)}")
+    return _RULES[name](**kw)
+
+
+# ---------------------------------------------------------------------------
+# tables
+# ---------------------------------------------------------------------------
 
 
 class SparseTable:
     """Host-memory embedding table shard (reference: ps/table/
     memory_sparse_table). Rows materialize on first touch (the reference's
-    lazy feature creation for unbounded id spaces)."""
+    lazy feature creation for unbounded id spaces); the accessor rule runs
+    server-side on push."""
 
     def __init__(self, dim: int, init_std: float = 0.01, seed: int = 0,
-                 optimizer: str = "sgd", lr: float = 0.05):
+                 optimizer: str = "sgd", lr: float = 0.05, rule=None):
         self.dim = dim
         self.init_std = init_std
-        self.optimizer = optimizer
-        self.lr = lr
+        self.rule = rule if rule is not None else make_rule(optimizer, lr=lr)
         self._rows: dict[int, np.ndarray] = {}
-        self._accum: dict[int, np.ndarray] = {}
+        self._state: dict[int, object] = {}
         self._rng = np.random.default_rng(seed)
         self._mu = threading.Lock()
 
@@ -66,30 +161,65 @@ class SparseTable:
                 row = self._rows.get(k)
                 if row is None:
                     continue
-                if self.optimizer == "adagrad":
-                    acc = self._accum.setdefault(
-                        k, np.zeros(self.dim, np.float32))
-                    acc += g * g
-                    row -= self.lr * g / (np.sqrt(acc) + 1e-8)
-                else:
-                    row -= self.lr * g
+                st = self._state.get(k)
+                if st is None and self.rule.n_state:
+                    st = self.rule.init_state(self.dim)
+                new_st = self.rule.update(row, st, g)
+                if self.rule.n_state:
+                    self._state[k] = new_st
 
     def __len__(self):
         return len(self._rows)
 
     def state_dict(self):
         with self._mu:
-            return {"rows": dict(self._rows), "accum": dict(self._accum)}
+            return {"rows": dict(self._rows), "state": dict(self._state)}
 
     def load_state_dict(self, sd):
         with self._mu:
             self._rows = dict(sd["rows"])
-            self._accum = dict(sd.get("accum", {}))
+            self._state = dict(sd.get("state", sd.get("accum", {})))
+
+
+class DenseTable:
+    """Fixed-shape dense parameter block with a server-side optimizer rule
+    (reference: ps/table/memory_dense_table — fc weights of the CTR dense
+    tower live on the server in CPU PS training)."""
+
+    def __init__(self, shape, init: Optional[np.ndarray] = None,
+                 optimizer: str = "sgd", lr: float = 0.05, rule=None,
+                 seed: int = 0):
+        self.shape = tuple(shape)
+        self.rule = rule if rule is not None else make_rule(optimizer, lr=lr)
+        if init is not None:
+            self._value = np.array(init, np.float32).reshape(self.shape)
+        else:
+            rng = np.random.default_rng(seed)
+            self._value = (rng.standard_normal(self.shape) *
+                           0.01).astype(np.float32)
+        flat_dim = self._value.size
+        self._state = (self.rule.init_state(flat_dim)
+                       if self.rule.n_state else None)
+        self._mu = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._mu:
+            return self._value.copy()
+
+    def push(self, grad) -> None:
+        grad = np.asarray(grad, np.float32).reshape(-1)
+        with self._mu:
+            flat = self._value.reshape(-1)
+            self._state = self.rule.update(flat, self._state, grad)
+
+    def __len__(self):
+        return self._value.size
 
 
 # module-level registry so rpc-invoked functions (pickled by name) can
 # reach the serving tables
-_SERVED_TABLES: dict[str, SparseTable] = {}
+_SERVED_TABLES: dict[str, object] = {}
+_STOP = threading.Event()
 
 
 def _ps_pull(table: str, keys):
@@ -101,8 +231,22 @@ def _ps_push(table: str, keys, grads):
     return True
 
 
+def _ps_dense_pull(table: str):
+    return _SERVED_TABLES[table].pull()
+
+
+def _ps_dense_push(table: str, grad):
+    _SERVED_TABLES[table].push(grad)
+    return True
+
+
 def _ps_size(table: str):
     return len(_SERVED_TABLES[table])
+
+
+def _ps_stop():
+    _STOP.set()
+    return True
 
 
 class PsServer:
@@ -114,14 +258,72 @@ class PsServer:
         for name, t in self.tables.items():
             _SERVED_TABLES[name] = t
 
-    def add_table(self, name: str, table: SparseTable):
+    def add_table(self, name: str, table):
         self.tables[name] = table
         _SERVED_TABLES[name] = table
 
 
+def signal_ready() -> None:
+    """Server-side: announce tables are registered (init_rpc's serve
+    thread starts BEFORE PsServer() runs, so a fast trainer could pull
+    into an empty registry without this)."""
+    rpc._STATE.store.add("ps/tables_ready", 1)
+
+
+def wait_servers_ready(n_servers: int, timeout: float = 60.0) -> None:
+    """Trainer-side: block until ``n_servers`` called signal_ready()."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if rpc._STATE.store.add("ps/tables_ready", 0) >= n_servers:
+            return
+        time.sleep(0.02)
+    raise TimeoutError("parameter servers did not become ready")
+
+
+def serve_forever(poll_s: float = 0.05) -> None:
+    """Block serving rpc requests until a trainer calls stop_servers()
+    (the_one_ps run_server role: the server process parks here while its
+    rpc serve thread handles pulls/pushes). Implies signal_ready()."""
+    _STOP.clear()
+    signal_ready()
+    while not _STOP.is_set():
+        time.sleep(poll_s)
+
+
+def stop_servers(server_names) -> None:
+    """Trainer-side shutdown fanout (the_one_ps stop_worker/stop server)."""
+    for s in server_names:
+        try:
+            rpc.rpc_sync(s, _ps_stop, timeout=10)
+        except Exception:  # noqa: BLE001 — a dead server is already stopped
+            pass
+
+
+class _MultiFuture:
+    """Composes per-shard rpc futures into one pull result."""
+
+    def __init__(self, parts, n, dim_probe: Callable):
+        self._parts = parts          # list of (idx, future)
+        self._n = n
+        self._dim_probe = dim_probe
+
+    def wait(self) -> np.ndarray:
+        out = None
+        for idx, fut in self._parts:
+            rows = fut.wait()
+            if out is None:
+                out = np.empty((self._n, rows.shape[1]), np.float32)
+            out[idx] = rows
+        if out is None:
+            return self._dim_probe()
+        return out
+
+
 class PsClient:
     """Key-sharded pull/push across PS workers (reference: BrpcPsClient;
-    shard = key % n_servers, the reference's default hash placement)."""
+    shard = key % n_servers, the reference's default hash placement).
+    ``*_async`` variants return futures so the trainer loop can overlap
+    RPC with device compute (the async communicator role)."""
 
     def __init__(self, server_names: list):
         self.servers = list(server_names)
@@ -131,27 +333,34 @@ class PsClient:
         sid = keys % len(self.servers)
         return [(s, np.nonzero(sid == s)[0]) for s in range(len(self.servers))]
 
-    def pull(self, table: str, keys) -> np.ndarray:
+    # -- sparse ------------------------------------------------------------
+
+    def pull_async(self, table: str, keys) -> _MultiFuture:
         keys = np.asarray(keys, np.int64)
-        if keys.size == 0:
-            # probe the table dim so empty shards still get a typed array
-            probe = rpc.rpc_sync(self.servers[0], _ps_pull,
-                                 args=(table, np.zeros(0, np.int64)))
-            return probe
-        out = None
+        parts = []
         for s, idx in self._shard(keys):
             if idx.size == 0:
                 continue
-            rows = rpc.rpc_sync(self.servers[s], _ps_pull,
-                                args=(table, keys[idx]))
-            if out is None:
-                out = np.empty((len(keys), rows.shape[1]), np.float32)
-            out[idx] = rows
-        return out
+            parts.append((idx, rpc.rpc_async(self.servers[s], _ps_pull,
+                                             args=(table, keys[idx]))))
+        probe = lambda: rpc.rpc_sync(self.servers[0], _ps_pull,
+                                     args=(table, np.zeros(0, np.int64)))
+        return _MultiFuture(parts, len(keys), probe)
 
-    def push(self, table: str, keys, grads) -> None:
+    def pull(self, table: str, keys) -> np.ndarray:
+        return self.pull_async(table, keys).wait()
+
+    def push(self, table: str, keys, grads, wait: bool = True):
         keys = np.asarray(keys, np.int64)
         grads = np.asarray(grads, np.float32)
+        # merge duplicate keys first (reference merged sparse push): a
+        # stateful rule (adagrad/adam) must see ONE summed gradient per
+        # id, not one optimizer step per occurrence
+        uniq, inv = np.unique(keys, return_inverse=True)
+        if uniq.size != keys.size:
+            merged = np.zeros((uniq.size, grads.shape[1]), np.float32)
+            np.add.at(merged, inv, grads)
+            keys, grads = uniq, merged
         futures = []
         for s, idx in self._shard(keys):
             if idx.size == 0:
@@ -159,9 +368,91 @@ class PsClient:
             futures.append(rpc.rpc_async(
                 self.servers[s], _ps_push, args=(table, keys[idx],
                                                  grads[idx])))
-        for f in futures:
-            f.wait()
+        if wait:
+            for f in futures:
+                f.wait()
+        return futures
+
+    # -- dense -------------------------------------------------------------
+
+    def pull_dense(self, table: str, server: int = 0) -> np.ndarray:
+        return rpc.rpc_sync(self.servers[server], _ps_dense_pull,
+                            args=(table,))
+
+    def push_dense(self, table: str, grad, server: int = 0,
+                   wait: bool = True):
+        fut = rpc.rpc_async(self.servers[server], _ps_dense_push,
+                            args=(table, np.asarray(grad, np.float32)))
+        if wait:
+            fut.wait()
+        return fut
 
     def table_size(self, table: str) -> int:
         return sum(rpc.rpc_sync(s, _ps_size, args=(table,))
                    for s in self.servers)
+
+
+class PsTrainer:
+    """Prefetch-pipelined PS trainer loop (reference: hogwild_worker.cc +
+    async communicator): for each batch, the NEXT batch's embedding rows
+    are already in flight while the device computes the current step, and
+    gradient pushes are fired async and only awaited one batch later
+    (bounded staleness of exactly one step, the reference's async mode).
+
+    ``step_fn(rows, dense, batch) -> (loss, row_grads, dense_grad)`` is
+    the user's (typically jitted) device step.
+    """
+
+    def __init__(self, client: PsClient, emb_table: str, dense_table: str,
+                 step_fn: Callable):
+        self.client = client
+        self.emb_table = emb_table
+        self.dense_table = dense_table
+        self.step_fn = step_fn
+        self.losses: list[float] = []
+
+    def train(self, batches) -> list:
+        """``batches``: iterable (may be a generator — only one batch of
+        lookahead is buffered, the streaming niche this module serves) of
+        (keys, batch_data). Returns THIS run's losses; ``self.losses``
+        accumulates across calls."""
+        it = iter(batches)
+        try:
+            cur = next(it)
+        except StopIteration:
+            return []
+        run_losses: list[float] = []
+        pending_push = []
+        # dense pull is queued BEFORE the sparse prefetch: the serve loop
+        # answers a server's inbox in FIFO order, so the reverse order
+        # would stall step i's dense pull behind step i+1's whole sparse
+        # shard on server 0, defeating the overlap
+        dense_fut = rpc.rpc_async(self.client.servers[0], _ps_dense_pull,
+                                  args=(self.dense_table,))
+        fut = self.client.pull_async(self.emb_table, cur[0])
+        while cur is not None:
+            keys, data = cur
+            nxt = next(it, None)
+            rows = fut.wait()
+            dense = dense_fut.wait()
+            if nxt is not None:
+                # prefetch the next batch's rows/dense while we compute
+                dense_fut = rpc.rpc_async(self.client.servers[0],
+                                          _ps_dense_pull,
+                                          args=(self.dense_table,))
+                fut = self.client.pull_async(self.emb_table, nxt[0])
+            loss, row_grads, dense_grad = self.step_fn(rows, dense, data)
+            # previous step's pushes must have landed before this step's
+            # pull observes them — one-step staleness, then drain
+            for f in pending_push:
+                f.wait()
+            pending_push = self.client.push(
+                self.emb_table, keys, np.asarray(row_grads), wait=False)
+            pending_push.append(self.client.push_dense(
+                self.dense_table, dense_grad, wait=False))
+            run_losses.append(float(loss))
+            cur = nxt
+        for f in pending_push:
+            f.wait()
+        self.losses.extend(run_losses)
+        return run_losses

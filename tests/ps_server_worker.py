@@ -1,0 +1,25 @@
+"""PS server process for test_ps_ctr: serves sparse embedding + dense
+tower tables until the trainer calls stop_servers (the_one_ps
+run_server role)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.distributed import ps, rpc
+
+name = os.environ["PS_NAME"]
+rank = int(os.environ["PS_RANK"])
+master = os.environ["PS_MASTER"]
+
+rpc.init_rpc(name, rank=rank, world_size=3, master_endpoint=master)
+ps.PsServer({
+    # accessor rules run ON THE SERVER: trainers push raw grads
+    "emb": ps.SparseTable(dim=8, rule=ps.AdagradRule(lr=0.3), seed=rank),
+    "dense": ps.DenseTable((9,), optimizer="adagrad", lr=0.3, seed=7),
+})
+print("PS_READY", flush=True)
+ps.serve_forever()
+print("PS_STOPPED", flush=True)
+rpc.shutdown()

@@ -1,0 +1,159 @@
+"""Parameter-server CTR training e2e (VERDICT r2 item 4; inventory rows
+49/50/75).
+
+The reference's CPU-PS story: trainers pull sparse embedding rows +
+dense tower weights from parameter servers, compute grads, push raw
+grads back, and the server-side accessor rules apply the optimizer
+(paddle/fluid/distributed/ps/table/, the_one_ps.py,
+framework/hogwild_worker.cc). Here: TWO real PS processes serve a
+key-sharded embedding whose id space (2^20) is far beyond what the
+trainer materializes (the larger-than-HBM niche — rows are lazy), a
+dense logistic tower lives in a DenseTable with server-side Adagrad,
+and the PsTrainer loop overlaps next-batch pulls with compute.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "ps_server_worker.py")
+
+DIM = 8
+SLOTS = 10          # ids per example
+KEYSPACE = 1 << 20  # sparse id space; only touched rows materialize
+
+
+def _make_batches(n_batches, batch, seed=0):
+    """Synthetic CTR data: each id has a latent ±1 weight; the label is
+    a logistic draw on the sum — learnable by the embedding table."""
+    rng = np.random.RandomState(seed)
+    # confine to a reusable pool so ids repeat enough to learn
+    pool = rng.randint(0, KEYSPACE, size=512).astype(np.int64)
+    latent = rng.choice([-1.0, 1.0], size=512)
+    batches = []
+    for _ in range(n_batches):
+        idx = rng.randint(0, 512, size=(batch, SLOTS))
+        ids = pool[idx]
+        logits = latent[idx].sum(axis=1) * 1.5
+        y = (rng.rand(batch) < 1.0 / (1.0 + np.exp(-logits))).astype(
+            np.float32)
+        batches.append((ids.reshape(-1), {"ids_shape": (batch, SLOTS),
+                                          "y": y}))
+    return batches
+
+
+@pytest.mark.slow
+def test_ps_ctr_two_servers_converges(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed import ps, rpc
+
+    port = 6271
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["PS_MASTER"] = f"127.0.0.1:{port}"
+    servers = []
+    for rank, name in ((1, "ps0"), (2, "ps1")):
+        e = dict(env, PS_NAME=name, PS_RANK=str(rank))
+        servers.append(subprocess.Popen(
+            [sys.executable, WORKER], env=e,
+            stdout=open(tmp_path / f"{name}.log", "w"),
+            stderr=subprocess.STDOUT))
+    try:
+        # trainer is rank 0: hosts the store master (servers retry-connect)
+        rpc.init_rpc("trainer", rank=0, world_size=3,
+                     master_endpoint=f"127.0.0.1:{port}")
+        ps.wait_servers_ready(2)
+        client = ps.PsClient(["ps0", "ps1"])
+
+        @jax.jit
+        def device_step(rows, dense, y):
+            # rows [B*SLOTS, DIM] -> pooled [B, DIM]; logistic tower
+            def loss_fn(rows, dense):
+                pooled = rows.reshape(-1, SLOTS, DIM).sum(1)
+                w, b = dense[:DIM], dense[DIM]
+                logit = pooled @ w + b
+                p = jax.nn.sigmoid(logit)
+                eps = 1e-6
+                return -jnp.mean(y * jnp.log(p + eps)
+                                 + (1 - y) * jnp.log(1 - p + eps))
+
+            loss, (dr, dd) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                rows, dense)
+            return loss, dr, dd
+
+        def step_fn(rows, dense, data):
+            loss, dr, dd = device_step(jnp.asarray(rows),
+                                       jnp.asarray(dense),
+                                       jnp.asarray(data["y"]))
+            return float(loss), np.asarray(dr), np.asarray(dd)
+
+        trainer = ps.PsTrainer(client, "emb", "dense", step_fn)
+        losses = trainer.train(_make_batches(40, batch=64))
+
+        first = np.mean(losses[:5])
+        last = np.mean(losses[-5:])
+        assert last < first - 0.05, (first, last)
+        # rows materialized lazily across BOTH shards
+        n_rows = client.table_size("emb")
+        assert 256 < n_rows <= 512, n_rows
+        sizes = [rpc.rpc_sync(s, ps._ps_size, args=("emb",))
+                 for s in ("ps0", "ps1")]
+        assert all(x > 0 for x in sizes), sizes  # key-sharded placement
+        # dense tower moved off its init (server-side adagrad applied)
+        dense = client.pull_dense("dense")
+        assert np.abs(dense).max() > 0.05
+
+        ps.stop_servers(["ps0", "ps1"])
+        for p in servers:
+            assert p.wait(timeout=30) == 0
+        rpc.shutdown()
+    finally:
+        for p in servers:
+            if p.poll() is None:
+                p.kill()
+
+
+def test_accessor_rules_unit():
+    """Server-side rules: adagrad shrinks effective lr over pushes; adam
+    bias-corrects; both beat zero-learning."""
+    from paddle_tpu.distributed.ps import (AdagradRule, AdamRule, SGDRule,
+                                           SparseTable, make_rule)
+
+    t = SparseTable(dim=4, rule=AdagradRule(lr=1.0))
+    k = [7]
+    r0 = t.pull(k).copy()
+    g = np.ones((1, 4), np.float32)
+    t.push(k, g)
+    d1 = r0 - t.pull(k)          # first step: lr/(sqrt(g^2)+eps) ~= 1
+    t.push(k, g)
+    d2 = (r0 - d1) - t.pull(k)   # second step smaller: acc grew
+    assert np.all(d2 < d1)
+
+    t2 = SparseTable(dim=4, rule=AdamRule(lr=0.1))
+    t2.pull(k)
+    t2.push(k, g)
+    assert np.abs(t2.pull(k) - t2._rows[7]).max() < 1e-6  # state kept
+
+    assert isinstance(make_rule("sgd", lr=0.1), SGDRule)
+    with pytest.raises(ValueError):
+        make_rule("rmsprop")
+
+
+def test_dense_table_unit():
+    from paddle_tpu.distributed.ps import DenseTable
+
+    dt = DenseTable((3, 2), init=np.zeros((3, 2)), optimizer="sgd", lr=0.5)
+    dt.push(np.ones(6))
+    np.testing.assert_allclose(dt.pull(), -0.5 * np.ones((3, 2)))
+    # state_ful rule on dense
+    dt2 = DenseTable((4,), init=np.zeros(4), optimizer="adam", lr=0.1)
+    dt2.push(np.ones(4))
+    assert np.all(dt2.pull() < 0)
