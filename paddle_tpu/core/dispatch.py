@@ -35,6 +35,10 @@ class _Ph:
     def __init__(self, i):
         self.i = i
 
+    def __repr__(self):
+        # stable repr: lazy-segment cache keys stringify arg templates
+        return f"Ph({self.i})"
+
 
 def _extract(obj, leaves: list):
     if isinstance(obj, Tensor):
@@ -120,6 +124,18 @@ def _amp_cast_arrays(opdef: OpDef, arrays: list):
     return arrays
 
 
+_SEGMENT_ACTIVE = None  # resolved on first dispatch (avoids an import
+#                         machinery hit per op and a load-time cycle)
+
+
+def _segment_runner():
+    global _SEGMENT_ACTIVE
+    if _SEGMENT_ACTIVE is None:
+        from ..jit.lazy_segments import _ACTIVE
+        _SEGMENT_ACTIVE = _ACTIVE
+    return _SEGMENT_ACTIVE[0]
+
+
 def _check_nan_inf(name: str, outs):
     for o in outs if isinstance(outs, tuple) else (outs,):
         if isinstance(o, jax.core.Tracer) or not jnp.issubdtype(
@@ -138,17 +154,33 @@ def op_call(opdef: OpDef, args, kwargs):
     leaves: list[Tensor] = []
     t_args = _extract(list(args), leaves)
     t_kwargs = _extract(kwargs, leaves) if kwargs else {}
-    arrays = [t._data for t in leaves]
-    arrays = _amp_cast_arrays(opdef, arrays)
-
-    for hook in DISPATCH_HOOKS:
-        hook(opdef.name)
 
     requires_grad = (
         opdef.differentiable
         and autograd.is_grad_enabled()
         and any(not t.stop_gradient for t in leaves)
     )
+
+    # Lazy-segment mode (jit/lazy_segments.py): on a graph-broken capture,
+    # tape-free ops accumulate into fused compiled segments instead of
+    # dispatching one XLA call each. Tape ops flush and run eagerly, as
+    # does everything when check_nan_inf debugging is on (per-op checks
+    # need per-op execution).
+    runner = _segment_runner()
+    if runner is not None:
+        if (not requires_grad and AMP_STATE is None
+                and not GLOBAL_FLAGS.get("check_nan_inf")):
+            for hook in DISPATCH_HOOKS:
+                hook(opdef.name)
+            return runner.record(opdef, args, kwargs)
+        runner.stats["eager_tape_ops"] += 1
+        runner.flush_all()
+
+    arrays = [t._mat() for t in leaves]
+    arrays = _amp_cast_arrays(opdef, arrays)
+
+    for hook in DISPATCH_HOOKS:
+        hook(opdef.name)
 
     if requires_grad:
         def primal(*arrs):

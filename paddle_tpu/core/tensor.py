@@ -43,9 +43,9 @@ class Tensor:
     def __init__(self, data, stop_gradient: bool = True, name: str = ""):
         if isinstance(data, Tensor):
             data = data._data
-        elif not isinstance(data, jax.Array) and not isinstance(
-            data, jax.core.Tracer
-        ):
+        elif (not isinstance(data, jax.Array)
+              and not isinstance(data, jax.core.Tracer)
+              and not hasattr(data, "_lazy_materialize")):
             data = jnp.asarray(data)
         self._data = data
         self.stop_gradient = stop_gradient
@@ -129,28 +129,36 @@ class Tensor:
         t = Tensor(self._data, stop_gradient=True, name=self.name)
         return t
 
+    def _mat(self):
+        """Resolve a lazy-segment placeholder (jit/lazy_segments.py) to a
+        concrete array; no-op for ordinary buffers/tracers."""
+        m = getattr(self._data, "_lazy_materialize", None)
+        if m is not None:
+            self._data = m()
+        return self._data
+
     # ---- conversion --------------------------------------------------------
     def numpy(self) -> np.ndarray:
-        return np.asarray(self._data)
+        return np.asarray(self._mat())
 
     def item(self):
-        return self._data.item()
+        return self._mat().item()
 
     def tolist(self):
-        return np.asarray(self._data).tolist()
+        return np.asarray(self._mat()).tolist()
 
     def __array__(self, dtype=None):
-        arr = np.asarray(self._data)
+        arr = np.asarray(self._mat())
         return arr.astype(dtype) if dtype is not None else arr
 
     def __float__(self):
-        return float(self._data)
+        return float(self._mat())
 
     def __int__(self):
-        return int(self._data)
+        return int(self._mat())
 
     def __bool__(self):
-        return bool(self._data)
+        return bool(self._mat())
 
     def __len__(self):
         if self.ndim == 0:
@@ -164,7 +172,7 @@ class Tensor:
         # Pickle via host numpy (spawned DataLoader workers, checkpointing);
         # device placement is not a portable property of a pickled tensor.
         return (_unpickle_tensor,
-                (np.asarray(self._data), self.stop_gradient, self.name))
+                (np.asarray(self._mat()), self.stop_gradient, self.name))
 
     # ---- mutation ----------------------------------------------------------
     def set_value(self, value):

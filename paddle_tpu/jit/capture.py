@@ -68,7 +68,9 @@ def _snapshot():
     values, setters = [], []
     params = sorted(live_parameters(), key=id)
     for p in params:
-        values.append(p._data)
+        # _mat: a parameter updated in-place inside a lazy-segmented
+        # region may still be LazyArray-backed; jit inputs must be real
+        values.append(p._mat())
         setters.append(p._bump)
     for obj in sorted(_STATEFUL, key=id):
         for get, set_ in obj._state_leaves():
@@ -237,6 +239,11 @@ class StaticFunction:
         self._cache: dict = {}
         self._donate = donate_states
         self.graph_break_count = 0
+        # Lazy-segment fallback (jit/lazy_segments.py): broken guard keys
+        # run as compiled subgraph segments around the break instead of
+        # pure per-op eager (reference BreakGraphError keeps compiled
+        # prefix/suffix, opcode_executor.py:1620).
+        self._segments = None
         # guard keys (minus the state-count component) that graph-broke:
         # the first eager run may grow state (n_state changes), which must
         # not trigger a second doomed trace
@@ -270,13 +277,15 @@ class StaticFunction:
             key = _guard_key(args, kwargs, len(state_vals))
             compiled = self._cache.get(key)
         if compiled is _EAGER_FALLBACK or key[:2] in self._broken_keys:
-            return self._fn(*args, **kwargs)
+            return self._run_segmented(args, kwargs)
         if compiled is None:
             try:
                 compiled = self._compile(args, kwargs, state_vals)
             except _BREAK_ERRORS as e:
                 # graph break: cache the fallback so later calls skip the
-                # doomed trace, clean up tracer-holding state, run eager
+                # doomed trace, clean up tracer-holding state, run in
+                # lazy-segment mode (compiled prefix/suffix around the
+                # break — see jit/lazy_segments.py)
                 self._cache[key] = _EAGER_FALLBACK
                 self._broken_keys.add(key[:2])
                 self.graph_break_count += 1
@@ -284,11 +293,12 @@ class StaticFunction:
                 import logging
 
                 logging.getLogger("paddle_tpu.jit").warning(
-                    "to_static graph break in %s (falling back to eager "
-                    "for this input spec): %s",
+                    "to_static graph break in %s (running as compiled "
+                    "segments around the break for this input spec; see "
+                    ".segment_stats): %s",
                     getattr(self._fn, "__name__", "<fn>"),
                     str(e).split("\n")[0])
-                return self._fn(*args, **kwargs)
+                return self._run_segmented(args, kwargs)
             self._cache[key] = compiled
             # State created during the trace (e.g. optimizer moments) holds
             # tracers until this first execution's out_setters overwrite it
@@ -301,6 +311,26 @@ class StaticFunction:
         for setter, val in zip(compiled.out_setters, state_out):
             setter(val)
         return _rebuild_tensors(compiled.out_template, outs_flat)
+
+    def _run_segmented(self, args, kwargs):
+        from .lazy_segments import SegmentRunner, active_runner, segment_mode
+
+        if active_runner() is not None:
+            # nested broken StaticFunction: join the outer runner's graphs
+            return self._fn(*args, **kwargs)
+        if self._segments is None:
+            self._segments = SegmentRunner()
+        with segment_mode(self._segments):
+            return self._fn(*args, **kwargs)
+
+    @property
+    def segment_stats(self) -> dict:
+        """Queryable break/segment counters (how much of a broken step
+        still runs compiled — the old fallback was silently 10-100x)."""
+        stats = {"graph_breaks": self.graph_break_count}
+        if self._segments is not None:
+            stats.update(self._segments.stats)
+        return stats
 
     def _compile(self, args, kwargs, state_vals_outer) -> _Compiled:
         fn = self._fn
