@@ -167,7 +167,7 @@ def op_call(opdef: OpDef, args, kwargs):
     # does everything when check_nan_inf debugging is on (per-op checks
     # need per-op execution).
     runner = _segment_runner()
-    if runner is not None:
+    if runner is not None and not runner.degraded:
         if (not requires_grad and AMP_STATE is None
                 and not GLOBAL_FLAGS.get("check_nan_inf")):
             for hook in DISPATCH_HOOKS:

@@ -206,13 +206,24 @@ def block_multihead_attention(qkv, cache: PagedKVCache,
 
 
 def paged_decode_attention(q, k_pages, v_pages, block_table, seq_lens):
-    """Single-token decode against the paged cache. q [B, 1, nh, dh];
-    gathers each sequence's pages and computes masked attention — XLA
-    fuses the gather+dot chain; see ops/pallas/decode_attention.py for
-    the kernelized long-context path."""
+    """Single-token decode against the paged cache. q [B, 1, nh, dh].
+
+    Kernel path (ops/pallas/decode_attention.py
+    paged_decode_attention_kernel): the block table drives the page
+    BlockSpec index maps, so the gathered/repeated KV tensor never
+    materializes. XLA gather+dot fallback for unsupported shapes."""
     B = q.shape[0]
     nh, bs, dh = k_pages.shape[1:]
     max_blocks = block_table.shape[1]
+
+    from ....ops.pallas.decode_attention import (
+        paged_decode_attention_kernel, paged_decode_supported)
+
+    if paged_decode_supported(k_pages.shape, q.shape[2]):
+        o = paged_decode_attention_kernel(
+            q[:, 0].astype(k_pages.dtype), k_pages, v_pages, block_table,
+            seq_lens, 1.0 / math.sqrt(dh))
+        return o[:, None].astype(q.dtype)             # [B, 1, nh, dh]
 
     kg = k_pages[block_table]            # [B, max_blocks, nh, bs, dh]
     vg = v_pages[block_table]

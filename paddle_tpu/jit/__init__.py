@@ -7,6 +7,7 @@ frontends; save/load of TranslatedLayer). See capture.py for the design.
 from . import capture as _capture
 from .capture import (
     StaticFunction,
+    capture_stats,
     live_optimizers,
     not_to_static,
     register_stateful,
@@ -15,7 +16,7 @@ from .capture import (
 
 __all__ = ["to_static", "not_to_static", "StaticFunction",
            "register_stateful", "live_optimizers", "save", "load",
-           "ignore_module", "enable_to_static"]
+           "ignore_module", "enable_to_static", "capture_stats"]
 
 def enable_to_static(flag: bool):
     """reference: paddle.jit.enable_to_static — global capture kill-switch

@@ -201,6 +201,20 @@ class _Compiled:
 # Sentinel cached for guard keys whose trace graph-broke: run eager.
 _EAGER_FALLBACK = object()
 
+# all StaticFunctions ever built (weak): capture_stats() aggregates them
+_LIVE_STATIC_FNS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def capture_stats() -> dict:
+    """Aggregate break/segment counters across every live StaticFunction
+    (per-function detail: StaticFunction.segment_stats)."""
+    total: dict = {"functions": 0, "graph_breaks": 0}
+    for fn in list(_LIVE_STATIC_FNS):
+        total["functions"] += 1
+        for k, v in fn.segment_stats.items():
+            total[k] = total.get(k, 0) + v
+    return total
+
 # Concretization errors = data-dependent Python control flow inside the
 # captured function (the reference SOT's BreakGraphError family,
 # jit/sot/.../opcode_executor.py:1620 — e.g. `if loss.item() > x`,
@@ -244,6 +258,7 @@ class StaticFunction:
         # pure per-op eager (reference BreakGraphError keeps compiled
         # prefix/suffix, opcode_executor.py:1620).
         self._segments = None
+        _LIVE_STATIC_FNS.add(self)
         # guard keys (minus the state-count component) that graph-broke:
         # the first eager run may grow state (n_state changes), which must
         # not trigger a second doomed trace

@@ -112,6 +112,37 @@ class LazyArray:
             raise AttributeError(name)
         return getattr(self._lazy_materialize(), name)
 
+    # operator dunders bypass __getattr__ (type-slot lookup): delegate the
+    # common ones so framework code applying operators to t._data directly
+    # keeps working on escaped placeholders
+    def __neg__(self):
+        return -self._lazy_materialize()
+
+    def __getitem__(self, idx):
+        return self._lazy_materialize()[idx]
+
+    def __len__(self):
+        return self.aval.shape[0]
+
+    def __iter__(self):
+        return iter(self._lazy_materialize())
+
+
+def _delegate_binop(name):
+    def fwd(self, other):
+        return getattr(self._lazy_materialize(), name)(other)
+
+    fwd.__name__ = name
+    return fwd
+
+
+for _n in ("__add__", "__radd__", "__sub__", "__rsub__", "__mul__",
+           "__rmul__", "__truediv__", "__rtruediv__", "__floordiv__",
+           "__rfloordiv__", "__pow__", "__rpow__", "__mod__", "__rmod__",
+           "__matmul__", "__rmatmul__", "__lt__", "__le__", "__gt__",
+           "__ge__", "__eq__", "__ne__", "__and__", "__or__", "__xor__"):
+    setattr(LazyArray, _n, _delegate_binop(_n))
+
 
 class _Graph:
     __slots__ = ("runner", "inputs", "in_avals", "ops", "outs", "flushed")
@@ -153,10 +184,12 @@ class SegmentRunner:
     """Per-StaticFunction lazy-segment state: one pending graph at a
     time, a compiled-segment cache, and counters."""
 
-    def __init__(self):
+    def __init__(self, max_segments: int = 32):
         self.pending: Optional[_Graph] = None
         self._cache: dict = {}
         self._aval_cache: dict = {}
+        self.max_segments = max_segments
+        self.degraded = False   # tripped the compile cap: plain eager
         self.stats = {"lazy_ops": 0, "flushes": 0, "segments_compiled": 0,
                       "segment_calls": 0, "eager_tape_ops": 0}
 
@@ -252,12 +285,19 @@ class SegmentRunner:
 
     def flush(self, graph: Optional[_Graph] = None):
         g = self.pending if graph is None else graph
-        if g is None or g.flushed:
+        if g is None:
             return
-        g.flushed = True
+        if g.flushed:
+            # a previously-failed flush must not silently yield None values
+            if any(la.value is None for la in g.outs):
+                raise RuntimeError(
+                    "lazy segment previously failed to execute; its "
+                    "outputs are unavailable")
+            return
         if g is self.pending:
             self.pending = None
         if not g.ops:
+            g.flushed = True
             return
         self.stats["flushes"] += 1
 
@@ -267,10 +307,32 @@ class SegmentRunner:
             jitted = jax.jit(functools.partial(_replay, tuple(g.ops)))
             self._cache[sig] = jitted
             self.stats["segments_compiled"] += 1
+            # varying Python scalars baked into op args (e.g. `h * s`
+            # with s from a prior .item()) compile a new segment per
+            # value; past this cap the mode has degraded below plain
+            # eager, so stop segmenting and stop caching executables
+            if self.stats["segments_compiled"] > self.max_segments:
+                self.degraded = True
+                self._cache.clear()
+                self._aval_cache.clear()
+                import logging
+
+                logging.getLogger("paddle_tpu.jit").warning(
+                    "lazy-segment cache exceeded %d compiled segments "
+                    "(per-call-varying scalar constants?); reverting this "
+                    "function to plain eager fallback", self.max_segments)
         self.stats["segment_calls"] += 1
         results = jitted(g.inputs)
+        # success: bind values, then release the recorded graph so
+        # retained output tensors don't pin inputs/ops in memory
         for la in g.outs:
             la.value = results[la.op][la.slot]
+            la.graph = None
+        g.flushed = True
+        g.inputs = []
+        g.in_avals = []
+        g.ops = []
+        g.outs = []
 
     def flush_all(self):
         self.flush(None)

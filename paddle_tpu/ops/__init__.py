@@ -188,7 +188,8 @@ def _install_tensor_methods():
     T.T = _T
 
     def _item(s, *args):
-        return s._data[args].item() if args else s._data.item()
+        d = s._mat()   # resolve lazy-segment placeholders first
+        return d[args].item() if args else d.item()
 
     T.item = _item
 

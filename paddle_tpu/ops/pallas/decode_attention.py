@@ -81,6 +81,134 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_s,
     o_ref[...] = (acc / jnp.maximum(l_i, 1e-30)[:, None]).astype(o_ref.dtype)
 
 
+def paged_decode_supported(pages_shape, n_q_heads: int) -> bool:
+    """Paged kernel constraints: page block (bs, d) must satisfy Mosaic's
+    last-two-dims rule, the cache must hold every q head (the paged
+    cache is full-head, no GQA sharing), and the double-buffered k+v
+    page working set must fit ~16MB VMEM (v5e) — larger configs take
+    the XLA gather path."""
+    _, nh, bs, d = pages_shape
+    page_bytes = nh * bs * d * 2                       # bf16
+    # k+v, double-buffered, + fp32 cast temps per page
+    if 2 * 2 * page_bytes + 3 * 2 * page_bytes > 12 * 2 ** 20:
+        return False
+    return (d in (64, 128, 256) and bs % 8 == 0
+            and nh == n_q_heads)
+
+
+def _paged_pages_per_program(max_blocks: int) -> int:
+    """Pages fetched per grid program: the kernel is program-latency
+    bound at one page each (~16us/program on v5e vs ~1us of DMA+VPU),
+    so amortize over the largest power-of-two divisor <= 4 (8 pages'
+    double-buffered k+v exceeds the ~16MB VMEM)."""
+    for k in (4, 2, 1):
+        if max_blocks % k == 0:
+            return k
+    return 1
+
+
+def _paged_decode_kernel(bt_ref, sl_ref, q_ref, *refs, bs, n_blocks,
+                         sm_scale, k_per):
+    """One (batch, block-group) program: the K k/v pages for THIS group
+    arrived via block-table-driven index maps; accumulate online-softmax
+    over the group grid dim in scratch. ``refs`` = K k-page refs, K
+    v-page refs, o_ref, then the 3 scratch refs."""
+    import jax.experimental.pallas as pl
+
+    k_refs = refs[:k_per]
+    v_refs = refs[k_per:2 * k_per]
+    o_ref = refs[2 * k_per]
+    m_sc, l_sc, acc_sc = refs[2 * k_per + 1:]
+
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nh, d = q_ref.shape
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc[...], -1e30)
+        l_sc[...] = jnp.zeros_like(l_sc[...])
+        acc_sc[...] = jnp.zeros_like(acc_sc[...])
+
+    seq_len = sl_ref[b]
+    # fully vectorized over heads on the VPU: decode is HBM-bound (one
+    # token's worth of flops per page read), so mul-reduce "dots" beat
+    # nh separate 1-row MXU dots and need no scalar scratch access
+    q = q_ref[...].astype(jnp.float32)                # [nh, d]
+    for c in range(k_per):
+        blk = j * k_per + c
+        pos = blk * bs + jax.lax.iota(jnp.int32, bs)
+        valid = pos < seq_len                         # [bs]
+        k = k_refs[c][...].astype(jnp.float32)        # [nh, bs, d]
+        v = v_refs[c][...].astype(jnp.float32)
+        s = jnp.sum(q[:, None, :] * k, axis=-1) * sm_scale  # [nh, bs]
+        s = s + jnp.where(valid, 0.0, -1e30)[None, :]
+        m_prev = m_sc[0, :]                           # [nh]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])               # [nh, bs]
+        alpha = jnp.exp(m_prev - m_new)
+        l_sc[0, :] = l_sc[0, :] * alpha + jnp.sum(p, axis=1)
+        m_sc[0, :] = m_new
+        acc_sc[...] = (acc_sc[...] * alpha[:, None]
+                       + jnp.sum(p[:, :, None] * v, axis=1))
+
+    @pl.when(j == n_blocks // k_per - 1)
+    def _fin():
+        o_ref[...] = (acc_sc[...] /
+                      jnp.maximum(l_sc[0, :], 1e-30)[:, None]
+                      ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale",))
+def paged_decode_attention_kernel(q, k_pages, v_pages, block_table,
+                                  seq_lens, sm_scale: float):
+    """Batched paged decode (reference block_multi_head_attention decode
+    branch, phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu):
+    q [B, nh, d] one token per sequence; k/v_pages
+    [n_pages, nh, bs, d]; block_table [B, max_blocks] int32;
+    seq_lens [B] int32. The block table rides scalar prefetch, and the
+    PAGE fetched for grid step (b, j) is chosen by the table inside the
+    BlockSpec index map — the repeated-KV gather of the XLA path never
+    materializes. Returns o [B, nh, d]."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, nh, d = q.shape
+    bs = k_pages.shape[2]
+    max_blocks = block_table.shape[1]
+    k_per = _paged_pages_per_program(max_blocks)
+    bt_flat = block_table.reshape(-1).astype(jnp.int32)
+
+    def page_spec(c):
+        # the page index for (b, group j, offset c) comes FROM the table
+        return pl.BlockSpec(
+            (None, nh, bs, d),
+            lambda b, j, bt, sl, c=c: (bt[b * max_blocks + j * k_per + c],
+                                       0, 0, 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                      # block_table, seq_lens
+        grid=(B, max_blocks // k_per),
+        in_specs=(
+            [pl.BlockSpec((None, nh, d), lambda b, j, bt, sl: (b, 0, 0))]
+            + [page_spec(c) for c in range(k_per)]      # k pages
+            + [page_spec(c) for c in range(k_per)]),    # v pages
+        out_specs=pl.BlockSpec((None, nh, d), lambda b, j, bt, sl: (b, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((8, nh), jnp.float32),
+                        pltpu.VMEM((8, nh), jnp.float32),
+                        pltpu.VMEM((nh, d), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_decode_kernel, bs=bs,
+                          n_blocks=max_blocks, sm_scale=sm_scale,
+                          k_per=k_per),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nh, d), q.dtype),
+        interpret=_interpret_mode(),
+    )(bt_flat, seq_lens.astype(jnp.int32), q,
+      *([k_pages] * k_per), *([v_pages] * k_per))
+
+
 @functools.partial(jax.jit, static_argnames=("sm_scale",))
 def decode_attention(q, cache_k, cache_v, pos, sm_scale: float):
     """q [B, nH, d] (one token); cache_k/v [B, nKV, S, d] (kv-head-major,

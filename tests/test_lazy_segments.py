@@ -162,6 +162,58 @@ def test_fresh_key_arrays_do_not_recompile():
     assert sfn.segment_stats["segments_compiled"] == n0, sfn.segment_stats
 
 
+def test_escaped_lazy_operators_and_stats():
+    """Operators applied directly to an escaped segmented output's buffer
+    must materialize; capture_stats() aggregates counters."""
+    from paddle_tpu.jit import capture_stats
+
+    m1, _ = _mk_model()
+
+    def fn(x):
+        with paddle.no_grad():
+            h = m1(x)
+            if float(h.mean()) > -1e9:
+                h = h + 1.0
+            return h
+
+    sfn = to_static(fn)
+    x = paddle.to_tensor(np.ones((2, 8), np.float32))
+    out = sfn(x)
+    d = out._data                       # may still be a LazyArray wrapper
+    np.testing.assert_allclose(np.asarray(-d), -np.asarray(d))
+    np.testing.assert_allclose(np.asarray(d * 2.0), 2.0 * np.asarray(d))
+    assert d[0].shape == (8,)
+    stats = capture_stats()
+    assert stats["graph_breaks"] >= 1 and stats["functions"] >= 1
+
+
+def test_varying_scalar_degrades_to_eager():
+    """`h * float(h.mean())` compiles a new suffix per distinct scalar;
+    past the cap the runner reverts to plain eager instead of paying a
+    compile per step."""
+    m1, _ = _mk_model()
+
+    def fn(x):
+        with paddle.no_grad():
+            h = m1(x)
+            s = float(h.mean())         # break; s varies per input
+            return h * s
+
+    sfn = to_static(fn)
+    rng = np.random.RandomState(5)
+    outs = []
+    for i in range(40):
+        x = paddle.to_tensor(rng.randn(2, 8).astype("float32"))
+        outs.append((x, sfn(x)))
+    assert sfn._segments.degraded
+    cap = sfn._segments.max_segments
+    assert sfn.segment_stats["segments_compiled"] <= cap + 1
+    # numerics identical before and after degradation
+    for x, got in (outs[0], outs[-1]):
+        np.testing.assert_allclose(np.asarray(got.numpy()),
+                                   fn(x).numpy(), rtol=1e-5, atol=1e-6)
+
+
 def test_unbroken_capture_unaffected():
     m1, _ = _mk_model()
 
