@@ -111,12 +111,20 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         }
 
     def _write():
-        np.savez(os.path.join(path, fname), **arrays_out)
+        # tmp + atomic rename: an elastic kill mid-save (launch controller
+        # tearing down the fleet) must never leave a torn npz beside valid
+        # metadata — the relaunched generation resumes from this file
+        tmp = os.path.join(path, f".{fname}.tmp.{os.getpid()}")
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays_out)
+        os.replace(tmp, os.path.join(path, fname))
         # every process writes its OWN chunk metadata (a coordinator-only
         # metadata file would silently drop other hosts' shards on load);
         # load merges all metadata_*.json files.
-        with open(os.path.join(path, f"metadata_{rank}.json"), "w") as f:
+        mtmp = os.path.join(path, f".metadata_{rank}.tmp.{os.getpid()}")
+        with open(mtmp, "w") as f:
             json.dump(meta, f)
+        os.replace(mtmp, os.path.join(path, f"metadata_{rank}.json"))
 
     if async_save:
         t = threading.Thread(target=_write, daemon=True)

@@ -23,7 +23,8 @@ from typing import Callable, Optional
 
 from ..store import TCPStore
 
-__all__ = ["ElasticManager", "ELASTIC_EXIT_CODE", "run_elastic"]
+__all__ = ["ElasticManager", "ElasticController", "ELASTIC_EXIT_CODE",
+           "run_elastic"]
 
 ELASTIC_EXIT_CODE = 101          # reference manager.py:33
 ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
@@ -119,27 +120,95 @@ class ElasticManager:
             t.join(timeout=2)
 
 
+class ElasticController:
+    """Trainer-side elastic glue (reference manager.py launch_watch +
+    the trainer's pre-train hook): heartbeats membership, watches for a
+    live-host set that no longer matches the launched world size, and
+    tells the training loop to checkpoint and exit for rescale.
+
+    Usage in a training loop::
+
+        ctl = ElasticController(manager, world_size)
+        ctl.start()
+        for step in ...:
+            if ctl.should_rescale():
+                save_checkpoint(...)
+                ctl.exit_for_rescale()      # sys.exit(101)
+            train_step(...)
+    """
+
+    def __init__(self, manager: ElasticManager, world_size: int,
+                 interval: float = 1.0):
+        self.manager = manager
+        self.world_size = world_size
+        self.interval = interval
+        self._rescale = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self.manager.register()
+        self._thread = threading.Thread(target=self._watch, daemon=True)
+        self._thread.start()
+
+    def _watch(self):
+        assembled = False
+        while not self.manager._stop.wait(self.interval):
+            live = self.manager.live_hosts()
+            if not assembled:
+                # launch skew grace: peers register at different times;
+                # only after the fleet has assembled once does a
+                # deviation mean an actual membership change
+                assembled = len(live) >= self.world_size
+                continue
+            if live and len(live) != self.world_size:
+                self._rescale.set()
+                if self.manager.on_change is not None:
+                    self.manager.on_change(live)
+                return
+
+    def should_rescale(self) -> bool:
+        return self._rescale.is_set()
+
+    def exit_for_rescale(self):
+        import sys
+
+        self.manager.exit(completed=False)
+        sys.exit(ELASTIC_EXIT_CODE)
+
+
 def run_elastic(script: str, script_args=None, nprocs: int = 1,
                 max_restarts: int = 3, log_dir=None, master=None,
-                env_extra=None) -> int:
+                env_extra=None, nprocs_fn: Optional[Callable[[int], int]]
+                = None) -> int:
     """Elastic trainer supervision (reference manager.py:125 watch loop +
     controller relaunch): run the fleet via the launch controller; when a
     generation exits with ELASTIC_EXIT_CODE (membership change — the
     trainer checkpointed and asked for relaunch) or dies abnormally,
     relaunch with a fresh rendezvous, up to ``max_restarts`` times.
     Returns the final generation's exit code (0 = trained to completion).
+
+    ``nprocs_fn(attempt)``: per-generation world size — the reference's
+    endpoint recomputation on relaunch (manager.py:410-513). Pass a
+    membership probe (e.g. ``lambda a: len(mgr.live_hosts())``) so a
+    generation launched after a node loss runs at the NEW world size and
+    the trainers reshard their checkpoint on load.
     """
     from ..launch import launch_procs
 
     attempt = 0
     while True:
+        # attempt 0 is the INITIAL launch: membership probes are empty
+        # before any trainer heartbeats, so only restarts recompute
+        world = nprocs if (nprocs_fn is None or attempt == 0) else max(
+            1, int(nprocs_fn(attempt)))
         env = dict(env_extra or {})
         env["PADDLE_ELASTIC_RESTART"] = str(attempt)
+        env["PADDLE_ELASTIC_NP"] = str(world)
         # per-generation subdir: a relaunch must not truncate the previous
         # generation's logs (they hold the crash being debugged)
         gen_dir = None if log_dir is None else \
             os.path.join(log_dir, f"restart_{attempt}")
-        rc = launch_procs(script, list(script_args or []), nprocs,
+        rc = launch_procs(script, list(script_args or []), world,
                           master=master, env_extra=env, log_dir=gen_dir)
         if rc == 0:
             return 0

@@ -219,7 +219,8 @@ def paged_decode_attention(q, k_pages, v_pages, block_table, seq_lens):
     from ....ops.pallas.decode_attention import (
         paged_decode_attention_kernel, paged_decode_supported)
 
-    if paged_decode_supported(k_pages.shape, q.shape[2]):
+    if paged_decode_supported(k_pages.shape, q.shape[2],
+                              max_blocks=max_blocks):
         o = paged_decode_attention_kernel(
             q[:, 0].astype(k_pages.dtype), k_pages, v_pages, block_table,
             seq_lens, 1.0 / math.sqrt(dh))

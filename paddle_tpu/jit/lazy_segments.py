@@ -288,11 +288,6 @@ class SegmentRunner:
         if g is None:
             return
         if g.flushed:
-            # a previously-failed flush must not silently yield None values
-            if any(la.value is None for la in g.outs):
-                raise RuntimeError(
-                    "lazy segment previously failed to execute; its "
-                    "outputs are unavailable")
             return
         if g is self.pending:
             self.pending = None

@@ -81,16 +81,19 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_s,
     o_ref[...] = (acc / jnp.maximum(l_i, 1e-30)[:, None]).astype(o_ref.dtype)
 
 
-def paged_decode_supported(pages_shape, n_q_heads: int) -> bool:
+def paged_decode_supported(pages_shape, n_q_heads: int,
+                           max_blocks: int | None = None) -> bool:
     """Paged kernel constraints: page block (bs, d) must satisfy Mosaic's
     last-two-dims rule, the cache must hold every q head (the paged
-    cache is full-head, no GQA sharing), and the double-buffered k+v
-    page working set must fit ~16MB VMEM (v5e) — larger configs take
-    the XLA gather path."""
+    cache is full-head, no GQA sharing), and the k_per-page
+    double-buffered k+v working set must fit ~16MB VMEM (v5e) — larger
+    configs take the XLA gather path."""
     _, nh, bs, d = pages_shape
-    page_bytes = nh * bs * d * 2                       # bf16
-    # k+v, double-buffered, + fp32 cast temps per page
-    if 2 * 2 * page_bytes + 3 * 2 * page_bytes > 12 * 2 ** 20:
+    k_per = (_paged_pages_per_program(max_blocks)
+             if max_blocks is not None else 4)     # worst case when unknown
+    page_bytes = nh * bs * d * 2                   # bf16
+    # k+v double-buffered for all k_per pages + fp32 cast temps per page
+    if k_per * (2 * 2 * page_bytes + 3 * 2 * page_bytes) > 12 * 2 ** 20:
         return False
     return (d in (64, 128, 256) and bs % 8 == 0
             and nh == n_q_heads)

@@ -1,0 +1,117 @@
+"""Worker for test_elastic_e2e: checkpointed DP training with elastic
+membership.
+
+Each process is one elastic "node": it heartbeats via ElasticManager,
+trains a tiny model data-parallel, checkpoints every step, and resumes
+from the checkpoint (resharding) when relaunched at a different world
+size. Rank 1 of generation 0 simulates a node failure by dying after a
+few steps. Prints STEP/RESUMED/DONE markers the test asserts on.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.distributed.launch import init_from_env
+
+# a rescaled-to-one generation is single-process: init_from_env
+# deliberately skips jax.distributed there
+inited = init_from_env()
+assert inited or os.environ.get("PADDLE_TRAINERS_NUM", "1") == "1", \
+    "launcher env not detected"
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                               save_state_dict)
+from paddle_tpu.distributed.fleet.elastic import (ELASTIC_EXIT_CODE,
+                                                  ElasticController,
+                                                  ElasticManager)
+
+rank = jax.process_index()
+nproc = jax.process_count()
+gen = int(os.environ.get("PADDLE_ELASTIC_RESTART", "0"))
+ckpt = os.environ["ELASTIC_CKPT_DIR"]
+membership_master = os.environ["ELASTIC_MEMBER_MASTER"]
+total_steps = int(os.environ.get("ELASTIC_TOTAL_STEPS", "6"))
+die_rank = int(os.environ.get("ELASTIC_DIE_RANK", "1"))
+die_gen = int(os.environ.get("ELASTIC_DIE_GEN", "0"))
+die_after = int(os.environ.get("ELASTIC_DIE_AFTER", "3"))
+
+# membership: one elastic node per process, named by STABLE node id so a
+# relaunched generation reuses the surviving nodes' identities
+mgr = ElasticManager(host=f"node{rank}", np=nproc, ttl=1.5,
+                     heartbeat_interval=0.3, master=membership_master,
+                     is_master=False)
+ctl = ElasticController(mgr, world_size=nproc, interval=0.5)
+ctl.start()
+
+mesh = Mesh(np.array(jax.devices()).reshape(nproc), ("dp",))
+
+# toy regression model trained DP on a fixed global batch
+rng = np.random.RandomState(0)
+Xg = rng.randn(8, 16).astype(np.float32)
+Yg = (Xg @ rng.randn(16, 4) * 0.1).astype(np.float32)
+W0 = rng.randn(16, 4).astype(np.float32) * 0.01
+
+from paddle_tpu.core.tensor import Tensor
+
+state = {"w": Tensor(jnp.asarray(W0)), "step": Tensor(jnp.zeros((), jnp.int32))}
+if os.path.exists(os.path.join(ckpt, "metadata_0.json")):
+    load_state_dict(state, ckpt)   # fills the Tensors in place, resharding
+    print(f"RESUMED step={int(state['step']._data)}", flush=True)
+    if rank == 0:
+        # drop dead ranks' shard metadata: later saves only refresh the
+        # live ranks' files, and a merge must not resurrect stale chunks
+        import glob as _glob
+
+        for m in _glob.glob(os.path.join(ckpt, "metadata_*.json")):
+            r = int(os.path.basename(m)[len("metadata_"):-len(".json")])
+            if r >= nproc:
+                os.remove(m)
+
+shard = 8 // nproc
+sl = slice(rank * shard, (rank + 1) * shard)
+sharding = NamedSharding(mesh, P("dp"))
+X = jax.make_array_from_process_local_data(sharding, Xg[sl])
+Y = jax.make_array_from_process_local_data(sharding, Yg[sl])
+
+
+@jax.jit
+def train_step(w, x, y):
+    def loss_fn(w):
+        return ((x @ w - y) ** 2).mean()
+
+    loss, g = jax.value_and_grad(loss_fn)(w)
+    return loss, w - 0.1 * g
+
+
+step = int(state["step"]._data)
+while step < total_steps:
+    if ctl.should_rescale():
+        save_state_dict(state, ckpt)
+        print(f"RESCALE_EXIT step={step}", flush=True)
+        ctl.exit_for_rescale()
+    loss, w = train_step(state["w"]._data, X, Y)
+    step += 1
+    state = {"w": Tensor(w), "step": Tensor(jnp.asarray(step, jnp.int32))}
+    save_state_dict(state, ckpt)
+    print(f"STEP {step} LOSS {float(loss):.6f}", flush=True)
+    if gen == die_gen and rank == die_rank and step >= die_after:
+        print("SIMULATED_NODE_FAILURE", flush=True)
+        os._exit(1)
+
+print(f"DONE step={step} final_loss={float(loss):.6f}", flush=True)
+mgr.exit()
+sys.exit(0)
