@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -113,15 +114,18 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
     def _write():
         # tmp + atomic rename: an elastic kill mid-save (launch controller
         # tearing down the fleet) must never leave a torn npz beside valid
-        # metadata — the relaunched generation resumes from this file
-        tmp = os.path.join(path, f".{fname}.tmp.{os.getpid()}")
+        # metadata — the relaunched generation resumes from this file.
+        # uniquified per-write: overlapping async saves from one process
+        # must not interleave into the same tmp file
+        uid = f"{os.getpid()}.{threading.get_ident()}.{time.monotonic_ns()}"
+        tmp = os.path.join(path, f".{fname}.tmp.{uid}")
         with open(tmp, "wb") as f:
             np.savez(f, **arrays_out)
         os.replace(tmp, os.path.join(path, fname))
         # every process writes its OWN chunk metadata (a coordinator-only
         # metadata file would silently drop other hosts' shards on load);
         # load merges all metadata_*.json files.
-        mtmp = os.path.join(path, f".metadata_{rank}.tmp.{os.getpid()}")
+        mtmp = os.path.join(path, f".metadata_{rank}.tmp.{uid}")
         with open(mtmp, "w") as f:
             json.dump(meta, f)
         os.replace(mtmp, os.path.join(path, f"metadata_{rank}.json"))

@@ -155,10 +155,11 @@ class ElasticController:
         while not self.manager._stop.wait(self.interval):
             live = self.manager.live_hosts()
             if not assembled:
-                # launch skew grace: peers register at different times;
-                # only after the fleet has assembled once does a
-                # deviation mean an actual membership change
-                assembled = len(live) >= self.world_size
+                # launch skew grace: peers register at different times,
+                # and a rescaled-down generation can still see the dead
+                # node's unexpired lease — require EXACT assembly before
+                # a deviation means an actual membership change
+                assembled = len(live) == self.world_size
                 continue
             if live and len(live) != self.world_size:
                 self._rescale.set()

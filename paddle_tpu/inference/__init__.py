@@ -152,6 +152,10 @@ class Predictor:
             from .. import jit as _jit
 
             payload = _jit.load(config.model_path)
+            if isinstance(payload, _jit.TranslatedLayer):
+                # a .pdmodel program artifact: runnable directly, no
+                # model class needed
+                return payload, ["x"]
             cls_path = payload["class"]
             mod, _, qual = cls_path.rpartition(".")
             import importlib

@@ -30,26 +30,140 @@ def ignore_module(modules):
     return None
 
 
+def _spec_to_example(spec, sym_prefix: str):
+    """InputSpec / Tensor / ndarray / (shape, dtype) -> export argument.
+    Dynamic dims (None/-1) become jax.export symbolic dimensions, so the
+    saved program accepts any size there (reference InputSpec
+    semantics), not a frozen example size."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor as _T
+
+    if isinstance(spec, _T):
+        return spec._data
+    if hasattr(spec, "shape") and hasattr(spec, "dtype"):
+        shape, dtype = list(spec.shape), spec.dtype
+    else:
+        shape, dtype = list(spec[0]), spec[1]
+    if any(d is None or d == -1 for d in shape):
+        dims = ",".join(f"{sym_prefix}d{i}" if (d is None or d == -1)
+                        else str(int(d)) for i, d in enumerate(shape))
+        sym = jax.export.symbolic_shape(dims)
+        return jax.ShapeDtypeStruct(sym, jnp.dtype(dtype))
+    return jnp.zeros([int(d) for d in shape], dtype)
+
+
+def jnp_asarray(v):
+    import jax.numpy as jnp
+
+    return jnp.asarray(v)
+
+
 def save(layer, path, input_spec=None, **configs):
-    """Save a layer/function for deployment (reference jit/api.py save →
-    TranslatedLayer program + params). Serialises the state_dict plus the
-    layer class qualname; the program itself is re-traced at load (XLA
-    executables are not portable artifacts the way ProgramDesc is)."""
+    """Save a layer for deployment (reference jit/api.py save →
+    TranslatedLayer: serialized program + params).
+
+    Always writes ``<path>.pdparams`` (pickled state_dict + class name).
+    With ``input_spec`` (list of InputSpec / example Tensors /
+    (shape, dtype) tuples), ALSO writes ``<path>.pdmodel``: a
+    ``jax.export`` serialization of the traced forward — a portable
+    StableHLO program artifact, the role of the reference's saved
+    ProgramDesc (fluid/jit/serializer.h). ``jit.load`` then runs it
+    without the model class being importable."""
     import pickle
 
+    sd = layer.state_dict()
     state = {
         "class": f"{type(layer).__module__}.{type(layer).__qualname__}",
-        "state_dict": {k: v.numpy() for k, v in layer.state_dict().items()},
+        "state_dict": {k: v.numpy() for k, v in sd.items()},
     }
-    with open(path + ".pdparams" if not path.endswith(".pdparams") else path,
-              "wb") as f:
+    base = path[:-len(".pdparams")] if path.endswith(".pdparams") else path
+    with open(base + ".pdparams", "wb") as f:
         pickle.dump(state, f)
+    if input_spec is None:
+        return
+
+    import jax
+
+    from ..core.tensor import Tensor as _T
+
+    examples = [_spec_to_example(s, f"s{i}_")
+                for i, s in enumerate(input_spec)]
+    keys = list(sd.keys())
+    params = [sd[k]._data if isinstance(sd[k], _T) else jnp_asarray(sd[k])
+              for k in keys]
+    param_objs = [sd[k] for k in keys]
+
+    def pure(flat_params, *xs):
+        # bind tracers into the live parameters, run (inference mode: the
+        # tape must not capture export tracers), restore
+        from ..core import autograd as _ag
+
+        old = [p._data for p in param_objs]
+        try:
+            for p, v in zip(param_objs, flat_params):
+                p._data = v
+            with _ag.no_grad():
+                out = layer(*[_T(x) for x in xs])
+        finally:
+            for p, v in zip(param_objs, old):
+                p._data = v
+        # multi-output layers return tuples/lists of Tensors
+        return jax.tree.map(
+            lambda o: o._data if isinstance(o, _T) else o, out,
+            is_leaf=lambda o: isinstance(o, _T))
+
+    exported = jax.export.export(jax.jit(pure))(params, *examples)
+    with open(base + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+
+
+class TranslatedLayer:
+    """A deployable loaded program (reference jit/translated_layer.py):
+    the serialized StableHLO artifact + its parameters; callable without
+    the original model class."""
+
+    def __init__(self, exported, params, state):
+        self._exported = exported
+        self._params = params
+        self._state = state
+
+    def __call__(self, *xs):
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor as _T
+
+        arrs = [x._data if isinstance(x, _T) else jnp.asarray(x)
+                for x in xs]
+        out = self._exported.call(self._params, *arrs)
+        return jax.tree.map(lambda o: _T(o, stop_gradient=True), out)
+
+    forward = __call__
+
+    def state_dict(self):
+        return self._state["state_dict"]
 
 
 def load(path, **configs):
-    """Load a saved state dict (pair with jit.save)."""
+    """Load a ``jit.save`` artifact. With a ``.pdmodel`` beside the
+    params, returns a runnable :class:`TranslatedLayer`; otherwise the
+    raw pickled envelope (state_dict + class name) for re-binding."""
+    import os
     import pickle
 
-    p = path + ".pdparams" if not path.endswith(".pdparams") else path
-    with open(p, "rb") as f:
-        return pickle.load(f)
+    import jax.numpy as jnp
+
+    base = path[:-len(".pdparams")] if path.endswith(".pdparams") else path
+    with open(base + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    model_path = base + ".pdmodel"
+    if os.path.exists(model_path):
+        import jax
+
+        with open(model_path, "rb") as f:
+            exported = jax.export.deserialize(bytearray(f.read()))
+        params = [jnp.asarray(v) for v in state["state_dict"].values()]
+        return TranslatedLayer(exported, params, state)
+    return state

@@ -38,6 +38,6 @@ class AlexNet(nn.Layer):
 
 
 def alexnet(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("no bundled pretrained weights")
-    return AlexNet(**kwargs)
+    from ...hapi.weights import maybe_load_pretrained
+
+    return maybe_load_pretrained(AlexNet(**kwargs), pretrained)

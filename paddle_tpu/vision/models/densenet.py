@@ -110,9 +110,9 @@ class DenseNet(nn.Layer):
 
 
 def _densenet(layers, pretrained, **kwargs):
-    if pretrained:
-        raise NotImplementedError("pretrained weights not bundled")
-    return DenseNet(layers=layers, **kwargs)
+    from ...hapi.weights import maybe_load_pretrained
+
+    return maybe_load_pretrained(DenseNet(layers=layers, **kwargs), pretrained)
 
 
 def densenet121(pretrained=False, **kwargs):

@@ -96,6 +96,6 @@ class GoogLeNet(nn.Layer):
 
 
 def googlenet(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("pretrained weights not bundled")
-    return GoogLeNet(**kwargs)
+    from ...hapi.weights import maybe_load_pretrained
+
+    return maybe_load_pretrained(GoogLeNet(**kwargs), pretrained)

@@ -160,6 +160,6 @@ class InceptionV3(nn.Layer):
 
 
 def inception_v3(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("pretrained weights not bundled")
-    return InceptionV3(**kwargs)
+    from ...hapi.weights import maybe_load_pretrained
+
+    return maybe_load_pretrained(InceptionV3(**kwargs), pretrained)

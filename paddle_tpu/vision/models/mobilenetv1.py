@@ -75,7 +75,6 @@ class MobileNetV1(nn.Layer):
 
 
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
-    if pretrained:
-        raise NotImplementedError(
-            "pretrained weights are not bundled (zero-egress build)")
-    return MobileNetV1(scale=scale, **kwargs)
+    from ...hapi.weights import maybe_load_pretrained
+
+    return maybe_load_pretrained(MobileNetV1(scale=scale, **kwargs), pretrained)

@@ -91,6 +91,6 @@ class MobileNetV2(nn.Layer):
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
-    if pretrained:
-        raise NotImplementedError("no bundled pretrained weights")
-    return MobileNetV2(scale=scale, **kwargs)
+    from ...hapi.weights import maybe_load_pretrained
+
+    return maybe_load_pretrained(MobileNetV2(scale=scale, **kwargs), pretrained)

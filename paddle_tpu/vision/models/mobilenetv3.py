@@ -141,12 +141,12 @@ class MobileNetV3Large(_MobileNetV3):
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
-    if pretrained:
-        raise NotImplementedError("pretrained weights not bundled")
-    return MobileNetV3Small(scale=scale, **kwargs)
+    from ...hapi.weights import maybe_load_pretrained
+
+    return maybe_load_pretrained(MobileNetV3Small(scale=scale, **kwargs), pretrained)
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
-    if pretrained:
-        raise NotImplementedError("pretrained weights not bundled")
-    return MobileNetV3Large(scale=scale, **kwargs)
+    from ...hapi.weights import maybe_load_pretrained
+
+    return maybe_load_pretrained(MobileNetV3Large(scale=scale, **kwargs), pretrained)

@@ -129,11 +129,10 @@ class ResNet(nn.Layer):
 
 
 def _resnet(block, depth, pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError(
-            "pretrained weights are not bundled (zero-egress build); load a "
-            "state_dict via set_state_dict instead")
-    return ResNet(block, depth, **kwargs)
+    from ...hapi.weights import maybe_load_pretrained
+
+    return maybe_load_pretrained(ResNet(block, depth, **kwargs),
+                                 pretrained, f"resnet{depth}")
 
 
 def resnet18(pretrained=False, **kwargs):

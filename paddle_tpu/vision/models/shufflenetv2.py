@@ -120,9 +120,9 @@ class ShuffleNetV2(nn.Layer):
 
 
 def _make(scale, act="relu", pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("pretrained weights not bundled")
-    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+    from ...hapi.weights import maybe_load_pretrained
+
+    return maybe_load_pretrained(ShuffleNetV2(scale=scale, act=act, **kwargs), pretrained)
 
 
 def shufflenet_v2_x0_25(pretrained=False, **kw):

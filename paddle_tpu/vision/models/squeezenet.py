@@ -82,12 +82,12 @@ class SqueezeNet(nn.Layer):
 
 
 def squeezenet1_0(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("pretrained weights not bundled")
-    return SqueezeNet("1.0", **kwargs)
+    from ...hapi.weights import maybe_load_pretrained
+
+    return maybe_load_pretrained(SqueezeNet("1.0", **kwargs), pretrained)
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("pretrained weights not bundled")
-    return SqueezeNet("1.1", **kwargs)
+    from ...hapi.weights import maybe_load_pretrained
+
+    return maybe_load_pretrained(SqueezeNet("1.1", **kwargs), pretrained)

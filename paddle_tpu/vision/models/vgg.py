@@ -62,9 +62,9 @@ class VGG(nn.Layer):
 
 
 def _vgg(arch, cfg, batch_norm, pretrained, **kwargs):
-    if pretrained:
-        raise NotImplementedError("no bundled pretrained weights")
-    return VGG(_make_layers(_CFGS[cfg], batch_norm), **kwargs)
+    from ...hapi.weights import maybe_load_pretrained
+
+    return maybe_load_pretrained(VGG(_make_layers(_CFGS[cfg], batch_norm), **kwargs), pretrained)
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):

@@ -1,0 +1,181 @@
+"""Model-zoo weight loading + deployable program serialization
+(VERDICT r2 item 8; inventory row #20 static program artifacts).
+
+(a) load_weights: reference-format .pdparams / npz / torch-style
+    checkpoints fill zoo models, with name normalization (module.
+    prefixes, running_mean/var) and torch Linear transposition —
+    synthesized files, no network.
+(b) jit.save/load: jax.export StableHLO artifact round-trips and runs
+    WITHOUT the model class, matching eager outputs.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+pytestmark = pytest.mark.smoke
+
+
+def _synth_checkpoint(model, mangle):
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    return {mangle(k): v for k, v in sd.items()}
+
+
+def test_load_weights_pdparams_roundtrip(tmp_path):
+    from paddle_tpu.hapi.weights import load_weights
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(0)
+    src = resnet18(num_classes=10)
+    ck = _synth_checkpoint(src, lambda k: k)
+    p = tmp_path / "r18.pdparams"
+    with open(p, "wb") as f:
+        pickle.dump(ck, f)
+
+    paddle.seed(1)
+    dst = resnet18(num_classes=10)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 32, 32)
+                         .astype("float32"))
+    assert not np.allclose(src.state_dict()["conv1.weight"].numpy(),
+                           dst.state_dict()["conv1.weight"].numpy())
+    report = load_weights(dst, str(p))
+    assert not report["missing"] and not report["unexpected"]
+    for k, v in src.state_dict().items():
+        np.testing.assert_allclose(v.numpy(),
+                                   dst.state_dict()[k].numpy(), rtol=1e-6)
+    np.testing.assert_allclose(src(x).numpy(), dst(x).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_load_weights_torch_style_names(tmp_path):
+    """module. prefix + running_mean/var + [out,in] Linear kernels."""
+    from paddle_tpu.hapi.weights import load_weights
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(2)
+    src = resnet18(num_classes=7)
+
+    def mangle(k):
+        k = "module." + k
+        k = k.replace("._mean", ".running_mean")
+        k = k.replace("._variance", ".running_var")
+        return k
+
+    ck = _synth_checkpoint(src, mangle)
+    ck["module.fc.weight"] = ck["module.fc.weight"].T   # torch layout
+    ck["module.bn1.num_batches_tracked"] = np.zeros((), np.int64)
+    p = tmp_path / "r18_torch.pdparams"
+    with open(p, "wb") as f:
+        pickle.dump({"state_dict": ck}, f)
+
+    paddle.seed(3)
+    dst = resnet18(num_classes=7)
+    report = load_weights(dst, str(p))
+    assert "fc.weight" in report["transposed"]
+    assert not report["missing"] and not report["unexpected"]
+    x = paddle.to_tensor(np.random.RandomState(1).randn(1, 3, 32, 32)
+                         .astype("float32"))
+    np.testing.assert_allclose(src(x).numpy(), dst(x).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pretrained_path_and_errors(tmp_path):
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(4)
+    src = resnet18(num_classes=4)
+    p = tmp_path / "w.pdparams"
+    with open(p, "wb") as f:
+        pickle.dump(_synth_checkpoint(src, lambda k: k), f)
+    m = resnet18(pretrained=str(p), num_classes=4)
+    x = paddle.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+    np.testing.assert_allclose(m(x).numpy(), src(x).numpy(), rtol=1e-5,
+                               atol=1e-5)
+    with pytest.raises(NotImplementedError):
+        resnet18(pretrained=True)
+    # shape mismatch is a hard error, not silent corruption
+    from paddle_tpu.hapi.weights import load_weights
+
+    with pytest.raises(ValueError):
+        load_weights(resnet18(num_classes=5), str(p))
+
+
+def test_jit_save_load_program_artifact(tmp_path):
+    """The .pdmodel artifact runs the forward WITHOUT the class."""
+    from paddle_tpu import jit
+
+    paddle.seed(5)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    x = np.random.RandomState(2).randn(3, 8).astype("float32")
+    eager = net(paddle.to_tensor(x)).numpy()
+
+    base = str(tmp_path / "deploy")
+    jit.save(net, base, input_spec=[((3, 8), "float32")])
+
+    loaded = jit.load(base)
+    assert type(loaded).__name__ == "TranslatedLayer"
+    out = loaded(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, eager, rtol=1e-5, atol=1e-6)
+    # numpy input works too; params round-tripped
+    out2 = loaded(x).numpy()
+    np.testing.assert_allclose(out2, eager, rtol=1e-5, atol=1e-6)
+    assert len(loaded.state_dict()) == len(net.state_dict())
+
+
+def test_jit_save_dynamic_batch(tmp_path):
+    """None/-1 dims export as jax symbolic dims: the artifact accepts any
+    batch size (reference InputSpec semantics)."""
+    from paddle_tpu import jit
+
+    paddle.seed(6)
+    net = nn.Sequential(nn.Linear(8, 4))
+    base = str(tmp_path / "dyn")
+    jit.save(net, base, input_spec=[((None, 8), "float32")])
+    loaded = jit.load(base)
+    for b in (1, 3, 7):
+        x = np.random.RandomState(b).randn(b, 8).astype("float32")
+        np.testing.assert_allclose(loaded(x).numpy(),
+                                   net(paddle.to_tensor(x)).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_jit_save_multi_output(tmp_path):
+    """Multi-output forwards export and load as tuples."""
+    from paddle_tpu import jit
+    from paddle_tpu.nn.layer.layers import Layer
+
+    class TwoHead(Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(4, 2)
+            self.b = nn.Linear(4, 3)
+
+        def forward(self, x):
+            return self.a(x), self.b(x)
+
+    paddle.seed(7)
+    net = TwoHead()
+    base = str(tmp_path / "two")
+    jit.save(net, base, input_spec=[((2, 4), "float32")])
+    loaded = jit.load(base)
+    x = np.random.RandomState(9).randn(2, 4).astype("float32")
+    got = loaded(x)
+    want = net(paddle.to_tensor(x))
+    assert isinstance(got, tuple) and len(got) == 2
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g.numpy(), w.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_jit_save_without_spec_is_params_only(tmp_path):
+    from paddle_tpu import jit
+
+    net = nn.Linear(4, 4)
+    base = str(tmp_path / "params_only")
+    jit.save(net, base)
+    env = jit.load(base)
+    assert isinstance(env, dict) and "state_dict" in env
