@@ -154,8 +154,10 @@ class Predictor:
             payload = _jit.load(config.model_path)
             if isinstance(payload, _jit.TranslatedLayer):
                 # a .pdmodel program artifact: runnable directly, no
-                # model class needed
-                return payload, ["x"]
+                # model class needed; one named handle per program input
+                n = payload.n_inputs
+                return payload, (["x"] if n == 1
+                                 else [f"x{i}" for i in range(n)])
             cls_path = payload["class"]
             mod, _, qual = cls_path.rpartition(".")
             import importlib

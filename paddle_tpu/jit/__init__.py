@@ -30,11 +30,12 @@ def ignore_module(modules):
     return None
 
 
-def _spec_to_example(spec, sym_prefix: str):
+def _spec_to_example(spec, sym_prefix: str, scope):
     """InputSpec / Tensor / ndarray / (shape, dtype) -> export argument.
     Dynamic dims (None/-1) become jax.export symbolic dimensions, so the
     saved program accepts any size there (reference InputSpec
-    semantics), not a frozen example size."""
+    semantics), not a frozen example size. All specs must share ONE
+    ``scope`` — jax.export rejects symbolic dims from mixed scopes."""
     import jax
     import jax.numpy as jnp
 
@@ -49,15 +50,9 @@ def _spec_to_example(spec, sym_prefix: str):
     if any(d is None or d == -1 for d in shape):
         dims = ",".join(f"{sym_prefix}d{i}" if (d is None or d == -1)
                         else str(int(d)) for i, d in enumerate(shape))
-        sym = jax.export.symbolic_shape(dims)
+        sym = jax.export.symbolic_shape(dims, scope=scope)
         return jax.ShapeDtypeStruct(sym, jnp.dtype(dtype))
     return jnp.zeros([int(d) for d in shape], dtype)
-
-
-def jnp_asarray(v):
-    import jax.numpy as jnp
-
-    return jnp.asarray(v)
 
 
 def save(layer, path, input_spec=None, **configs):
@@ -73,10 +68,13 @@ def save(layer, path, input_spec=None, **configs):
     without the model class being importable."""
     import pickle
 
+    import numpy as _np
+
     sd = layer.state_dict()
     state = {
         "class": f"{type(layer).__module__}.{type(layer).__qualname__}",
-        "state_dict": {k: v.numpy() for k, v in sd.items()},
+        "state_dict": {k: (v.numpy() if hasattr(v, "numpy")
+                           else _np.asarray(v)) for k, v in sd.items()},
     }
     base = path[:-len(".pdparams")] if path.endswith(".pdparams") else path
     with open(base + ".pdparams", "wb") as f:
@@ -88,12 +86,20 @@ def save(layer, path, input_spec=None, **configs):
 
     from ..core.tensor import Tensor as _T
 
-    examples = [_spec_to_example(s, f"s{i}_")
+    scope = jax.export.SymbolicScope()
+    examples = [_spec_to_example(s, f"s{i}_", scope)
                 for i, s in enumerate(input_spec)]
-    keys = list(sd.keys())
-    params = [sd[k]._data if isinstance(sd[k], _T) else jnp_asarray(sd[k])
-              for k in keys]
+    # only Tensor-backed entries ride as program parameters (they can be
+    # tracer-rebound); any raw-array entries stay baked constants. The
+    # exported key subset is recorded so load feeds params in the same
+    # order.
+    keys = [k for k in sd if isinstance(sd[k], _T)]
+    params = [sd[k]._data for k in keys]
     param_objs = [sd[k] for k in keys]
+    state["exported_params"] = keys
+    state["n_inputs"] = len(input_spec)
+    with open(base + ".pdparams", "wb") as f:
+        pickle.dump(state, f)          # rewrite with export metadata
 
     def pure(flat_params, *xs):
         # bind tracers into the live parameters, run (inference mode: the
@@ -145,6 +151,10 @@ class TranslatedLayer:
     def state_dict(self):
         return self._state["state_dict"]
 
+    @property
+    def n_inputs(self) -> int:
+        return int(self._state.get("n_inputs", 1))
+
 
 def load(path, **configs):
     """Load a ``jit.save`` artifact. With a ``.pdmodel`` beside the
@@ -164,6 +174,7 @@ def load(path, **configs):
 
         with open(model_path, "rb") as f:
             exported = jax.export.deserialize(bytearray(f.read()))
-        params = [jnp.asarray(v) for v in state["state_dict"].values()]
+        keys = state.get("exported_params", list(state["state_dict"]))
+        params = [jnp.asarray(state["state_dict"][k]) for k in keys]
         return TranslatedLayer(exported, params, state)
     return state

@@ -162,7 +162,152 @@ def _map_layer(lyr, idx, cur, nodes, inits):
             nodes.append(P.node("GlobalAveragePool", [cur], [out],
                                 name=f"gap{idx}"))
             return out
+    if isinstance(lyr, nn.LayerNorm):
+        shape = getattr(lyr, "_normalized_shape",
+                        getattr(lyr, "normalized_shape", None))
+        if shape is None or lyr.weight is None or lyr.bias is None:
+            return None
+        shape = [shape] if isinstance(shape, int) else list(shape)
+        nodes.append(P.node(
+            "LayerNormalization",
+            [cur, w("scale", lyr.weight._data), w("bias", lyr.bias._data)],
+            [out], name=f"ln{idx}", axis=-len(shape),
+            epsilon=float(getattr(lyr, "_epsilon", 1e-5))))
+        return out
+    if isinstance(lyr, nn.Embedding):
+        # Gather(weight [V, E], int indices)
+        nodes.append(P.node("Gather", [w("W", lyr.weight._data), cur],
+                            [out], name=f"emb{idx}", axis=0))
+        return out
+    if isinstance(lyr, nn.MultiHeadAttention):
+        return _map_mha(lyr, idx, cur, cur, nodes, inits, out, w)
+    if isinstance(lyr, nn.TransformerEncoderLayer):
+        return _map_encoder_layer(lyr, idx, cur, nodes, inits, out, w)
+    if isinstance(lyr, nn.TransformerEncoder):
+        for j, sub in enumerate(lyr.layers):
+            sidx = f"{idx}_{j}"
+            nxt = _map_encoder_layer(
+                sub, sidx, cur, nodes, inits, f"t{sidx}",
+                lambda name, arr, s=sidx: w(f"{name}_{s}", arr))
+            if nxt is None:
+                return None
+            cur = nxt
+        if lyr.norm is not None:
+            nxt = _map_layer(lyr.norm, f"{idx}_norm", cur, nodes, inits)
+            if nxt is None:
+                return None
+            cur = nxt
+        nodes.append(P.node("Identity", [cur], [out], name=f"enc{idx}"))
+        return out
     return None
+
+
+def _emit_linear(P, nodes, w, lin, cur, out, tag):
+    mm = f"{out}_mm" if lin.bias is not None else out
+    nodes.append(P.node("MatMul", [cur, w(f"{tag}W", lin.weight._data)],
+                        [mm], name=f"{tag}mm_{out}"))
+    if lin.bias is not None:
+        nodes.append(P.node("Add", [mm, w(f"{tag}B", lin.bias._data)],
+                            [out], name=f"{tag}b_{out}"))
+    return out
+
+
+def _map_mha(lyr, idx, q_in, kv_in, nodes, inits, out, w):
+    """Self-attention MultiHeadAttention (no mask, no cache) as explicit
+    ONNX ops: per-head scaled dot-product with Reshape([0,0,H,D]) /
+    Transpose plumbing — the reference paddle2onnx lowering shape."""
+    import numpy as np
+
+    from . import _proto as P
+
+    if getattr(lyr, "need_weights", False):
+        return None
+    H, D = lyr.num_heads, lyr.head_dim
+    scale = 1.0 / float(np.sqrt(D))
+
+    def reshape_to_heads(src, tag):
+        shp = w(f"{tag}shape", np.asarray([0, 0, H, D], np.int64))
+        nodes.append(P.node("Reshape", [src, shp], [f"{src}_h4"],
+                            name=f"rs_{src}"))
+        nodes.append(P.node("Transpose", [f"{src}_h4"], [f"{src}_bhsd"],
+                            name=f"tp_{src}", perm=[0, 2, 1, 3]))
+        return f"{src}_bhsd"
+
+    q = _emit_linear(P, nodes, w, lyr.q_proj, q_in, f"{out}_q", "q")
+    k = _emit_linear(P, nodes, w, lyr.k_proj, kv_in, f"{out}_k", "k")
+    v = _emit_linear(P, nodes, w, lyr.v_proj, kv_in, f"{out}_v", "v")
+    qh, kh, vh = (reshape_to_heads(t, t) for t in (q, k, v))
+    nodes.append(P.node("Transpose", [kh], [f"{out}_kT"],
+                        name=f"kT{idx}", perm=[0, 1, 3, 2]))
+    nodes.append(P.node("MatMul", [qh, f"{out}_kT"], [f"{out}_sraw"],
+                        name=f"scores{idx}"))
+    nodes.append(P.node("Mul", [f"{out}_sraw",
+                                w("scale", np.asarray(scale, np.float32))],
+                        [f"{out}_s"], name=f"scale{idx}"))
+    nodes.append(P.node("Softmax", [f"{out}_s"], [f"{out}_p"],
+                        name=f"softmax{idx}", axis=-1))
+    nodes.append(P.node("MatMul", [f"{out}_p", vh], [f"{out}_o"],
+                        name=f"ctx{idx}"))
+    nodes.append(P.node("Transpose", [f"{out}_o"], [f"{out}_obshd"],
+                        name=f"oT{idx}", perm=[0, 2, 1, 3]))
+    mshp = w("merge_shape", np.asarray([0, 0, H * D], np.int64))
+    nodes.append(P.node("Reshape", [f"{out}_obshd", mshp],
+                        [f"{out}_merged"], name=f"merge{idx}"))
+    return _emit_linear(P, nodes, w, lyr.out_proj, f"{out}_merged", out,
+                        "o")
+
+
+def _map_encoder_layer(lyr, idx, cur, nodes, inits, out, w):
+    """TransformerEncoderLayer (inference: dropouts are identity), both
+    normalize_before variants."""
+    from . import _proto as P
+
+    act = getattr(lyr.activation, "__name__", "relu")
+    if act not in ("relu", "gelu", "sigmoid", "tanh"):
+        return None
+
+    def ln(norm, src, tag):
+        return _map_layer(norm, f"{idx}{tag}", src, nodes, inits)
+
+    residual = cur
+    src = cur
+    if lyr.normalize_before:
+        src = ln(lyr.norm1, src, "n1")
+        if src is None:
+            return None
+    src = _map_mha(lyr.self_attn, f"{idx}a", src, src, nodes, inits,
+                   f"{out}_attn", w)
+    if src is None:
+        return None
+    nodes.append(P.node("Add", [residual, src], [f"{out}_res1"],
+                        name=f"res1_{out}"))
+    src = f"{out}_res1"
+    if not lyr.normalize_before:
+        src = ln(lyr.norm1, src, "n1")
+        if src is None:
+            return None
+    residual = src
+    if lyr.normalize_before:
+        src = ln(lyr.norm2, src, "n2")
+        if src is None:
+            return None
+    src = _emit_linear(P, nodes, w, lyr.linear1, src, f"{out}_ff1", "f1")
+    act_op = {"relu": "Relu", "gelu": "Gelu", "sigmoid": "Sigmoid",
+              "tanh": "Tanh"}[act]
+    kw = {"approximate": "none"} if act_op == "Gelu" else {}
+    nodes.append(P.node(act_op, [src], [f"{out}_act"],
+                        name=f"act_{out}", **kw))
+    src = _emit_linear(P, nodes, w, lyr.linear2, f"{out}_act",
+                       f"{out}_ff2", "f2")
+    nodes.append(P.node("Add", [residual, src], [f"{out}_res2"],
+                        name=f"res2_{out}"))
+    src = f"{out}_res2"
+    if not lyr.normalize_before:
+        src = ln(lyr.norm2, src, "n2")
+        if src is None:
+            return None
+    nodes.append(P.node("Identity", [src], [out], name=f"encl_{out}"))
+    return out
 
 
 def export(layer, path, input_spec=None, opset_version=13, **configs):
@@ -189,13 +334,31 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
     if not ok:
         return export_stablehlo(layer, path, input_spec=input_spec)
 
-    # ai.onnx Gelu needs opset >= 20
-    if any(type(l).__name__ == "GELU" for l in chain):
+    from .. import nn
+
+    # opset floors: ai.onnx Gelu is opset >= 20; LayerNormalization >= 17
+    # (transformer blocks contain both LN and possibly gelu activations)
+    def _walk(root):
+        stack = [root]
+        while stack:
+            lyr = stack.pop()
+            yield lyr
+            stack.extend(s for _, s in getattr(
+                lyr, "named_children", lambda: [])())
+
+    if any(type(l).__name__ == "GELU"
+           or getattr(getattr(l, "activation", None), "__name__",
+                      "") == "gelu" for l in _walk(layer)):
         opset_version = max(opset_version, 20)
+    if any(isinstance(l, (nn.LayerNorm, nn.TransformerEncoderLayer,
+                          nn.TransformerEncoder)) for l in _walk(layer)):
+        opset_version = max(opset_version, 17)
     spec = input_spec[0]
     shape = tuple(getattr(spec, "shape", spec))
+    # integer token inputs when the graph starts at an Embedding gather
+    in_type = P.INT64 if isinstance(chain[0], nn.Embedding) else P.FLOAT
     g = P.graph(nodes, "paddle_tpu_graph",
-                [P.value_info("input", P.FLOAT, shape)],
+                [P.value_info("input", in_type, shape)],
                 [P.value_info(cur, P.FLOAT, None)],  # rank inferred
                 inits)
     blob = P.model(g, opset_version=opset_version)

@@ -1,0 +1,295 @@
+"""Minimal ONNX reader + numpy evaluator.
+
+Round-3 companion to the wire-format writer in ``_proto.py``: parses a
+ModelProto produced by this package (generic protobuf wire decoding +
+the public ONNX field numbers) and evaluates the inference-op subset
+the exporter emits with numpy. The image bundles no ``onnx`` or
+``onnxruntime``, so this is how exports get NUMERICS validation — the
+tests run BERT-class exports through this evaluator against the eager
+model (reference paddle2onnx validates with onnxruntime the same way).
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+__all__ = ["parse_model", "run_model"]
+
+_ONNX2NP = {1: np.float32, 2: np.uint8, 3: np.int8, 6: np.int32,
+            7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64}
+
+
+def _read_varint(buf, i):
+    shift = 0
+    out = 0
+    while True:
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def _fields(buf):
+    """Generic wire decode: yields (field_number, wire_type, value)."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        key, i = _read_varint(buf, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, i = _read_varint(buf, i)
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            v = struct.unpack("<f", buf[i:i + 4])[0]
+            i += 4
+        elif wire == 1:
+            v = struct.unpack("<d", buf[i:i + 8])[0]
+            i += 8
+        else:
+            raise ValueError(f"wire type {wire}")
+        yield field, wire, v
+
+
+def _signed(v: int) -> int:
+    """Protobuf int64 varints are two's-complement: undo for negatives
+    (the writer emits axis=-1 as 2^64-1)."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _parse_attr(buf):
+    name = None
+    val = None
+    ints: list = []
+    floats: list = []
+    for f, _, v in _fields(buf):
+        if f == 1:
+            name = v.decode()
+        elif f == 2:
+            val = v
+        elif f == 3:
+            val = _signed(v)
+        elif f == 4:
+            val = v.decode()
+        elif f == 7:
+            floats.append(v)
+        elif f == 8:
+            ints.append(_signed(v))
+    if ints:
+        val = ints
+    elif floats:
+        val = floats
+    return name, val
+
+
+def _parse_node(buf):
+    node = {"inputs": [], "outputs": [], "op": None, "name": "",
+            "attrs": {}}
+    for f, _, v in _fields(buf):
+        if f == 1:
+            node["inputs"].append(v.decode())
+        elif f == 2:
+            node["outputs"].append(v.decode())
+        elif f == 3:
+            node["name"] = v.decode()
+        elif f == 4:
+            node["op"] = v.decode()
+        elif f == 5:
+            k, val = _parse_attr(v)
+            node["attrs"][k] = val
+    return node
+
+
+def _parse_tensor(buf):
+    dims: list = []
+    dtype = 1
+    name = ""
+    raw = b""
+    for f, _, v in _fields(buf):
+        if f == 1:
+            dims.append(v)
+        elif f == 2:
+            dtype = v
+        elif f == 8:
+            name = v.decode()
+        elif f == 9:
+            raw = v
+    arr = np.frombuffer(raw, dtype=_ONNX2NP[dtype]).reshape(dims)
+    return name, arr
+
+
+def _parse_graph(buf):
+    g = {"nodes": [], "inits": {}, "inputs": [], "outputs": []}
+    for f, _, v in _fields(buf):
+        if f == 1:
+            g["nodes"].append(_parse_node(v))
+        elif f == 5:
+            name, arr = _parse_tensor(v)
+            g["inits"][name] = arr
+        elif f == 11:
+            for f2, _, v2 in _fields(v):
+                if f2 == 1:
+                    g["inputs"].append(v2.decode())
+        elif f == 12:
+            for f2, _, v2 in _fields(v):
+                if f2 == 1:
+                    g["outputs"].append(v2.decode())
+    return g
+
+
+def parse_model(blob: bytes) -> dict:
+    """ModelProto -> {'graph': ..., 'opset': int}."""
+    out = {"graph": None, "opset": 0}
+    for f, _, v in _fields(blob):
+        if f == 7:
+            out["graph"] = _parse_graph(v)
+        elif f == 8:
+            for f2, _, v2 in _fields(v):
+                if f2 == 2:
+                    out["opset"] = v2
+    return out
+
+
+def _softmax(x, axis):
+    m = x.max(axis=axis, keepdims=True)
+    e = np.exp(x - m)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _gelu(x, approximate):
+    if approximate == "tanh":
+        return 0.5 * x * (1 + np.tanh(np.sqrt(2 / np.pi)
+                                      * (x + 0.044715 * x ** 3)))
+    import math
+
+    erf = np.vectorize(math.erf, otypes=[x.dtype])
+    return 0.5 * x * (1 + erf(x / math.sqrt(2.0)))
+
+
+def _conv2d(x, w, b, strides, pads, dils, group):
+    """Naive NCHW conv (validation-sized inputs only)."""
+    n, cin, hh, ww = x.shape
+    cout, cing, kh, kw = w.shape
+    t, l, bt, r = pads
+    xp = np.pad(x, ((0, 0), (0, 0), (t, bt), (l, r)))
+    sh, sw = strides
+    dh, dw = dils
+    oh = (xp.shape[2] - (kh - 1) * dh - 1) // sh + 1
+    ow = (xp.shape[3] - (kw - 1) * dw - 1) // sw + 1
+    out = np.zeros((n, cout, oh, ow), x.dtype)
+    cpg_in, cpg_out = cin // group, cout // group
+    for g in range(group):
+        xs = xp[:, g * cpg_in:(g + 1) * cpg_in]
+        ws = w[g * cpg_out:(g + 1) * cpg_out]
+        for i in range(oh):
+            for j in range(ow):
+                patch = xs[:, :, i * sh:i * sh + kh * dh:dh,
+                           j * sw:j * sw + kw * dw:dw]
+                out[:, g * cpg_out:(g + 1) * cpg_out, i, j] = np.einsum(
+                    "nchw,ochw->no", patch, ws)
+    if b is not None:
+        out = out + b.reshape(1, -1, 1, 1)
+    return out
+
+
+def _maxpool2d(x, kernel, strides, pads):
+    kh, kw = kernel
+    sh, sw = strides
+    t, l, b, r = pads
+    xp = np.pad(x, ((0, 0), (0, 0), (t, b), (l, r)),
+                constant_values=-np.inf)
+    oh = (xp.shape[2] - kh) // sh + 1
+    ow = (xp.shape[3] - kw) // sw + 1
+    out = np.empty(x.shape[:2] + (oh, ow), x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            out[:, :, i, j] = xp[:, :, i * sh:i * sh + kh,
+                                 j * sw:j * sw + kw].max(axis=(2, 3))
+    return out
+
+
+def run_model(model: dict, feeds: dict) -> list:
+    """Evaluate a parsed model with numpy; returns outputs in graph
+    order. Supports the exporter's inference op set."""
+    g = model["graph"]
+    env = dict(g["inits"])
+    env.update(feeds)
+
+    for nd in g["nodes"]:
+        ins = [env[i] for i in nd["inputs"]]
+        op = nd["op"]
+        a = nd["attrs"]
+        if op == "MatMul":
+            out = ins[0] @ ins[1]
+        elif op == "Add":
+            out = ins[0] + ins[1]
+        elif op == "Sub":
+            out = ins[0] - ins[1]
+        elif op == "Mul":
+            out = ins[0] * ins[1]
+        elif op == "Div":
+            out = ins[0] / ins[1]
+        elif op == "Relu":
+            out = np.maximum(ins[0], 0)
+        elif op == "Sigmoid":
+            out = 1 / (1 + np.exp(-ins[0]))
+        elif op == "Tanh":
+            out = np.tanh(ins[0])
+        elif op == "Gelu":
+            out = _gelu(ins[0], a.get("approximate", "none"))
+        elif op == "Softmax":
+            out = _softmax(ins[0], a.get("axis", -1))
+        elif op == "Transpose":
+            out = np.transpose(ins[0], a["perm"])
+        elif op == "Reshape":
+            shape = [int(s) for s in np.asarray(ins[1]).tolist()]
+            # ONNX semantics: 0 copies the input dim, -1 infers
+            shape = [ins[0].shape[i] if s == 0 else s
+                     for i, s in enumerate(shape)]
+            out = ins[0].reshape(shape)
+        elif op == "Identity":
+            out = ins[0]
+        elif op == "Flatten":
+            ax = a.get("axis", 1)
+            out = ins[0].reshape(int(np.prod(ins[0].shape[:ax])), -1)
+        elif op == "Gather":
+            out = np.take(ins[0], ins[1].astype(np.int64),
+                          axis=a.get("axis", 0))
+        elif op == "LayerNormalization":
+            x, scale, bias = ins
+            axis = a.get("axis", -1)
+            eps = a.get("epsilon", 1e-5)
+            axes = tuple(range(axis % x.ndim, x.ndim))
+            mu = x.mean(axis=axes, keepdims=True)
+            var = x.var(axis=axes, keepdims=True)
+            out = (x - mu) / np.sqrt(var + eps) * scale + bias
+        elif op == "Conv":
+            out = _conv2d(ins[0], ins[1],
+                          ins[2] if len(ins) > 2 else None,
+                          a.get("strides", [1, 1]), a.get("pads",
+                                                          [0, 0, 0, 0]),
+                          a.get("dilations", [1, 1]), a.get("group", 1))
+        elif op == "MaxPool":
+            out = _maxpool2d(ins[0], a["kernel_shape"],
+                             a.get("strides", a["kernel_shape"]),
+                             a.get("pads", [0, 0, 0, 0]))
+        elif op == "GlobalAveragePool":
+            out = ins[0].mean(axis=(2, 3), keepdims=True)
+        elif op == "BatchNormalization":
+            x, scale, bias, mean, var = ins
+            eps = a.get("epsilon", 1e-5)
+            shp = [1, -1] + [1] * (x.ndim - 2)
+            out = ((x - mean.reshape(shp))
+                   / np.sqrt(var.reshape(shp) + eps)
+                   * scale.reshape(shp) + bias.reshape(shp))
+        else:
+            raise NotImplementedError(f"evaluator op {op}")
+        for o in nd["outputs"]:
+            env[o] = out
+    return [env[o] for o in g["outputs"]]

@@ -1,0 +1,93 @@
+"""ONNX export of transformer-class models (VERDICT r2 item 9): BERT-tiny
+exports as REAL ONNX (not the StableHLO fallback), the protobuf parses,
+and the numbers match eager — validated through the package's own
+numpy ONNX evaluator (onnx/_runtime.py; the image bundles no
+onnxruntime)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.onnx import export
+from paddle_tpu.onnx._runtime import parse_model, run_model
+
+pytestmark = pytest.mark.smoke
+
+V, E, H, FF, L = 97, 32, 4, 64, 2
+
+
+def _bert_tiny(act="gelu", normalize_before=False):
+    paddle.seed(0)
+    enc_layer = nn.TransformerEncoderLayer(
+        E, H, FF, dropout=0.0, activation=act,
+        normalize_before=normalize_before)
+    return nn.Sequential(
+        nn.Embedding(V, E),
+        nn.TransformerEncoder(enc_layer, L),
+        nn.LayerNorm(E),
+        nn.Linear(E, 5),
+    )
+
+
+@pytest.mark.parametrize("act,pre", [("gelu", False), ("relu", True)])
+def test_bert_tiny_exports_real_onnx(tmp_path, act, pre):
+    model = _bert_tiny(act, pre)
+    model.eval()
+    path = export(model, str(tmp_path / "bert"), input_spec=[(2, 9)])
+    assert path.endswith(".onnx"), path   # NOT the StableHLO fallback
+
+    parsed = parse_model(open(path, "rb").read())
+    ops = {n["op"] for n in parsed["graph"]["nodes"]}
+    assert {"Gather", "MatMul", "Softmax", "Transpose", "Reshape",
+            "LayerNormalization"} <= ops
+    assert parsed["opset"] >= (20 if act == "gelu" else 17)
+
+    toks = np.random.RandomState(0).randint(0, V, (2, 9)).astype(np.int64)
+    want = model(paddle.to_tensor(toks)).numpy()
+    (got,) = run_model(parsed, {"input": toks})
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_standalone_mha_exports(tmp_path):
+    paddle.seed(1)
+    model = nn.Sequential(nn.Linear(8, E), nn.MultiHeadAttention(E, H),
+                          nn.Linear(E, 3))
+    model.eval()
+    path = export(model, str(tmp_path / "mha"), input_spec=[(2, 5, 8)])
+    assert path.endswith(".onnx")
+    parsed = parse_model(open(path, "rb").read())
+    x = np.random.RandomState(1).randn(2, 5, 8).astype(np.float32)
+    want = model(paddle.to_tensor(x)).numpy()
+    (got,) = run_model(parsed, {"input": x})
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_evaluator_matches_eager_on_cnn(tmp_path):
+    """The round-2 CNN path now also gets numerics (was structural-only):
+    Conv/BN/MaxPool/GAP evaluate in the mini-runtime too."""
+    paddle.seed(2)
+    model = nn.Sequential(nn.Conv2D(3, 4, 3, padding=1, stride=2),
+                          nn.BatchNorm2D(4), nn.ReLU(), nn.MaxPool2D(2),
+                          nn.AdaptiveAvgPool2D(1), nn.Flatten(),
+                          nn.Linear(4, 2), nn.Softmax())
+    model.eval()
+    path = export(model, str(tmp_path / "cnn"), input_spec=[(2, 3, 16, 16)])
+    assert path.endswith(".onnx")
+    parsed = parse_model(open(path, "rb").read())
+    x = np.random.RandomState(3).randn(2, 3, 16, 16).astype(np.float32)
+    want = model(paddle.to_tensor(x)).numpy()
+    (got,) = run_model(parsed, {"input": x})
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_mha_with_cache_or_weights_falls_back(tmp_path):
+    paddle.seed(3)
+    model = nn.Sequential(
+        nn.Linear(4, E),
+        nn.MultiHeadAttention(E, H, need_weights=True))
+    path = export(model, str(tmp_path / "fb"), input_spec=[(1, 3, 4)])
+    assert path.endswith(".stablehlo")
+    assert os.path.getsize(path) > 0

@@ -171,6 +171,39 @@ def test_jit_save_multi_output(tmp_path):
                                    atol=1e-6)
 
 
+def test_jit_save_multi_input_dynamic(tmp_path):
+    """Two dynamic-batch inputs share one symbolic scope; the Predictor
+    exposes one named handle per program input."""
+    from paddle_tpu import jit
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.nn.layer.layers import Layer
+
+    class TwoIn(Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(6, 3)
+
+        def forward(self, a, b):
+            # no cross-input dim equality: each input's dynamic batch is
+            # an independent symbol
+            return self.lin(a) * self.lin(b).mean()
+
+    paddle.seed(8)
+    net = TwoIn()
+    base = str(tmp_path / "two_in")
+    jit.save(net, base, input_spec=[((None, 6), "float32"),
+                                   ((None, 6), "float32")])
+    loaded = jit.load(base)
+    rng = np.random.RandomState(4)
+    a = rng.randn(5, 6).astype("float32")
+    b = rng.randn(3, 6).astype("float32")
+    want = net(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+    np.testing.assert_allclose(loaded(a, b).numpy(), want, rtol=1e-5,
+                               atol=1e-6)
+    pred = create_predictor(Config(model_path=base))
+    assert pred.get_input_names() == ["x0", "x1"]
+
+
 def test_jit_save_without_spec_is_params_only(tmp_path):
     from paddle_tpu import jit
 
