@@ -241,12 +241,61 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
                                quant_round_type=1, quant_max_bound=127.0,
                                quant_min_bound=-127.0):
     """Decode-step attention against a KV cache (reference:
-    masked_multihead_attention_kernel). The compiled serving path lives in
-    models/llama.py::LlamaForCausalLM; this functional form covers ported
-    code operating on explicit [2, B, nH, S, dH] cache tensors."""
-    raise NotImplementedError(
-        "use paddle_tpu.models.llama.LlamaForCausalLM for compiled decode; "
-        "the standalone cache-tensor op form is not yet provided")
+    phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu). The
+    compiled serving path lives in models/llama.py::LlamaForCausalLM;
+    this functional form covers ported code operating on explicit
+    [2, B, nH, S, dH] cache tensors: ``x`` is the fused single-token qkv
+    [B, 3*nH*dH], the decode position is ``sequence_lengths`` (per-batch
+    int tensor, reference contract — each sequence writes and attends at
+    its OWN length) or uniform 0. Returns (out [B, nH*dH], cache_kv) as
+    framework Tensors through the dispatch funnel. (This cache layout is
+    full-head — no GQA grouping — so the masked XLA expression is the
+    right lowering; the Pallas decode kernel serves the GQA/paged caches
+    in models/llama.py and fused_transformer.py.)"""
+    if any(v is not None for v in (bias, src_mask, beam_cache_offset,
+                                   qkv_out_scale, out_shift,
+                                   rotary_tensor)) \
+            or rotary_emb_dims or out_scale != -1:
+        raise NotImplementedError(
+            "masked_multihead_attention: quant/rotary/bias/mask variants "
+            "are served by models/llama.py's compiled decode path")
+    if cache_kv is None:
+        raise ValueError("cache_kv [2, B, nH, S, dH] is required")
+    if sequence_lengths is None:
+        import jax.numpy as jnp
+
+        B = getattr(cache_kv, "shape", cache_kv.shape)[1]
+        sequence_lengths = jnp.zeros((B,), jnp.int32)
+    return _masked_mha_impl(x, cache_kv, sequence_lengths)
+
+
+@op("masked_multihead_attention", differentiable=False)
+def _masked_mha_impl(x, cache_kv, sequence_lengths):
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    _, B, nH, S, dH = cache_kv.shape
+    qkv = jnp.reshape(x, (B, 3, nH, dH))
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]          # [B, nH, dH]
+    pos = jnp.reshape(sequence_lengths, (-1,)).astype(jnp.int32)
+
+    # per-batch cache write at each sequence's own position
+    def write(cache_b, kv_b, p):
+        return jax.lax.dynamic_update_slice(
+            cache_b, kv_b[:, None, :].astype(cache_b.dtype), (0, p, 0))
+
+    kc = jax.vmap(write)(cache_kv[0], k, pos)
+    vc = jax.vmap(write)(cache_kv[1], v, pos)
+    s = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                   kc.astype(jnp.float32)) / math.sqrt(dH)
+    mask = jnp.arange(S)[None, :] <= pos[:, None]      # [B, S]
+    s = jnp.where(mask[:, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhs,bhsd->bhd", p, vc.astype(jnp.float32))
+    out = o.reshape(B, nH * dH).astype(x.dtype)
+    return out, jnp.stack([kc, vc])
 
 
 def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):

@@ -398,8 +398,76 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
     return out[:, :, pt : pt + oh_out, pl : pl + ow_out]
 
 
+@op("class_center_sample", differentiable=False)
+def _class_center_sample_impl(label, key, *, num_classes, num_samples,
+                              rank, world):
+    lab = label.astype(jnp.int32)
+
+    def shard_samples(r):
+        lo = r * num_classes
+        in_shard = (lab >= lo) & (lab < lo + num_classes)
+        local = jnp.where(in_shard, lab - lo, 0)
+        pos = jnp.zeros((num_classes,), jnp.float32).at[local].max(
+            jnp.where(in_shard, 1.0, 0.0))
+        noise = jax.random.uniform(jax.random.fold_in(key, r),
+                                   (num_classes,))
+        order = jnp.argsort(noise - pos)          # positives first
+        sampled = jnp.sort(order[:num_samples])   # ascending, reference
+        inv = jnp.full((num_classes,), -1, jnp.int32).at[sampled].set(
+            jnp.arange(num_samples, dtype=jnp.int32))
+        return in_shard, local, sampled, inv
+
+    # remap against EVERY rank's (deterministically reproducible) sample
+    # set: all ranks share the seed, so rank r's samples are computable
+    # anywhere without communication — the role of the reference
+    # kernel's cross-rank positive exchange
+    remapped = lab
+    my_sampled = None
+    for r in range(world):
+        in_shard, local, sampled, inv = shard_samples(r)
+        remapped = jnp.where(in_shard, r * num_samples + inv[local],
+                             remapped)
+        if r == rank:
+            my_sampled = sampled + r * num_classes
+    return remapped, my_sampled.astype(jnp.int32)
+
+
 def class_center_sample(label, num_classes, num_samples, group=None):
-    raise NotImplementedError(
-        "class_center_sample requires distributed negative sampling; "
-        "planned with the EP/MoE utilities"
-    )
+    """Sample ``num_samples`` class centers containing every positive
+    class in the batch; returns (remapped_label, sampled_class_index).
+
+    Reference: phi/kernels/gpu/class_center_sample_kernel.cu (the
+    margin-softmax large-classifier trick: train against positives + a
+    random subset of negatives). TPU-native formulation: one noise-sort
+    per shard (positives get a -1 key offset so they sort first) instead
+    of the reference's CUB-based multi-pass select — static shapes.
+
+    ``group``: model-parallel class sharding (mp_ops parity). Each rank
+    owns classes [rank*C_local, (rank+1)*C_local) with ``num_classes`` =
+    the LOCAL shard size; ``label`` is the full replicated batch. The
+    remapped labels index the concatenation of every rank's samples.
+    Like the reference op's ``seed`` argument, ranks MUST share the
+    framework RNG seed — each rank then reproduces every peer's sample
+    set deterministically instead of exchanging it.
+    """
+    rank, world = 0, 1
+    if group is not None:
+        rank = getattr(group, "rank", 0)
+        world = getattr(group, "nranks", getattr(group, "world_size", 1))
+
+    lab_raw = getattr(label, "_data", label)
+    if not isinstance(lab_raw, jax.core.Tracer):
+        # eager-time contract check: positives beyond the sample budget
+        # cannot be remapped (the reference asserts the same)
+        arr = np.asarray(lab_raw).reshape(-1)
+        for r in range(world):
+            lo = r * num_classes
+            n_pos = len(np.unique(arr[(arr >= lo)
+                                      & (arr < lo + num_classes)]))
+            if n_pos > num_samples:
+                raise ValueError(
+                    f"class_center_sample: shard {r} has {n_pos} distinct "
+                    f"positive classes > num_samples={num_samples}")
+    return _class_center_sample_impl(
+        label, prandom.next_key(), num_classes=num_classes,
+        num_samples=num_samples, rank=rank, world=world)

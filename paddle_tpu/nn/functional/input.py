@@ -32,4 +32,25 @@ def embedding(x, weight, padding_idx=None, sparse: bool = False):
 
 
 def embedding_renorm_(weight, x, max_norm, norm_type=2.0):
-    raise NotImplementedError("embedding max_norm renorm not yet implemented")
+    """In-place renorm of the embedding rows referenced by ``x``: any row
+    whose ``norm_type``-norm exceeds ``max_norm`` is scaled down to it
+    (reference embedding op's max_norm semantics / torch
+    embedding_renorm_). Rows not referenced are untouched. Returns the
+    (rebound) weight."""
+    from ...core.tensor import Tensor
+
+    w = weight._data if isinstance(weight, Tensor) else jnp.asarray(weight)
+    idx = (x._data if isinstance(x, Tensor) else jnp.asarray(x)) \
+        .astype(jnp.int32).reshape(-1)
+    # scatter-min a scale per referenced row (duplicates resolve to the
+    # same value; untouched rows keep scale 1)
+    rows = w[idx]
+    norms = jnp.sum(jnp.abs(rows) ** norm_type, axis=-1) ** (1.0 / norm_type)
+    scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    full = jnp.ones((w.shape[0],), w.dtype).at[idx].min(
+        scale.astype(w.dtype))
+    new_w = w * full[:, None]
+    if isinstance(weight, Tensor):
+        weight.set_value(new_w)
+        return weight
+    return new_w
