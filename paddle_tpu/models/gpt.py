@@ -63,6 +63,12 @@ class GPTConfig:
     # faster on v5e; costs compile time linear in depth (use for the
     # single-program bench/train path, keep scan for quick iteration).
     unroll: bool = False
+    # Context parallelism: when set to a mesh axis name (and that axis has
+    # size > 1 in the active mesh), attention runs as RING attention over
+    # it — the sequence shards the ring, k/v rotate by ppermute, per-device
+    # attention memory is O(S/cp) (parallel/ring_attention.py; beyond the
+    # reference, which has no context-parallel attention).
+    ring_axis: Optional[str] = None
     eps: float = 1e-5
 
     @property
@@ -150,6 +156,15 @@ def _layer_norm(x, g, b, eps):
 
 def _attention(q, k, v, cfg: GPTConfig):
     # q,k,v: [B, T, nH, dH]
+    if cfg.ring_axis:
+        am = jax.sharding.get_abstract_mesh()
+        if (am is not None and not am.empty
+                and cfg.ring_axis in am.axis_names
+                and am.shape[cfg.ring_axis] > 1):
+            from ..parallel.ring_attention import ring_attention
+
+            return ring_attention(q, k, v, am, axis=cfg.ring_axis,
+                                  causal=True)
     if cfg.use_flash:
         from ..ops.pallas.flash_attention import flash_attention_raw, supported
 

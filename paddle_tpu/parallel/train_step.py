@@ -205,7 +205,10 @@ def adamw_update(params, grads, state, lr, wd=0.1, b1=0.9, b2=0.95,
     bc1 = 1.0 - b1 ** t.astype(jnp.float32)
     bc2 = 1.0 - b2 ** t.astype(jnp.float32)
     masters = state.get("master")
-    sr_base = (jax.random.fold_in(jax.random.PRNGKey(0x5e0), t)
+    # rbg keys: the XLA RngBitGenerator is ~19x faster than threefry for
+    # the SR noise (25ms vs 470ms per 162M u16 on v5e) and SR needs no
+    # cryptographic stream quality
+    sr_base = (jax.random.fold_in(jax.random.key(0x5e0, impl="rbg"), t)
                if stochastic_round else None)
 
     def upd(i, p, g, m, v, mw):
@@ -284,7 +287,11 @@ def make_sharded_train_step(cfg: GPTConfig, mesh: Mesh, lr: float = 1e-4,
                    written back with stochastic rounding. Halves optimizer
                    HBM traffic and sheds the 4-bytes/param master; the
                    memory mode that fits a full 1.3B AdamW step on one
-                   v5e (VERDICT r2 item 1)."""
+                   v5e (VERDICT r2 item 1).
+
+    Long-context: set ``cfg.ring_axis='mp'`` (or any mesh axis > 1) and
+    attention runs as ring attention over that axis — sequence sharded,
+    k/v rotating by ppermute, per-device attention memory O(S/cp)."""
     if weights not in ("auto", "sr-bf16"):
         raise ValueError(f"weights mode {weights!r}: expected 'auto' or "
                          "'sr-bf16'")

@@ -23,6 +23,7 @@ from .. import nn
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "ImperativeQuantAware",
            "AbsmaxObserver", "MovingAverageObserver", "QuantizedLinear",
+           "QuantizedConv2D",
            "quant", "dequant", "fake_quant"]
 
 
@@ -195,12 +196,60 @@ class QuantizedLinear(Layer):
         return out
 
 
+class QuantizedConv2D(Layer):
+    """Statically-quantized Conv2D with PER-OUTPUT-CHANNEL weight scales
+    (the reference PTQ's channel_wise_abs_max for conv weights) and a
+    calibrated activation scale."""
+
+    def __init__(self, inner, act_scale: float, bits: int = 8):
+        super().__init__()
+        qmax = 2 ** (bits - 1) - 1
+        w = inner.weight._data                  # [out_c, in_c, kh, kw]
+        per_ch = jnp.max(jnp.abs(w.reshape(w.shape[0], -1)), axis=1)
+        self.w_scale = jnp.maximum(per_ch, 1e-8)          # [out_c]
+        self.qweight = jnp.clip(
+            jnp.round(w / self.w_scale[:, None, None, None] * qmax),
+            -qmax, qmax).astype(jnp.int8)
+        self.bias = inner.bias
+        self.act_scale = float(act_scale) or 1.0
+        self.bits = bits
+        self._stride = getattr(inner, "_stride", 1)
+        self._padding = getattr(inner, "_padding", 0)
+        self._dilation = getattr(inner, "_dilation", 1)
+        self._groups = getattr(inner, "_groups", 1)
+        self._data_format = getattr(inner, "_data_format", "NCHW")
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        qmax = 2 ** (self.bits - 1) - 1
+        xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        xq = jnp.clip(jnp.round(xa / self.act_scale * qmax), -qmax, qmax)
+        acc = F.conv2d(Tensor(xq.astype(jnp.float32)),
+                       Tensor(self.qweight.astype(jnp.float32)),
+                       bias=None, stride=self._stride,
+                       padding=self._padding, dilation=self._dilation,
+                       groups=self._groups,
+                       data_format=self._data_format)
+        # per-channel dequant along the layout's channel axis
+        ch = ((None, slice(None), None, None)
+              if self._data_format == "NCHW"
+              else (None, None, None, slice(None)))
+        scale = (self.act_scale * self.w_scale) / (qmax * qmax)
+        out = acc * Tensor(scale[ch])
+        if self.bias is not None:
+            out = out + Tensor(self.bias._data[ch])
+        return out
+
+
 class PTQ:
     """Static post-training quantization (reference: quantization/ptq.py +
-    static quant_post pipeline): ``quantize`` instruments Linear layers
-    with activation observers, the user runs calibration batches, and
-    ``convert`` swaps in ``QuantizedLinear`` with int8 weights and the
-    calibrated activation scales."""
+    static quant_post pipeline): ``quantize`` instruments Linear AND
+    Conv2D layers (including the Linears nested inside attention blocks —
+    named_sublayers recurses) with activation observers, the user runs
+    calibration batches, and ``convert`` swaps in ``QuantizedLinear`` /
+    ``QuantizedConv2D`` with int8 weights (per-output-channel scales for
+    conv) and the calibrated activation scales."""
 
     def __init__(self, config: Optional[QuantConfig] = None):
         self.config = config or QuantConfig()
@@ -209,7 +258,7 @@ class PTQ:
 
     def quantize(self, model: Layer, inplace: bool = False) -> Layer:
         for name, sub in model.named_sublayers():
-            if isinstance(sub, nn.Linear):
+            if isinstance(sub, (nn.Linear, nn.Conv2D)):
                 obs = MovingAverageObserver(self.config.quant_bits)
                 self._observers[name] = obs
                 h = sub.register_forward_pre_hook(
@@ -226,13 +275,17 @@ class PTQ:
             except Exception:
                 pass
         self._hooks.clear()
-        # swap Linears for their statically-quantized form
+        # swap Linears/Convs for their statically-quantized forms
         for name, sub in list(model.named_sublayers()):
-            if not isinstance(sub, nn.Linear):
+            if isinstance(sub, nn.Linear):
+                qcls = QuantizedLinear
+            elif isinstance(sub, nn.Conv2D):
+                qcls = QuantizedConv2D
+            else:
                 continue
             obs = self._observers.get(name)
             act_scale = obs.scale if obs is not None else 1.0
-            qlin = QuantizedLinear(sub, act_scale, bits)
+            qlin = qcls(sub, act_scale, bits)
             parent, _, leaf = name.rpartition(".")
             holder = model
             if parent:
