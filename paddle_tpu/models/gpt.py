@@ -196,11 +196,22 @@ def block_apply(bp: dict, x, cfg: GPTConfig, sp_constraint=None):
     h = _layer_norm(x, bp["ln1_g"], bp["ln1_b"], cfg.eps)
     qkv = jnp.einsum("bth,hk->btk", h, bp["qkv_w"].astype(cfg.dtype))
     qkv = qkv + bp["qkv_b"].astype(cfg.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(B, T, cfg.n_heads, cfg.head_dim)
-    k = k.reshape(B, T, cfg.n_heads, cfg.head_dim)
-    v = v.reshape(B, T, cfg.n_heads, cfg.head_dim)
-    o = _attention(q, k, v, cfg).reshape(B, T, H)
+    o = None
+    if cfg.use_flash and not cfg.ring_axis:
+        from ..ops.pallas.flash_attention import (flash_attention_qkv_raw,
+                                                 flash_qkv_supported)
+
+        if flash_qkv_supported(qkv.shape, cfg.n_heads, qkv.dtype):
+            # fused entry: kernels read q/k/v from the projection output
+            # through lane-offset views — no 3-way split copies
+            o = flash_attention_qkv_raw(qkv, cfg.n_heads,
+                                        causal=True).reshape(B, T, H)
+    if o is None:
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(B, T, cfg.n_heads, cfg.head_dim)
+        v = v.reshape(B, T, cfg.n_heads, cfg.head_dim)
+        o = _attention(q, k, v, cfg).reshape(B, T, H)
     o = jnp.einsum("bth,hk->btk", o, bp["proj_w"].astype(cfg.dtype))
     x = x + o + bp["proj_b"].astype(cfg.dtype)
     if sp_constraint is not None:

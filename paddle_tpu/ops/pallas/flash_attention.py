@@ -456,29 +456,52 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale",
-                                             "with_lse", "native"))
+                                             "with_lse", "native",
+                                             "n_heads"))
 def _flash_fwd(q, k, v, causal: bool, sm_scale: float, with_lse: bool = False,
-               native: bool = True):
+               native: bool = True, n_heads: int | None = None):
+    """``n_heads`` set => FUSED input mode: q IS the whole (b, s, 3*h*d)
+    qkv projection output (k and v must be None) and the kernels read
+    q/k/v through lane-block-offset index maps — the 3-way split copies
+    (~96 MB/layer at 350m/b16) never materialize."""
     import jax.experimental.pallas as pl
 
-    b, s, h, d = q.shape
+    fused = n_heads is not None
+    if fused:
+        b, s, hd3 = q.shape
+        h = n_heads
+        d = hd3 // (3 * h)
+    else:
+        b, s, h, d = q.shape
     block_q, block_k = _block_sizes(s)
     native = native and _native_supported(h, d)
+    assert native or not fused, "fused qkv requires the native layout"
 
     if native:
         hp = _heads_per_program(h, d)
         hd = hp * d
-        # free reshapes: (b, s, h, d) -> (b, s, h*d) is contiguous
-        qf = q.reshape(b, s, h * d)
-        kf = k.reshape(b, s, h * d)
-        vf = v.reshape(b, s, h * d)
-        grid = (b, h // hp, s // block_q)
+        HB = h // hp                      # lane blocks per q/k/v tensor
+        if fused:
+            # one array, three views: block index offsets select the
+            # q/k/v regions of the fused lane dim
+            qf = kf = vf = q
+            off_k, off_v = HB, 2 * HB
+        else:
+            # free reshapes: (b, s, h, d) -> (b, s, h*d) is contiguous
+            qf = q.reshape(b, s, h * d)
+            kf = k.reshape(b, s, h * d)
+            vf = v.reshape(b, s, h * d)
+            off_k = off_v = 0
+        grid = (b, HB, s // block_q)
         q_spec = pl.BlockSpec((None, block_q, hd),
                               lambda ib, ih, iq: (ib, iq, ih))
-        kv_spec = pl.BlockSpec((None, s, hd),
-                               lambda ib, ih, iq: (ib, 0, ih))
+        k_spec = pl.BlockSpec((None, s, hd),
+                              lambda ib, ih, iq: (ib, 0, off_k + ih))
+        v_spec = pl.BlockSpec((None, s, hd),
+                              lambda ib, ih, iq: (ib, 0, off_v + ih))
         out_shapes = [jax.ShapeDtypeStruct((b, s, h * d), q.dtype)]
-        out_specs = [q_spec]
+        out_specs = [pl.BlockSpec((None, block_q, hd),
+                                  lambda ib, ih, iq: (ib, iq, ih))]
         if with_lse:
             # lse stays head-major (b, h, 8, s) in both modes — it is tiny
             # (b*h*s fp32), so its layout never costs a large copy. Block
@@ -495,7 +518,7 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, with_lse: bool = False,
         res = pl.pallas_call(
             kern,
             grid=grid,
-            in_specs=[q_spec, kv_spec, kv_spec],
+            in_specs=[q_spec, k_spec, v_spec],
             out_specs=out_specs if with_lse else out_specs[0],
             out_shape=out_shapes if with_lse else out_shapes[0],
             interpret=_interpret_mode(),
@@ -546,16 +569,26 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, with_lse: bool = False,
     return jnp.swapaxes(res, 1, 2)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "native"))
+@functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "native",
+                                             "n_heads"))
 def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float,
-               native: bool = True):
+               native: bool = True, n_heads: int | None = None):
     """Tiled backward: dq over q-blocks, dk/dv over k-blocks, never
     materializing the [S, S] score matrix (the role of the reference's
-    flash_attn_bwd CUDA kernels, flash_attn_grad_kernel.cu)."""
+    flash_attn_bwd CUDA kernels, flash_attn_grad_kernel.cu). With
+    ``n_heads`` set, q is the FUSED (b, s, 3*h*d) qkv residual (k=v=None)
+    read through offset index maps."""
     import jax.experimental.pallas as pl
 
-    b, s, h, d = q.shape
+    fused = n_heads is not None
+    if fused:
+        b, s, _ = q.shape
+        h = n_heads
+        d = q.shape[-1] // (3 * h)
+    else:
+        b, s, h, d = q.shape
     native = native and _native_supported(h, d)
+    assert native or not fused, "fused qkv requires the native layout"
     # delta (a reduction) is computed in the ORIGINAL [b, s, h, d] layout so
     # o never needs a 16MB-per-layer transpose — only the tiny [b,s,h]
     # reduction result gets permuted (lse/delta keep the head-major packed
@@ -570,15 +603,31 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float,
     if native:
         hp = _heads_per_program(h, d)
         hd = hp * d
-        qf = q.reshape(b, s, h * d)
-        kf = k.reshape(b, s, h * d)
-        vf = v.reshape(b, s, h * d)
-        dof = do.astype(q.dtype).reshape(b, s, h * d)
+        HB = h // hp
+        if fused:
+            qf = kf = vf = q
+            off_k, off_v = HB, 2 * HB
+        else:
+            qf = q.reshape(b, s, h * d)
+            kf = k.reshape(b, s, h * d)
+            vf = v.reshape(b, s, h * d)
+            off_k = off_v = 0
+        dtype = qf.dtype
+        dof = do.astype(dtype).reshape(b, s, h * d)
         blk_q = pl.BlockSpec((None, block_q, hd),
                              lambda ib, ih, iq: (ib, iq, ih))
-        blk_k = pl.BlockSpec((None, block_k, hd),
-                             lambda ib, ih, ik: (ib, ik, ih))
-        full = pl.BlockSpec((None, s, hd), lambda ib, ih, i: (ib, 0, ih))
+        blk_kk = pl.BlockSpec((None, block_k, hd),
+                              lambda ib, ih, ik: (ib, ik, off_k + ih))
+        blk_kv = pl.BlockSpec((None, block_k, hd),
+                              lambda ib, ih, ik: (ib, ik, off_v + ih))
+        out_blk_k = pl.BlockSpec((None, block_k, hd),
+                                 lambda ib, ih, ik: (ib, ik, ih))
+        full_q = pl.BlockSpec((None, s, hd),
+                              lambda ib, ih, i: (ib, 0, ih))
+        full_k = pl.BlockSpec((None, s, hd),
+                              lambda ib, ih, i: (ib, 0, off_k + ih))
+        full_v = pl.BlockSpec((None, s, hd),
+                              lambda ib, ih, i: (ib, 0, off_v + ih))
         pack_q = pl.BlockSpec((None, hp, 8, block_q),
                               lambda ib, ih, iq: (ib, ih, 0, iq))
         full_pack = pl.BlockSpec((None, hp, 8, s),
@@ -588,10 +637,10 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float,
             functools.partial(_flash_bwd_dq_kernel_native, causal=causal,
                               sm_scale=sm_scale, block_k=block_k, seq_len=s,
                               hp=hp, d=d),
-            grid=(b, h // hp, s // block_q),
-            in_specs=[blk_q, full, full, blk_q, pack_q, pack_q],
+            grid=(b, HB, s // block_q),
+            in_specs=[blk_q, full_k, full_v, blk_q, pack_q, pack_q],
             out_specs=blk_q,
-            out_shape=jax.ShapeDtypeStruct((b, s, h * d), q.dtype),
+            out_shape=jax.ShapeDtypeStruct((b, s, h * d), dtype),
             interpret=_interpret_mode(),
             compiler_params=_tpu_params(2),
         )(qf, kf, vf, dof, lse, delta)
@@ -600,14 +649,16 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float,
             functools.partial(_flash_bwd_dkv_kernel_native, causal=causal,
                               sm_scale=sm_scale, block_q=block_q, seq_len=s,
                               hp=hp, d=d),
-            grid=(b, h // hp, s // block_k),
-            in_specs=[full, blk_k, blk_k, full, full_pack, full_pack],
-            out_specs=[blk_k, blk_k],
-            out_shape=[jax.ShapeDtypeStruct((b, s, h * d), k.dtype),
-                       jax.ShapeDtypeStruct((b, s, h * d), v.dtype)],
+            grid=(b, HB, s // block_k),
+            in_specs=[full_q, blk_kk, blk_kv, full_q, full_pack, full_pack],
+            out_specs=[out_blk_k, out_blk_k],
+            out_shape=[jax.ShapeDtypeStruct((b, s, h * d), dtype),
+                       jax.ShapeDtypeStruct((b, s, h * d), dtype)],
             interpret=_interpret_mode(),
             compiler_params=_tpu_params(2),
         )(qf, kf, vf, dof, lse, delta)
+        if fused:
+            return jnp.concatenate([dq, dk, dv], axis=-1)
         return (dq.reshape(b, s, h, d), dk.reshape(b, s, h, d),
                 dv.reshape(b, s, h, d))
 
@@ -786,6 +837,74 @@ def flash_attention_raw(q, k, v, causal: bool = False, sm_scale: float | None = 
 
     fa.defvjp(fwd, bwd)
     return fa(q, k, v)
+
+
+def flash_attention_qkv_raw(qkv, n_heads: int, causal: bool = True,
+                            sm_scale: float | None = None):
+    """Flash attention straight from the FUSED qkv projection output
+    (``qkv`` [B, S, 3*H]): the kernels read q/k/v through lane-block
+    offset views, so the FORWARD's 3-way split copies (and their saved
+    residuals) never materialize. The backward still concatenates
+    dq/dk/dv into the qkv cotangent — the same copy the split path's
+    vjp-of-split pays, so the win is forward-side only (a fused dqkv
+    output via cross-call aliasing is the known next step). Requires the
+    native layout. Returns [B, S, n_heads, head_dim]."""
+    if not flash_qkv_supported(qkv.shape, n_heads, qkv.dtype):
+        raise ValueError(
+            f"flash_attention_qkv_raw: shape {tuple(qkv.shape)} with "
+            f"{n_heads} heads is not supported (needs 3*h*d fused lanes, "
+            "128-aligned seq blocks, head_dim in (64,128,256) dividing "
+            "the lane blocks); use flash_attention_raw instead")
+    b, s, hd3 = qkv.shape
+    d = hd3 // (3 * n_heads)
+    scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+
+    @jax.custom_vjp
+    def fa(qkv):
+        return _flash_fwd(qkv, None, None, causal, scale, n_heads=n_heads)
+
+    def fwd(qkv):
+        from jax.ad_checkpoint import checkpoint_name
+
+        o, lse = _flash_fwd(qkv, None, None, causal, scale, with_lse=True,
+                            n_heads=n_heads)
+        o = checkpoint_name(o, "flash_o")
+        lse = checkpoint_name(lse, "flash_lse")
+        return o, (qkv, o, lse)
+
+    def bwd(res, g):
+        qkv, o, lse = res
+        return (_flash_bwd(qkv, None, None, o, lse, g, causal, scale,
+                           n_heads=n_heads),)
+
+    fa.defvjp(fwd, bwd)
+    return fa(qkv)
+
+
+def flash_qkv_supported(shape, n_heads: int, dtype) -> bool:
+    """Also consults the flash flags: the fused entry hardcodes the
+    native kernels fwd+bwd, so any flag that redirects
+    flash_attention_raw (layout A/B, XLA-expression bwd, library kernel)
+    must disable this path too — otherwise the documented escape hatches
+    silently stop affecting models using the fused entry."""
+    from ...core.flags import GLOBAL_FLAGS
+
+    def flag(name, default):
+        return (GLOBAL_FLAGS.get(name) if GLOBAL_FLAGS.has(name)
+                else default)
+
+    if (not flag("flash_attention_native_layout", True)
+            or not flag("flash_attention_kernel_bwd", True)
+            or flag("use_library_flash_attention", False)):
+        return False
+    if len(shape) != 3:
+        return False
+    b, s, hd3 = shape
+    if hd3 % (3 * n_heads):
+        return False
+    d = hd3 // (3 * n_heads)
+    return (supported((b, s, n_heads, d), dtype)
+            and _native_supported(n_heads, d))
 
 
 # Framework-op wrapper (Tensor in/out, tape-recorded); pure-jnp callers

@@ -104,3 +104,51 @@ def test_raw_entrypoint_grad_native_default():
     for a, b in zip(got, want):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-3, atol=2e-3)
+
+
+def test_fused_qkv_entry_matches_split():
+    """flash_attention_qkv_raw (lane-offset fused reads) must match the
+    split-tensor path in values AND the qkv cotangent."""
+    rng = np.random.RandomState(5)
+    B, S, h, d = 2, 128, 2, 64
+    qkv = jnp.asarray(rng.randn(B, S, 3 * h * d), jnp.float32)
+    assert fa.flash_qkv_supported(qkv.shape, h, qkv.dtype)
+
+    def fused(qkv):
+        return (fa.flash_attention_qkv_raw(qkv, h, causal=True)
+                .astype(jnp.float32).sum())
+
+    def split(qkv):
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (t.reshape(B, S, h, d) for t in (q, k, v))
+        return (fa.flash_attention_raw(q, k, v, causal=True)
+                .astype(jnp.float32).sum())
+
+    np.testing.assert_allclose(float(fused(qkv)), float(split(qkv)),
+                               rtol=1e-5)
+    gf = jax.grad(fused)(qkv)
+    gs = jax.grad(split)(qkv)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gs),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_fused_qkv_respects_flags():
+    """The escape-hatch flags must disable the fused entry (it hardcodes
+    native kernels), and bad shapes raise instead of asserting."""
+    from paddle_tpu.core.flags import GLOBAL_FLAGS
+
+    qkv_shape = (2, 128, 3 * 2 * 64)
+    assert fa.flash_qkv_supported(qkv_shape, 2, jnp.float32)
+    GLOBAL_FLAGS.set("flash_attention_native_layout", False)
+    try:
+        assert not fa.flash_qkv_supported(qkv_shape, 2, jnp.float32)
+    finally:
+        GLOBAL_FLAGS.set("flash_attention_native_layout", True)
+    GLOBAL_FLAGS.set("flash_attention_kernel_bwd", False)
+    try:
+        assert not fa.flash_qkv_supported(qkv_shape, 2, jnp.float32)
+    finally:
+        GLOBAL_FLAGS.set("flash_attention_kernel_bwd", True)
+    with pytest.raises(ValueError):
+        # head_dim 80: not a supported lane layout
+        fa.flash_attention_qkv_raw(jnp.zeros((1, 128, 3 * 32 * 80)), 32)
