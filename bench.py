@@ -122,21 +122,25 @@ def main():
         "n_devices": n_dev,
     }
     if on_tpu:
-        # fault-isolated: a failure in the secondary measurements must not
-        # discard the already-measured flagship result (the driver contract
-        # is one JSON line).
-        result["extra"] = {}
-        # decode first: the 1.3B bench fills nearly all HBM, and allocator
-        # pressure after it measurably degrades the decode numbers
-        try:
-            result["extra"].update(_bench_decode())
-        except Exception as e:  # noqa: BLE001
-            result["extra"]["llama_decode_error"] = str(e)[:200]
-        try:
-            result["extra"].update(_bench_13b())
-        except Exception as e:  # noqa: BLE001
-            result["extra"]["gpt3_1p3b_error"] = str(e)[:200]
+        result["extra"] = _run_secondary_benches()
     print(json.dumps(result))
+
+
+def _run_secondary_benches() -> dict:
+    """Fault-isolated: a failure in a secondary measurement must not
+    discard the already-measured flagship result (the driver contract is
+    one JSON line) — but it must be VISIBLE as a named error marker, not
+    silently dropped (tests/test_bench_contract.py pins this down).
+    Decode runs first: the 1.3B bench fills nearly all HBM, and
+    allocator pressure after it measurably degrades decode numbers."""
+    extra: dict = {}
+    for fn, err_key in ((_bench_decode, "llama_decode_error"),
+                        (_bench_13b, "gpt3_1p3b_error")):
+        try:
+            extra.update(fn())
+        except Exception as e:  # noqa: BLE001
+            extra[err_key] = str(e)[:200]
+    return extra
 
 
 def _bench_decode():

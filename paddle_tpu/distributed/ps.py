@@ -27,6 +27,8 @@ host-memory embedding tables too large for HBM, plus small dense state
 - ``PsTrainer``: prefetch-pipelined trainer loop — the next batch's
   embedding pull rides RPC while the current device step computes (the
   async communicator + hogwild_worker role).
+- ``DeviceCachedEmbedding``: device-HBM hot-row cache in front of the
+  host PS (the heter-PS / ps_gpu_wrapper accelerator-cache role).
 """
 
 from __future__ import annotations
@@ -42,7 +44,7 @@ from . import rpc
 __all__ = ["SGDRule", "AdagradRule", "AdamRule", "make_rule",
            "SparseTable", "DenseTable", "PsServer", "PsClient",
            "PsTrainer", "serve_forever", "stop_servers", "signal_ready",
-           "wait_servers_ready"]
+           "wait_servers_ready", "DeviceCachedEmbedding"]
 
 
 # ---------------------------------------------------------------------------
@@ -456,3 +458,122 @@ class PsTrainer:
             f.wait()
         self.losses.extend(run_losses)
         return run_losses
+
+
+class DeviceCachedEmbedding:
+    """Device-HBM hot-row cache in front of the host parameter server —
+    the TPU-native analog of the reference's heter-PS GPU cache
+    (paddle/fluid/framework/fleet/heter_ps/, ps_gpu_wrapper.cc: hot
+    embedding rows cached in accelerator memory, cold rows pulled from
+    the CPU PS). Inventory row 76.
+
+    The hottest ``cache_rows`` ids live in one device array; ``lookup``
+    serves cached ids from HBM and pulls only the misses over RPC;
+    ``push`` sends raw grads to the server (accessor rules run there —
+    the server stays the source of truth) and re-pulls the touched
+    cached rows, so THIS client's pushes are never served stale.
+    OTHER trainers' pushes are visible with bounded staleness (at most
+    ``refresh_every`` lookups until the periodic refresh resyncs) — the
+    same relaxed-consistency contract as the reference's async heter-PS
+    cache. Admission is frequency-based with exponential decay (counts
+    halve each refresh, so yesterday's hot set cannot pin the cache),
+    and refreshes pull only NEWLY-admitted rows — a stable hot set costs
+    no steady-state refresh traffic beyond the resync of evicted slots.
+    """
+
+    def __init__(self, client: PsClient, table: str, dim: int,
+                 cache_rows: int = 4096, refresh_every: int = 50):
+        import collections
+
+        import jax
+        import jax.numpy as jnp
+
+        self.client = client
+        self.table = table
+        self.dim = dim
+        self.cache_rows = cache_rows
+        self.refresh_every = refresh_every
+        self._jnp = jnp
+        self._jax = jax
+        self.cache = jnp.zeros((cache_rows, dim), jnp.float32)
+        self._slot_of: dict[int, int] = {}       # id -> cache slot
+        self._counts = collections.Counter()
+        self._lookups = 0
+        self.hits = 0
+        self.misses = 0
+
+    # -- cache management ---------------------------------------------------
+
+    def _refresh(self):
+        """Re-admit the currently hottest ids INCREMENTALLY: keep already
+        -cached hot ids in their slots (also resyncing them, which gives
+        other trainers' pushes their bounded-staleness visibility), pull
+        only newly-admitted ids, then decay the counters so hotness
+        adapts and the counter stays bounded."""
+        hot = [k for k, _ in self._counts.most_common(self.cache_rows)]
+        if not hot:
+            return
+        hot_set = set(hot)
+        keep = {k: s for k, s in self._slot_of.items() if k in hot_set}
+        new_ids = [k for k in hot if k not in keep]
+        free = [s for s in range(self.cache_rows)
+                if s not in set(keep.values())]
+        admit = list(zip(new_ids, free))
+        pull_ids = [k for k, _ in admit] + list(keep)
+        if pull_ids:
+            rows = self.client.pull(self.table,
+                                    np.asarray(pull_ids, np.int64))
+            slots = np.asarray([s for _, s in admit]
+                               + [keep[k] for k in keep])
+            self.cache = self.cache.at[slots].set(self._jnp.asarray(rows))
+        self._slot_of = {**keep, **{int(k): s for k, s in admit}}
+        # exponential decay: halve and drop the long tail (bounds host
+        # memory over unbounded id spaces, lets new hot ids displace old)
+        self._counts = type(self._counts)(
+            {k: c // 2 for k, c in self._counts.items() if c > 1})
+
+    def _sync_rows(self, ids):
+        """Re-pull specific cached ids (after a push touched them)."""
+        cached = [int(k) for k in ids if int(k) in self._slot_of]
+        if not cached:
+            return
+        rows = self.client.pull(self.table, np.asarray(cached, np.int64))
+        slots = np.asarray([self._slot_of[k] for k in cached])
+        self.cache = self.cache.at[slots].set(self._jnp.asarray(rows))
+
+    # -- serving ------------------------------------------------------------
+
+    def lookup(self, ids):
+        """ids [N] -> device rows [N, dim]: HBM gather for hits, sharded
+        host pull for misses."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        self._counts.update(int(i) for i in ids)
+        self._lookups += 1
+
+        slots = np.asarray([self._slot_of.get(int(i), -1) for i in ids])
+        hit = slots >= 0
+        self.hits += int(hit.sum())
+        self.misses += int((~hit).sum())
+        out = self._jnp.zeros((len(ids), self.dim), self._jnp.float32)
+        if hit.any():
+            out = out.at[np.nonzero(hit)[0]].set(
+                self.cache[slots[hit]])
+        if (~hit).any():
+            pulled = self.client.pull(self.table, ids[~hit])
+            out = out.at[np.nonzero(~hit)[0]].set(
+                self._jnp.asarray(pulled))
+        if self._lookups % self.refresh_every == 0:
+            self._refresh()
+        return out
+
+    def push(self, ids, grads):
+        """Raw grads to the server (its accessor applies the optimizer),
+        then resync any cached rows the push touched."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        self.client.push(self.table, ids, np.asarray(grads))
+        self._sync_rows(np.unique(ids))
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0

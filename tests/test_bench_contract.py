@@ -1,0 +1,70 @@
+"""bench.py driver-contract guards (VERDICT r2 weak 9): the secondary
+benches' fault isolation must not silently swallow regressions — a
+passing secondary contributes its keys, a failing one contributes a
+NAMED error marker, and one always-parseable JSON line emits."""
+
+import importlib
+import io
+import json
+import os
+import sys
+from contextlib import redirect_stdout
+
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def bench(monkeypatch):
+    monkeypatch.syspath_prepend(REPO)
+    import bench as b
+
+    importlib.reload(b)
+    return b
+
+
+def test_secondary_success_keys_propagate(bench, monkeypatch):
+    monkeypatch.setattr(bench, "_bench_decode",
+                        lambda: {"llama1b_decode_tokens_per_sec": 450.0})
+    monkeypatch.setattr(bench, "_bench_13b",
+                        lambda: {"gpt3_1p3b_train_mfu": 0.57})
+    extra = bench._run_secondary_benches()
+    assert extra == {"llama1b_decode_tokens_per_sec": 450.0,
+                     "gpt3_1p3b_train_mfu": 0.57}
+
+
+def test_secondary_failure_is_visible_not_silent(bench, monkeypatch):
+    def boom():
+        raise RuntimeError("decode exploded")
+
+    monkeypatch.setattr(bench, "_bench_decode", boom)
+    monkeypatch.setattr(bench, "_bench_13b",
+                        lambda: {"gpt3_1p3b_train_mfu": 0.57})
+    extra = bench._run_secondary_benches()
+    # the 1.3B result survives AND the failure is recorded by name
+    assert "decode exploded" in extra["llama_decode_error"]
+    assert extra["gpt3_1p3b_train_mfu"] == 0.57
+    # a failing FIRST bench must not stop the second from running
+    order = []
+    monkeypatch.setattr(bench, "_bench_decode",
+                        lambda: order.append("d") or (_ for _ in ()).throw(
+                            RuntimeError("x")))
+    monkeypatch.setattr(bench, "_bench_13b",
+                        lambda: order.append("b") or {})
+    bench._run_secondary_benches()
+    assert order == ["d", "b"]
+
+
+def test_cpu_main_emits_one_json_line(bench):
+    """The CI-path main() honors the one-JSON-line driver contract."""
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench.main()
+    lines = [ln for ln in buf.getvalue().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert {"metric", "value", "unit", "vs_baseline"} <= out.keys()

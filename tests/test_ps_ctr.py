@@ -157,3 +157,76 @@ def test_dense_table_unit():
     dt2 = DenseTable((4,), init=np.zeros(4), optimizer="adam", lr=0.1)
     dt2.push(np.ones(4))
     assert np.all(dt2.pull() < 0)
+
+
+def test_device_cached_embedding(tmp_path):
+    """Heter-PS analog (inventory row 76): hot rows served from device
+    HBM, misses pulled from the host PS, cache resynced after pushes."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed import ps, rpc
+
+    rpc.init_rpc("solo_cache", rank=0, world_size=1,
+                 master_endpoint="127.0.0.1:6341")
+    try:
+        table = ps.SparseTable(dim=4, rule=ps.SGDRule(lr=1.0), seed=0)
+        ps.PsServer({"emb": table})
+        client = ps.PsClient(["solo_cache"])
+        cache = ps.DeviceCachedEmbedding(client, "emb", dim=4,
+                                         cache_rows=8, refresh_every=2)
+
+        rng = np.random.RandomState(0)
+        hot = np.array([1, 2, 3], np.int64)
+        # skewed lookups: hot ids repeat, cold ids are one-off
+        for i in range(12):
+            ids = np.concatenate([hot, [100 + i]])
+            rows = cache.lookup(ids)
+            assert rows.shape == (4, 4)
+        assert cache.hit_rate > 0.4, cache.hit_rate   # hot ids cached
+
+        # correctness: cached lookups equal direct server pulls
+        direct = client.pull("emb", hot)
+        via_cache = np.asarray(cache.lookup(hot))[:3]
+        np.testing.assert_allclose(via_cache, direct, rtol=1e-6)
+
+        # pushes flow to the server's accessor AND resync the cache
+        before = np.asarray(cache.lookup(hot))
+        g = np.ones((3, 4), np.float32)
+        cache.push(hot, g)
+        after = np.asarray(cache.lookup(hot))
+        np.testing.assert_allclose(after, before - 1.0, rtol=1e-5)
+        np.testing.assert_allclose(after, client.pull("emb", hot),
+                                   rtol=1e-6)
+    finally:
+        rpc.shutdown()
+        ps._SERVED_TABLES.clear()
+
+
+def test_cache_decay_and_incremental_refresh():
+    """Counter decays (old hot sets can be displaced, memory bounded)
+    and refresh pulls stay incremental."""
+    from paddle_tpu.distributed import ps, rpc
+
+    rpc.init_rpc("solo_cache2", rank=0, world_size=1,
+                 master_endpoint="127.0.0.1:6343")
+    try:
+        table = ps.SparseTable(dim=4, seed=0)
+        ps.PsServer({"emb2": table})
+        client = ps.PsClient(["solo_cache2"])
+        cache = ps.DeviceCachedEmbedding(client, "emb2", dim=4,
+                                         cache_rows=4, refresh_every=2)
+        # phase 1: ids 1..4 hot
+        for _ in range(6):
+            cache.lookup(np.array([1, 2, 3, 4], np.int64))
+        assert set(cache._slot_of) == {1, 2, 3, 4}
+        # phase 2: shift hotness to 11..14 — decay lets them displace
+        for _ in range(20):
+            cache.lookup(np.array([11, 12, 13, 14], np.int64))
+        assert set(cache._slot_of) == {11, 12, 13, 14}
+        # counter stays bounded: the long tail of one-off ids is dropped
+        for i in range(200):
+            cache.lookup(np.array([1000 + i], np.int64))
+        assert len(cache._counts) < 50
+    finally:
+        rpc.shutdown()
+        ps._SERVED_TABLES.clear()
