@@ -41,6 +41,8 @@ _VMEM_BUDGET_BWD = 11 * 2 ** 20
 
 
 def _pick_bv(H: int, is_bwd: bool) -> int:
+    """Largest feasible vocab tile, or 0 when NO tile fits VMEM (wide
+    hidden sizes: the bwd accumulator block alone is 4*bt*H bytes)."""
     bt = BLOCK_T
     for bv in (2048, 1024, 512, 256, 128):
         # double-buffered x and h tiles + fp32 logits tile
@@ -52,13 +54,17 @@ def _pick_bv(H: int, is_bwd: bool) -> int:
                 return bv
         elif est <= _VMEM_BUDGET_FWD:
             return bv
-    return 128
+    return 0
 
 
 def fused_ce_supported(n_tokens: int, hidden: int, vocab: int) -> bool:
-    """Token count must tile evenly; H must be lane-aligned."""
+    """Token count must tile evenly; H must be lane-aligned; BOTH the
+    fwd and bwd kernels must have a VMEM-feasible tile (the chunked XLA
+    scan serves the rest)."""
+    bv_f = _pick_bv(hidden, False)
+    bv_b = _pick_bv(hidden, True)
     return (n_tokens % BLOCK_T == 0 and hidden % 128 == 0
-            and vocab >= _pick_bv(hidden, False))
+            and bv_f > 0 and bv_b > 0 and vocab >= bv_f)
 
 
 def _fwd_kernel(x_ref, h_ref, lab_ref, nll_ref, lse_ref, m_sc, l_sc, g_sc,
@@ -189,6 +195,9 @@ def _fused_ce_fwd(x, head, labels):
     N, H = x.shape
     V = head.shape[1]
     bt, bv = BLOCK_T, _pick_bv(H, False)
+    if bv <= 0:
+        raise ValueError(f"fused CE fwd has no VMEM-feasible tile for "
+                         f"hidden={H}; gate with fused_ce_supported()")
     n_t, n_v = N // bt, _cdiv(V, bv)
     headp = _pad_head(head, n_v * bv)
     lab2 = _pack8(labels.reshape(n_t, bt).astype(jnp.int32))
@@ -219,6 +228,9 @@ def _fused_ce_bwd(x, head, labels, lse, g):
     N, H = x.shape
     V = head.shape[1]
     bt, bv = BLOCK_T, _pick_bv(H, True)
+    if bv <= 0:
+        raise ValueError(f"fused CE bwd has no VMEM-feasible tile for "
+                         f"hidden={H}; gate with fused_ce_supported()")
     n_t, n_v = N // bt, _cdiv(V, bv)
     headp = _pad_head(head, n_v * bv)
     lab2 = _pack8(labels.reshape(n_t, bt).astype(jnp.int32))

@@ -76,3 +76,14 @@ def test_mean_loss_path(data):
     ref = float(_ref_nll(x, head, labels).mean())
     assert abs(float(loss) - ref) < 1e-5
     assert float(jnp.abs(dx).max()) > 0
+
+
+def test_wide_hidden_gate():
+    """H=2560 has no VMEM-feasible bwd tile (the fp32 accumulator block
+    alone is 4*bt*H); the gate must route such configs to the chunked
+    scan instead of crashing Mosaic at compile."""
+    from paddle_tpu.ops.pallas.fused_ce import _pick_bv
+
+    assert fused_ce_supported(2048, 1024, 50304)
+    assert _pick_bv(2560, True) == 0
+    assert not fused_ce_supported(2048, 2560, 50304)
