@@ -30,6 +30,25 @@ from .flash_attention import _interpret_mode
 BLOCK_S = 512
 
 
+def _online_softmax_page(q, k, v, base_pos, bs, seq_len, sm_scale,
+                         m_sc, l_sc, acc_sc):
+    """One page's contribution to the running (m, l, acc) scratch state —
+    shared by the index-map and manual-DMA paged kernels so their
+    numerics can never diverge. q [nh, d] fp32; k/v [nh, bs, d] fp32."""
+    pos = base_pos + jax.lax.iota(jnp.int32, bs)
+    valid = pos < seq_len
+    s = jnp.sum(q[:, None, :] * k, axis=-1) * sm_scale       # [nh, bs]
+    s = s + jnp.where(valid, 0.0, -1e30)[None, :]
+    m_prev = m_sc[0, :]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+    p = jnp.exp(s - m_new[:, None])
+    alpha = jnp.exp(m_prev - m_new)
+    l_sc[0, :] = l_sc[0, :] * alpha + jnp.sum(p, axis=1)
+    m_sc[0, :] = m_new
+    acc_sc[...] = (acc_sc[...] * alpha[:, None]
+                   + jnp.sum(p[:, :, None] * v, axis=1))
+
+
 def decode_attention_supported(cache_shape, head_dim: int,
                                num_heads: int | None = None) -> bool:
     _, nKV, S, d = cache_shape         # [B, nKV, S, d]
@@ -89,24 +108,31 @@ def paged_decode_supported(pages_shape, n_q_heads: int,
     double-buffered k+v working set must fit ~16MB VMEM (v5e) — larger
     configs take the XLA gather path."""
     _, nh, bs, d = pages_shape
-    k_per = (_paged_pages_per_program(max_blocks)
-             if max_blocks is not None else 4)     # worst case when unknown
     page_bytes = nh * bs * d * 2                   # bf16
-    # k+v double-buffered for all k_per pages + fp32 cast temps per page
-    if k_per * (2 * 2 * page_bytes + 3 * 2 * page_bytes) > 12 * 2 ** 20:
+    k_per = _paged_pages_per_program(max_blocks if max_blocks is not None
+                                     else 4, page_bytes)
+    # double-buffered k+v operands for the whole group + ONE page's fp32
+    # cast temps (pages compute serially) — calibrated against the
+    # measured-working 1B config (nh=16, bs=128, d=128, k_per=4 ≈ 10MB)
+    est = 2 * 2 * k_per * page_bytes + 4 * page_bytes
+    if est > 12 * 2 ** 20:
         return False
     return (d in (64, 128, 256) and bs % 8 == 0
             and nh == n_q_heads)
 
 
-def _paged_pages_per_program(max_blocks: int) -> int:
-    """Pages fetched per grid program: the kernel is program-latency
-    bound at one page each (~16us/program on v5e vs ~1us of DMA+VPU),
-    so amortize over the largest power-of-two divisor <= 4 (8 pages'
-    double-buffered k+v exceeds the ~16MB VMEM)."""
+def _paged_pages_per_program(max_blocks: int,
+                             page_bytes: int | None = None) -> int:
+    """Pages fetched per grid program / DMA group: amortizes per-step
+    overhead over the largest power-of-two divisor <= 4 whose
+    double-buffered k+v working set also fits VMEM when ``page_bytes``
+    is given (2 slots x 2 tensors x k pages <= ~12MB)."""
     for k in (4, 2, 1):
-        if max_blocks % k == 0:
-            return k
+        if max_blocks % k:
+            continue
+        if page_bytes is not None and 4 * k * page_bytes > 12 * 2 ** 20:
+            continue
+        return k
     return 1
 
 
@@ -139,27 +165,126 @@ def _paged_decode_kernel(bt_ref, sl_ref, q_ref, *refs, bs, n_blocks,
     # nh separate 1-row MXU dots and need no scalar scratch access
     q = q_ref[...].astype(jnp.float32)                # [nh, d]
     for c in range(k_per):
-        blk = j * k_per + c
-        pos = blk * bs + jax.lax.iota(jnp.int32, bs)
-        valid = pos < seq_len                         # [bs]
-        k = k_refs[c][...].astype(jnp.float32)        # [nh, bs, d]
-        v = v_refs[c][...].astype(jnp.float32)
-        s = jnp.sum(q[:, None, :] * k, axis=-1) * sm_scale  # [nh, bs]
-        s = s + jnp.where(valid, 0.0, -1e30)[None, :]
-        m_prev = m_sc[0, :]                           # [nh]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_new[:, None])               # [nh, bs]
-        alpha = jnp.exp(m_prev - m_new)
-        l_sc[0, :] = l_sc[0, :] * alpha + jnp.sum(p, axis=1)
-        m_sc[0, :] = m_new
-        acc_sc[...] = (acc_sc[...] * alpha[:, None]
-                       + jnp.sum(p[:, :, None] * v, axis=1))
+        _online_softmax_page(
+            q, k_refs[c][...].astype(jnp.float32),
+            v_refs[c][...].astype(jnp.float32),
+            (j * k_per + c) * bs, bs, seq_len, sm_scale,
+            m_sc, l_sc, acc_sc)
 
     @pl.when(j == n_blocks // k_per - 1)
     def _fin():
         o_ref[...] = (acc_sc[...] /
                       jnp.maximum(l_sc[0, :], 1e-30)[:, None]
                       ).astype(o_ref.dtype)
+
+
+def _paged_decode_dma_kernel(bt_ref, sl_ref, q_ref, k_hbm, v_hbm, o_ref,
+                             k_buf, v_buf, sems, m_sc, l_sc, acc_sc, *,
+                             bs, max_blocks, sm_scale, gk):
+    """One program per SEQUENCE: pages stay in HBM (memory_space=ANY) and
+    the kernel issues its own double-buffered async copies driven by the
+    prefetched block table — the next GROUP of ``gk`` pages' DMAs are in
+    flight while the current group computes (vllm-TPU's pattern). Group
+    size amortizes the ~10us/iteration loop overhead that bounds the
+    one-page-per-step variants."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b = pl.program_id(0)
+    nh, d = q_ref.shape
+    n_groups = max_blocks // gk
+
+    def group_dmas(slot, g):
+        out = []
+        for c in range(gk):
+            page = bt_ref[b * max_blocks + g * gk + c]
+            out.append(pltpu.make_async_copy(
+                k_hbm.at[page], k_buf.at[slot, c], sems.at[0, slot, c]))
+            out.append(pltpu.make_async_copy(
+                v_hbm.at[page], v_buf.at[slot, c], sems.at[1, slot, c]))
+        return out
+
+    for dma in group_dmas(0, 0):
+        dma.start()
+
+    m_sc[...] = jnp.full_like(m_sc[...], -1e30)
+    l_sc[...] = jnp.zeros_like(l_sc[...])
+    acc_sc[...] = jnp.zeros_like(acc_sc[...])
+    seq_len = sl_ref[b]
+    q = q_ref[...].astype(jnp.float32)                # [nh, d]
+
+    def loop(g, _):
+        slot = g % 2
+
+        @pl.when(g + 1 < n_groups)
+        def _prefetch():
+            for dma in group_dmas((g + 1) % 2, g + 1):
+                dma.start()
+
+        for dma in group_dmas(slot, g):
+            dma.wait()
+
+        for c in range(gk):
+            _online_softmax_page(
+                q, k_buf[slot, c].astype(jnp.float32),
+                v_buf[slot, c].astype(jnp.float32),
+                (g * gk + c) * bs, bs, seq_len, sm_scale,
+                m_sc, l_sc, acc_sc)
+        return 0
+
+    jax.lax.fori_loop(0, n_groups, loop, 0)
+    o_ref[...] = (acc_sc[...] /
+                  jnp.maximum(l_sc[0, :], 1e-30)[:, None]).astype(
+        o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale",))
+def paged_decode_attention_dma(q, k_pages, v_pages, block_table,
+                               seq_lens, sm_scale: float):
+    """DMA-pipelined batched paged decode (see _paged_decode_dma_kernel).
+    Same contract as paged_decode_attention_kernel."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if not paged_decode_supported(k_pages.shape, q.shape[1],
+                                  max_blocks=block_table.shape[1]):
+        raise ValueError(
+            f"paged_decode_attention_dma: pages {tuple(k_pages.shape)} "
+            f"with {q.shape[1]} q heads unsupported; gate with "
+            "paged_decode_supported()")
+    B, nh, d = q.shape
+    bs = k_pages.shape[2]
+    max_blocks = block_table.shape[1]
+    gk = _paged_pages_per_program(max_blocks,
+                                  page_bytes=nh * bs * d *
+                                  k_pages.dtype.itemsize)
+    bt_flat = block_table.reshape(-1).astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((None, nh, d), lambda b, bt, sl: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),     # k_pages stay in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),     # v_pages stay in HBM
+        ],
+        out_specs=pl.BlockSpec((None, nh, d), lambda b, bt, sl: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, gk, nh, bs, d), k_pages.dtype),
+            pltpu.VMEM((2, gk, nh, bs, d), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2, gk)),
+            pltpu.VMEM((8, nh), jnp.float32),
+            pltpu.VMEM((8, nh), jnp.float32),
+            pltpu.VMEM((nh, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_decode_dma_kernel, bs=bs,
+                          max_blocks=max_blocks, sm_scale=sm_scale, gk=gk),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nh, d), q.dtype),
+        interpret=_interpret_mode(),
+    )(bt_flat, seq_lens.astype(jnp.int32), q, k_pages, v_pages)
 
 
 @functools.partial(jax.jit, static_argnames=("sm_scale",))

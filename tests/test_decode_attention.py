@@ -127,3 +127,27 @@ def test_llama_decode_kernel_vs_dense_path():
         DA.decode_attention_supported = orig
     np.testing.assert_array_equal(np.asarray(out_kernel),
                                   np.asarray(out_dense))
+
+
+@pytest.mark.smoke
+def test_dma_pipelined_kernel_matches_index_map():
+    """The manual-DMA paged kernel (pages in HBM, double-buffered async
+    copies driven by the prefetched table) must match the index-map
+    kernel exactly."""
+    from paddle_tpu.ops.pallas.decode_attention import (
+        paged_decode_attention_dma, paged_decode_attention_kernel)
+
+    rng = np.random.RandomState(3)
+    B, nh, bs, d, mb = 4, 8, 16, 64, 4
+    n_pages = 32
+    q = jnp.asarray(rng.randn(B, nh, d).astype(np.float32))
+    kp = jnp.asarray(rng.randn(n_pages, nh, bs, d).astype(np.float32))
+    vp = jnp.asarray(rng.randn(n_pages, nh, bs, d).astype(np.float32))
+    table = jnp.asarray(rng.permutation(n_pages)[:B * mb]
+                        .reshape(B, mb).astype(np.int32))
+    sl = jnp.asarray([1, bs, 2 * bs + 3, mb * bs], jnp.int32)
+    a = paged_decode_attention_dma(q, kp, vp, table, sl,
+                                   1.0 / math.sqrt(d))
+    b_ = paged_decode_attention_kernel(q, kp, vp, table, sl,
+                                       1.0 / math.sqrt(d))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
