@@ -70,6 +70,12 @@ class OpDef(NamedTuple):
 
 OP_REGISTRY: dict[str, OpDef] = {}
 
+# Reentrancy depth of op impl execution — nested wrapper calls run raw
+# (see op_call); list cell so closures share the counter.
+_IMPL_DEPTH = [0]
+
+from jax._src.core import trace_state_clean as _trace_state_clean
+
 # Zero-bubble split backward rules (the tape analog of the reference's
 # matmul-grad split in pipeline_zero_bubble.py). A rule has signature
 #   rule(arrays, weight_slots, kwargs, cotangents)
@@ -155,6 +161,27 @@ def op_call(opdef: OpDef, args, kwargs):
     t_args = _extract(list(args), leaves)
     t_kwargs = _extract(kwargs, leaves) if kwargs else {}
 
+    # Nested call: an @op impl invoking another op's PUBLIC wrapper (the
+    # fused ops compose this way). Boxing the nested result in Tensor
+    # would feed a Tensor into the outer impl's raw jnp math — run the
+    # impl at the jax level instead; the OUTERMOST op_call owns the
+    # tape/AMP/hooks for the whole composition. Same rule for wrappers
+    # reached with raw tracers from inside someone else's jax trace.
+    # (trace_state_clean() is a cheap global gate: in plain eager no
+    # tracer can exist, so the per-leaf scan never runs on the hot path.)
+    if _IMPL_DEPTH[0] > 0 or (
+            not leaves and not _trace_state_clean()
+            and any(isinstance(a, jax.core.Tracer)
+                    for a in jax.tree.leaves((args, kwargs)))):
+        arrays = [t._mat() for t in leaves]
+        _IMPL_DEPTH[0] += 1
+        try:
+            return opdef.impl(*_rebuild(t_args, arrays),
+                              **(_rebuild(t_kwargs, arrays)
+                                 if kwargs else {}))
+        finally:
+            _IMPL_DEPTH[0] -= 1
+
     requires_grad = (
         opdef.differentiable
         and autograd.is_grad_enabled()
@@ -184,9 +211,13 @@ def op_call(opdef: OpDef, args, kwargs):
 
     if requires_grad:
         def primal(*arrs):
-            out = opdef.impl(
-                *_rebuild(t_args, arrs), **_rebuild(t_kwargs, arrs)
-            )
+            _IMPL_DEPTH[0] += 1
+            try:
+                out = opdef.impl(
+                    *_rebuild(t_args, arrs), **_rebuild(t_kwargs, arrs)
+                )
+            finally:
+                _IMPL_DEPTH[0] -= 1
             return tuple(out) if isinstance(out, list) else out
 
         outs, vjp_fn = jax.vjp(primal, *arrays)
@@ -211,7 +242,12 @@ def op_call(opdef: OpDef, args, kwargs):
 
                 node.split = split
     else:
-        outs = opdef.impl(*_rebuild(t_args, arrays), **_rebuild(t_kwargs, arrays))
+        _IMPL_DEPTH[0] += 1
+        try:
+            outs = opdef.impl(*_rebuild(t_args, arrays),
+                              **_rebuild(t_kwargs, arrays))
+        finally:
+            _IMPL_DEPTH[0] -= 1
         if isinstance(outs, list):
             outs = tuple(outs)
         node = None

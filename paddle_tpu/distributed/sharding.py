@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = [
     "apply_zero_sharding",
     "shard_array_over",
+    "shard_spec_over",
     "group_sharded_parallel",
 ]
 
@@ -46,29 +47,42 @@ def _shardable_dim(shape, axis_size: int) -> Optional[int]:
     return best_d
 
 
-def shard_array_over(arr: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
-    """Shard an array's largest divisible dim over `axis` (keeping existing
-    shardings on other axes)."""
+def shard_spec_over(shape, cur_spec, mesh: Mesh, axis: str) -> Optional[P]:
+    """PartitionSpec that adds `axis` on the largest divisible dim of
+    ``shape`` not already sharded (None = leave the array as-is). Pure
+    spec arithmetic so the AOT/abstract path (parallel/aot.py) can apply
+    the identical ZeRO placement without materialized arrays."""
     axis_size = mesh.shape[axis]
     if axis_size == 1:
-        return arr
-    cur = getattr(arr, "sharding", None)
-    entries = [None] * arr.ndim
-    if isinstance(cur, NamedSharding) and cur.mesh == mesh:
-        for d, e in enumerate(cur.spec):
+        return None
+    entries = [None] * len(shape)
+    if cur_spec is not None:
+        for d, e in enumerate(cur_spec):
             entries[d] = e
             names = e if isinstance(e, tuple) else (e,) if e else ()
             if axis in names:
-                return arr  # already sharded over this axis
+                return None  # already sharded over this axis
     # pick a dim not already sharded
     free_shape = [
-        s if entries[d] is None else 0 for d, s in enumerate(arr.shape)
+        s if entries[d] is None else 0 for d, s in enumerate(shape)
     ]
     d = _shardable_dim(free_shape, axis_size)
     if d is None:
-        return arr
+        return None
     entries[d] = (axis,) if not entries[d] else tuple(entries[d]) + (axis,)
-    return jax.device_put(arr, NamedSharding(mesh, P(*entries)))
+    return P(*entries)
+
+
+def shard_array_over(arr: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
+    """Shard an array's largest divisible dim over `axis` (keeping existing
+    shardings on other axes)."""
+    cur = getattr(arr, "sharding", None)
+    cur_spec = (cur.spec if isinstance(cur, NamedSharding)
+                and cur.mesh == mesh else None)
+    spec = shard_spec_over(arr.shape, cur_spec, mesh, axis)
+    if spec is None:
+        return arr
+    return jax.device_put(arr, NamedSharding(mesh, spec))
 
 
 def apply_zero_sharding(optimizer, stage):

@@ -18,7 +18,7 @@ from ..core.tensor import Tensor
 
 __all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
            "segment_mean", "segment_max", "segment_min", "reindex_graph",
-           "sample_neighbors"]
+           "sample_neighbors", "weighted_sample_neighbors"]
 
 def _segment(data, ids, num, pool):
     if pool == "sum":
@@ -136,21 +136,30 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
                               else count)))
 
 
-def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
-                     eids=None, return_eids: bool = False, perm_buffer=None,
-                     name=None):
-    """Uniform neighbor sampling on CSC (host-side; reference
-    geometric/sampling/neighbors.py). Draws from the framework's global
-    seed — fresh samples per call, reproducible under paddle_tpu.seed."""
+def _np_of(x):
     import numpy as np
 
-    if return_eids:
-        raise NotImplementedError("return_eids is not supported yet")
-    r = np.asarray(row._data if isinstance(row, Tensor) else row)
-    cp = np.asarray(colptr._data if isinstance(colptr, Tensor) else colptr)
-    nodes = np.asarray(input_nodes._data if isinstance(input_nodes, Tensor)
-                       else input_nodes)
-    out_neighbors, out_counts = [], []
+    return np.asarray(x._data if isinstance(x, Tensor) else x)
+
+
+def _sample_neighbors_impl(row, colptr, input_nodes, sample_size, eids,
+                           return_eids, weights=None):
+    """Shared uniform/weighted CSC neighbor sampler (host-side; the
+    reference's gpu samplers are shape-dynamic, which is inherently a
+    host/eager operation under XLA). Weighted draws select without
+    replacement with probability proportional to edge weight (reference
+    weighted_sample_neighbors semantics, sampling/neighbors.py:218)."""
+    import numpy as np
+
+    r = _np_of(row)
+    cp = _np_of(colptr)
+    nodes = _np_of(input_nodes)
+    w = _np_of(weights).astype(np.float64) if weights is not None else None
+    e = _np_of(eids) if eids is not None else None
+    if return_eids and e is None:
+        raise ValueError("`eids` should not be None if `return_eids` is "
+                         "True.")
+    out_neighbors, out_counts, out_eids = [], [], []
     # fresh stream per call from the global key: fresh samples every call,
     # reproducible after paddle_tpu.seed
     from ..core import random as _random
@@ -158,11 +167,44 @@ def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
     rng = np.random.default_rng(int(np.asarray(_random.next_key())[-1]))
     for n in nodes.tolist():
         lo, hi = int(cp[n]), int(cp[n + 1])
-        neigh = r[lo:hi]
-        if 0 <= sample_size < len(neigh):
-            neigh = rng.choice(neigh, size=sample_size, replace=False)
-        out_neighbors.append(neigh)
-        out_counts.append(len(neigh))
-    return (Tensor(np.concatenate(out_neighbors) if out_neighbors
-                   else np.zeros(0, r.dtype)),
-            Tensor(np.asarray(out_counts)))
+        idx = np.arange(lo, hi)
+        if 0 <= sample_size < len(idx):
+            if w is None:
+                idx = rng.choice(idx, size=sample_size, replace=False)
+            else:
+                p = w[lo:hi]
+                s = p.sum()
+                p = (np.full(len(idx), 1.0 / len(idx)) if s <= 0
+                     else p / s)
+                idx = rng.choice(idx, size=sample_size, replace=False, p=p)
+        out_neighbors.append(r[idx])
+        out_counts.append(len(idx))
+        if return_eids:
+            out_eids.append(e[idx])
+    cat = lambda xs, dt: (np.concatenate(xs) if xs else np.zeros(0, dt))
+    res = (Tensor(cat(out_neighbors, r.dtype)),
+           Tensor(np.asarray(out_counts)))
+    if return_eids:
+        res = res + (Tensor(cat(out_eids, e.dtype)),)
+    return res
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
+                     eids=None, return_eids: bool = False, perm_buffer=None,
+                     name=None):
+    """Uniform neighbor sampling on CSC (reference
+    geometric/sampling/neighbors.py:30). Draws from the framework's
+    global seed — fresh samples per call, reproducible under
+    paddle_tpu.seed."""
+    return _sample_neighbors_impl(row, colptr, input_nodes, sample_size,
+                                  eids, return_eids)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size: int = -1, eids=None,
+                              return_eids: bool = False, name=None):
+    """Weighted neighbor sampling on CSC (reference
+    geometric/sampling/neighbors.py:218): selection probability is
+    proportional to edge weight, without replacement."""
+    return _sample_neighbors_impl(row, colptr, input_nodes, sample_size,
+                                  eids, return_eids, weights=edge_weight)

@@ -124,18 +124,28 @@ class PagedKVCache:
     cache layout): pages of ``block_size`` tokens allocated on demand,
     per-sequence block tables mapping logical blocks -> physical pages.
 
-    Layout: k_pages/v_pages [n_pages, n_heads, block_size, head_dim];
-    block_table [B, max_blocks]; seq_lens [B].
+    Layout: v_pages [n_pages, n_heads, block_size, head_dim]; k_pages the
+    same with ``k_layout='token_major'``, or [n_pages, n_heads, head_dim,
+    block_size] with ``k_layout='d_major'`` (default) — the d-major k page
+    flattens to the [nh*d, bs] operand the MXU-formulated decode kernel
+    consumes directly (ops/pallas/decode_attention.py
+    paged_decode_attention_mxu), written natively so no per-step
+    transpose exists. block_table [B, max_blocks]; seq_lens [B].
     """
 
     def __init__(self, n_pages: int, n_heads: int, block_size: int,
                  head_dim: int, batch: int, max_seq: int,
-                 dtype=jnp.bfloat16):
+                 dtype=jnp.bfloat16, k_layout: str = "d_major"):
+        if k_layout not in ("d_major", "token_major"):
+            raise ValueError(f"k_layout {k_layout!r}")
         self.block_size = block_size
+        self.k_layout = k_layout
         self.max_blocks = (max_seq + block_size - 1) // block_size
-        self.k_pages = jnp.zeros((n_pages, n_heads, block_size, head_dim),
+        self.v_pages = jnp.zeros((n_pages, n_heads, block_size, head_dim),
                                  dtype)
-        self.v_pages = jnp.zeros_like(self.k_pages)
+        self.k_pages = (jnp.zeros((n_pages, n_heads, head_dim, block_size),
+                                  dtype) if k_layout == "d_major"
+                        else jnp.zeros_like(self.v_pages))
         # static round-robin allocation: sequence b owns pages
         # [b*max_blocks, (b+1)*max_blocks) — the allocator policy is
         # host-side; any table works for the kernels
@@ -157,6 +167,8 @@ class PagedKVCache:
             .reshape(B * nblk, nh, bs, dh)
         vb = jnp.swapaxes(vp.reshape(B, nblk, bs, nh, dh), 2, 3) \
             .reshape(B * nblk, nh, bs, dh)
+        if self.k_layout == "d_major":
+            kb = jnp.swapaxes(kb, 2, 3)           # [B*nblk, nh, dh, bs]
         pages = self.block_table[:, :nblk].reshape(-1)
         self.k_pages = self.k_pages.at[pages].set(kb.astype(
             self.k_pages.dtype))
@@ -172,8 +184,13 @@ class PagedKVCache:
         pages = jax.vmap(lambda t, b: t[b])(self.block_table, blk)
         kt = jnp.swapaxes(k, 1, 2)  # [B, nh, 1, dh]
         vt = jnp.swapaxes(v, 1, 2)
-        self.k_pages = self.k_pages.at[pages, :, off].set(
-            kt[:, :, 0].astype(self.k_pages.dtype))
+        if self.k_layout == "d_major":
+            # token slot is the LANE position of the d-major page
+            self.k_pages = self.k_pages.at[pages, :, :, off].set(
+                kt[:, :, 0].astype(self.k_pages.dtype))
+        else:
+            self.k_pages = self.k_pages.at[pages, :, off].set(
+                kt[:, :, 0].astype(self.k_pages.dtype))
         self.v_pages = self.v_pages.at[pages, :, off].set(
             vt[:, :, 0].astype(self.v_pages.dtype))
         self.seq_lens = self.seq_lens + 1
@@ -202,34 +219,56 @@ def block_multihead_attention(qkv, cache: PagedKVCache,
     # decode
     cache.write_decode(k, v)
     return paged_decode_attention(q, cache.k_pages, cache.v_pages,
-                                  cache.block_table, cache.seq_lens)
+                                  cache.block_table, cache.seq_lens,
+                                  k_layout=cache.k_layout)
 
 
-def paged_decode_attention(q, k_pages, v_pages, block_table, seq_lens):
+def paged_decode_attention(q, k_pages, v_pages, block_table, seq_lens,
+                           k_layout: str = "token_major"):
     """Single-token decode against the paged cache. q [B, 1, nh, dh].
 
-    Kernel path (ops/pallas/decode_attention.py
-    paged_decode_attention_kernel): the block table drives the page
-    BlockSpec index maps, so the gathered/repeated KV tensor never
+    Kernel path (ops/pallas/decode_attention.py): with d-major k pages
+    (``k_layout='d_major'``) the MXU-formulated kernel — per-page scores
+    and weighted values as block-diagonal MXU dots; with token-major
+    pages the vector kernel. Both drive page fetch from the block table
+    via BlockSpec index maps, so the gathered/repeated KV tensor never
     materializes. XLA gather+dot fallback for unsupported shapes."""
     B = q.shape[0]
-    nh, bs, dh = k_pages.shape[1:]
+    if k_layout == "d_major":
+        nh, dh, bs = k_pages.shape[1:]
+    else:
+        nh, bs, dh = k_pages.shape[1:]
     max_blocks = block_table.shape[1]
 
     from ....ops.pallas.decode_attention import (
-        paged_decode_attention_kernel, paged_decode_supported)
+        paged_decode_attention_kernel, paged_decode_attention_mxu,
+        paged_decode_mxu_supported, paged_decode_supported)
 
-    if paged_decode_supported(k_pages.shape, q.shape[2],
-                              max_blocks=max_blocks):
+    if (k_layout == "d_major"
+            and paged_decode_mxu_supported(k_pages.shape, q.shape[2],
+                                           max_blocks=max_blocks)):
+        o = paged_decode_attention_mxu(
+            q[:, 0].astype(k_pages.dtype), k_pages, v_pages, block_table,
+            seq_lens, 1.0 / math.sqrt(dh))
+        return o[:, None].astype(q.dtype)             # [B, 1, nh, dh]
+    if (k_layout == "token_major"
+            and paged_decode_supported(k_pages.shape, q.shape[2],
+                                       max_blocks=max_blocks)):
         o = paged_decode_attention_kernel(
             q[:, 0].astype(k_pages.dtype), k_pages, v_pages, block_table,
             seq_lens, 1.0 / math.sqrt(dh))
         return o[:, None].astype(q.dtype)             # [B, 1, nh, dh]
 
     kg = k_pages[block_table]            # [B, max_blocks, nh, bs, dh]
+    if k_layout == "d_major":
+        kg = jnp.swapaxes(kg, 3, 4)      # back to token-major for the dot
     vg = v_pages[block_table]
     kg = jnp.swapaxes(kg, 1, 2).reshape(B, nh, max_blocks * bs, dh)
     vg = jnp.swapaxes(vg, 1, 2).reshape(B, nh, max_blocks * bs, dh)
+    if q.shape[2] != nh:                 # GQA fallback: repeat kv heads
+        kg = jnp.repeat(kg, q.shape[2] // nh, axis=1)
+        vg = jnp.repeat(vg, q.shape[2] // nh, axis=1)
+        nh = q.shape[2]
     qh = jnp.swapaxes(q, 1, 2)           # [B, nh, 1, dh]
     s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(kg.dtype), kg,
                    preferred_element_type=jnp.float32) / math.sqrt(dh)

@@ -1,0 +1,352 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+The request-serving runtime above the kernels — the role of the
+reference's AnalysisPredictor + fused_multi_transformer serving path
+(fluid/inference/api/analysis_predictor.cc:1657; block_multi_head_attention
+for the paged cache). TPU design:
+
+- TWO compiled programs, static shapes: a per-bucket prefill (one request,
+  prompt padded to the bucket) and ONE batched decode step over all
+  ``max_batch`` slots. Requests at different positions/lengths share the
+  decode program — per-request state is data (block tables, seq_lens),
+  never shape.
+- vLLM-style paged KV: per-layer page arrays, physical pages allocated
+  per request from a free list and returned on completion; page 0 is a
+  write sink for idle slots so the batched program needs no masking
+  branches. k pages are d-major — the MXU decode kernel's native operand
+  (ops/pallas/decode_attention.py paged_decode_attention_mxu).
+- Continuous batching: the scheduler admits queued requests into free
+  slots between decode steps (prefill interleaves with decode), so a
+  long generation never blocks the queue — the reference gets this from
+  serving frameworks above the predictor; here it is the engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+import time
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models.llama import (LlamaConfig, apply_rope, block_apply,
+                            init_llama_params, rms_norm, rope_angles, _mm)
+
+__all__ = ["Request", "ServingEngine"]
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    prompt: np.ndarray                 # [T] int32
+    max_new_tokens: int
+    arrival: float = 0.0               # seconds from engine start
+    # filled by the engine:
+    out_tokens: list = dataclasses.field(default_factory=list)
+    t_first: Optional[float] = None    # first-token wall time
+    t_done: Optional[float] = None
+
+
+class _PagePool:
+    """Free-list page allocator. Page 0 is reserved as the idle-slot
+    write sink and never handed out."""
+
+    def __init__(self, n_pages: int):
+        self.free = list(range(n_pages - 1, 0, -1))
+
+    def alloc(self, n: int) -> Optional[list[int]]:
+        if len(self.free) < n:
+            return None
+        return [self.free.pop() for _ in range(n)]
+
+    def release(self, pages: list[int]) -> None:
+        self.free.extend(pages)
+
+
+class ServingEngine:
+    """Continuous-batching LLaMA serving over paged KV.
+
+    ``step()`` = admissions (prefill) + one batched decode tick;
+    ``run(requests)`` drives wall-clock arrivals to completion and
+    returns latency/throughput stats.
+    """
+
+    def __init__(self, cfg: LlamaConfig, params: Optional[dict] = None,
+                 seed: int = 0, max_batch: int = 8, page_size: int = 128,
+                 max_seq: Optional[int] = None, n_pages: Optional[int] = None,
+                 prefill_buckets: tuple = (128, 256, 512, 1024),
+                 decode_quantum: int = 8):
+        self.cfg = cfg
+        self.params = params if params is not None else init_llama_params(
+            cfg, jax.random.PRNGKey(seed))
+        self.B = max_batch
+        self.bs = page_size
+        self.max_seq = max_seq or cfg.max_seq_len
+        self.max_blocks = (self.max_seq + page_size - 1) // page_size
+        self.n_pages = n_pages or (1 + max_batch * self.max_blocks)
+        self.buckets = tuple(b for b in sorted(prefill_buckets)
+                             if b % page_size == 0 or b < page_size)
+        L, nKV, d = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        self.k_pages = jnp.zeros((L, self.n_pages, nKV, d, self.bs),
+                                 cfg.dtype)
+        self.v_pages = jnp.zeros((L, self.n_pages, nKV, self.bs, d),
+                                 cfg.dtype)
+        self.table = np.zeros((self.B, self.max_blocks), np.int32)  # sink
+        self.seq_lens = np.zeros((self.B,), np.int32)
+        self.cur_tok = np.zeros((self.B,), np.int32)
+        self.slots: list[Optional[Request]] = [None] * self.B
+        self._slot_pages: list[list[int]] = [[] for _ in range(self.B)]
+        self.pool = _PagePool(self.n_pages)
+        self.queue: list[Request] = []
+        self._prefills = {}
+        # Decode runs in QUANTA of K steps per dispatch (one lax.scan
+        # program): over remote-device links a host round-trip costs
+        # ~100 ms, so per-token dispatch would bound throughput at
+        # ~10 steps/s regardless of the kernels. The scheduler touches
+        # the batch (admissions/finishes) between quanta; a request
+        # finishing mid-quantum wastes at most K-1 slot-steps (its junk
+        # tokens write into its own or the sink pages and are discarded).
+        self.decode_quantum = max(1, decode_quantum)
+        self._decode = jax.jit(
+            functools.partial(self._decode_n_impl, n=self.decode_quantum),
+            donate_argnums=(1, 2))
+        self.stats = {"decode_steps": 0, "prefills": 0,
+                      "decode_slot_tokens": 0, "decode_active_tokens": 0}
+
+    # -- compiled programs --------------------------------------------------
+
+    def _prefill_impl(self, params, k_pages, v_pages, tokens, pages,
+                      n_valid):
+        """One request's prompt (padded to a bucket) through the shared
+        block_apply, k/v written straight into its pages; returns the
+        last REAL token's logits. tokens [1, Tb]; pages [Tb//bs]."""
+        cfg = self.cfg
+        T = tokens.shape[1]
+        nblk = (T + self.bs - 1) // self.bs
+        pad = nblk * self.bs - T
+        x = params["wte"][tokens].astype(cfg.dtype)
+        cos, sin = rope_angles(cfg, jnp.arange(T))
+        cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+
+        def body(carry, inp):
+            x = carry
+            bp, kp, vp = inp
+            x, k, v = block_apply(bp, x, cfg, cos, sin, return_kv=True)
+            # [1, T, nKV, d] -> pages [nblk, nKV, d|bs, bs|d]; the pad
+            # tail (and any tokens past n_valid) is masked by seq_lens
+            # at every future read
+            if pad:
+                k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            kb = k[0].reshape(nblk, self.bs, cfg.n_kv_heads, cfg.head_dim)
+            vb = v[0].reshape(nblk, self.bs, cfg.n_kv_heads, cfg.head_dim)
+            kp = kp.at[pages].set(
+                jnp.transpose(kb, (0, 2, 3, 1)).astype(kp.dtype))
+            vp = vp.at[pages].set(
+                jnp.transpose(vb, (0, 2, 1, 3)).astype(vp.dtype))
+            return x, (kp, vp)
+
+        x, (ks, vs) = lax.scan(body, x, (params["blocks"], k_pages,
+                                         v_pages))
+        x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+        last = lax.dynamic_slice_in_dim(x, n_valid - 1, 1, axis=1)
+        logits = _mm(last, params["head"], cfg).astype(jnp.float32)
+        return logits[:, 0], ks, vs
+
+    def _decode_n_impl(self, params, k_pages, v_pages, tokens, table,
+                       seq_lens, *, n):
+        """``n`` greedy decode ticks in ONE program: scan over the
+        single-tick body, feeding each tick's argmax to the next.
+        Returns (toks [n, B], k_pages, v_pages)."""
+
+        def tick(carry, _):
+            kp, vp, tok, sl = carry
+            logits, kp, vp = self._decode_impl(params, kp, vp, tok, table,
+                                               sl)
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            return (kp, vp, nxt, sl + 1), nxt
+
+        (k_pages, v_pages, _, _), toks = lax.scan(
+            tick, (k_pages, v_pages, tokens, seq_lens), None, length=n)
+        return toks, k_pages, v_pages
+
+    def _decode_impl(self, params, k_pages, v_pages, tokens, table,
+                     seq_lens):
+        """One decode tick for ALL slots: tokens [B] (idle slots: token 0
+        into the sink page), per-request positions = seq_lens. Returns
+        (logits [B, V], k_pages, v_pages)."""
+        cfg = self.cfg
+        B = tokens.shape[0]
+        nH, nKV, dH = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        from ..incubate.nn.functional.fused_transformer import \
+            paged_decode_attention
+
+        x = params["wte"][tokens].astype(cfg.dtype)[:, None]   # [B, 1, H]
+        cos, sin = rope_angles(cfg, seq_lens)                  # [B, dH/2]
+        cos, sin = cos[:, None, None, :], sin[:, None, None, :]
+        blk = seq_lens // self.bs
+        off = seq_lens % self.bs
+        pages_b = jnp.take_along_axis(table, blk[:, None], axis=1)[:, 0]
+
+        def body(carry, inp):
+            x = carry
+            bp, kp, vp = inp
+            h = rms_norm(x, bp["attn_norm"], cfg.rms_eps)
+            q = _mm(h, bp["wq"], cfg).reshape(B, 1, nH, dH)
+            k = _mm(h, bp["wk"], cfg).reshape(B, 1, nKV, dH)
+            v = _mm(h, bp["wv"], cfg).reshape(B, 1, nKV, dH)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            kp = kp.at[pages_b, :, :, off].set(k[:, 0].astype(kp.dtype))
+            vp = vp.at[pages_b, :, off].set(v[:, 0].astype(vp.dtype))
+            o = paged_decode_attention(q, kp, vp, table, seq_lens + 1,
+                                       k_layout="d_major")
+            x = x + _mm(o.reshape(B, 1, nH * dH), bp["wo"], cfg)
+            h = rms_norm(x, bp["ffn_norm"], cfg.rms_eps)
+            x = x + _mm(jax.nn.silu(
+                _mm(h, bp["w_gate"], cfg).astype(jnp.float32)).astype(
+                    cfg.dtype) * _mm(h, bp["w_up"], cfg), bp["w_down"], cfg)
+            return x, (kp, vp)
+
+        x, (ks, vs) = lax.scan(body, x, (params["blocks"], k_pages,
+                                         v_pages))
+        x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+        logits = _mm(x, params["head"], cfg).astype(jnp.float32)
+        return logits[:, 0], ks, vs
+
+    def _get_prefill(self, bucket: int):
+        if bucket not in self._prefills:
+            self._prefills[bucket] = jax.jit(self._prefill_impl,
+                                             donate_argnums=(1, 2))
+        return self._prefills[bucket]
+
+    # -- scheduler ----------------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        if len(req.prompt) + req.max_new_tokens > self.max_seq:
+            raise ValueError(
+                f"request {req.rid}: prompt {len(req.prompt)} + "
+                f"{req.max_new_tokens} new tokens exceeds max_seq "
+                f"{self.max_seq}")
+        self.queue.append(req)
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt length {n} exceeds largest bucket "
+                         f"{self.buckets[-1]}")
+
+    def _admit(self, now: float) -> None:
+        for slot in range(self.B):
+            if self.slots[slot] is not None or not self.queue:
+                continue
+            i = next((i for i, r in enumerate(self.queue)
+                      if r.arrival <= now), None)
+            if i is None:
+                return
+            req = self.queue[i]
+            T = len(req.prompt)
+            bucket = self._bucket_for(T)
+            need = max(bucket, T + req.max_new_tokens)
+            n_blk = (need + self.bs - 1) // self.bs
+            pages = self.pool.alloc(n_blk)
+            if pages is None:
+                return                     # no memory: keep queued
+            self.queue.pop(i)
+            self.slots[slot] = req
+            self._slot_pages[slot] = pages
+            row = np.zeros((self.max_blocks,), np.int32)
+            row[:n_blk] = pages
+            self.table[slot] = row
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :T] = req.prompt
+            prefill_pages = jnp.asarray(
+                row[:(bucket + self.bs - 1) // self.bs])
+            logits, self.k_pages, self.v_pages = self._get_prefill(bucket)(
+                self.params, self.k_pages, self.v_pages,
+                jnp.asarray(toks), prefill_pages,
+                jnp.asarray(T, jnp.int32))
+            first = int(jnp.argmax(logits[0]))
+            req.out_tokens.append(first)
+            req.t_first = time.monotonic()
+            self.seq_lens[slot] = T
+            self.cur_tok[slot] = first
+            self.stats["prefills"] += 1
+            self._finish_if_done(slot)
+
+    def _finish_if_done(self, slot: int) -> None:
+        req = self.slots[slot]
+        if req is not None and len(req.out_tokens) >= req.max_new_tokens:
+            req.t_done = time.monotonic()
+            self.pool.release(self._slot_pages[slot])
+            self._slot_pages[slot] = []
+            self.table[slot] = 0           # sink
+            self.seq_lens[slot] = 0
+            self.cur_tok[slot] = 0
+            self.slots[slot] = None
+
+    def step(self, now: Optional[float] = None) -> bool:
+        """Admissions + one decode tick. Returns False when fully idle."""
+        now = time.monotonic() if now is None else now
+        self._admit(now)
+        active = [s for s in range(self.B) if self.slots[s] is not None]
+        if not active:
+            return bool(self.queue)
+        K = self.decode_quantum
+        toks, self.k_pages, self.v_pages = self._decode(
+            self.params, self.k_pages, self.v_pages,
+            jnp.asarray(self.cur_tok), jnp.asarray(self.table),
+            jnp.asarray(self.seq_lens))
+        toks = np.asarray(toks)                     # [K, B]
+        self.stats["decode_steps"] += K
+        self.stats["decode_slot_tokens"] += K * self.B
+        for s in active:
+            req = self.slots[s]
+            take = min(K, req.max_new_tokens - len(req.out_tokens))
+            self.stats["decode_active_tokens"] += take
+            req.out_tokens.extend(int(t) for t in toks[:take, s])
+            self.seq_lens[s] += K
+            self.cur_tok[s] = int(toks[-1, s])
+            self._finish_if_done(s)
+        return True
+
+    def run(self, requests: list[Request]) -> dict:
+        """Drive all requests to completion against wall-clock arrivals;
+        returns throughput + p50/p99 latency stats."""
+        for r in sorted(requests, key=lambda r: r.arrival):
+            self.submit(r)
+        self.stats = {k: 0 for k in self.stats}   # per-run counters
+        t0 = time.monotonic()
+        while any(s is not None for s in self.slots) or self.queue:
+            progressed = self.step(now=time.monotonic() - t0)
+            if not progressed and self.queue:
+                # nothing active and next arrival is in the future
+                nxt = min(r.arrival for r in self.queue)
+                wait = max(0.0, nxt - (time.monotonic() - t0))
+                time.sleep(min(wait, 0.05))
+        wall = time.monotonic() - t0
+        lat = [r.t_done - (t0 + r.arrival) for r in requests]
+        ttft = [r.t_first - (t0 + r.arrival) for r in requests]
+        total_new = sum(len(r.out_tokens) for r in requests)
+        q = lambda xs, p: float(np.percentile(np.asarray(xs), p))
+        return {
+            "n_requests": len(requests),
+            "total_new_tokens": total_new,
+            "wall_s": round(wall, 3),
+            "throughput_tok_s": round(total_new / wall, 1),
+            "latency_p50_s": round(q(lat, 50), 3),
+            "latency_p99_s": round(q(lat, 99), 3),
+            "ttft_p50_s": round(q(ttft, 50), 3),
+            "ttft_p99_s": round(q(ttft, 99), 3),
+            "slot_occupancy": round(
+                self.stats["decode_active_tokens"]
+                / max(1, self.stats["decode_slot_tokens"]), 3),
+            **self.stats,
+        }

@@ -248,11 +248,31 @@ class StaticFunction:
     Data-dependent control flow graph-breaks: the call falls back to
     eager permanently for that guard key (SOT BreakGraphError parity)."""
 
-    def __init__(self, fn: Callable, build_strategy=None, donate_states: bool = True):
+    def __init__(self, fn: Callable, build_strategy=None,
+                 donate_states: bool = True, buckets: Optional[dict] = None,
+                 pad_values: Optional[dict] = None):
         self._fn = fn
         self._cache: dict = {}
         self._donate = donate_states
         self.graph_break_count = 0
+        # Shape bucketing (the dynamic-shape policy; reference solves this
+        # with the PIR symbolic-shape dialect, pir/include/dialect/shape/ —
+        # under XLA's static-shape model the policy is pad-to-bucket):
+        # ``buckets`` maps argument name -> {axis: sorted candidate sizes};
+        # a matching tensor arg is right-padded along each axis to the
+        # smallest bucket >= its size, so a variable-length workload
+        # compiles once per BUCKET, not once per shape. Masking the pad
+        # tail is the model's contract (pass real lengths as 0-d arrays —
+        # python ints are guard constants and would re-trace per length).
+        self._buckets = buckets or {}
+        self._pad_values = pad_values or {}
+        self.bucket_stats: dict = {}
+        try:
+            import inspect as _inspect
+
+            self._sig = _inspect.signature(fn) if buckets else None
+        except (TypeError, ValueError):
+            self._sig = None
         # Lazy-segment fallback (jit/lazy_segments.py): broken guard keys
         # run as compiled subgraph segments around the break instead of
         # pure per-op eager (reference BreakGraphError keeps compiled
@@ -267,16 +287,92 @@ class StaticFunction:
         # the reference's dist_main_program / executor plan objects).
         self.last_lowered = None
         self.last_compiled = None
+        # dy2static AST conversion: attempted once, on the first tensor-
+        # bool graph break; on success every later compile uses the
+        # converted function (cond/while_loop capture).
+        self._ast_tried = False
+        self.ast_converted = False
         functools.update_wrapper(self, fn)
+
+    def _try_ast_retrace(self, args, kwargs, state_vals):
+        """On a tensor-bool break, retrace through the dy2static AST
+        conversion (jit/dy2static.py). Returns the compiled program or
+        None (→ segment fallback). Any conversion/trace failure is
+        swallowed: the segments path is always a safe answer."""
+        if self._ast_tried and not self.ast_converted:
+            return None
+        _purge_leaked_tracers()
+        if not self._ast_tried:
+            self._ast_tried = True
+            try:
+                from .dy2static import ast_transform
+
+                converted = ast_transform(self._fn)
+            except Exception:  # noqa: BLE001 — any failure → segments
+                return None
+            self._orig_fn, self._fn = self._fn, converted
+        try:
+            compiled = self._compile(args, kwargs, state_vals)
+            self.ast_converted = True
+            return compiled
+        except Exception:  # noqa: BLE001
+            _purge_leaked_tracers()
+            if not self.ast_converted:
+                self._fn = self._orig_fn
+            return None
 
     @property
     def compile_count(self) -> int:
         return sum(1 for v in self._cache.values()
                    if v is not _EAGER_FALLBACK)
 
+    def _apply_buckets(self, args, kwargs):
+        if not self._buckets or self._sig is None:
+            return args, kwargs
+        import numpy as _np
+
+        try:
+            bound = self._sig.bind(*args, **kwargs)
+        except TypeError:
+            return args, kwargs
+        for name, axes in self._buckets.items():
+            if name not in bound.arguments:
+                continue
+            v = bound.arguments[name]
+            data = v._data if isinstance(v, Tensor) else v
+            if not hasattr(data, "shape"):
+                continue
+            pads = [(0, 0)] * len(data.shape)
+            changed = False
+            for ax, sizes in axes.items():
+                cur = data.shape[ax]
+                tgt = next((s for s in sorted(sizes) if s >= cur), None)
+                if tgt is None or tgt == cur:
+                    # above the largest bucket: leave exact (degrades to
+                    # per-shape compile, never wrong numerics)
+                    self.bucket_stats[(name, ax, cur if tgt is None
+                                       else tgt)] = \
+                        self.bucket_stats.get((name, ax, cur if tgt is None
+                                               else tgt), 0) + 1
+                    continue
+                pads[ax] = (0, tgt - cur)
+                changed = True
+                self.bucket_stats[(name, ax, tgt)] = \
+                    self.bucket_stats.get((name, ax, tgt), 0) + 1
+            if changed:
+                pv = self._pad_values.get(name, 0)
+                arr = (_np.pad(data, pads, constant_values=pv)
+                       if isinstance(data, _np.ndarray)
+                       else jnp.pad(data, pads, constant_values=pv))
+                bound.arguments[name] = (
+                    Tensor(arr, stop_gradient=v.stop_gradient)
+                    if isinstance(v, Tensor) else arr)
+        return bound.args, bound.kwargs
+
     def __call__(self, *args, **kwargs):
         if not TO_STATIC_ENABLED[0]:
             return self._fn(*args, **kwargs)
+        args, kwargs = self._apply_buckets(args, kwargs)
         state_vals, state_setters = _snapshot()
         key = _guard_key(args, kwargs, len(state_vals))
         compiled: Optional[_Compiled] = self._cache.get(key)
@@ -297,23 +393,30 @@ class StaticFunction:
             try:
                 compiled = self._compile(args, kwargs, state_vals)
             except _BREAK_ERRORS as e:
-                # graph break: cache the fallback so later calls skip the
-                # doomed trace, clean up tracer-holding state, run in
-                # lazy-segment mode (compiled prefix/suffix around the
-                # break — see jit/lazy_segments.py)
-                self._cache[key] = _EAGER_FALLBACK
-                self._broken_keys.add(key[:2])
-                self.graph_break_count += 1
-                _purge_leaked_tracers()
-                import logging
+                # Before graph-breaking, try the dy2static AST retrace:
+                # If/While over tensor predicates become lax.cond /
+                # lax.while_loop (reference ifelse/loop transformers,
+                # jit/dy2static/transformers/) — a `.item()`-free branchy
+                # function then captures WHOLE.
+                compiled = self._try_ast_retrace(args, kwargs, state_vals)
+                if compiled is None:
+                    # graph break: cache the fallback so later calls skip
+                    # the doomed trace, clean up tracer-holding state, run
+                    # in lazy-segment mode (compiled prefix/suffix around
+                    # the break — see jit/lazy_segments.py)
+                    self._cache[key] = _EAGER_FALLBACK
+                    self._broken_keys.add(key[:2])
+                    self.graph_break_count += 1
+                    _purge_leaked_tracers()
+                    import logging
 
-                logging.getLogger("paddle_tpu.jit").warning(
-                    "to_static graph break in %s (running as compiled "
-                    "segments around the break for this input spec; see "
-                    ".segment_stats): %s",
-                    getattr(self._fn, "__name__", "<fn>"),
-                    str(e).split("\n")[0])
-                return self._run_segmented(args, kwargs)
+                    logging.getLogger("paddle_tpu.jit").warning(
+                        "to_static graph break in %s (running as compiled "
+                        "segments around the break for this input spec; see "
+                        ".segment_stats): %s",
+                        getattr(self._fn, "__name__", "<fn>"),
+                        str(e).split("\n")[0])
+                    return self._run_segmented(args, kwargs)
             self._cache[key] = compiled
             # State created during the trace (e.g. optimizer moments) holds
             # tracers until this first execution's out_setters overwrite it
@@ -398,13 +501,20 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True, **kwargs):
-    """Decorator / wrapper (reference: python/paddle/jit/api.py:195)."""
+              backend=None, full_graph=True, buckets=None, pad_values=None,
+              **kwargs):
+    """Decorator / wrapper (reference: python/paddle/jit/api.py:195).
+
+    ``buckets``: optional shape-bucketing policy — see StaticFunction;
+    e.g. ``to_static(step, buckets={"tokens": {1: (128, 256, 512)}})``
+    pads tokens' axis 1 to the next bucket so variable-length batches
+    reuse at most len(buckets) compiled programs."""
 
     def wrap(fn):
         if isinstance(fn, StaticFunction):
             return fn
-        return StaticFunction(fn, build_strategy=build_strategy)
+        return StaticFunction(fn, build_strategy=build_strategy,
+                              buckets=buckets, pad_values=pad_values)
 
     if function is not None:
         return wrap(function)

@@ -1,0 +1,397 @@
+"""dy2static: AST conversion of data-dependent Python control flow.
+
+The reference rewrites user functions with 19 AST transformers so that
+``if``/``while`` over Tensors become cond/while ops
+(python/paddle/jit/dy2static/transformers/ifelse_transformer.py,
+while statements -> control_flow.while_loop). Here the same move targets
+``lax.cond`` / ``lax.while_loop``: when a capture trace hits a tensor-bool
+conversion (the SOT BreakGraphError case), StaticFunction retries the
+trace with this module's transformed function — a ``.item()``-free
+branchy step then captures WHOLE instead of graph-breaking into segments.
+
+Conversion contract (conservative — any violation falls back to the
+untransformed function and the segment runner):
+
+- ``if``/``while`` whose predicate is a Tensor/jax array at runtime run
+  through ``converted_cond`` / ``converted_while``; Python-bool
+  predicates take the original Python path (zero behavior change).
+- branch/loop bodies must not ``return``/``break``/``continue``/``yield``.
+- both branches must bind the same set of names with matching pytree
+  structure (checked at trace time).
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ast_transform", "converted_cond", "converted_while",
+           "UnsupportedControlFlow"]
+
+
+class UnsupportedControlFlow(Exception):
+    """Raised (at transform or trace time) when the function's control
+    flow cannot be captured; callers fall back to graph-break segments."""
+
+
+def _is_tensor_pred(pred) -> bool:
+    from ..core.tensor import Tensor
+
+    if isinstance(pred, Tensor):
+        return True
+    return isinstance(pred, jax.core.Tracer) or isinstance(pred, jax.Array)
+
+
+def _as_bool_array(pred):
+    from ..core.tensor import Tensor
+
+    if isinstance(pred, Tensor):
+        pred = pred._data
+    return jnp.asarray(pred).astype(bool).reshape(())
+
+
+def _check_match(ta, tb, names):
+    if ta != tb:
+        raise UnsupportedControlFlow(
+            f"cond branches bind different structures for {names}: "
+            f"{ta!r} vs {tb!r}")
+
+
+def converted_cond(pred, true_fn: Callable, false_fn: Callable,
+                   names: tuple, operands: tuple):
+    """``if`` over a tensor predicate -> lax.cond; Python predicate ->
+    direct call. ``true_fn(*operands) -> tuple`` rebinding ``names``."""
+    if not _is_tensor_pred(pred):
+        return true_fn(*operands) if pred else false_fn(*operands)
+    from .capture import _extract_arrays, _rebuild_tensors
+
+    op_arrays: list = []
+    op_template = _extract_arrays(operands, op_arrays)
+    holder = {}
+
+    def wrap(fn, tag):
+        def inner(arrs):
+            outs = fn(*_rebuild_tensors(op_template, arrs))
+            flat: list = []
+            template = _extract_arrays(outs, flat)
+            holder[tag] = template
+            return flat
+
+        return inner
+
+    # structure probe: trace both branches abstractly first so a mismatch
+    # raises UnsupportedControlFlow (-> segment fallback), not an opaque
+    # lax.cond error
+    ta = jax.eval_shape(wrap(true_fn, "t"), op_arrays)
+    tb = jax.eval_shape(wrap(false_fn, "f"), op_arrays)
+    _check_match(holder["t"], holder["f"], names)
+    _check_match(jax.tree.map(lambda x: (x.shape, str(x.dtype)), ta),
+                 jax.tree.map(lambda x: (x.shape, str(x.dtype)), tb), names)
+    out_flat = jax.lax.cond(_as_bool_array(pred), wrap(true_fn, "t"),
+                            wrap(false_fn, "f"), op_arrays)
+    return _rebuild_tensors(holder["t"], out_flat)
+
+
+def converted_while(test_fn: Callable, body_fn: Callable, names: tuple,
+                    operands: tuple):
+    """``while`` with a tensor predicate -> lax.while_loop over the
+    carried ``names``. ``test_fn(*carry) -> pred``; ``body_fn(*carry) ->
+    carry'``. A Python-bool first predicate keeps the Python loop."""
+    first = test_fn(*operands)
+    if not _is_tensor_pred(first):
+        vals = operands
+        cont = first
+        while cont:
+            vals = body_fn(*vals)
+            cont = test_fn(*vals)
+            if _is_tensor_pred(cont):
+                raise UnsupportedControlFlow(
+                    "while predicate became a tensor mid-loop")
+        return vals
+    from .capture import _extract_arrays, _rebuild_tensors
+
+    arrs: list = []
+    template = _extract_arrays(operands, arrs)
+    holder = {"t": template}
+
+    def cond(arrs):
+        return _as_bool_array(test_fn(*_rebuild_tensors(holder["t"], arrs)))
+
+    def body(arrs):
+        outs = body_fn(*_rebuild_tensors(holder["t"], arrs))
+        flat: list = []
+        t2 = _extract_arrays(outs, flat)
+        _check_match(jax.tree.structure(t2), jax.tree.structure(holder["t"]),
+                     names)
+        return flat
+
+    out = jax.lax.while_loop(cond, body, arrs)
+    return _rebuild_tensors(holder["t"], out)
+
+
+class _Forbidden(ast.NodeVisitor):
+    """Reject bodies whose conversion would change semantics."""
+
+    def __init__(self):
+        self.bad = None
+
+    def visit_Return(self, node):
+        self.bad = "return"
+
+    def visit_Break(self, node):
+        self.bad = "break"
+
+    def visit_Continue(self, node):
+        self.bad = "continue"
+
+    def visit_Yield(self, node):
+        self.bad = "yield"
+
+    def visit_YieldFrom(self, node):
+        self.bad = "yield"
+
+    # nested defs own their control flow
+    def visit_FunctionDef(self, node):
+        pass
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _forbidden(stmts) -> str | None:
+    v = _Forbidden()
+    for s in stmts:
+        v.visit(s)
+        if v.bad:
+            return v.bad
+    return None
+
+
+class _Names(ast.NodeVisitor):
+    def __init__(self):
+        self.load: set = set()
+        self.store: set = set()
+
+    def visit_Name(self, node):
+        (self.store if isinstance(node.ctx, (ast.Store, ast.Del))
+         else self.load).add(node.id)
+
+    def visit_FunctionDef(self, node):
+        self.store.add(node.name)
+
+    def visit_Lambda(self, node):
+        for n in ast.walk(node.body):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                self.load.add(n.id)
+
+
+import builtins as _builtins
+
+_BUILTIN_NAMES = set(dir(_builtins))
+
+
+def _names_of(stmts):
+    """(loads, stores) of user-level names: generated __ptu_* helpers are
+    region-local, and builtin names resolve lexically — neither may leak
+    into an enclosing conversion's operand tuple."""
+    v = _Names()
+    for s in stmts:
+        v.visit(s)
+    stores = {n for n in v.store if not n.startswith("__ptu_")}
+    loads = {n for n in v.load if not n.startswith("__ptu_")
+             and (n in stores or n not in _BUILTIN_NAMES)}
+    return loads, stores
+
+
+_COUNTER = [0]
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrite If/While statements into converted_cond/converted_while
+    calls (reference ifelse_transformer.py / loop_transformer.py roles)."""
+
+    def _fresh(self, base):
+        _COUNTER[0] += 1
+        return f"__ptu_{base}_{_COUNTER[0]}"
+
+    @staticmethod
+    def _bind_guards(names):
+        """`try: n \n except NameError: n = __ptu_rt.UNDEF` per name, so
+        store-only branch vars can ride the operand tuple unbound."""
+        out = []
+        for n in names:
+            out.append(ast.Try(
+                body=[ast.Expr(value=ast.Name(id=n, ctx=ast.Load()))],
+                handlers=[ast.ExceptHandler(
+                    type=ast.Name(id="NameError", ctx=ast.Load()),
+                    name=None,
+                    body=[ast.Assign(
+                        targets=[ast.Name(id=n, ctx=ast.Store())],
+                        value=ast.Attribute(
+                            value=ast.Name(id="__ptu_rt", ctx=ast.Load()),
+                            attr="UNDEF", ctx=ast.Load()))])],
+                orelse=[], finalbody=[]))
+        return out
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        bad = _forbidden(node.body + node.orelse)
+        if bad:
+            raise UnsupportedControlFlow(f"'{bad}' inside converted if")
+        load_t, store_t = _names_of(node.body)
+        load_f, store_f = _names_of(node.orelse)
+        stores = sorted(store_t | store_f)
+        loads = sorted((load_t | load_f | set(stores)) - {"__ptu_rt"})
+        tname, fname = self._fresh("true"), self._fresh("false")
+        pname = self._fresh("pred")
+
+        def make_branch(name, body):
+            args = ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=n) for n in loads],
+                kwonlyargs=[], kw_defaults=[], defaults=[])
+            ret = ast.Return(value=ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Load()) for n in stores],
+                ctx=ast.Load()))
+            fd = ast.FunctionDef(
+                name=name, args=args, body=(list(body) or [ast.Pass()])
+                + [ret], decorator_list=[], returns=None)
+            fd.type_params = []          # required by the 3.12+ compiler
+            return fd
+
+        assign_pred = ast.Assign(
+            targets=[ast.Name(id=pname, ctx=ast.Store())], value=node.test)
+        call = ast.Call(
+            func=ast.Attribute(value=ast.Name(id="__ptu_rt",
+                                              ctx=ast.Load()),
+                               attr="converted_cond", ctx=ast.Load()),
+            args=[
+                ast.Name(id=pname, ctx=ast.Load()),
+                ast.Name(id=tname, ctx=ast.Load()),
+                ast.Name(id=fname, ctx=ast.Load()),
+                ast.Tuple(elts=[ast.Constant(value=n) for n in stores],
+                          ctx=ast.Load()),
+                ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                for n in loads], ctx=ast.Load()),
+            ], keywords=[])
+        target = ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Store())
+                                 for n in stores], ctx=ast.Store())
+        assign_out = ast.Assign(targets=[target], value=call) if stores \
+            else ast.Expr(value=call)
+        return (self._bind_guards(loads)
+                + [assign_pred,
+                   make_branch(tname, node.body),
+                   make_branch(fname, node.orelse),
+                   assign_out])
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            raise UnsupportedControlFlow("while/else")
+        bad = _forbidden(node.body)
+        if bad:
+            raise UnsupportedControlFlow(f"'{bad}' inside converted while")
+        load_b, store_b = _names_of(node.body)
+        load_t, _ = _names_of([ast.Expr(value=node.test)])
+        stores = sorted(store_b)
+        carry = sorted((load_b | load_t | set(stores)) - {"__ptu_rt"})
+        tname, bname = self._fresh("test"), self._fresh("body")
+
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in carry],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        test_fn = ast.FunctionDef(
+            name=tname, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[],
+            returns=None)
+        test_fn.type_params = []
+        body_ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in carry],
+            ctx=ast.Load()))
+        body_fn = ast.FunctionDef(
+            name=bname, args=args, body=list(node.body) + [body_ret],
+            decorator_list=[], returns=None)
+        body_fn.type_params = []
+        call = ast.Call(
+            func=ast.Attribute(value=ast.Name(id="__ptu_rt",
+                                              ctx=ast.Load()),
+                               attr="converted_while", ctx=ast.Load()),
+            args=[
+                ast.Name(id=tname, ctx=ast.Load()),
+                ast.Name(id=bname, ctx=ast.Load()),
+                ast.Tuple(elts=[ast.Constant(value=n) for n in carry],
+                          ctx=ast.Load()),
+                ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                for n in carry], ctx=ast.Load()),
+            ], keywords=[])
+        target = ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Store())
+                                 for n in carry], ctx=ast.Store())
+        return (self._bind_guards(carry)
+                + [test_fn, body_fn,
+                   ast.Assign(targets=[target], value=call)])
+
+
+class _Undef:
+    """Placeholder for names not yet bound when a converted if/while
+    starts (the reference's UndefinedVar, dy2static/utils.py): rides the
+    operand tuple as a constant; a branch that leaves it undefined while
+    the other binds an array is a structure mismatch -> segment
+    fallback."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+class _Runtime:
+    converted_cond = staticmethod(converted_cond)
+    converted_while = staticmethod(converted_while)
+    UNDEF = _Undef()
+
+
+def ast_transform(fn: Callable) -> Callable:
+    """Source-rewrite ``fn``: If/While over tensor predicates become
+    converted_cond/converted_while. Raises UnsupportedControlFlow when
+    the function cannot be converted (no source, decorators that confuse
+    re-exec, forbidden statements)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError) as e:
+        raise UnsupportedControlFlow(f"no source for {fn!r}") from e
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        raise UnsupportedControlFlow(str(e)) from e
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise UnsupportedControlFlow("not a plain function")
+    fdef.decorator_list = []          # re-applying decorators would recurse
+    new_tree = _ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(new_tree)
+    code = compile(new_tree, filename=f"<dy2static {fn.__name__}>",
+                   mode="exec")
+    glb = dict(fn.__globals__)
+    glb["__ptu_rt"] = _Runtime
+    # rebind the original closure cells
+    if fn.__closure__:
+        freevars = fn.__code__.co_freevars
+        for name, cell in zip(freevars, fn.__closure__):
+            try:
+                glb.setdefault(name, cell.cell_contents)
+            except ValueError:
+                pass
+    loc: dict = {}
+    exec(code, glb, loc)              # noqa: S102 — dy2static by design
+    out = loc[fdef.name]
+    out = functools.wraps(fn)(out)
+    out.__ptu_dy2static__ = True
+    return out

@@ -281,10 +281,13 @@ def poisson_nll_loss(input, label, log_input: bool = True,
 
 @op("pdist")
 def pdist(x, p: float = 2.0):
+    # gather the upper-triangle pairs FIRST: the full pairwise matrix's
+    # zero diagonal makes norm's vjp NaN there, and 0-cotangent * NaN
+    # poisons every grad (found by tests/test_grad_coverage.py)
     n = x.shape[0]
-    d = jnp.linalg.norm(x[:, None] - x[None, :] + 1e-30, ord=p, axis=-1)
     iu = jnp.triu_indices(n, k=1)
-    return d[iu]
+    diff = x[iu[0]] - x[iu[1]]
+    return jnp.linalg.norm(diff + 1e-30, ord=p, axis=-1)
 
 
 @op("cdist")

@@ -287,6 +287,153 @@ def paged_decode_attention_dma(q, k_pages, v_pages, block_table,
     )(bt_flat, seq_lens.astype(jnp.int32), q, k_pages, v_pages)
 
 
+def paged_decode_mxu_supported(kt_pages_shape, n_q_heads: int,
+                               max_blocks: int | None = None) -> bool:
+    """Gate for the MXU paged kernel: d-major k pages [n_pages, nkv, d, bs]
+    with MXU-tileable flattened pages — bs a lane multiple for k [nkv*d, bs]
+    and d one for v [nkv*bs, d] — plus the same VMEM working-set bound as
+    the vector kernel. GQA native: q may carry G = n_q/nkv heads per kv
+    head (the repeated-KV tensor never exists)."""
+    _, nkv, d, bs = kt_pages_shape
+    page_bytes = nkv * bs * d * 2
+    k_per = _paged_pages_per_program(max_blocks if max_blocks is not None
+                                     else 4, page_bytes)
+    est = 2 * 2 * k_per * page_bytes + 2 * n_q_heads * nkv * d * 2
+    if est > 12 * 2 ** 20:
+        return False
+    return (d in (128, 256) and bs % 128 == 0 and n_q_heads % nkv == 0
+            and n_q_heads >= 8)
+
+
+def _paged_decode_mxu_kernel(bt_ref, sl_ref, q_ref, *refs, bs, n_blocks,
+                             sm_scale, k_per):
+    """MXU-formulated paged decode program (see paged_decode_attention_mxu):
+    per page, scores and weighted values are TWO block-diagonal MXU dots —
+    no VPU cross-lane reductions, no fp32 page-sized cast temps. k pages
+    arrive d-major [nkv, d, bs]; v pages token-major [nkv, bs, d]; q
+    carries all nh = G*nkv query heads."""
+    import jax.experimental.pallas as pl
+
+    k_refs = refs[:k_per]
+    v_refs = refs[k_per:2 * k_per]
+    o_ref = refs[2 * k_per]
+    m_sc, l_sc, acc_sc, qblk_sc = refs[2 * k_per + 1:]
+
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nh, d = q_ref.shape
+    nkv = k_refs[0].shape[0]
+    G = nh // nkv
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc[...], -1e30)
+        l_sc[...] = jnp.zeros_like(l_sc[...])
+        acc_sc[...] = jnp.zeros_like(acc_sc[...])
+        # block-diagonal Q [nh, nkv*d]: row h holds q[h] in the column
+        # block of ITS kv head (h//G) — one MXU dot against the flattened
+        # page then computes every head's scores with no cross-head terms
+        # and no GQA repeat. Built once per sequence (j==0), reused
+        # across its pages.
+        q = q_ref[...]
+        qt = jnp.concatenate([q] * nkv, axis=1)           # [nh, nkv*d]
+        col_kv = jax.lax.broadcasted_iota(jnp.int32, (nh, nkv * d), 1) // d
+        row_kv = jax.lax.broadcasted_iota(jnp.int32, (nh, nkv * d), 0) // G
+        qblk_sc[...] = jnp.where(col_kv == row_kv, qt, 0)
+
+    seq_len = sl_ref[b]
+    q_blk = qblk_sc[...]                                  # [nh, nkv*d]
+    for c in range(k_per):
+        base = (j * k_per + c) * bs
+        k_flat = k_refs[c][...].reshape(nkv * d, bs)      # d-major page
+        s = jax.lax.dot(q_blk, k_flat,
+                        preferred_element_type=jnp.float32) * sm_scale
+        pos = base + jax.lax.iota(jnp.int32, bs)
+        s = s + jnp.where(pos < seq_len, 0.0, -1e30)[None, :]  # [nh, bs]
+        m_prev = m_sc[0, :]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])                   # [nh, bs]
+        alpha = jnp.exp(m_prev - m_new)
+        l_sc[0, :] = l_sc[0, :] * alpha + jnp.sum(p, axis=1)
+        m_sc[0, :] = m_new
+        # block-diagonal P [nh, nkv*bs] against the token-major v page
+        pt = jnp.concatenate([p] * nkv, axis=1)           # [nh, nkv*bs]
+        col_kv = jax.lax.broadcasted_iota(jnp.int32, (nh, nkv * bs), 1) // bs
+        row_kv = jax.lax.broadcasted_iota(jnp.int32, (nh, nkv * bs), 0) // G
+        p_blk = jnp.where(col_kv == row_kv, pt, 0).astype(v_refs[c].dtype)
+        v_flat = v_refs[c][...].reshape(nkv * bs, d)
+        pv = jax.lax.dot(p_blk, v_flat,
+                         preferred_element_type=jnp.float32)
+        acc_sc[...] = acc_sc[...] * alpha[:, None] + pv
+
+    @pl.when(j == n_blocks // k_per - 1)
+    def _fin():
+        o_ref[...] = (acc_sc[...] /
+                      jnp.maximum(l_sc[0, :], 1e-30)[:, None]
+                      ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale",))
+def paged_decode_attention_mxu(q, kt_pages, v_pages, block_table,
+                               seq_lens, sm_scale: float):
+    """Batched paged decode with MXU-formulated per-page math.
+
+    Same contract as paged_decode_attention_kernel EXCEPT k pages are
+    stored d-major: kt_pages [n_pages, nkv, d, bs] (PagedKVCache
+    k_layout='d_major' writes this layout natively), and GQA is native
+    (nkv may divide the q head count; v_pages [n_pages, nkv, bs, d]).
+    Motivation (PERF.md round-3 "Paged decode kernel" negative result):
+    the vector-formulated per-page softmax/update — not fetch latency —
+    bounds the index-map AND manual-DMA variants at ~85-90 GB/s;
+    reformulating the per-page score and weighted-value steps as
+    block-diagonal MXU dots removes the VPU mul-reduce and its fp32 cast
+    temps (reference serving kernel:
+    phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, nh, d = q.shape
+    nkv, bs = kt_pages.shape[1], kt_pages.shape[3]
+    max_blocks = block_table.shape[1]
+    k_per = _paged_pages_per_program(max_blocks)
+    bt_flat = block_table.reshape(-1).astype(jnp.int32)
+
+    def k_spec(c):
+        return pl.BlockSpec(
+            (None, nkv, d, bs),
+            lambda b, j, bt, sl, c=c: (bt[b * max_blocks + j * k_per + c],
+                                       0, 0, 0))
+
+    def v_spec(c):
+        return pl.BlockSpec(
+            (None, nkv, bs, d),
+            lambda b, j, bt, sl, c=c: (bt[b * max_blocks + j * k_per + c],
+                                       0, 0, 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, max_blocks // k_per),
+        in_specs=(
+            [pl.BlockSpec((None, nh, d), lambda b, j, bt, sl: (b, 0, 0))]
+            + [k_spec(c) for c in range(k_per)]
+            + [v_spec(c) for c in range(k_per)]),
+        out_specs=pl.BlockSpec((None, nh, d), lambda b, j, bt, sl: (b, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((8, nh), jnp.float32),
+                        pltpu.VMEM((8, nh), jnp.float32),
+                        pltpu.VMEM((nh, d), jnp.float32),
+                        pltpu.VMEM((nh, nkv * d), q.dtype)],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_decode_mxu_kernel, bs=bs,
+                          n_blocks=max_blocks, sm_scale=sm_scale,
+                          k_per=k_per),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nh, d), q.dtype),
+        interpret=_interpret_mode(),
+    )(bt_flat, seq_lens.astype(jnp.int32), q,
+      *([kt_pages] * k_per), *([v_pages] * k_per))
+
+
 @functools.partial(jax.jit, static_argnames=("sm_scale",))
 def paged_decode_attention_kernel(q, k_pages, v_pages, block_table,
                                   seq_lens, sm_scale: float):

@@ -67,9 +67,10 @@ def pipeline_blocks_fn(stage_fn: Callable, mesh: Mesh, n_microbatches: int,
     # propagation inside the body. The context mesh resolves only under
     # jit; callers outside jit must wrap in `jax.sharding.set_mesh(mesh)`.
     local = None
+    local_f32 = False
 
     def blocks_fn(stacked_params, x):
-        nonlocal local
+        nonlocal local, local_f32
         if n_stages == 1:
             return stage_fn(stacked_params, x)
         B = x.shape[0]
@@ -87,6 +88,23 @@ def pipeline_blocks_fn(stage_fn: Callable, mesh: Mesh, n_microbatches: int,
                 body = functools.partial(_pipeline_local, stage_fn=stage_fn,
                                          n_stages=n_stages, n_micro=M,
                                          pp_axis=pp_axis)
+            # XLA-CPU-only hazard: the shard_map transpose inserts a psum
+            # for the replicated xs cotangent whose reducer carries a
+            # sharding custom-call; CPU's AllReducePromotion pass (bf16
+            # all-reduce -> f32, CPU has no native bf16 reduction) crashes
+            # cloning it. Keep the shard_map BOUNDARY f32 on CPU — compute
+            # inside stages stays in the model dtype — so the transposed
+            # psum is f32 and the promotion pass never runs. TPU programs
+            # (native bf16 all-reduce, no promotion) are untouched.
+            f32_boundary = (jax.default_backend() == "cpu"
+                            and x.dtype == jnp.bfloat16)
+            if f32_boundary:
+                inner = body
+
+                def body(sp, xs_f32):
+                    return inner(sp, xs_f32.astype(jnp.bfloat16)).astype(
+                        jnp.float32)
+
             run = jax.shard_map(
                 body,
                 in_specs=in_specs,
@@ -97,7 +115,12 @@ def pipeline_blocks_fn(stage_fn: Callable, mesh: Mesh, n_microbatches: int,
                 check_vma=False,
             )
             local = jax.jit(run)
-        ys = local(stacked_params, xs)[-1]
+            local_f32 = f32_boundary
+        if local_f32:
+            ys = local(stacked_params, xs.astype(jnp.float32))[-1]
+            ys = ys.astype(x.dtype)
+        else:
+            ys = local(stacked_params, xs)[-1]
         return ys.reshape((B,) + x.shape[1:])
 
     return blocks_fn

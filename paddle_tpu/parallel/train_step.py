@@ -249,6 +249,64 @@ def adamw_update(params, grads, state, lr, wd=0.1, b1=0.9, b2=0.95,
     return new_p, new_state
 
 
+# -- abstract (AOT) state: ShapeDtypeStructs with the same shardings the
+#    materialized path produces, for lowering/compiling configs too large to
+#    instantiate on the analysis host (the 13B north-star memory analysis) --
+
+
+def _abstract_params(cfg: GPTConfig, mesh: Mesh, seed: int) -> dict:
+    shapes = jax.eval_shape(lambda k: init_params(cfg, k),
+                            jax.random.PRNGKey(seed))
+    specs = gpt_param_specs(cfg)
+
+    def put(a, s):
+        ns = NamedSharding(mesh, _sanitize(s, a.shape, mesh))
+        return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=ns)
+
+    return jax.tree.map(put, shapes, specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def _abstract_opt_state(params_abs: dict, mesh: Mesh, *, master: bool,
+                        m_dtype, v_dtype, zero1: bool) -> dict:
+    """adamw_init over abstract params, with moments/masters inheriting the
+    param's TP/PP spec plus the ZeRO-1 dp shard (the sharding the jit's
+    donated arguments are expected in)."""
+    shapes = jax.eval_shape(
+        lambda p: adamw_init(p, master_weights=master, m_dtype=m_dtype,
+                             v_dtype=v_dtype), params_abs)
+    from ..distributed.sharding import shard_spec_over
+
+    flat_p, _ = jax.tree.flatten(params_abs)
+
+    def attach(leaf, p):
+        if leaf.shape == p.shape and isinstance(p.sharding, NamedSharding):
+            spec = p.sharding.spec
+        else:
+            spec = P()  # quantized blocks / size-0 sentinels: replicated
+        if zero1:
+            z = shard_spec_over(leaf.shape, spec, mesh, "dp")
+            spec = z if z is not None else spec
+        return jax.ShapeDtypeStruct(
+            leaf.shape, leaf.dtype, sharding=NamedSharding(mesh, spec))
+
+    out = {"t": jax.ShapeDtypeStruct(
+        (), shapes["t"].dtype, sharding=NamedSharding(mesh, P()))}
+    for key in ("m", "v", "master"):
+        if key not in shapes:
+            continue
+        leaves, tdef = jax.tree.flatten(
+            shapes[key], is_leaf=lambda x: isinstance(x, dict) and "qm" in x)
+        new = []
+        for leaf, p in zip(leaves, flat_p):
+            if isinstance(leaf, dict):
+                new.append({k: attach(v, p) for k, v in leaf.items()})
+            else:
+                new.append(attach(leaf, p))
+        out[key] = jax.tree.unflatten(tdef, new)
+    return out
+
+
 def zero_shard_opt_state(state: dict, mesh: Mesh, axis: str = "dp") -> dict:
     """ZeRO-1: spread AdamW moments (and fp32 masters, when present) over
     the dp axis (reference DygraphShardingOptimizer,
@@ -271,7 +329,7 @@ def make_sharded_train_step(cfg: GPTConfig, mesh: Mesh, lr: float = 1e-4,
                             n_microbatches: int = 1, zero1: bool = True,
                             seed: int = 0, m_dtype: str | None = None,
                             v_dtype: str | None = None,
-                            weights: str = "auto"):
+                            weights: str = "auto", abstract: bool = False):
     """Build (step_fn, params, opt_state): a donated, fully-sharded
     train step. ``step_fn(params, opt_state, tokens, labels) ->
     (loss, params, opt_state)``.
@@ -304,8 +362,6 @@ def make_sharded_train_step(cfg: GPTConfig, mesh: Mesh, lr: float = 1e-4,
         # explode m/(sqrt(v)+eps); refuse rather than silently diverge
         raise ValueError("v_dtype='int8' is unsafe (zeroed second moments "
                          "explode the update); use 'bfloat16'")
-    params = init_params(cfg, jax.random.PRNGKey(seed))
-    params = shard_gpt_params(params, cfg, mesh)
     # Master-weight mode when params would be cast per-use anyway: keep the
     # fp32 master in the optimizer state and the live MATMUL weights in the
     # compute dtype (matmuls consumed them bf16 either way; the update
@@ -316,13 +372,29 @@ def make_sharded_train_step(cfg: GPTConfig, mesh: Mesh, lr: float = 1e-4,
     low_precision = jnp.dtype(cfg.param_dtype) != jnp.dtype(cfg.dtype)
     sr = weights == "sr-bf16" and low_precision
     master = low_precision and not sr
-    opt_state = adamw_init(params, master_weights=master,
-                           m_dtype=m_dtype, v_dtype=v_dtype)
-    if master or sr:
-        params = jax.tree.map(
-            lambda a: a.astype(cfg.dtype) if a.ndim >= 2 else a, params)
-    if zero1:
-        opt_state = zero_shard_opt_state(opt_state, mesh)
+    if abstract:
+        # AOT mode: ShapeDtypeStructs with the exact shardings the real
+        # path would produce — lets configs too large for the analysis host
+        # (13B+) be lowered/compiled for memory + collective analysis.
+        params = _abstract_params(cfg, mesh, seed)
+        if master or sr:
+            params = jax.tree.map(
+                lambda a: (jax.ShapeDtypeStruct(a.shape, cfg.dtype,
+                                                sharding=a.sharding)
+                           if a.ndim >= 2 else a), params)
+        opt_state = _abstract_opt_state(params, mesh, master=master,
+                                        m_dtype=m_dtype, v_dtype=v_dtype,
+                                        zero1=zero1)
+    else:
+        params = init_params(cfg, jax.random.PRNGKey(seed))
+        params = shard_gpt_params(params, cfg, mesh)
+        opt_state = adamw_init(params, master_weights=master,
+                               m_dtype=m_dtype, v_dtype=v_dtype)
+        if master or sr:
+            params = jax.tree.map(
+                lambda a: a.astype(cfg.dtype) if a.ndim >= 2 else a, params)
+        if zero1:
+            opt_state = zero_shard_opt_state(opt_state, mesh)
 
     use_pp = "pp" in mesh.axis_names and mesh.shape["pp"] > 1
     use_sp = "mp" in mesh.axis_names and mesh.shape["mp"] > 1
@@ -385,5 +457,9 @@ def make_sharded_train_step(cfg: GPTConfig, mesh: Mesh, lr: float = 1e-4,
             return jitted(params, opt_state, tokens, labels)
 
     step_fn.put_batch = put_batch
+    # AOT access: step_fn.jitted.lower(params, opt_state, tok_sds, lab_sds)
+    # under `with jax.sharding.set_mesh(mesh)` (abstract=True callers).
+    step_fn.jitted = jitted
+    step_fn.mesh = mesh
 
     return step_fn, params, opt_state

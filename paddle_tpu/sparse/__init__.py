@@ -200,9 +200,6 @@ sin = _unary(jnp.sin)
 tanh = _unary(jnp.tanh)
 
 
-class nn:
-    """paddle.sparse.nn subset (ReLU layer)."""
-
-    class ReLU:
-        def __call__(self, x):
-            return relu(x)
+# paddle.sparse.nn: conv/norm/pooling layers (sparse/nn/); imported last
+# so the subpackage sees this module fully initialized.
+from . import nn  # noqa: E402

@@ -1,0 +1,169 @@
+"""paddle.sparse.nn: sparse NN layers over COO tensors.
+
+Reference layer surface: python/paddle/sparse/nn/layer/conv.py (Conv3D,
+SubmConv3D, Conv2D, SubmConv2D), norm.py (BatchNorm, SyncBatchNorm),
+pooling.py (MaxPool3D), activation.py (ReLU). Compute design notes in
+functional.py (dense MXU conv + sparse COO format)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Parameter, Tensor
+from ...nn.layer.layers import Layer
+from . import functional
+from .functional import (batch_norm_values, conv2d, conv3d, max_pool3d,
+                         subm_conv2d, subm_conv3d)
+
+__all__ = ["Conv3D", "SubmConv3D", "Conv2D", "SubmConv2D", "BatchNorm",
+           "SyncBatchNorm", "MaxPool3D", "ReLU", "functional"]
+
+
+class _ConvNd(Layer):
+    _nd: int
+    _subm: bool
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 padding_mode: str = "zeros", weight_attr=None,
+                 bias_attr=None, data_format: Optional[str] = None,
+                 key=None):
+        super().__init__()
+        assert padding_mode == "zeros", "sparse conv pads zeros"
+        nd = self._nd
+        ks = (kernel_size,) * nd if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        self._kernel_size = ks
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._key = key
+        fan_in = in_channels * int(np.prod(ks)) // groups
+        std = 1.0 / math.sqrt(fan_in)
+        w = np.random.RandomState(0).uniform(
+            -std, std, ks + (in_channels // groups, out_channels))
+        self.weight = self.create_parameter(
+            shape=list(w.shape), default_initializer=None, attr=weight_attr)
+        self.weight.set_value(w.astype(np.float32))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                shape=[out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        fn = {(2, False): conv2d, (2, True): subm_conv2d,
+              (3, False): conv3d, (3, True): subm_conv3d}[
+                  (self._nd, self._subm)]
+        return fn(x, self.weight, self.bias, stride=self._stride,
+                  padding=self._padding, dilation=self._dilation,
+                  groups=self._groups, key=self._key)
+
+
+class Conv3D(_ConvNd):
+    """reference sparse/nn/layer/conv.py:308."""
+    _nd, _subm = 3, False
+
+
+class SubmConv3D(_ConvNd):
+    """reference sparse/nn/layer/conv.py:578 (submanifold: output index
+    set == input index set)."""
+    _nd, _subm = 3, True
+
+
+class Conv2D(_ConvNd):
+    """reference sparse/nn/layer/conv.py:443."""
+    _nd, _subm = 2, False
+
+
+class SubmConv2D(_ConvNd):
+    """reference sparse/nn/layer/conv.py:720."""
+    _nd, _subm = 2, True
+
+
+class BatchNorm(Layer):
+    """Sparse BatchNorm (reference sparse/nn/layer/norm.py:35): BN over
+    the COO values' channel axis, statistics over active sites only."""
+
+    def __init__(self, num_features: int, momentum: float = 0.9,
+                 epsilon: float = 1e-5, weight_attr=None, bias_attr=None,
+                 data_format: str = "NDHWC", use_global_stats=None,
+                 name=None):
+        super().__init__()
+        self._momentum = momentum
+        self._eps = epsilon
+        self._use_global_stats = use_global_stats
+        self.weight = self.create_parameter(shape=[num_features],
+                                            attr=weight_attr)
+        self.weight.set_value(np.ones((num_features,), np.float32))
+        self.bias = self.create_parameter(shape=[num_features],
+                                          attr=bias_attr, is_bias=True)
+        self.register_buffer("_mean",
+                             Tensor(jnp.zeros((num_features,), jnp.float32)))
+        self.register_buffer("_variance",
+                             Tensor(jnp.ones((num_features,), jnp.float32)))
+
+    def forward(self, x):
+        from .. import SparseCooTensor
+
+        bcoo = x._bcoo
+        vals = bcoo.data                       # [nnz, C]
+        use_global = (self._use_global_stats
+                      if self._use_global_stats is not None
+                      else not self.training)
+        if use_global:
+            mean = self._mean._data
+            var = self._variance._data
+        else:
+            v32 = vals.astype(jnp.float32)
+            mean = v32.mean(0)
+            var = v32.var(0)
+            m = self._momentum
+            self._mean._data = m * self._mean._data + (1 - m) * mean
+            self._variance._data = (m * self._variance._data
+                                    + (1 - m) * var)
+        y = batch_norm_values(vals, mean, var,
+                              self.weight._data.astype(jnp.float32),
+                              self.bias._data.astype(jnp.float32),
+                              self._eps)
+        from jax.experimental import sparse as jsparse
+
+        return SparseCooTensor(jsparse.BCOO((y, bcoo.indices),
+                                            shape=bcoo.shape))
+
+
+class SyncBatchNorm(BatchNorm):
+    """reference sparse/nn/layer/norm.py:218 — cross-replica statistics.
+    Single-program eager sparse ops see the full batch already; under
+    pmap-style replication the mean/var reduce would ride lax.p* — sparse
+    eager ops are host-driven, so this is BatchNorm with the reference's
+    name (the DATA-parallel training path shards dense tensors)."""
+
+
+class MaxPool3D(Layer):
+    """reference sparse/nn/layer/pooling.py:33."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format: str = "NDHWC", name=None):
+        super().__init__()
+        self._ks = kernel_size
+        self._stride = stride
+        self._padding = padding
+
+    def forward(self, x):
+        return max_pool3d(x, self._ks, stride=self._stride,
+                          padding=self._padding)
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return functional.relu(x)

@@ -1,0 +1,187 @@
+"""paddle.sparse.nn.functional: sparse conv / pooling / batch_norm.
+
+Reference: python/paddle/sparse/nn/functional/conv.py (conv3d:362,
+subm_conv3d:468), pooling.py (max_pool3d:36) over SparseCooTensor with
+gather-GEMM-scatter CUDA kernels (phi/kernels/sparse/gpu/conv_kernel.cu).
+
+TPU design: unstructured gather/scatter starves the MXU, and XLA needs
+static shapes — so compute runs as a DENSE conv on the MXU over the
+materialized voxel grid (numerically identical: inactive sites are zero,
+exactly the sum the sparse kernel computes), while SPARSITY lives in the
+FORMAT: the output keeps sparse COO storage, its index set derived the
+reference's way (conv3d: sites whose receptive field touches an active
+site, from an occupancy conv; subm_conv3d: the input's index set
+unchanged). Index sets are data-dependent (host-side nonzero), so these
+ops are eager — same as the reference, whose nnz is device-computed but
+shape-dynamic. For MXU-friendly *structured* sparsity see
+paddle_tpu.incubate.asp.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import sparse as jsparse
+
+from ...core.tensor import Tensor
+
+__all__ = ["conv3d", "subm_conv3d", "conv2d", "subm_conv2d", "max_pool3d",
+           "relu", "batch_norm_values"]
+
+
+def _tuple(v, n: int) -> tuple:
+    if isinstance(v, (list, tuple)):
+        assert len(v) == n, (v, n)
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _coo(x):
+    from .. import SparseCooTensor
+
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError(f"expected SparseCooTensor, got {type(x)}")
+    return x
+
+
+def _occupancy(bcoo) -> jnp.ndarray:
+    """Dense 0/1 mask over the SPARSE dims (active sites stay active even
+    when every stored value is zero — deriving occupancy from the dense
+    values would silently drop them)."""
+    idx = bcoo.indices                       # [nnz, n_sparse]
+    shape = bcoo.shape[: idx.shape[1]]
+    ones = jnp.ones((idx.shape[0],), jnp.float32)
+    return jsparse.BCOO((ones, idx), shape=shape).todense()
+
+
+def _sparsify(dense_out, occ_out, dtype):
+    """dense values [N, *S, C] + occupancy [N, *S] -> SparseCooTensor
+    holding only active sites (host-side nonzero: nnz is data-dependent,
+    the eager boundary of sparse ops)."""
+    from .. import SparseCooTensor
+
+    sites = np.stack(np.nonzero(np.asarray(occ_out) > 0))   # [nd, nnz]
+    vals = dense_out[tuple(jnp.asarray(sites))]             # [nnz, C]
+    idx = jnp.asarray(sites.T, jnp.int32)
+    return SparseCooTensor(jsparse.BCOO(
+        (vals.astype(dtype), idx),
+        shape=tuple(dense_out.shape[:-1]) + (dense_out.shape[-1],)))
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, subm,
+             nd: int):
+    x = _coo(x)
+    bcoo = x._bcoo
+    w = jnp.asarray(weight._data if isinstance(weight, Tensor) else weight)
+    ks = w.shape[:nd]
+    stride = _tuple(stride, nd)
+    dilation = _tuple(dilation, nd)
+    if subm:
+        assert stride == (1,) * nd, "subm conv requires stride 1"
+        # reference subm: pad so output sites == input sites
+        padding = tuple((d * (k - 1)) // 2 for k, d in zip(ks, dilation))
+    else:
+        padding = _tuple(padding, nd)
+    pads = [(p, p) for p in padding]
+    dense = bcoo.todense()                  # [N, *S, Cin]
+    spec = "DHW"[3 - nd:]
+    dn = lax.conv_dimension_numbers(
+        dense.shape, w.shape,
+        (f"N{spec}C", f"{spec}IO", f"N{spec}C"))
+    out = lax.conv_general_dilated(
+        dense.astype(jnp.float32), w.astype(jnp.float32), stride, pads,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        b = jnp.asarray(bias._data if isinstance(bias, Tensor) else bias)
+        out = out + b.astype(jnp.float32)
+    if subm:
+        idx = bcoo.indices                  # unchanged site set
+        vals = out[tuple(idx.T)]
+        return type(x)(jsparse.BCOO((vals.astype(bcoo.dtype), idx),
+                                    shape=tuple(out.shape[:-1])
+                                    + (out.shape[-1],)))
+    occ = _occupancy(bcoo)[..., None]       # [N, *S, 1]
+    kern = jnp.ones(ks + (1, 1), jnp.float32)
+    occ_out = lax.conv_general_dilated(
+        occ, kern, stride, pads, rhs_dilation=dilation,
+        dimension_numbers=dn)[..., 0]
+    return _sparsify(out, occ_out, bcoo.dtype)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format: str = "NDHWC", key=None):
+    """Sparse 3-D conv (reference sparse/nn/functional/conv.py:362):
+    x COO [N, D, H, W, Cin], weight [kd, kh, kw, Cin/groups, Cout]."""
+    assert data_format == "NDHWC", "sparse conv3d is NDHWC (channels-last)"
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    subm=False, nd=3)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format: str = "NDHWC", key=None):
+    """Submanifold sparse conv (reference conv.py:468): the output index
+    set IS the input index set — no dilation of the active region."""
+    assert data_format == "NDHWC"
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    subm=True, nd=3)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format: str = "NHWC", key=None):
+    assert data_format == "NHWC"
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    subm=False, nd=2)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format: str = "NHWC", key=None):
+    assert data_format == "NHWC"
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    subm=True, nd=2)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format: str = "NDHWC"):
+    """Sparse max pool (reference pooling.py:36): max over the ACTIVE
+    sites of each window; output sites = windows containing >= 1 active
+    site."""
+    assert data_format == "NDHWC"
+    x = _coo(x)
+    bcoo = x._bcoo
+    ks = _tuple(kernel_size, 3)
+    stride = _tuple(stride if stride is not None else kernel_size, 3)
+    padding = _tuple(padding, 3)
+    dense = bcoo.todense().astype(jnp.float32)      # [N, D, H, W, C]
+    occ = _occupancy(bcoo)                          # [N, D, H, W]
+    neg = jnp.finfo(jnp.float32).min
+    masked = jnp.where(occ[..., None] > 0, dense, neg)
+    window = (1,) + ks + (1,)
+    strides = (1,) + stride + (1,)
+    pads = ((0, 0),) + tuple((p, p) for p in padding) + ((0, 0),)
+    out = lax.reduce_window(masked, neg, lax.max, window, strides, pads)
+    occ_out = lax.reduce_window(occ, 0.0, lax.max, (1,) + ks,
+                                (1,) + stride,
+                                ((0, 0),) + tuple((p, p) for p in padding))
+    return _sparsify(out, occ_out, bcoo.dtype)
+
+
+def relu(x):
+    from .. import relu as _relu
+
+    return _relu(x)
+
+
+def batch_norm_values(values, mean, var, gamma, beta, eps: float):
+    """Normalize COO values [nnz, C] (the reference's sparse_batch_norm
+    computes statistics over the nnz axis — exactly BatchNorm1D on
+    values, phi/kernels/sparse/batch_norm_kernel.cc)."""
+    v32 = values.astype(jnp.float32)
+    y = (v32 - mean) * lax.rsqrt(var + eps)
+    return (y * gamma + beta).astype(values.dtype)

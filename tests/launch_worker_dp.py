@@ -45,14 +45,32 @@ assert len(jax.devices()) == nproc, jax.devices()
 mesh_shape = tuple(int(x) for x in
                    os.environ.get("PT_TEST_MESH", f"{nproc},1,1").split(","))
 n_micro = int(os.environ.get("PT_TEST_MICRO", "1"))
+# Axis variants (VERDICT r3 item 4 — the axes the reference's collective
+# fleet suite covers in multi-process form):
+#   PT_TEST_MOE=E    expert-parallel MoE layer, E experts over dp ("ep")
+#   PT_TEST_RING=mp  ring attention over the mp axis (SEP/context para.)
+#   PT_TEST_ZERO=3   param+moment sharding over dp (GroupSharded stage 3)
+n_experts = int(os.environ.get("PT_TEST_MOE", "0"))
+ring = os.environ.get("PT_TEST_RING") or None
+zero_stage = int(os.environ.get("PT_TEST_ZERO", "0"))
 assert mesh_shape[0] * mesh_shape[1] * mesh_shape[2] == nproc, mesh_shape
 
 cfg = GPTConfig(vocab_size=128, hidden=64, n_layers=2, n_heads=4, seq_len=16,
-                dtype=jnp.float32, use_flash=False, remat=False)
+                dtype=jnp.float32, use_flash=False, remat=False,
+                n_experts=n_experts, n_moe_layers=1 if n_experts else 0,
+                ring_axis=ring)
 mesh = build_mesh(mesh_shape, ("dp", "pp", "mp"))
 step, params, opt_state = make_sharded_train_step(cfg, mesh, lr=1e-2,
                                                   n_microbatches=n_micro,
-                                                  zero1=False)
+                                                  zero1=zero_stage >= 1)
+if zero_stage >= 3:
+    # GroupSharded stage 3 (reference group_sharded_stage3.py:85): the
+    # PARAMETERS shard over dp too; XLA all-gathers at use sites and
+    # reduce-scatters grads (sharding.py design notes)
+    from paddle_tpu.distributed.sharding import shard_array_over
+
+    params = jax.tree.map(
+        lambda a: shard_array_over(a, mesh, "dp") if a.ndim else a, params)
 
 GLOBAL_BATCH = 8
 rng = np.random.RandomState(0)  # same seed everywhere: global batch

@@ -1,0 +1,62 @@
+"""Worker for the multi-process INTERLEAVED pipeline (VPP) test.
+
+pp=2 across TWO processes, 2 virtual stages per rank (reference:
+test/collective/fleet hybrid_parallel_pp_interleave run under
+launch): each process owns model-order layers {rank, rank+2} — the
+interleave placement — and train_batch streams 2 microbatches through
+the 1F1B-with-virtual-stages schedule. Prints FINAL_LOSS for the test
+to compare with a numpy serial reference.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.distributed.launch import init_from_env
+
+assert init_from_env(), "launcher env not detected"
+
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    LayerDesc, PipelineLayer, PipelineParallelWithInterleave)
+from paddle_tpu.optimizer import SGD
+
+strat = fleet.DistributedStrategy()
+strat.hybrid_configs = {"dp_degree": 1, "pp_degree": 2}
+strat.pipeline_configs = {"accumulate_steps": 2, "micro_batch_size": 4}
+fleet.init(strategy=strat)
+
+rng = np.random.RandomState(0)
+Ws = [rng.randn(8, 8).astype(np.float32) * 0.4 for _ in range(4)]
+X = rng.randn(8, 8).astype(np.float32)
+Y = rng.randint(0, 8, size=(8,))
+
+
+def loss_fn(pred, label):
+    return nn.functional.cross_entropy(pred, label)
+
+
+descs = [LayerDesc(nn.Linear, 8, 8, bias_attr=False) for _ in range(4)]
+pipe = PipelineLayer(descs, loss_fn=loss_fn,
+                     num_virtual_pipeline_stages=2)
+for i, w in enumerate(Ws):
+    pipe._built_by_index[i].weight.set_value(pt.to_tensor(w))
+model = PipelineParallelWithInterleave(
+    pipe, fleet.get_hybrid_communicate_group(), strat)
+opt = SGD(learning_rate=0.05, parameters=pipe.parameters())
+vpp_loss = float(model.train_batch(
+    (pt.to_tensor(X), pt.to_tensor(Y)), opt).numpy())
+print(f"FINAL_LOSS {vpp_loss:.8f}", flush=True)

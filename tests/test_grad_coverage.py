@@ -1,0 +1,659 @@
+"""Machine-checkable GRADIENT coverage over the op registry.
+
+VERDICT r3 item 8: the FD-grad harness (op_test_base.check_grad,
+reference test/legacy_test/op_test.py:3114) existed but was applied to a
+sampled subset. This file makes gradient coverage an INVENTORY like
+tests/test_op_coverage.py: every op registered differentiable=True must
+be accounted for by exactly one of
+
+1. SPECS — an executable finite-difference gradient check (run below,
+   chunked);
+2. NONDIFF_NATURE — differentiable-flagged ops whose outputs are
+   discrete/boolean/bit-level, where an FD check is meaningless;
+3. ALLOWLIST — consciously skipped with a justification, budget < 60.
+
+Input generators choose kink-free neighborhoods (|x| in [0.15, 0.45]
+for piecewise ops, SPD matrices for factorizations) so central
+differences see the smooth branch — the reference's OpTest does the same
+with its per-op user_defined_grads escapes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional  # noqa: F401 — fills registry
+import paddle_tpu.ops.parity  # noqa: F401
+from paddle_tpu.core.dispatch import OP_REGISTRY, op_call
+
+from op_test_base import check_grad
+
+_R = np.random.RandomState
+
+
+def C(name):
+    """Call a registry op with Tensor args through the dispatch funnel."""
+
+    def f(*a, **k):
+        return op_call(OP_REGISTRY[name], a, k)
+
+    f.__name__ = name
+    return f
+
+
+# -- input generators -------------------------------------------------------
+
+def U(*s, seed=0, lo=-0.8, hi=0.8):
+    return (_R(seed).uniform(lo, hi, s)).astype(np.float32)
+
+
+def P(*s, seed=0, lo=0.5, hi=1.5):
+    return (_R(seed).uniform(lo, hi, s)).astype(np.float32)
+
+
+def S(*s, seed=0):
+    """Kink-safe: |x| in [0.15, 0.45], random sign — central differences
+    at eps=1e-3 never straddle 0, +-0.5 or integers."""
+    r = _R(seed)
+    return (r.uniform(0.15, 0.45, s)
+            * np.where(r.rand(*s) < 0.5, -1, 1)).astype(np.float32)
+
+
+def UNIT(*s, seed=0):
+    return (_R(seed).uniform(-0.7, 0.7, s)).astype(np.float32)
+
+
+def GT1(*s, seed=0):
+    return (_R(seed).uniform(1.2, 1.9, s)).astype(np.float32)
+
+
+def PROB(*s, seed=0):
+    return (_R(seed).uniform(0.15, 0.85, s)).astype(np.float32)
+
+
+def DISTINCT(*s, seed=0):
+    """All-distinct values, generic spacing (safe for max/sort/median)."""
+    n = int(np.prod(s))
+    vals = np.linspace(-1.0, 1.0, n) + _R(seed).uniform(-.2, .2, n) / n
+    return _R(seed + 1).permutation(vals).reshape(s).astype(np.float32)
+
+
+def SPD(n, seed=0):
+    a = _R(seed).randn(n, n).astype(np.float32) * 0.3
+    return a @ a.T + np.eye(n, dtype=np.float32)
+
+
+def CHOL(n, seed=0):
+    return np.linalg.cholesky(SPD(n, seed)).astype(np.float32)
+
+
+def IDX(*s, n, seed=0):
+    return _R(seed).randint(0, n, s).astype(np.int64)
+
+
+_t = paddle.to_tensor
+
+
+# -- spec table -------------------------------------------------------------
+# name -> (fn, inputs) | (fn, inputs, opts). fn closes over non-FD args
+# (integer indices, configs); opts: dict(atol=, rtol=, idx=[...]).
+
+SPECS: dict = {}
+
+
+def spec(name, fn, inputs, **opts):
+    SPECS[name] = (fn, inputs, opts)
+
+
+def unary(names, gen, **kw):
+    for n in names.split():
+        spec(n, C(n), [gen(2, 3, seed=abs(hash(n)) % 1000)], **kw)
+
+
+# smooth-anywhere unaries
+unary("sin cos tanh sinh cosh asinh atan erf exp expm1 neg silu sigmoid "
+      "log_sigmoid softsign gelu mish swish stanh nn_sigmoid nn_tanh "
+      "square deg2rad rad2deg sinc tanhshrink softplus i0 i0e i1 i1e "
+      "hardswish hardsigmoid _clone conj real increment nan_to_num "
+      "scale ravel fliplr flipud identity_loss l1_norm squared_l2_norm", U)
+spec("angle", C("angle"), [P(2, 3)])          # real input: branch at 0
+spec("imag", C("imag"), [U(2, 3)])
+spec("square_error_cost", C("square_error_cost"), [U(2, 3), U(2, 3, seed=9)])
+# kinked / piecewise unaries on the safe generator
+unary("abs relu relu6 leaky_relu hardtanh hardshrink softshrink "
+      "thresholded_relu sign sgn round floor ceil trunc fix frac elu celu "
+      "selu", S)
+# domain-restricted
+unary("log log2 log10 log1p sqrt rsqrt reciprocal", P)
+unary("digamma lgamma gammaln", GT1)
+unary("erfinv atanh asin acos", UNIT)
+unary("logit", PROB)
+spec("acosh", C("acosh"), [GT1(2, 3)])
+spec("tan", C("tan"), [UNIT(2, 3)])
+spec("polygamma", lambda x: C("polygamma")(x, 1), [GT1(2, 3)])
+spec("multigammaln", lambda x: C("multigammaln")(x, 2), [GT1(2, 3)])
+
+# binaries
+for n in ("add subtract multiply maximum minimum fmax fmin atan2 hypot "
+          "logaddexp").split():
+    spec(n, C(n), [U(2, 3, seed=1), U(2, 3, seed=2)])
+spec("divide", C("divide"), [U(2, 3), P(2, 3)])
+spec("copysign", C("copysign"), [S(2, 3), S(2, 3, seed=5)], idx=[0])
+spec("fmod", C("fmod"), [S(2, 3), P(2, 3, lo=1.0, hi=2.0)], idx=[0])
+spec("pow", C("pow"), [P(2, 3), P(2, 3, seed=7)])
+spec("ldexp", lambda x: C("ldexp")(x, _t(np.array([1, 2, 0], np.int32))),
+     [U(2, 3)])
+spec("lerp", C("lerp"), [U(2, 3), U(2, 3, seed=3), PROB(2, 3)])
+spec("gammainc", C("gammainc"), [GT1(2, 3), P(2, 3)], idx=[1])
+spec("gammaincc", C("gammaincc"), [GT1(2, 3), P(2, 3)], idx=[1])
+spec("heaviside", C("heaviside"), [S(2, 3), U(2, 3)], idx=[0])
+
+# matmul family
+spec("matmul", C("matmul"), [U(3, 4), U(4, 2, seed=1)])
+spec("bmm", C("bmm"), [U(2, 3, 4), U(2, 4, 2, seed=1)])
+spec("mv", C("mv"), [U(3, 4), U(4, seed=1)])
+spec("dot", C("dot"), [U(4), U(4, seed=1)])
+spec("inner", C("inner"), [U(3, 4), U(2, 4, seed=1)])
+spec("outer", C("outer"), [U(3), U(4, seed=1)])
+spec("vdot", C("vdot"), [U(4), U(4, seed=1)])
+spec("kron", C("kron"), [U(2, 2), U(2, 3, seed=1)])
+spec("cross", C("cross"), [U(2, 3), U(2, 3, seed=1)])
+spec("tensordot", lambda a, b: C("tensordot")(a, b, axes=1),
+     [U(3, 4), U(4, 2, seed=1)])
+spec("einsum", lambda a, b: C("einsum")("ij,jk->ik", a, b),
+     [U(3, 4), U(4, 2, seed=1)])
+spec("multi_dot", lambda a, b: C("multi_dot")([a, b]),
+     [U(3, 4), U(4, 2, seed=1)])
+spec("addmm", C("addmm"), [U(3, 2), U(3, 4, seed=1), U(4, 2, seed=2)])
+spec("linear", C("linear"), [U(3, 4), U(4, 2, seed=1), U(2, seed=2)])
+spec("fc", C("fc"), [U(3, 4), U(4, 2, seed=1)])
+spec("bilinear", C("bilinear"), [U(3, 4), U(3, 5, seed=1),
+                                 U(2, 4, 5, seed=2)])
+spec("mse_loss", C("mse_loss"), [U(2, 3), U(2, 3, seed=1)])
+
+# reductions
+for n in "sum mean logsumexp nanmean nansum logcumsumexp cumsum".split():
+    spec(n, C(n), [U(2, 3)])
+spec("max", C("max"), [DISTINCT(2, 3)])
+spec("min", C("min"), [DISTINCT(2, 3)])
+spec("median", C("median"), [DISTINCT(3, 5)])
+spec("nanmedian", C("nanmedian"), [DISTINCT(3, 5)])
+spec("prod", C("prod"), [P(2, 3)])
+spec("cumprod", lambda x: C("cumprod")(x, dim=1), [P(2, 3)])
+spec("std", C("std"), [U(2, 3)])
+spec("var", C("var"), [U(2, 3)])
+spec("norm", C("norm"), [U(2, 3)])
+spec("p_norm", C("p_norm"), [U(2, 3)])
+spec("vector_norm", C("vector_norm"), [U(2, 3)])
+spec("matrix_norm", C("matrix_norm"), [U(3, 3)])
+spec("quantile", lambda x: C("quantile")(x, 0.3), [DISTINCT(3, 5)])
+spec("nanquantile", lambda x: C("nanquantile")(x, 0.3), [DISTINCT(3, 5)])
+spec("kthvalue", lambda x: C("kthvalue")(x, 2), [DISTINCT(2, 5)])
+spec("trace", C("trace"), [U(3, 3)])
+spec("dist", C("dist"), [U(2, 3), U(2, 3, seed=1)])
+spec("cdist", C("cdist"), [U(3, 4), U(2, 4, seed=1)])
+spec("pdist", C("pdist"), [U(4, 3)])
+spec("cov", C("cov"), [U(3, 5)])
+spec("corrcoef", C("corrcoef"), [U(3, 5)])
+spec("trapezoid", C("trapezoid"), [U(2, 5)])
+spec("cumulative_trapezoid", C("cumulative_trapezoid"), [U(2, 5)])
+spec("diff", C("diff"), [U(2, 5)])
+spec("log_loss", C("log_loss"), [PROB(3, 1), PROB(3, 1, seed=1)], idx=[0])
+spec("renorm", lambda x: C("renorm")(x, 2.0, 0, 0.3), [U(3, 4)])
+spec("clip_by_norm", lambda x: C("clip_by_norm")(x, 0.3), [U(3, 4)])
+spec("normalize", C("normalize"), [U(3, 4)])
+spec("cosine_similarity", C("cosine_similarity"),
+     [U(3, 4), U(3, 4, seed=1)])
+spec("clip", lambda x: C("clip")(x, -0.5, 0.5), [S(2, 3)])
+
+# shape / movement (identity-like grads; cheap sanity that the vjp wiring
+# through the dispatch funnel is right for each)
+spec("reshape", lambda x: C("reshape")(x, [3, 2]), [U(2, 3)])
+spec("transpose", lambda x: C("transpose")(x, [1, 0]), [U(2, 3)])
+spec("t", C("t"), [U(2, 3)])
+spec("swapaxes", lambda x: C("swapaxes")(x, 0, 1), [U(2, 3)])
+spec("moveaxis", lambda x: C("moveaxis")(x, 0, 1), [U(2, 3)])
+spec("squeeze", C("squeeze"), [U(2, 1, 3)])
+spec("unsqueeze", lambda x: C("unsqueeze")(x, 1), [U(2, 3)])
+spec("flatten", C("flatten"), [U(2, 3)])
+spec("unflatten", lambda x: C("unflatten")(x, 1, [3, 1]), [U(2, 3)])
+spec("broadcast_to", lambda x: C("broadcast_to")(x, [2, 2, 3]), [U(2, 3)])
+spec("expand", lambda x: C("expand")(x, [2, 2, 3]), [U(2, 3)])
+spec("expand_as", lambda x: C("expand_as")(x, _t(U(2, 2, 3))), [U(2, 3)])
+spec("tile", lambda x: C("tile")(x, [2, 1]), [U(2, 3)])
+spec("roll", lambda x: C("roll")(x, 1, 0), [U(2, 3)])
+spec("flip", lambda x: C("flip")(x, 0), [U(2, 3)])
+spec("reverse", lambda x: C("reverse")(x, [0]), [U(2, 3)])
+spec("rot90", C("rot90"), [U(2, 3)])
+spec("concat", lambda a, b: C("concat")([a, b], 0),
+     [U(2, 3), U(1, 3, seed=1)])
+spec("stack", lambda a, b: C("stack")([a, b], 0),
+     [U(2, 3), U(2, 3, seed=1)])
+for n in "hstack vstack dstack row_stack column_stack".split():
+    spec(n, lambda a, b, n=n: C(n)([a, b]), [U(2, 3), U(2, 3, seed=1)])
+spec("block_diag", lambda a, b: C("block_diag")(a, b),
+     [U(2, 2), U(3, 3, seed=1)])
+spec("cartesian_prod", lambda a, b: C("cartesian_prod")([a, b]),
+     [U(3), U(2, seed=1)])
+spec("combinations", C("combinations"), [U(4)])
+for n in "chunk hsplit vsplit dsplit tensor_split".split():
+    shape = (4, 2, 2) if n in ("dsplit",) else (4, 4)
+    spec(n, lambda x, n=n: C(n)(x, 2), [U(*shape)])
+spec("tensor_split", lambda x: C("tensor_split")(x, 2, 0), [U(4, 4)])
+spec("split", lambda x: C("split")(x, 2, 0), [U(4, 3)])
+spec("unbind", C("unbind"), [U(3, 2)])
+spec("slice", lambda x: C("slice")(x, [0], [1], [3]), [U(4, 3)])
+spec("strided_slice", lambda x: C("strided_slice")(x, [0], [0], [4], [2]),
+     [U(4, 3)])
+spec("slice_scatter", lambda x, v: C("slice_scatter")(x, v, [0], [1], [3],
+                                                      [1]),
+     [U(4, 3), U(2, 3, seed=1)])
+spec("select_scatter", lambda x, v: C("select_scatter")(x, v, 0, 1),
+     [U(4, 3), U(3, seed=1)])
+spec("diagonal", C("diagonal"), [U(3, 3)])
+spec("diag_embed", C("diag_embed"), [U(2, 3)])
+spec("diagonal_scatter", C("diagonal_scatter"), [U(3, 3), U(3, seed=1)])
+spec("fill_diagonal", lambda x: C("fill_diagonal")(x, 0.0), [U(3, 3)])
+spec("fill_diagonal_tensor", C("fill_diagonal_tensor"),
+     [U(3, 4), U(3, seed=1)])
+spec("crop", lambda x: C("crop")(x, [2, 2], [1, 0]), [U(4, 3)])
+spec("pad", lambda x: C("pad")(x, [1, 1], mode="constant",
+                               data_format="NCL"), [U(2, 3, 4)])
+spec("_tril", C("_tril"), [U(3, 3)])
+spec("_triu", C("_triu"), [U(3, 3)])
+spec("cast", lambda x: C("cast")(x, "float32"), [U(2, 3)])
+spec("where", lambda x, y: C("where")(
+    _t(np.array([[True, False, True], [False, True, False]])), x, y),
+    [U(2, 3), U(2, 3, seed=1)])
+spec("as_strided", lambda x: C("as_strided")(x, [2, 2], [3, 1]), [U(2, 3)])
+spec("tensor_unfold", lambda x: C("tensor_unfold")(x, 1, 2, 1), [U(2, 4)])
+spec("getitem", lambda x: C("getitem")(x, (slice(0, 2), 1)), [U(3, 3)])
+spec("setitem", lambda x, v: C("setitem")(x, (slice(0, 2),), v),
+     [U(3, 3), U(2, 3, seed=1)])
+spec("vander", lambda x: C("vander")(x, 3), [DISTINCT(4)])
+
+# gather/scatter/indexing
+_ix = _t(np.array([2, 0, 1], np.int64))
+spec("gather", lambda x: C("gather")(x, _ix, 0), [U(3, 4)])
+spec("gather_nd", lambda x: C("gather_nd")(
+    x, _t(np.array([[0, 1], [2, 0]], np.int64))), [U(3, 4)])
+spec("index_select", lambda x: C("index_select")(x, _ix, 0), [U(3, 4)])
+spec("index_sample", lambda x: C("index_sample")(
+    x, _t(np.array([[0, 2], [1, 0]], np.int64))), [U(2, 4)])
+spec("index_add", lambda x, v: C("index_add")(x, _ix, 0, v),
+     [U(3, 4), U(3, 4, seed=1)])
+spec("index_fill", lambda x: C("index_fill")(x, _t(np.array([1], np.int64)),
+                                             0, 0.5), [U(3, 4)])
+spec("index_put", lambda x, v: C("index_put")(
+    x, (_t(np.array([0, 2], np.int64)),), v), [U(3, 4), U(2, 4, seed=1)])
+spec("take", lambda x: C("take")(x, _t(np.array([1, 5], np.int64))),
+     [U(2, 4)])
+spec("take_along_axis", lambda x: C("take_along_axis")(
+    x, _t(np.array([[0], [1]], np.int64)), 1), [U(2, 4)])
+spec("put_along_axis", lambda x, v: C("put_along_axis")(
+    x, _t(np.array([[0], [1]], np.int64)), v, 1),
+    [U(2, 4), U(2, 1, seed=1)])
+spec("scatter", lambda x, u: C("scatter")(x, _t(np.array([1, 0], np.int64)),
+                                          u), [U(3, 4), U(2, 4, seed=1)])
+spec("scatter_nd", lambda u: C("scatter_nd")(
+    _t(np.array([[1], [3]], np.int64)), u, [5, 2]), [U(2, 2)])
+spec("scatter_nd_add", lambda x, u: C("scatter_nd_add")(
+    x, _t(np.array([[1], [3]], np.int64)), u), [U(5, 2), U(2, 2, seed=1)])
+spec("masked_fill", lambda x: C("masked_fill")(
+    x, _t(np.array([[True, False, True], [False, True, False]])), 0.5),
+    [U(2, 3)])
+spec("masked_scatter", lambda x, v: C("masked_scatter")(
+    x, _t(np.array([[True, False, True], [False, True, False]])), v),
+    [U(2, 3), U(3, seed=1)])
+spec("repeat_interleave", lambda x: C("repeat_interleave")(x, 2, 0),
+     [U(2, 3)])
+spec("embedding", lambda w: C("embedding")(
+    _t(np.array([[0, 2], [1, 1]], np.int64)), w), [U(4, 3)])
+spec("reduce_as", lambda x: C("reduce_as")(x, _t(U(3, seed=9))), [U(2, 3)])
+
+# losses
+spec("l1_loss", C("l1_loss"), [S(2, 3), S(2, 3, seed=99)])
+spec("huber_loss", C("huber_loss"), [U(2, 3), U(2, 3, seed=1) + 3.0])
+spec("smooth_l1_loss", C("smooth_l1_loss"), [U(2, 3), U(2, 3, seed=1) + 3])
+spec("binary_cross_entropy", C("binary_cross_entropy"),
+     [PROB(2, 3), PROB(2, 3, seed=1)], idx=[0])
+spec("binary_cross_entropy_with_logits",
+     C("binary_cross_entropy_with_logits"), [U(2, 3), PROB(2, 3, seed=1)],
+     idx=[0])
+_lab4 = _t(np.array([1, 3], np.int64))
+spec("cross_entropy", lambda x: C("cross_entropy")(x, _lab4), [U(2, 4)])
+spec("nll_loss", lambda x: C("nll_loss")(x, _lab4), [U(2, 4)])
+spec("kl_div", C("kl_div"), [U(2, 3), PROB(2, 3, seed=1)], idx=[0])
+spec("label_smooth", C("label_smooth"), [PROB(2, 4)])
+spec("margin_ranking_loss", lambda a, b: C("margin_ranking_loss")(
+    a, b, _t(np.array([[1.], [-1.]], np.float32))),
+    [U(2, 1), U(2, 1, seed=1)])
+spec("hinge_embedding_loss", lambda x: C("hinge_embedding_loss")(
+    x, _t(np.array([[1., -1., 1.], [-1., 1., -1.]], np.float32))),
+    [P(2, 3)])
+spec("cosine_embedding_loss", lambda a, b: C("cosine_embedding_loss")(
+    a, b, _t(np.array([1, -1], np.int64))), [U(2, 4), U(2, 4, seed=1)])
+spec("triplet_margin_loss", C("triplet_margin_loss"),
+     [U(2, 4), U(2, 4, seed=1), U(2, 4, seed=2)])
+spec("multi_label_soft_margin_loss",
+     lambda x: C("multi_label_soft_margin_loss")(
+         x, _t(np.array([[1., 0., 1.], [0., 1., 0.]], np.float32))),
+     [U(2, 3)])
+spec("multi_margin_loss", lambda x: C("multi_margin_loss")(
+    x, _t(np.array([1, 2], np.int64)), None, p=1, margin=1.0,
+    reduction="mean"), [U(2, 4)])
+spec("soft_margin_loss", lambda x: C("soft_margin_loss")(
+    x, _t(np.array([[1., -1., 1.], [-1., 1., -1.]], np.float32))),
+    [U(2, 3)])
+spec("sigmoid_focal_loss", lambda x: C("sigmoid_focal_loss")(
+    x, _t(np.array([[1., 0., 1.], [0., 1., 0.]], np.float32))),
+    [U(2, 3)])
+spec("gaussian_nll_loss", C("gaussian_nll_loss"),
+     [U(2, 3), U(2, 3, seed=1), P(2, 3)])
+spec("poisson_nll_loss", C("poisson_nll_loss"), [U(2, 3), P(2, 3, seed=1)],
+     idx=[0])
+spec("dice_loss", lambda x: C("dice_loss")(
+    x, _t(np.array([[0], [1], [1]], np.int64))), [PROB(3, 2)])
+spec("npair_loss", lambda a, p: C("npair_loss")(
+    a, p, _t(np.array([0, 1], np.int64))), [U(2, 3), U(2, 3, seed=1)])
+spec("hsigmoid_loss", lambda x, w: C("hsigmoid_loss")(
+    x, _t(np.array([1, 2], np.int64)), 4, w), [U(2, 3), U(3, 3, seed=1)])
+spec("margin_cross_entropy", lambda x: C("margin_cross_entropy")(
+    x, _t(np.array([1, 3], np.int64))), [U(2, 4)], atol=5e-2, rtol=5e-2)
+spec("ctc_loss", lambda lp: C("ctc_loss")(
+    lp, _t(np.array([[1, 2]], np.int64)),
+    _t(np.array([4], np.int64)), _t(np.array([2], np.int64))),
+    [U(4, 1, 3)], atol=5e-2, rtol=5e-2)
+spec("rnnt_loss", lambda lg: C("rnnt_loss")(
+    lg, _t(np.array([[1, 1]], np.int32)), _t(np.array([3], np.int32)),
+    _t(np.array([2], np.int32))), [U(1, 3, 3, 2)], atol=5e-2, rtol=5e-2)
+
+# softmax family / activations with args
+spec("softmax", C("softmax"), [U(2, 4)])
+spec("log_softmax", C("log_softmax"), [U(2, 4)])
+spec("glu", C("glu"), [U(2, 4)])
+spec("maxout", lambda x: C("maxout")(x, 2), [DISTINCT(1, 4, 2, 2)])
+spec("prelu", C("prelu"), [S(1, 2, 3), P(2)])
+spec("swiglu", C("swiglu"), [U(2, 4)])
+spec("dropout_impl", lambda x: C("dropout_impl")(
+    x, paddle.to_tensor(np.zeros(2, np.uint32)), 0.0, True), [U(2, 3)])
+
+# norms
+spec("layer_norm", C("layer_norm"), [U(2, 4)])
+spec("rms_norm", C("rms_norm"), [U(2, 4)])
+spec("group_norm", lambda x, w, b: C("group_norm")(x, 2, weight=w, bias=b),
+     [U(2, 4, 3, 3), P(4), U(4, seed=2)])
+spec("instance_norm", C("instance_norm"), [U(2, 3, 4, 4)])
+spec("local_response_norm", lambda x: C("local_response_norm")(x, 3),
+     [U(1, 4, 3, 3)])
+spec("batch_norm_train", lambda x, w, b: C("batch_norm_train")(
+    x, w, b, 1, (0, 2, 3), 1e-5), [U(2, 3, 2, 2), P(3), U(3, seed=2)])
+spec("batch_norm_infer", lambda x, w, b: C("batch_norm_infer")(
+    x, _t(np.zeros(3, np.float32)), _t(np.ones(3, np.float32)), w, b, 1,
+    1e-5), [U(2, 3, 2, 2), P(3), U(3, seed=2)])
+spec("affine_channel", C("affine_channel"), [U(1, 3, 2, 2), P(3), U(3)])
+
+# convs / vision
+spec("conv1d", C("conv1d"), [U(1, 2, 5), U(3, 2, 3, seed=1)])
+spec("conv2d", C("conv2d"), [U(1, 2, 4, 4), U(2, 2, 3, 3, seed=1)])
+spec("conv3d", C("conv3d"), [U(1, 1, 3, 3, 3), U(1, 1, 2, 2, 2, seed=1)])
+spec("conv1d_transpose", C("conv1d_transpose"),
+     [U(1, 2, 4), U(2, 2, 3, seed=1)])
+spec("conv2d_transpose", C("conv2d_transpose"),
+     [U(1, 2, 3, 3), U(2, 2, 3, 3, seed=1)])
+spec("conv3d_transpose", C("conv3d_transpose"),
+     [U(1, 1, 2, 2, 2), U(1, 1, 2, 2, 2, seed=1)])
+spec("fold", lambda x: C("fold")(x, [4, 4], [2, 2], strides=2),
+     [U(1, 4, 4)])
+spec("unfold", lambda x: C("unfold")(x, [2, 2], strides=2),
+     [U(1, 1, 4, 4)])
+spec("interpolate", lambda x: C("interpolate")(
+    x, size=[4, 4], mode="bilinear", align_corners=True), [U(1, 2, 2, 2)])
+spec("grid_sample", C("grid_sample"),
+     [U(1, 2, 3, 3), UNIT(1, 2, 2, 2, seed=1)])
+spec("affine_grid", lambda th: C("affine_grid")(th, [1, 1, 3, 3]),
+     [U(1, 2, 3)])
+spec("pixel_shuffle", lambda x: C("pixel_shuffle")(x, 2), [U(1, 4, 2, 2)])
+spec("pixel_unshuffle", lambda x: C("pixel_unshuffle")(x, 2),
+     [U(1, 1, 4, 4)])
+spec("channel_shuffle", lambda x: C("channel_shuffle")(x, 2),
+     [U(1, 4, 2, 2)])
+spec("lp_pool2d", lambda x: C("lp_pool2d")(x, 2.0, 2), [P(1, 1, 4, 4)])
+spec("temporal_shift", lambda x: C("temporal_shift")(x, 2),
+     [U(4, 4, 2, 2)])
+spec("correlation", lambda a, b: C("correlation")(a, b, max_displacement=1),
+     [U(1, 2, 4, 4), U(1, 2, 4, 4, seed=1)])
+
+# linalg
+spec("cholesky", C("cholesky"), [SPD(3)])
+spec("cholesky_inverse", C("cholesky_inverse"), [CHOL(3)])
+spec("cholesky_solve", C("cholesky_solve"), [U(3, 2), CHOL(3)])
+spec("solve", C("solve"), [SPD(3), U(3, 2, seed=1)])
+spec("triangular_solve", C("triangular_solve"),
+     [np.triu(SPD(3)).astype(np.float32), U(3, 2, seed=1)])
+spec("inverse", C("inverse"), [SPD(3)])
+spec("pinv", C("pinv"), [SPD(3)], atol=5e-2, rtol=5e-2)
+spec("det", C("det"), [SPD(3)])
+spec("logdet", C("logdet"), [SPD(3)])
+spec("slogdet", lambda x: C("slogdet")(x)[1], [SPD(3)])
+spec("matrix_power", lambda x: C("matrix_power")(x, 2), [U(3, 3)])
+spec("matrix_exp", C("matrix_exp"), [U(3, 3) * 0.3], atol=5e-2, rtol=5e-2)
+spec("cond", C("cond"), [SPD(3)], atol=5e-2, rtol=5e-2)
+spec("eigh", lambda x: C("eigh")(x)[0], [SPD(3)])
+spec("eigvalsh", C("eigvalsh"), [SPD(3)])
+spec("svdvals", C("svdvals"), [U(3, 4)])
+spec("svd", lambda x: C("svd")(x)[1], [U(3, 4)])
+spec("qr", lambda x: C("qr")(x)[1], [SPD(3)], atol=5e-2, rtol=5e-2)
+spec("householder_product", C("householder_product"),
+     [U(4, 2), P(2, seed=1)], atol=5e-2, rtol=5e-2)
+
+# fused / serving ops
+spec("add_n", lambda a, b: C("add_n")([a, b]), [U(2, 3), U(2, 3, seed=1)])
+spec("add_position_encoding", C("add_position_encoding"), [U(1, 4, 6)])
+spec("apply_per_channel_scale", C("apply_per_channel_scale"),
+     [U(2, 3), P(3)])
+spec("fused_softmax_mask", lambda x: C("fused_softmax_mask")(
+    x, _t(np.zeros((1, 1, 2, 4), np.float32))), [U(1, 2, 2, 4)])
+spec("fused_softmax_mask_upper_triangle",
+     C("fused_softmax_mask_upper_triangle"), [U(1, 2, 4, 4)])
+spec("fused_rotary_position_embedding",
+     lambda q: C("fused_rotary_position_embedding")(q)[0], [U(1, 4, 2, 4)])
+spec("fused_dot_product_attention", C("fused_dot_product_attention"),
+     [U(1, 3, 2, 4), U(1, 3, 2, 4, seed=1), U(1, 3, 2, 4, seed=2)])
+spec("qkv_unpack_mha", C("qkv_unpack_mha"),
+     [U(1, 3, 2, 4), U(1, 3, 2, 4, seed=1), U(1, 3, 2, 4, seed=2)])
+spec("self_dp_attention", lambda x: C("self_dp_attention")(x, 2),
+     [U(1, 3, 3, 2, 4)])
+spec("multihead_matmul", lambda x, w: C("multihead_matmul")(
+    x, w, head_number=2), [U(1, 3, 4), U(4, 12, seed=1)])
+spec("fused_layer_norm", C("fused_layer_norm"), [U(2, 4), P(4), U(4)])
+spec("fused_rms_norm", C("fused_rms_norm"), [U(2, 4), P(4)])
+spec("skip_layernorm", C("skip_layernorm"), [U(2, 4), U(2, 4, seed=1)])
+spec("fused_bias_residual_layernorm",
+     lambda x, r: C("fused_bias_residual_layernorm")(x, residual=r),
+     [U(2, 4), U(2, 4, seed=1)])
+spec("fused_bias_dropout_residual_layer_norm",
+     lambda x, r: C("fused_bias_dropout_residual_layer_norm")(
+         x, r, dropout_rate=0.0), [U(2, 4), U(2, 4, seed=1)])
+spec("fused_bias_act", lambda x: C("fused_bias_act")(x), [U(2, 4)])
+spec("fused_dropout_add", lambda x, y: C("fused_dropout_add")(
+    x, y, p=0.0, training=False), [U(2, 3), U(2, 3, seed=1)])
+for n in ("fused_elementwise_add fused_elementwise_mul "
+          "fused_elementwise_sub").split():
+    spec(n, C(n), [U(2, 3), U(2, 3, seed=1)])
+spec("fused_elementwise_div", C("fused_elementwise_div"),
+     [U(2, 3), P(2, 3)])
+spec("fused_elemwise_activation", C("fused_elemwise_activation"),
+     [P(2, 3), P(2, 3, seed=1)])
+spec("fused_elemwise_add_activation", C("fused_elemwise_add_activation"),
+     [P(2, 3), P(2, 3, seed=1)])
+spec("fusion_squared_mat_sub", C("fusion_squared_mat_sub"),
+     [U(2, 3), U(3, 2, seed=1)])
+spec("fusion_repeated_fc_relu",
+     lambda x, w, b: C("fusion_repeated_fc_relu")(x, [w], [b]),
+     [U(2, 3), U(3, 2, seed=1), U(2, seed=2)])
+spec("fusion_transpose_flatten_concat",
+     lambda a, b: C("fusion_transpose_flatten_concat")(
+         [a, b], [0, 2, 1]), [U(2, 3, 2), U(2, 3, 2, seed=1)])
+spec("fused_fc_elementwise_layernorm",
+     C("fused_fc_elementwise_layernorm"),
+     [U(2, 3), U(3, 4, seed=1), U(2, 4, seed=2)])
+spec("fused_embedding_eltwise_layernorm",
+     lambda e: C("fused_embedding_eltwise_layernorm")(
+         [_t(np.array([[0, 2], [1, 1]], np.int64))], [e]), [U(4, 6)])
+spec("squeeze_excitation_block", C("squeeze_excitation_block"),
+     [P(1, 4, 2, 2), U(4, 2, seed=1), U(2, seed=2), U(2, 4, seed=3),
+      U(4, seed=4)], atol=5e-2, rtol=5e-2)
+spec("add_group_norm_silu", lambda x: C("add_group_norm_silu")(
+    x, groups=2), [U(1, 4, 2, 2)])
+spec("fused_batch_norm_act", lambda x, s, b: C("fused_batch_norm_act")(
+    x, s, b, _t(np.zeros(3, np.float32)), _t(np.ones(3, np.float32))),
+    [P(2, 3, 2, 2), P(3), U(3, seed=2)])
+spec("fused_bn_add_activation",
+     lambda x, z, s, b: C("fused_bn_add_activation")(
+         x, z, s, b, _t(np.zeros(3, np.float32)),
+         _t(np.ones(3, np.float32))),
+     [P(2, 3, 2, 2), P(2, 3, 2, 2, seed=1), P(3), U(3, seed=2)])
+spec("fused_conv2d_add_act", C("fused_conv2d_add_act"),
+     [P(1, 2, 4, 4), U(2, 2, 3, 3, seed=1)])
+spec("fused_scale_bias_add_relu", lambda x1, s1, b1, x2:
+     C("fused_scale_bias_add_relu")(x1, s1, b1, x2),
+     [P(1, 3, 2, 2), P(3, 1, 1), P(3, 1, 1, seed=2),
+      P(1, 3, 2, 2, seed=3)])
+spec("fused_scale_bias_relu_conv_bn",
+     lambda x, w, s, b: C("fused_scale_bias_relu_conv_bn")(
+         x, w, s, b, np.ones(2, np.float32), np.zeros(2, np.float32),
+         np.zeros(2, np.float32), np.ones(2, np.float32)),
+     [P(1, 3, 3, 3), U(2, 3, 2, 2, seed=1), P(3, 1, 1),
+      P(3, 1, 1, seed=2)], atol=5e-2, rtol=5e-2)
+spec("resnet_basic_block", lambda x, f1, f2: C("resnet_basic_block")(
+    x, f1, np.ones(2, np.float32), np.zeros(2, np.float32),
+    np.zeros(2, np.float32), np.ones(2, np.float32),
+    f2, np.ones(2, np.float32), np.zeros(2, np.float32),
+    np.zeros(2, np.float32), np.ones(2, np.float32)),
+    [P(1, 2, 4, 4), U(2, 2, 3, 3, seed=1), U(2, 2, 3, 3, seed=2)],
+    atol=5e-2, rtol=5e-2)
+spec("resnet_unit", lambda x, f: C("resnet_unit")(
+    x, f, np.ones(2, np.float32), np.zeros(2, np.float32),
+    np.zeros(2, np.float32), np.ones(2, np.float32)),
+    [P(1, 2, 4, 4), U(2, 2, 3, 3, seed=1)], atol=5e-2, rtol=5e-2)
+spec("llm_int8_linear", lambda x: C("llm_int8_linear")(
+    x, _t(np.array([[3, 1, -1], [-2, 4, 2]], np.int8)),
+    _t(np.array([0.05, 0.02], np.float32))), [U(2, 3)])
+
+# quantize-dequantize fakes: straight-through estimator — FD on the
+# dequantized STAIRCASE output is meaningless EXCEPT that STE grad == 1
+# inside range; inputs chosen mid-step would still FD to ~0. The STE
+# CONTRACT (analytic grad == pass-through) is what we pin instead.
+STE_OPS = ("fake_quantize_dequantize_abs_max "
+           "fake_channel_wise_quantize_dequantize_abs_max").split()
+
+
+# -- the inventory ----------------------------------------------------------
+
+NONDIFF_NATURE = {
+    # discrete / bit-level / boolean outputs — FD meaningless by type
+    "iscomplex", "isreal", "signbit", "frexp", "nextafter",
+    # index/position outputs consumed as data
+    "sort", "topk", "mode",
+}
+
+ALLOWLIST = {
+    # complex-valued outputs: the eager tape is real-valued end-to-end
+    "eig": "complex eigenpairs; real-path covered by eigh/eigvalsh",
+    "eigvals": "complex eigenvalues; real-path covered by eigvalsh",
+    # decomposition gauge freedom: factor outputs are unique only up to
+    # sign/permutation — FD across a gauge flip is undefined; the
+    # well-defined reductions ARE covered (det/slogdet/svdvals/qr-R)
+    "lu": "pivot permutation discrete; solve/qr/cholesky cover",
+    "lu_unpack": "consumes lu's pivots; same justification",
+    "lstsq": "rank-revealing branch discrete; solve/pinv cover",
+    "ormqr": "householder gauge; householder_product covers the grad path",
+    # stateful quantizers (running scale state updated in-place)
+    "fake_quantize_dequantize_moving_average_abs_max":
+        "moving-average state op; STE contract pinned in test_ste_grads",
+    # misc
+    "masked_multihead_attention":
+        "decode-cache op: takes mutable cache state; equality + grad of "
+        "the underlying attention covered by fused_dot_product_attention",
+    "polar": "complex-valued output; the eager tape is real-valued "
+             "(same rule as eig/eigvals)",
+    "pallas_flash_attention":
+        "TPU kernel op gated by supported() shapes (>= 128-wide tiles, "
+        "infeasible for FD); fwd+bwd equality vs the XLA attention is "
+        "pinned in test_flash_native_layout / test_gpt_model",
+    "tensor_getitem":
+        "internal carrier of getitem's traced-index protocol (requires a "
+        "template operand); the public getitem spec covers the grad path",
+}
+
+CHUNK = 40
+
+
+def _inventory():
+    diff_ops = sorted(n for n, d in OP_REGISTRY.items() if d.differentiable)
+    return diff_ops
+
+
+@pytest.mark.smoke
+def test_grad_inventory_complete():
+    """Every differentiable-registered op is spec'd, nature-exempt, or
+    allowlisted — and the allowlist stays under budget."""
+    missing = []
+    for name in _inventory():
+        if name in SPECS or name in NONDIFF_NATURE or name in ALLOWLIST \
+                or name in STE_OPS:
+            continue
+        missing.append(name)
+    assert not missing, (
+        f"{len(missing)} differentiable ops lack a grad spec or "
+        f"justification: {missing}")
+
+
+@pytest.mark.smoke
+def test_grad_allowlist_budget():
+    assert len(ALLOWLIST) < 60, len(ALLOWLIST)
+
+
+def test_specs_name_valid():
+    unknown = [n for n in SPECS if n not in OP_REGISTRY]
+    assert not unknown, f"specs for unregistered ops: {unknown}"
+
+
+def test_ste_grads():
+    """Fake-quant ops: analytic grad is the straight-through estimator
+    (pass-through == 1 in-range), the reference's documented grad rule."""
+    for name in STE_OPS:
+        x = paddle.to_tensor(U(2, 3), stop_gradient=False)
+        out = op_call(OP_REGISTRY[name], (x,), {})
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones((2, 3)),
+                                   atol=1e-5)
+
+
+_names_sorted = sorted(SPECS)
+_chunks = [_names_sorted[i:i + CHUNK]
+           for i in range(0, len(_names_sorted), CHUNK)]
+
+
+@pytest.mark.parametrize("chunk_id", range(len(_chunks)))
+def test_fd_grad_chunk(chunk_id):
+    failures = []
+    for name in _chunks[chunk_id]:
+        fn, inputs, opts = SPECS[name]
+        kw = {}
+        if "idx" in opts:
+            kw["grad_input_idx"] = opts["idx"]
+        try:
+            check_grad(fn, [np.array(i) for i in inputs],
+                       atol=opts.get("atol", 1e-2),
+                       rtol=opts.get("rtol", 1e-2), **kw)
+        except Exception as e:  # noqa: BLE001 — aggregate for one report
+            failures.append(f"{name}: {str(e)[:200]}")
+    assert not failures, "\n".join(failures)

@@ -142,3 +142,50 @@ def test_sparse_multiply_pattern_intersection():
     y = sparse.sparse_coo_tensor([[0, 1], [1, 0]], [5.0, 7.0], shape=[2, 2])
     out = sparse.multiply(x, y)
     np.testing.assert_allclose(out.to_dense().numpy(), np.zeros((2, 2)))
+
+
+def test_weighted_sample_neighbors():
+    """reference geometric/sampling/neighbors.py:218: selection
+    probability proportional to edge weight, without replacement; eids
+    follow the chosen edges."""
+    from paddle_tpu.geometric import weighted_sample_neighbors
+
+    # node 0 has neighbors [3, 7] with weights heavily favoring 7
+    row = pt.to_tensor(np.array([3, 7, 0, 9, 1], np.int64))
+    colptr = pt.to_tensor(np.array([0, 2, 4, 5], np.int64))
+    weight = pt.to_tensor(np.array([1e-6, 1.0, 0.5, 0.5, 1.0], np.float32))
+    eids = pt.to_tensor(np.arange(5, dtype=np.int64))
+    nodes = pt.to_tensor(np.array([0, 1, 2], np.int64))
+
+    pt.seed(7)
+    picks = []
+    for _ in range(20):
+        neigh, count, out_eids = weighted_sample_neighbors(
+            row, colptr, weight, nodes, sample_size=1, eids=eids,
+            return_eids=True)
+        assert list(count.numpy()) == [1, 1, 1]
+        # eids index the chosen edges: neighbor == row[eid]
+        np.testing.assert_array_equal(
+            np.asarray(row.numpy())[out_eids.numpy()], neigh.numpy())
+        picks.append(int(neigh.numpy()[0]))
+    # weight 1.0 vs 1e-6: node 0 should essentially always pick 7
+    assert picks.count(7) >= 19, picks
+
+    # full-neighborhood mode returns everything in order
+    neigh, count = weighted_sample_neighbors(row, colptr, weight, nodes)
+    assert list(count.numpy()) == [2, 2, 1]
+    np.testing.assert_array_equal(neigh.numpy(), [3, 7, 0, 9, 1])
+
+
+def test_sample_neighbors_return_eids():
+    from paddle_tpu.geometric import sample_neighbors
+
+    row = pt.to_tensor(np.array([3, 7, 0, 9, 1], np.int64))
+    colptr = pt.to_tensor(np.array([0, 2, 4, 5], np.int64))
+    eids = pt.to_tensor(np.array([10, 11, 12, 13, 14], np.int64))
+    nodes = pt.to_tensor(np.array([0, 2], np.int64))
+    neigh, count, out_eids = sample_neighbors(row, colptr, nodes,
+                                              eids=eids, return_eids=True)
+    assert list(count.numpy()) == [2, 1]
+    np.testing.assert_array_equal(neigh.numpy(), [3, 7, 1])
+    np.testing.assert_array_equal(out_eids.numpy(), [10, 11, 14])

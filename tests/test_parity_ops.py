@@ -175,7 +175,8 @@ def test_paged_attention_matches_dense():
     v1 = jnp.asarray(rng.randn(B, 1, nh, dh).astype(np.float32))
     cache.write_decode(k1, v1)
     out = paged_decode_attention(q1, cache.k_pages, cache.v_pages,
-                                 cache.block_table, cache.seq_lens)
+                                 cache.block_table, cache.seq_lens,
+                                 k_layout=cache.k_layout)
     # dense reference over the full (S+1)-token history
     kk = np.concatenate([np.asarray(k), np.asarray(k1)], axis=1)
     vv = np.concatenate([np.asarray(v), np.asarray(v1)], axis=1)
@@ -187,3 +188,47 @@ def test_paged_attention_matches_dense():
     p = p / p.sum(-1, keepdims=True)
     want = np.swapaxes(np.einsum("bhqk,bhkd->bhqd", p, vh), 1, 2)
     np.testing.assert_allclose(np.asarray(out), want, rtol=2e-4, atol=2e-4)
+
+
+def test_paged_decode_mxu_matches_vector_kernel():
+    """MXU-formulated paged decode (block-diagonal dots over d-major k
+    pages) == vector kernel == XLA fallback, at a serving-real shape
+    (interpret mode; the real-chip GB/s measurement lives in PERF.md)."""
+    from paddle_tpu.ops.pallas import decode_attention as da
+
+    rng = np.random.RandomState(1)
+    B, nh, d, bs, max_blocks = 2, 8, 128, 128, 4
+    n_pages = B * max_blocks
+    q = jnp.asarray(rng.randn(B, nh, d).astype(np.float32) * 0.3,
+                    jnp.float32)
+    k_pages = jnp.asarray(rng.randn(n_pages, nh, bs, d).astype(np.float32)
+                          * 0.3)
+    v_pages = jnp.asarray(rng.randn(n_pages, nh, bs, d).astype(np.float32)
+                          * 0.3)
+    table = jnp.arange(n_pages, dtype=jnp.int32).reshape(B, max_blocks)
+    seq_lens = jnp.asarray([300, 17], jnp.int32)   # ragged, mid-page ends
+    scale = 1.0 / np.sqrt(d)
+
+    assert da.paged_decode_mxu_supported(
+        (n_pages, nh, d, bs), nh, max_blocks=max_blocks)
+    kt_pages = jnp.swapaxes(k_pages, 2, 3)         # d-major
+    got = da.paged_decode_attention_mxu(q, kt_pages, v_pages, table,
+                                        seq_lens, scale)
+    ref = da.paged_decode_attention_kernel(q, k_pages, v_pages, table,
+                                           seq_lens, scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+    # numpy dense reference bounds both kernels
+    for b in range(B):
+        L = int(seq_lens[b])
+        kk = np.swapaxes(np.asarray(k_pages[table[b]]), 1, 2) \
+            .reshape(-1, nh, d)[:L]
+        vv = np.swapaxes(np.asarray(v_pages[table[b]]), 1, 2) \
+            .reshape(-1, nh, d)[:L]
+        s = np.einsum("hd,khd->hk", np.asarray(q[b]), kk) * scale
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = np.einsum("hk,khd->hd", p, vv)
+        np.testing.assert_allclose(np.asarray(got[b]), want,
+                                   rtol=2e-3, atol=2e-3)

@@ -1,0 +1,80 @@
+"""Continuous-batching serving engine: correctness under admission,
+completion, and page reuse.
+
+The critical property (VERDICT r3 item 3): admission/eviction must never
+corrupt cross-request attention — a request decoded while slots fill,
+drain, and pages are recycled must produce EXACTLY the tokens it produces
+alone (greedy, fp32). Reference role: analysis_predictor.cc serving path
++ block_multi_head_attention's per-sequence block tables.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.inference.serving import Request, ServingEngine
+
+CFG = LlamaConfig(vocab_size=512, hidden=128, n_layers=2, n_heads=8,
+                  n_kv_heads=4, ffn_hidden=256, max_seq_len=256,
+                  dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def _isolated_reference(engine, prompts, max_new):
+    """Greedy generations one-at-a-time through the contiguous-cache
+    engine (independently implemented path)."""
+    m = LlamaForCausalLM(CFG, params=engine.params, max_batch=1,
+                         max_seq_len=256)
+    outs = []
+    for p in prompts:
+        toks = m.generate(np.asarray(p)[None], max_new_tokens=max_new)
+        outs.append(list(np.asarray(toks)[0]))
+    return outs
+
+
+def test_serving_matches_isolated_generation():
+    rng = np.random.RandomState(0)
+    # 2 slots, 5 requests, staggered arrivals -> queueing + slot reuse +
+    # page recycling while other requests are mid-decode
+    engine = ServingEngine(CFG, max_batch=2, page_size=16, max_seq=256,
+                           prefill_buckets=(16, 32, 64))
+    prompts = [rng.randint(1, 512, size=n).astype(np.int32)
+               for n in (9, 16, 23, 31, 12)]
+    max_new = 6
+    reqs = [Request(rid=i, prompt=p, max_new_tokens=max_new, arrival=0.0)
+            for i, p in enumerate(prompts)]
+    stats = engine.run(reqs)
+
+    assert stats["n_requests"] == 5
+    assert stats["total_new_tokens"] == 5 * max_new
+    want = _isolated_reference(engine, prompts, max_new)
+    for r, w in zip(reqs, want):
+        assert r.out_tokens == w, (r.rid, r.out_tokens, w)
+    # every page returned to the pool
+    assert len(engine.pool.free) == engine.n_pages - 1
+    assert all(s is None for s in engine.slots)
+
+
+def test_serving_admission_respects_memory():
+    engine = ServingEngine(CFG, max_batch=4, page_size=16, max_seq=256,
+                           n_pages=1 + 6,  # room for ~1.5 requests
+                           prefill_buckets=(16, 32, 64))
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(1, 512, size=20).astype(np.int32)
+               for _ in range(3)]
+    # each request needs ceil((32... bucket 32)+4 /16) >= 3 pages
+    reqs = [Request(rid=i, prompt=p, max_new_tokens=4, arrival=0.0)
+            for i, p in enumerate(prompts)]
+    stats = engine.run(reqs)
+    # all complete despite the pool forcing serialized admission
+    assert all(r.t_done is not None for r in reqs)
+    assert len(engine.pool.free) == 6
+
+
+def test_serving_rejects_oversized():
+    engine = ServingEngine(CFG, max_batch=1, page_size=16, max_seq=64,
+                           prefill_buckets=(16, 32, 64))
+    with pytest.raises(ValueError):
+        engine.submit(Request(rid=0, prompt=np.zeros(60, np.int32),
+                              max_new_tokens=10))

@@ -1,0 +1,163 @@
+"""Sparse NN layers vs dense references (inventory row 62 -> full).
+
+Reference semantics: sparse/nn/layer/conv.py (Conv3D output sites =
+receptive-field dilation of the input sites; SubmConv3D sites unchanged),
+pooling.py (max over active sites only), norm.py (BN statistics over
+active values). Each test builds the dense equivalent with numpy/lax and
+compares values AND index sets.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu.sparse as sparse
+
+
+def _rand_coo(rng, shape_spatial, C, density=0.2):
+    """Random COO [N, *S, C] with ~density active sites."""
+    occ = rng.rand(*shape_spatial) < density
+    if not occ.any():
+        occ.flat[0] = True
+    idx = np.stack(np.nonzero(occ)).astype(np.int32)     # [nd, nnz]
+    vals = rng.randn(idx.shape[1], C).astype(np.float32)
+    st = sparse.sparse_coo_tensor(idx, vals,
+                                  shape=tuple(shape_spatial) + (C,))
+    dense = np.zeros(tuple(shape_spatial) + (C,), np.float32)
+    dense[tuple(idx)] = vals
+    return st, dense, occ
+
+
+def _dense_conv3d(dense, w, b, stride, pad):
+    import jax
+    from jax import lax
+
+    dn = lax.conv_dimension_numbers(dense.shape, w.shape,
+                                    ("NDHWC", "DHWIO", "NDHWC"))
+    out = lax.conv_general_dilated(
+        jnp.asarray(dense), jnp.asarray(w), (stride,) * 3,
+        [(pad, pad)] * 3, dimension_numbers=dn)
+    return np.asarray(out) + b
+
+
+def test_conv3d_matches_dense_and_dilates_sites():
+    rng = np.random.RandomState(0)
+    st, dense, occ = _rand_coo(rng, (2, 6, 6, 6), C=3, density=0.15)
+    w = rng.randn(3, 3, 3, 3, 4).astype(np.float32) * 0.1
+    b = rng.randn(4).astype(np.float32)
+    out = sparse.nn.functional.conv3d(st, jnp.asarray(w), jnp.asarray(b),
+                                      stride=1, padding=1)
+    want = _dense_conv3d(dense, w, b, 1, 1)
+    # active output sites: any input site within the receptive field
+    got_dense = np.asarray(out.to_dense().numpy())
+    kern = np.ones((3, 3, 3, 1, 1), np.float32)
+    occ_out = _dense_conv3d(occ[..., None].astype(np.float32), kern,
+                            np.zeros(1, np.float32), 1, 1)[..., 0] > 0
+    assert out.nnz == int(occ_out.sum())
+    np.testing.assert_allclose(got_dense[occ_out], want[occ_out],
+                               rtol=1e-4, atol=1e-5)
+    # inactive sites carry no values even when the dense conv is nonzero
+    assert np.all(got_dense[~occ_out] == 0)
+
+
+def test_subm_conv3d_preserves_index_set():
+    rng = np.random.RandomState(1)
+    st, dense, occ = _rand_coo(rng, (1, 5, 5, 5), C=2, density=0.2)
+    w = rng.randn(3, 3, 3, 2, 2).astype(np.float32) * 0.1
+    out = sparse.nn.functional.subm_conv3d(st, jnp.asarray(w))
+    assert out.nnz == st.nnz
+    np.testing.assert_array_equal(np.asarray(out.indices().numpy()),
+                                  np.asarray(st.indices().numpy()))
+    want = _dense_conv3d(dense, w, np.zeros(2, np.float32), 1, 1)
+    got = np.asarray(out.to_dense().numpy())
+    np.testing.assert_allclose(got[occ], want[occ], rtol=1e-4, atol=1e-5)
+
+
+def test_conv3d_stride2():
+    rng = np.random.RandomState(2)
+    st, dense, occ = _rand_coo(rng, (1, 6, 6, 6), C=2, density=0.3)
+    w = rng.randn(2, 2, 2, 2, 3).astype(np.float32) * 0.1
+    out = sparse.nn.functional.conv3d(st, jnp.asarray(w), stride=2)
+    want = _dense_conv3d_s(dense, w, 2)
+    got = np.asarray(out.to_dense().numpy())
+    nz = np.any(got != 0, axis=-1)
+    np.testing.assert_allclose(got[nz], want[nz], rtol=1e-4, atol=1e-5)
+    assert out.shape[:4] == list(want.shape[:4])
+
+
+def _dense_conv3d_s(dense, w, stride):
+    return _dense_conv3d(dense, w, np.zeros(w.shape[-1], np.float32),
+                         stride, 0)
+
+
+def test_subm_conv2d():
+    rng = np.random.RandomState(3)
+    st, dense, occ = _rand_coo(rng, (2, 7, 7), C=3, density=0.25)
+    w = rng.randn(3, 3, 3, 5).astype(np.float32) * 0.1
+    out = sparse.nn.functional.subm_conv2d(st, jnp.asarray(w))
+    assert out.nnz == st.nnz
+    from jax import lax
+
+    dn = lax.conv_dimension_numbers(dense.shape, w.shape,
+                                    ("NHWC", "HWIO", "NHWC"))
+    want = np.asarray(lax.conv_general_dilated(
+        jnp.asarray(dense), jnp.asarray(w), (1, 1), [(1, 1)] * 2,
+        dimension_numbers=dn))
+    got = np.asarray(out.to_dense().numpy())
+    np.testing.assert_allclose(got[occ], want[occ], rtol=1e-4, atol=1e-5)
+
+
+def test_max_pool3d_active_only():
+    rng = np.random.RandomState(4)
+    st, dense, occ = _rand_coo(rng, (1, 4, 4, 4), C=2, density=0.3)
+    out = sparse.nn.functional.max_pool3d(st, kernel_size=2, stride=2)
+    got = np.asarray(out.to_dense().numpy())
+    # manual reference: max over ACTIVE sites per window (NOT plain dense
+    # max-pool: zeros at inactive sites must not win over negative values)
+    D = 2
+    for z in range(D):
+        for y in range(D):
+            for x in range(D):
+                win_occ = occ[0, 2*z:2*z+2, 2*y:2*y+2, 2*x:2*x+2]
+                win = dense[0, 2*z:2*z+2, 2*y:2*y+2, 2*x:2*x+2]
+                if win_occ.any():
+                    want = win[win_occ].max(axis=0)
+                    np.testing.assert_allclose(got[0, z, y, x], want,
+                                               rtol=1e-5, atol=1e-6)
+                else:
+                    assert np.all(got[0, z, y, x] == 0)
+
+
+def test_sparse_batchnorm_train_eval():
+    rng = np.random.RandomState(5)
+    st, dense, occ = _rand_coo(rng, (2, 4, 4, 4), C=3, density=0.4)
+    bn = sparse.nn.BatchNorm(3, momentum=0.5)
+    bn.train()
+    out = bn(st)
+    vals = np.asarray(st.values().numpy())
+    want = (vals - vals.mean(0)) / np.sqrt(vals.var(0) + 1e-5)
+    np.testing.assert_allclose(np.asarray(out.values().numpy()), want,
+                               rtol=1e-4, atol=1e-5)
+    assert out.nnz == st.nnz
+    # eval: running stats (updated once from the train step)
+    bn.eval()
+    out2 = bn(st)
+    run_m = 0.5 * 0.0 + 0.5 * vals.mean(0)
+    run_v = 0.5 * 1.0 + 0.5 * vals.var(0)
+    want2 = (vals - run_m) / np.sqrt(run_v + 1e-5)
+    np.testing.assert_allclose(np.asarray(out2.values().numpy()), want2,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_conv_layers_construct_and_run():
+    rng = np.random.RandomState(6)
+    st, _, _ = _rand_coo(rng, (1, 5, 5, 5), C=4, density=0.2)
+    for cls, kw in ((sparse.nn.Conv3D, {}), (sparse.nn.SubmConv3D, {})):
+        layer = cls(4, 8, kernel_size=3, padding=1, **kw)
+        out = layer(st)
+        assert out.shape[-1] == 8
+    pool = sparse.nn.MaxPool3D(kernel_size=2, stride=2)
+    assert pool(st).shape[1] == 2  # 5//2
+    relu = sparse.nn.ReLU()
+    assert relu(st).nnz == st.nnz
