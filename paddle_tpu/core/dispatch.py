@@ -71,10 +71,24 @@ class OpDef(NamedTuple):
 OP_REGISTRY: dict[str, OpDef] = {}
 
 # Reentrancy depth of op impl execution — nested wrapper calls run raw
-# (see op_call); list cell so closures share the counter.
-_IMPL_DEPTH = [0]
+# (see op_call). Thread-local: an eager op on another thread must not be
+# misrouted to the raw path because THIS thread is inside an impl trace.
+import threading as _threading
 
-from jax._src.core import trace_state_clean as _trace_state_clean
+
+class _ImplDepth(_threading.local):
+    def __init__(self):
+        self.v = 0
+
+
+_IMPL_DEPTH = _ImplDepth()
+
+try:  # private jax API (fast global "any trace active?" gate) — fall
+    # back to "never clean" (always scan leaves) if it moves
+    from jax._src.core import trace_state_clean as _trace_state_clean
+except ImportError:  # pragma: no cover — jax version drift
+    def _trace_state_clean():
+        return False
 
 # Zero-bubble split backward rules (the tape analog of the reference's
 # matmul-grad split in pipeline_zero_bubble.py). A rule has signature
@@ -169,18 +183,18 @@ def op_call(opdef: OpDef, args, kwargs):
     # reached with raw tracers from inside someone else's jax trace.
     # (trace_state_clean() is a cheap global gate: in plain eager no
     # tracer can exist, so the per-leaf scan never runs on the hot path.)
-    if _IMPL_DEPTH[0] > 0 or (
+    if _IMPL_DEPTH.v > 0 or (
             not leaves and not _trace_state_clean()
             and any(isinstance(a, jax.core.Tracer)
                     for a in jax.tree.leaves((args, kwargs)))):
         arrays = [t._mat() for t in leaves]
-        _IMPL_DEPTH[0] += 1
+        _IMPL_DEPTH.v += 1
         try:
             return opdef.impl(*_rebuild(t_args, arrays),
                               **(_rebuild(t_kwargs, arrays)
                                  if kwargs else {}))
         finally:
-            _IMPL_DEPTH[0] -= 1
+            _IMPL_DEPTH.v -= 1
 
     requires_grad = (
         opdef.differentiable
@@ -211,13 +225,13 @@ def op_call(opdef: OpDef, args, kwargs):
 
     if requires_grad:
         def primal(*arrs):
-            _IMPL_DEPTH[0] += 1
+            _IMPL_DEPTH.v += 1
             try:
                 out = opdef.impl(
                     *_rebuild(t_args, arrs), **_rebuild(t_kwargs, arrs)
                 )
             finally:
-                _IMPL_DEPTH[0] -= 1
+                _IMPL_DEPTH.v -= 1
             return tuple(out) if isinstance(out, list) else out
 
         outs, vjp_fn = jax.vjp(primal, *arrays)
@@ -242,12 +256,12 @@ def op_call(opdef: OpDef, args, kwargs):
 
                 node.split = split
     else:
-        _IMPL_DEPTH[0] += 1
+        _IMPL_DEPTH.v += 1
         try:
             outs = opdef.impl(*_rebuild(t_args, arrays),
                               **_rebuild(t_kwargs, arrays))
         finally:
-            _IMPL_DEPTH[0] -= 1
+            _IMPL_DEPTH.v -= 1
         if isinstance(outs, list):
             outs = tuple(outs)
         node = None

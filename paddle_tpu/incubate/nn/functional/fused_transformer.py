@@ -245,15 +245,17 @@ def paged_decode_attention(q, k_pages, v_pages, block_table, seq_lens,
         paged_decode_mxu_supported, paged_decode_supported)
 
     if (k_layout == "d_major"
-            and paged_decode_mxu_supported(k_pages.shape, q.shape[2],
-                                           max_blocks=max_blocks)):
+            and paged_decode_mxu_supported(
+                k_pages.shape, q.shape[2], max_blocks=max_blocks,
+                itemsize=k_pages.dtype.itemsize)):
         o = paged_decode_attention_mxu(
             q[:, 0].astype(k_pages.dtype), k_pages, v_pages, block_table,
             seq_lens, 1.0 / math.sqrt(dh))
         return o[:, None].astype(q.dtype)             # [B, 1, nh, dh]
     if (k_layout == "token_major"
             and paged_decode_supported(k_pages.shape, q.shape[2],
-                                       max_blocks=max_blocks)):
+                                       max_blocks=max_blocks,
+                                       itemsize=k_pages.dtype.itemsize)):
         o = paged_decode_attention_kernel(
             q[:, 0].astype(k_pages.dtype), k_pages, v_pages, block_table,
             seq_lens, 1.0 / math.sqrt(dh))

@@ -234,6 +234,13 @@ class ServingEngine:
                 f"request {req.rid}: prompt {len(req.prompt)} + "
                 f"{req.max_new_tokens} new tokens exceeds max_seq "
                 f"{self.max_seq}")
+        need = max(self._bucket_for(len(req.prompt)),
+                   len(req.prompt) + req.max_new_tokens)
+        n_blk = (need + self.bs - 1) // self.bs
+        if n_blk > self.n_pages - 1:       # page 0 is the sink
+            raise ValueError(
+                f"request {req.rid}: needs {n_blk} pages but the pool "
+                f"holds {self.n_pages - 1} — it could never be admitted")
         self.queue.append(req)
 
     def _bucket_for(self, n: int) -> int:
@@ -293,7 +300,9 @@ class ServingEngine:
             self.slots[slot] = None
 
     def step(self, now: Optional[float] = None) -> bool:
-        """Admissions + one decode tick. Returns False when fully idle."""
+        """Admissions + one decode tick. Returns True while work remains
+        (active slots or queued requests) — `while engine.step(): ...` is
+        the external drive contract; an idle tick runs no compute."""
         now = time.monotonic() if now is None else now
         self._admit(now)
         active = [s for s in range(self.B) if self.slots[s] is not None]
@@ -325,12 +334,15 @@ class ServingEngine:
         self.stats = {k: 0 for k in self.stats}   # per-run counters
         t0 = time.monotonic()
         while any(s is not None for s in self.slots) or self.queue:
-            progressed = self.step(now=time.monotonic() - t0)
-            if not progressed and self.queue:
-                # nothing active and next arrival is in the future
+            self.step(now=time.monotonic() - t0)
+            if not any(s is not None for s in self.slots) and self.queue:
+                # nothing active and next arrival is in the future (or
+                # admission is transiently pool-blocked): sleep, don't
+                # busy-spin — floor keeps the pool-blocked case off 100%
+                # CPU (submit() rejects requests that can NEVER fit)
                 nxt = min(r.arrival for r in self.queue)
                 wait = max(0.0, nxt - (time.monotonic() - t0))
-                time.sleep(min(wait, 0.05))
+                time.sleep(min(max(wait, 0.001), 0.05))
         wall = time.monotonic() - t0
         lat = [r.t_done - (t0 + r.arrival) for r in requests]
         ttft = [r.t_first - (t0 + r.arrival) for r in requests]

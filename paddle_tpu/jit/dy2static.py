@@ -98,11 +98,125 @@ def converted_cond(pred, true_fn: Callable, false_fn: Callable,
     return _rebuild_tensors(holder["t"], out_flat)
 
 
+def _is_placeholder(tpl) -> bool:
+    """True for the _extract_arrays leaf markers ("__tensor__", i, sg) /
+    ("__array__", i) — positions that ride the while_loop carry."""
+    return (isinstance(tpl, tuple) and len(tpl) in (2, 3)
+            and tpl and tpl[0] in ("__tensor__", "__array__"))
+
+
+def _promotable_scalar(v) -> bool:
+    import numpy as _np
+
+    return isinstance(v, (bool, int, float, _np.bool_, _np.number))
+
+
+def _promote_mutated(op, t_body, names, changed):
+    """Walk the live operand tree alongside the body-output template.
+    Non-array leaves the body MUTATES are silently frozen by the carry
+    rebuild (only arrays ride lax.while_loop), so: promote mutated Python
+    scalars to jnp arrays (they then ride the carry), and raise
+    UnsupportedControlFlow for any other mutated leaf (-> segment
+    fallback, the always-correct path)."""
+    from ..core.tensor import Tensor
+    import numpy as _np
+
+    if _is_placeholder(t_body):
+        if isinstance(op, (Tensor, jax.Array, _np.ndarray)):
+            return op
+        if _promotable_scalar(op):
+            changed[0] = True
+            return jnp.asarray(op)
+        raise UnsupportedControlFlow(
+            f"while carry {names}: non-array value {op!r} becomes a "
+            "traced array in the loop body")
+    if isinstance(op, (list, tuple)) and isinstance(t_body, (list, tuple)) \
+            and type(op) is type(t_body) and len(op) == len(t_body):
+        return type(op)(_promote_mutated(o, t, names, changed)
+                        for o, t in zip(op, t_body))
+    if isinstance(op, dict) and isinstance(t_body, dict) \
+            and set(op) == set(t_body):
+        return {k: _promote_mutated(op[k], t_body[k], names, changed)
+                for k in op}
+    if isinstance(op, (Tensor, jax.Array, _np.ndarray)):
+        # non-traceable ndarrays (object/str dtype) ride the template as
+        # constants on both sides — fine as long as the body returns them
+        # unchanged; traceable arrays reaching here mean the body turned
+        # a carried array into a non-array
+        same = op is t_body
+        if not same and isinstance(op, _np.ndarray) \
+                and isinstance(t_body, _np.ndarray):
+            try:
+                same = bool(_np.array_equal(op, t_body))
+            except Exception:  # noqa: BLE001
+                same = False
+        if same:
+            return op
+        raise UnsupportedControlFlow(
+            f"while carry {names}: carried array is mutated or replaced "
+            f"in the loop body ({type(op).__name__} -> "
+            f"{type(t_body).__name__})")
+    try:
+        same = bool(op == t_body)
+    except Exception:  # noqa: BLE001 — unorderable leaf: identity only
+        same = op is t_body
+    if same:
+        return op
+    if _promotable_scalar(op) and _promotable_scalar(t_body):
+        changed[0] = True
+        return jnp.asarray(op)
+    raise UnsupportedControlFlow(
+        f"while carry {names}: non-array value mutates in the loop body "
+        f"({op!r} -> {t_body!r}) and cannot ride the carry")
+
+
+def _check_const_leaves(t_init, t_body, names):
+    """Trace-time guard: every non-placeholder (constant) leaf of the
+    carry template must come back unchanged from the body."""
+    if _is_placeholder(t_init) and _is_placeholder(t_body):
+        return
+    if isinstance(t_init, (list, tuple)) and isinstance(t_body, (list, tuple)) \
+            and type(t_init) is type(t_body) and len(t_init) == len(t_body) \
+            and not _is_placeholder(t_init) and not _is_placeholder(t_body):
+        for a, b in zip(t_init, t_body):
+            _check_const_leaves(a, b, names)
+        return
+    if isinstance(t_init, dict) and isinstance(t_body, dict) \
+            and set(t_init) == set(t_body):
+        for k in t_init:
+            _check_const_leaves(t_init[k], t_body[k], names)
+        return
+    import numpy as _np
+
+    same = t_init is t_body
+    if not same and isinstance(t_init, _np.ndarray) \
+            and isinstance(t_body, _np.ndarray):
+        try:                       # same tolerance as _promote_mutated
+            same = bool(_np.array_equal(t_init, t_body))
+        except Exception:  # noqa: BLE001
+            same = False
+    elif not same:
+        try:
+            same = bool(t_init == t_body)
+        except Exception:  # noqa: BLE001
+            same = False
+    if not same:
+        raise UnsupportedControlFlow(
+            f"while carry {names}: constant leaf changed in the loop body "
+            f"({t_init!r} -> {t_body!r})")
+
+
 def converted_while(test_fn: Callable, body_fn: Callable, names: tuple,
                     operands: tuple):
     """``while`` with a tensor predicate -> lax.while_loop over the
     carried ``names``. ``test_fn(*carry) -> pred``; ``body_fn(*carry) ->
-    carry'``. A Python-bool first predicate keeps the Python loop."""
+    carry'``. A Python-bool first predicate keeps the Python loop.
+
+    Only arrays ride the lax.while_loop carry; other leaves are rebuilt
+    from the initial template. Python-scalar carries the body mutates
+    (e.g. an int step counter) are therefore PROMOTED to jnp arrays
+    first (found by an abstract probe of the body); any other mutated
+    non-array leaf raises UnsupportedControlFlow -> segment fallback."""
     first = test_fn(*operands)
     if not _is_tensor_pred(first):
         vals = operands
@@ -118,6 +232,47 @@ def converted_while(test_fn: Callable, body_fn: Callable, names: tuple,
 
     arrs: list = []
     template = _extract_arrays(operands, arrs)
+
+    def _probe(tpl, flat_arrs):
+        probe_holder = {}
+
+        def run(a):
+            outs = body_fn(*_rebuild_tensors(tpl, a))
+            flat: list = []
+            probe_holder["t"] = _extract_arrays(outs, flat)
+            return flat
+
+        jax.eval_shape(run, flat_arrs)
+        return probe_holder["t"]
+
+    def has_constant_leaves(tpl):
+        if _is_placeholder(tpl):
+            return False
+        if isinstance(tpl, (list, tuple)):
+            return any(has_constant_leaves(t) for t in tpl)
+        if isinstance(tpl, dict):
+            return any(has_constant_leaves(v) for v in tpl.values())
+        return True
+
+    # Promote-until-stable: promoting one scalar can make another leaf
+    # traced on the next probe (e.g. `m = n * x` after `n` joins the
+    # carry), so iterate; a handful of rounds always suffices or the
+    # carry is genuinely unconvertible. An all-array carry (the common
+    # case) has nothing to promote or freeze — skip the probe retraces.
+    if has_constant_leaves(template):
+        for _ in range(4):
+            t_body = _probe(template, arrs)
+            changed = [False]
+            operands = _promote_mutated(operands, t_body, names, changed)
+            if not changed[0]:
+                break
+            arrs = []
+            template = _extract_arrays(operands, arrs)
+        else:
+            raise UnsupportedControlFlow(
+                f"while carry {names} did not stabilize under scalar "
+                "promotion")
+
     holder = {"t": template}
 
     def cond(arrs):
@@ -129,6 +284,7 @@ def converted_while(test_fn: Callable, body_fn: Callable, names: tuple,
         t2 = _extract_arrays(outs, flat)
         _check_match(jax.tree.structure(t2), jax.tree.structure(holder["t"]),
                      names)
+        _check_const_leaves(holder["t"], t2, names)
         return flat
 
     out = jax.lax.while_loop(cond, body, arrs)

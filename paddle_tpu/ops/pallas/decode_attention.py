@@ -101,14 +101,16 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_s,
 
 
 def paged_decode_supported(pages_shape, n_q_heads: int,
-                           max_blocks: int | None = None) -> bool:
+                           max_blocks: int | None = None,
+                           itemsize: int = 2) -> bool:
     """Paged kernel constraints: page block (bs, d) must satisfy Mosaic's
     last-two-dims rule, the cache must hold every q head (the paged
     cache is full-head, no GQA sharing), and the k_per-page
     double-buffered k+v working set must fit ~16MB VMEM (v5e) — larger
-    configs take the XLA gather path."""
+    configs take the XLA gather path. Pass the cache dtype's itemsize
+    (default bf16) so the VMEM estimate matches the kernel's k_per."""
     _, nh, bs, d = pages_shape
-    page_bytes = nh * bs * d * 2                   # bf16
+    page_bytes = nh * bs * d * itemsize
     k_per = _paged_pages_per_program(max_blocks if max_blocks is not None
                                      else 4, page_bytes)
     # double-buffered k+v operands for the whole group + ONE page's fp32
@@ -247,7 +249,8 @@ def paged_decode_attention_dma(q, k_pages, v_pages, block_table,
     from jax.experimental.pallas import tpu as pltpu
 
     if not paged_decode_supported(k_pages.shape, q.shape[1],
-                                  max_blocks=block_table.shape[1]):
+                                  max_blocks=block_table.shape[1],
+                                  itemsize=k_pages.dtype.itemsize):
         raise ValueError(
             f"paged_decode_attention_dma: pages {tuple(k_pages.shape)} "
             f"with {q.shape[1]} q heads unsupported; gate with "
@@ -288,17 +291,18 @@ def paged_decode_attention_dma(q, k_pages, v_pages, block_table,
 
 
 def paged_decode_mxu_supported(kt_pages_shape, n_q_heads: int,
-                               max_blocks: int | None = None) -> bool:
+                               max_blocks: int | None = None,
+                               itemsize: int = 2) -> bool:
     """Gate for the MXU paged kernel: d-major k pages [n_pages, nkv, d, bs]
     with MXU-tileable flattened pages — bs a lane multiple for k [nkv*d, bs]
     and d one for v [nkv*bs, d] — plus the same VMEM working-set bound as
     the vector kernel. GQA native: q may carry G = n_q/nkv heads per kv
     head (the repeated-KV tensor never exists)."""
     _, nkv, d, bs = kt_pages_shape
-    page_bytes = nkv * bs * d * 2
+    page_bytes = nkv * bs * d * itemsize
     k_per = _paged_pages_per_program(max_blocks if max_blocks is not None
                                      else 4, page_bytes)
-    est = 2 * 2 * k_per * page_bytes + 2 * n_q_heads * nkv * d * 2
+    est = 2 * 2 * k_per * page_bytes + 2 * n_q_heads * nkv * d * itemsize
     if est > 12 * 2 ** 20:
         return False
     return (d in (128, 256) and bs % 128 == 0 and n_q_heads % nkv == 0
@@ -395,7 +399,10 @@ def paged_decode_attention_mxu(q, kt_pages, v_pages, block_table,
     B, nh, d = q.shape
     nkv, bs = kt_pages.shape[1], kt_pages.shape[3]
     max_blocks = block_table.shape[1]
-    k_per = _paged_pages_per_program(max_blocks)
+    # page_bytes must match paged_decode_mxu_supported's, or the gate
+    # validates a smaller k_per than the kernel runs (VMEM blowout)
+    k_per = _paged_pages_per_program(
+        max_blocks, page_bytes=nkv * bs * d * kt_pages.dtype.itemsize)
     bt_flat = block_table.reshape(-1).astype(jnp.int32)
 
     def k_spec(c):
@@ -451,7 +458,9 @@ def paged_decode_attention_kernel(q, k_pages, v_pages, block_table,
     B, nh, d = q.shape
     bs = k_pages.shape[2]
     max_blocks = block_table.shape[1]
-    k_per = _paged_pages_per_program(max_blocks)
+    # same k_per formula as paged_decode_supported's gate (VMEM bound)
+    k_per = _paged_pages_per_program(
+        max_blocks, page_bytes=nh * bs * d * k_pages.dtype.itemsize)
     bt_flat = block_table.reshape(-1).astype(jnp.int32)
 
     def page_spec(c):

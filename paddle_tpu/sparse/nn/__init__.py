@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from ...core.tensor import Parameter, Tensor
+from ...nn import initializer as I
 from ...nn.layer.layers import Layer
 from . import functional
 from .functional import (batch_norm_values, conv2d, conv3d, max_pool3d,
@@ -49,11 +50,9 @@ class _ConvNd(Layer):
         self._key = key
         fan_in = in_channels * int(np.prod(ks)) // groups
         std = 1.0 / math.sqrt(fan_in)
-        w = np.random.RandomState(0).uniform(
-            -std, std, ks + (in_channels // groups, out_channels))
         self.weight = self.create_parameter(
-            shape=list(w.shape), default_initializer=None, attr=weight_attr)
-        self.weight.set_value(w.astype(np.float32))
+            shape=list(ks) + [in_channels // groups, out_channels],
+            default_initializer=I.Uniform(-std, std), attr=weight_attr)
         if bias_attr is False:
             self.bias = None
         else:

@@ -120,3 +120,29 @@ def test_tensor_concat_free_function_only():
     assert not hasattr(paddle.Tensor, "concat") or callable(paddle.concat)
     out = paddle.concat([t, t])
     assert out.shape == [2]
+
+
+def test_dispatch_depth_is_thread_local():
+    """ADVICE r4: an eager op on another thread must not be misrouted to
+    the raw (tape-free) path because this thread is inside an op impl."""
+    import threading
+
+    from paddle_tpu.core import dispatch
+
+    results = {}
+
+    def worker():
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        x.stop_gradient = False
+        y = (x * 2.0).sum()
+        y.backward()
+        results["grad"] = np.asarray(x.grad.numpy())
+
+    dispatch._IMPL_DEPTH.v = 1       # simulate: main thread inside an impl
+    try:
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    finally:
+        dispatch._IMPL_DEPTH.v = 0
+    np.testing.assert_allclose(results["grad"], [2.0, 2.0])

@@ -151,3 +151,43 @@ def test_dma_pipelined_kernel_matches_index_map():
     b_ = paged_decode_attention_kernel(q, kp, vp, table, sl,
                                        1.0 / math.sqrt(d))
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+
+
+def test_paged_k_per_gate_kernel_consistency():
+    """ADVICE r4 medium: the kernels must size k_per with the SAME
+    page_bytes VMEM bound the support gates use — for big pages the gate
+    approves a clamped k_per and the kernel must not run a larger one."""
+    from paddle_tpu.ops.pallas import decode_attention as da
+
+    big_page = 4 * 1024 * 128 * 2            # nkv=4, bs=1024, d=128 bf16
+    assert da._paged_pages_per_program(4, big_page) == 2
+    # without the bound the helper returns 4 — the pre-fix kernel path
+    assert da._paged_pages_per_program(4) == 4
+
+    # end-to-end on a big-page GQA config: the clamped-k_per grid must
+    # still be numerically right (f32 itemsize clamps to k_per=1 here)
+    rng = np.random.RandomState(7)
+    B, nkv, G, d, bs, mb = 1, 4, 2, 128, 1024, 4
+    nh = nkv * G
+    n_pages = B * mb
+    q = jnp.asarray(rng.randn(B, nh, d).astype(np.float32) * 0.3)
+    kp = rng.randn(n_pages, nkv, bs, d).astype(np.float32) * 0.3
+    vp = jnp.asarray(rng.randn(n_pages, nkv, bs, d).astype(np.float32)
+                     * 0.3)
+    kt = jnp.asarray(np.swapaxes(kp, 2, 3))          # d-major
+    table = jnp.arange(n_pages, dtype=jnp.int32).reshape(B, mb)
+    sl = jnp.asarray([2 * bs + 5], jnp.int32)
+    scale = 1.0 / math.sqrt(d)
+    got = da.paged_decode_attention_mxu(q, kt, jnp.asarray(vp), table, sl,
+                                        scale)
+    L = int(sl[0])
+    kk = np.repeat(kp[table[0]], G, axis=1)          # [mb, nh, bs, d]
+    kk = np.swapaxes(kk, 1, 2).reshape(-1, nh, d)[:L]
+    vv = np.repeat(np.asarray(vp)[table[0]], G, axis=1)
+    vv = np.swapaxes(vv, 1, 2).reshape(-1, nh, d)[:L]
+    s = np.einsum("hd,khd->hk", np.asarray(q[0]), kk) * scale
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want = np.einsum("hk,khd->hd", p, vv)
+    np.testing.assert_allclose(np.asarray(got[0]), want, rtol=2e-3,
+                               atol=2e-3)

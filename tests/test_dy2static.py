@@ -109,6 +109,48 @@ def test_item_branch_still_falls_back():
     assert not step.ast_converted
 
 
+def test_while_python_int_carry_promoted():
+    """A Python int counter mutated inside a tensor-predicate while must
+    ride the lax.while_loop carry (scalar promotion), not silently freeze
+    at its initial value (ADVICE r4 high)."""
+
+    @pjit.to_static
+    def step(x):
+        n = 0
+        while (x.sum() < 10):
+            x = x * 2
+            n = n + 1
+        return x + n
+
+    out = step(paddle.to_tensor(np.ones((2,), np.float32)))
+    # sums 2 -> 4 -> 8 -> 16: three iterations, x ends at 8, n at 3
+    np.testing.assert_allclose(out.numpy(), np.full((2,), 11.0))
+    assert step.ast_converted
+    # and the same executable is correct when the loop doesn't run
+    out2 = step(paddle.to_tensor(np.full((2,), 6.0, np.float32)))
+    np.testing.assert_allclose(out2.numpy(), np.full((2,), 6.0))
+
+
+def test_while_nonpromotable_carry_falls_back():
+    """A non-scalar Python value mutated in the loop body cannot ride the
+    carry: conversion must refuse (UnsupportedControlFlow) and the
+    segment fallback must produce the right answer."""
+
+    @pjit.to_static
+    def step(x):
+        tag = "a"
+        while (x.sum() < 10):
+            x = x * 2
+            tag = tag + "b"
+        return x + len(tag)
+
+    out = step(paddle.to_tensor(np.ones((2,), np.float32)))
+    # eager fallback: x ends at 8, tag == "abbb" -> 8 + 4
+    np.testing.assert_allclose(out.numpy(), np.full((2,), 12.0))
+    assert not step.ast_converted
+    assert step.graph_break_count >= 1
+
+
 def test_python_bool_predicate_unchanged():
     """Python-bool predicates keep the Python path: two configs, two
     traces, no cond in either."""
