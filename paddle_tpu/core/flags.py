@@ -143,6 +143,10 @@ define_flag("flash_attention_native_layout", True,
             "Flash kernels consume the model's (b, s, h, d) layout via "
             "lane-fused 2-D blocks (no transpose copies); 0 restores the "
             "round-2 transpose-based kernels for A/B measurement.")
+define_flag("flash_attention_fused_dqkv", True,
+            "Fused-qkv flash backward writes dq/dk/dv into ONE dqkv "
+            "cotangent tile per program (merged kernel, no concatenate); "
+            "0 restores the split two-kernel + concat path for A/B.")
 define_flag(
     "use_pallas_attention",
     True,

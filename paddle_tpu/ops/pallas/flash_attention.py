@@ -291,6 +291,132 @@ def _flash_bwd_dkv_kernel_native(q_ref, k_ref, v_ref, do_ref, lse_ref,
     dv_ref[...] = dvs[0] if hp == 1 else jnp.concatenate(dvs, axis=1)
 
 
+def _flash_bwd_fused_kernel_native(qkv_qblk_ref, qkv_kfull_ref,
+                                   qkv_vfull_ref, qkv_kblk_ref,
+                                   qkv_vblk_ref, qkv_qfull_ref,
+                                   do_blk_ref, do_full_ref, lse_blk_ref,
+                                   delta_blk_ref, lse_full_ref,
+                                   delta_full_ref, dqkv_ref, *, causal,
+                                   sm_scale, block, seq_len, hp, d):
+    """Merged backward for the FUSED qkv path: one program computes dq
+    for its sequence block (k-loop) AND dk/dv for the same block
+    (q-loop), writing all three into one [block, 3, hp*d] tile of the
+    dqkv cotangent — the concatenate of the split path (~192 MB of HBM
+    traffic per layer at b16) never happens. Under causal the two loops
+    are complementary (dq touches blocks <= i, dkv touches >= i), so
+    per-program work is uniform across the grid."""
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(2)
+    bq = block
+    q_offs = i * bq + jax.lax.iota(jnp.int32, bq)
+    k_offs_self = q_offs                     # same seq block for dk/dv
+    num_full_blocks, num_k_blocks = _causal_bounds(i, bq, block, seq_len,
+                                                   causal)
+    num_q_blocks = seq_len // block
+    start_q = 0
+    end_masked = 0
+    if causal:
+        start_q = i
+        end_masked = jax.lax.min(i + 1, num_q_blocks)
+
+    ql = qkv_qblk_ref[...]                   # [bq, hp*d]
+    dol = do_blk_ref[...]
+    kl = qkv_kblk_ref[...]
+    vl = qkv_vblk_ref[...]
+    dq_outs, dk_outs, dv_outs = [], [], []
+    for j in range(hp):
+        # ---- dq for this q block: loop k blocks ----------------------
+        q = ql[:, j * d:(j + 1) * d]
+        do = dol[:, j * d:(j + 1) * d]
+        lse = lse_blk_ref[j, 0, :]
+        delta = delta_blk_ref[j, 0, :]
+
+        def dq_body(kb, dq, *, masked, j=j, q=q, do=do, lse=lse,
+                    delta=delta):
+            k = qkv_kfull_ref[pl.dslice(kb * block, block),
+                              j * d:(j + 1) * d]
+            v = qkv_vfull_ref[pl.dslice(kb * block, block),
+                              j * d:(j + 1) * d]
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) \
+                * sm_scale
+            p = jnp.exp(s - lse[:, None])
+            if masked:
+                k_offs = kb * block + jax.lax.iota(jnp.int32, block)
+                p = jnp.where(q_offs[:, None] >= k_offs[None, :], p, 0.0)
+            dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta[:, None])).astype(k.dtype)
+            return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+        dq = jax.lax.fori_loop(0, num_full_blocks,
+                               functools.partial(dq_body, masked=False),
+                               jnp.zeros((bq, d), jnp.float32))
+        dq = jax.lax.fori_loop(num_full_blocks, num_k_blocks,
+                               functools.partial(dq_body, masked=causal),
+                               dq)
+        dq_outs.append((dq * sm_scale).astype(dqkv_ref.dtype))
+
+        # ---- dk/dv for the SAME seq block: loop q blocks -------------
+        k = kl[:, j * d:(j + 1) * d]
+        v = vl[:, j * d:(j + 1) * d]
+
+        def dkv_body(qb, carry, *, masked, j=j, k=k, v=v):
+            dk, dv = carry
+            q = qkv_qfull_ref[pl.dslice(qb * block, block),
+                              j * d:(j + 1) * d]
+            do = do_full_ref[pl.dslice(qb * block, block),
+                             j * d:(j + 1) * d]
+            lse = lse_full_ref[j, 0, pl.dslice(qb * block, block)]
+            delta = delta_full_ref[j, 0, pl.dslice(qb * block, block)]
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) \
+                * sm_scale
+            p = jnp.exp(s - lse[:, None])
+            if masked:
+                q_offs2 = qb * block + jax.lax.iota(jnp.int32, block)
+                p = jnp.where(q_offs2[:, None] >= k_offs_self[None, :],
+                              p, 0.0)
+            p_lo = p.astype(do.dtype)
+            dv_new = dv + jnp.dot(p_lo.T, do,
+                                  preferred_element_type=jnp.float32)
+            dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta[:, None])).astype(q.dtype)
+            dk_new = dk + jnp.dot(ds.T, q,
+                                  preferred_element_type=jnp.float32)
+            return dk_new, dv_new
+
+        zero = (jnp.zeros((bq, d), jnp.float32),
+                jnp.zeros((bq, d), jnp.float32))
+        dk, dv = jax.lax.fori_loop(start_q, end_masked,
+                                   functools.partial(dkv_body,
+                                                     masked=causal), zero)
+        dk, dv = jax.lax.fori_loop(jax.lax.max(start_q, end_masked),
+                                   num_q_blocks,
+                                   functools.partial(dkv_body,
+                                                     masked=False),
+                                   (dk, dv))
+        dk_outs.append((dk * sm_scale).astype(dqkv_ref.dtype))
+        dv_outs.append(dv.astype(dqkv_ref.dtype))
+
+    dq_t = dq_outs[0] if hp == 1 else jnp.concatenate(dq_outs, axis=1)
+    dk_t = dk_outs[0] if hp == 1 else jnp.concatenate(dk_outs, axis=1)
+    dv_t = dv_outs[0] if hp == 1 else jnp.concatenate(dv_outs, axis=1)
+    # integer index on the middle ref dim = plain offset store (the
+    # value-slicing Mosaic hazards in PERF.md don't apply to ref stores)
+    dqkv_ref[:, 0, :] = dq_t
+    dqkv_ref[:, 1, :] = dk_t
+    dqkv_ref[:, 2, :] = dv_t
+
+
+def _fused_dqkv_ok(s: int, hd: int, itemsize: int = 2) -> bool:
+    """Merged-kernel gate: one program holds FOUR full-sequence slabs
+    (k, v, q, do at [s, hp*d]) plus blocks and fp32 accumulators; cap
+    the slab set at 8 MB of the ~16 MB v5e VMEM. Larger configs take
+    the split two-kernel path (2 slabs each)."""
+    bq, bk = _block_sizes(s)
+    return bq == bk and bq >= _MIN_BLOCK \
+        and 4 * s * hd * itemsize <= 8 * 2 ** 20
+
+
 # ---------------------------------------------------------------------------
 # transpose-layout kernels (round 2; FLAGS_flash_attention_native_layout=0)
 # ---------------------------------------------------------------------------
@@ -570,9 +696,10 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, with_lse: bool = False,
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "native",
-                                             "n_heads"))
+                                             "n_heads", "fused_dqkv"))
 def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float,
-               native: bool = True, n_heads: int | None = None):
+               native: bool = True, n_heads: int | None = None,
+               fused_dqkv: bool = True):
     """Tiled backward: dq over q-blocks, dk/dv over k-blocks, never
     materializing the [S, S] score matrix (the role of the reference's
     flash_attn_bwd CUDA kernels, flash_attn_grad_kernel.cu). With
@@ -614,6 +741,49 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float,
             off_k = off_v = 0
         dtype = qf.dtype
         dof = do.astype(dtype).reshape(b, s, h * d)
+        if fused:
+            # fused_dqkv is a STATIC arg read by the caller OUTSIDE this
+            # jit (the jit cache doesn't key on GLOBAL_FLAGS, so an
+            # in-trace read would make in-process flag flips a no-op)
+            if fused_dqkv and _fused_dqkv_ok(
+                    s, hd, jnp.dtype(dtype).itemsize):
+                block = _block_sizes(s)[0]
+                blk = pl.BlockSpec((None, block, hd),
+                                   lambda ib, ih, i: (ib, i, ih))
+                kblk = pl.BlockSpec(
+                    (None, block, hd),
+                    lambda ib, ih, i: (ib, i, off_k + ih))
+                vblk = pl.BlockSpec(
+                    (None, block, hd),
+                    lambda ib, ih, i: (ib, i, off_v + ih))
+                qfull = pl.BlockSpec((None, s, hd),
+                                     lambda ib, ih, i: (ib, 0, ih))
+                kfull = pl.BlockSpec(
+                    (None, s, hd), lambda ib, ih, i: (ib, 0, off_k + ih))
+                vfull = pl.BlockSpec(
+                    (None, s, hd), lambda ib, ih, i: (ib, 0, off_v + ih))
+                lse_blk = pl.BlockSpec((None, hp, 8, block),
+                                       lambda ib, ih, i: (ib, ih, 0, i))
+                lse_full = pl.BlockSpec((None, hp, 8, s),
+                                        lambda ib, ih, i: (ib, ih, 0, 0))
+                dqkv4 = pl.pallas_call(
+                    functools.partial(_flash_bwd_fused_kernel_native,
+                                      causal=causal, sm_scale=sm_scale,
+                                      block=block, seq_len=s, hp=hp, d=d),
+                    grid=(b, HB, s // block),
+                    in_specs=[blk, kfull, vfull, kblk, vblk, qfull,
+                              blk, qfull, lse_blk, lse_blk, lse_full,
+                              lse_full],
+                    out_specs=pl.BlockSpec(
+                        (None, block, 3, hd),
+                        lambda ib, ih, i: (ib, i, 0, ih)),
+                    out_shape=jax.ShapeDtypeStruct((b, s, 3, h * d),
+                                                   dtype),
+                    interpret=_interpret_mode(),
+                    compiler_params=_tpu_params(2),
+                )(qf, qf, qf, qf, qf, qf, dof, dof, lse, delta, lse,
+                  delta)
+                return dqkv4.reshape(b, s, 3 * h * d)
         blk_q = pl.BlockSpec((None, block_q, hd),
                              lambda ib, ih, iq: (ib, iq, ih))
         blk_kk = pl.BlockSpec((None, block_k, hd),
@@ -844,11 +1014,12 @@ def flash_attention_qkv_raw(qkv, n_heads: int, causal: bool = True,
     """Flash attention straight from the FUSED qkv projection output
     (``qkv`` [B, S, 3*H]): the kernels read q/k/v through lane-block
     offset views, so the FORWARD's 3-way split copies (and their saved
-    residuals) never materialize. The backward still concatenates
-    dq/dk/dv into the qkv cotangent — the same copy the split path's
-    vjp-of-split pays, so the win is forward-side only (a fused dqkv
-    output via cross-call aliasing is the known next step). Requires the
-    native layout. Returns [B, S, n_heads, head_dim]."""
+    residuals) never materialize. The backward writes dq/dk/dv into ONE
+    dqkv cotangent through the merged kernel
+    (_flash_bwd_fused_kernel_native) when _fused_dqkv_ok — no
+    concatenate; larger configs fall back to the split two-kernel +
+    concat path. Requires the native layout.
+    Returns [B, S, n_heads, head_dim]."""
     if not flash_qkv_supported(qkv.shape, n_heads, qkv.dtype):
         raise ValueError(
             f"flash_attention_qkv_raw: shape {tuple(qkv.shape)} with "
@@ -874,8 +1045,12 @@ def flash_attention_qkv_raw(qkv, n_heads: int, causal: bool = True,
 
     def bwd(res, g):
         qkv, o, lse = res
+        from ...core.flags import GLOBAL_FLAGS as _GF
+
+        merged = (_GF.get("flash_attention_fused_dqkv")
+                  if _GF.has("flash_attention_fused_dqkv") else True)
         return (_flash_bwd(qkv, None, None, o, lse, g, causal, scale,
-                           n_heads=n_heads),)
+                           n_heads=n_heads, fused_dqkv=bool(merged)),)
 
     fa.defvjp(fwd, bwd)
     return fa(qkv)

@@ -152,3 +152,34 @@ def test_fused_qkv_respects_flags():
     with pytest.raises(ValueError):
         # head_dim 80: not a supported lane layout
         fa.flash_attention_qkv_raw(jnp.zeros((1, 128, 3 * 32 * 80)), 32)
+
+
+def test_fused_dqkv_merged_kernel_matches_split_path():
+    """The merged dq+dkv backward (one program per seq block writing a
+    [block, 3, hd] dqkv tile — no concatenate) must be bit-identical to
+    the split two-kernel + concat path; both must track autodiff of the
+    reference attention."""
+    from paddle_tpu.core.flags import GLOBAL_FLAGS
+
+    rng = np.random.RandomState(11)
+    B, S, h, d = 2, 256, 4, 64
+    qkv = jnp.asarray(rng.randn(B, S, 3 * h * d) * 0.3, jnp.float32)
+    assert fa._fused_dqkv_ok(S, fa._heads_per_program(h, d) * d, 4)
+
+    def loss(qkv):
+        return (fa.flash_attention_qkv_raw(qkv, h, causal=True)
+                .astype(jnp.float32) ** 2).sum()
+
+    g_merged = jax.grad(loss)(qkv)
+    n_before = fa._flash_bwd._cache_size()
+    GLOBAL_FLAGS.set("flash_attention_fused_dqkv", False)
+    try:
+        g_split = jax.grad(loss)(qkv)
+    finally:
+        GLOBAL_FLAGS.set("flash_attention_fused_dqkv", True)
+    # the flag is a STATIC arg of _flash_bwd: the flip must retrace —
+    # otherwise the jit cache serves the merged program twice and this
+    # comparison is vacuous
+    assert fa._flash_bwd._cache_size() == n_before + 1
+    np.testing.assert_array_equal(np.asarray(g_merged),
+                                  np.asarray(g_split))
