@@ -93,7 +93,13 @@ def _ring_local(q, k, v, *, axis, causal, scale, cp):
         vc = lax.ppermute(vc, axis, perm)
         return (m, l, a, kc, vc), None
 
-    (m, l, a, _, _), _ = lax.scan(hop, (m0, l0, a0, k, v),
+    # remat the hop: without it grad-of-scan saves every hop's fp32
+    # [B, H, Sq, Sk] probabilities for backward (cp x layers of them —
+    # measured 51 GB vs SP+flash's 21.6 GB at 1.3B/S=8192/cp=4,
+    # artifacts/ring_attention_aot.json); recomputing the block attention
+    # in backward is the standard ring-attention trade and restores the
+    # O(S/cp) per-device memory claim
+    (m, l, a, _, _), _ = lax.scan(jax.checkpoint(hop), (m0, l0, a0, k, v),
                                   jnp.arange(cp))
     out = a / jnp.clip(l, 1e-30)[..., None]               # [B, H, Sq, D]
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
@@ -117,16 +123,19 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sep",
         return jnp.einsum("bhqd->bqhd",
                           a / jnp.clip(l, 1e-30)[..., None]).astype(q.dtype)
 
-    run = _build_ring(axis, causal, float(scale), cp)
+    run = _build_ring(axis, causal, float(scale), cp, mesh)
     if isinstance(q, jax.core.Tracer):
         # inside an outer jit: the caller provides the context mesh
         return run(q, k, v)
     with jax.sharding.set_mesh(mesh):
-        return _jitted_ring(axis, causal, float(scale), cp)(q, k, v)
+        return _jitted_ring(axis, causal, float(scale), cp, mesh)(q, k, v)
 
 
 @functools.lru_cache(maxsize=64)
-def _build_ring(axis, causal, scale, cp):
+def _build_ring(axis, causal, scale, cp, mesh):
+    # mesh is part of the cache key: shard_map resolves its mesh at
+    # first trace, so a cached closure must never be reused under a
+    # different-shaped context mesh (Mesh/AbstractMesh both hash)
     spec = P(None, axis)  # shard the sequence dim
     return jax.shard_map(
         functools.partial(_ring_local, axis=axis, causal=causal,
@@ -139,6 +148,6 @@ def _build_ring(axis, causal, scale, cp):
 
 
 @functools.lru_cache(maxsize=64)
-def _jitted_ring(axis, causal, scale, cp):
+def _jitted_ring(axis, causal, scale, cp, mesh):
     # cached per config: a fresh jit per eager call would recompile
-    return jax.jit(_build_ring(axis, causal, scale, cp))
+    return jax.jit(_build_ring(axis, causal, scale, cp, mesh))

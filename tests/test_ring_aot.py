@@ -1,0 +1,38 @@
+"""Ring-attention compiled-program facts at test scale (VERDICT r3 weak
+#2; the full-size artifact is artifacts/ring_attention_aot.json via
+tools/ring_aot.py)."""
+
+import re
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.process_mesh import build_mesh
+from paddle_tpu.models.gpt import GPTConfig
+from paddle_tpu.parallel import make_sharded_train_step
+
+
+def _hlo(ring_axis):
+    mesh = build_mesh((1, 1, 4), ("dp", "pp", "mp"))
+    cfg = GPTConfig(vocab_size=128, hidden=64, n_layers=2, n_heads=4,
+                    seq_len=64, dtype=jnp.float32, use_flash=False,
+                    remat=False, ring_axis=ring_axis)
+    step, params, opt = make_sharded_train_step(cfg, mesh, abstract=True)
+    tok = jax.ShapeDtypeStruct((4, 64), jnp.int32,
+                               sharding=NamedSharding(mesh, P("dp")))
+    with jax.sharding.set_mesh(mesh):
+        return step.jitted.lower(params, opt, tok, tok).compile().as_text()
+
+
+def test_ring_program_carries_ppermute_ring():
+    """The ring-attention step must rotate k/v by collective-permute
+    (the ppermute ring over the cp axis); the Megatron-SP dense step on
+    the same mesh must NOT — its sequence exchange is all-gather shaped."""
+    hlo_ring = _hlo("mp")
+    n_cp = len(re.findall(r"collective-permute(?:-start)?\(", hlo_ring))
+    assert n_cp >= 2, f"expected k+v rotation permutes, found {n_cp}"
+
+    hlo_sp = _hlo(None)
+    n_cp_sp = len(re.findall(r"collective-permute(?:-start)?\(", hlo_sp))
+    assert n_cp_sp == 0, f"SP path unexpectedly permutes ({n_cp_sp})"

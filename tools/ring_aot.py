@@ -1,0 +1,83 @@
+"""Ring-attention compiled-program facts (VERDICT r3 weak #2).
+
+AOT-compiles the 1.3B long-context train step on a 4-way virtual mesh
+twice — ring attention over the axis vs the Megatron-SP dense path — and
+records the collective inventory (the ppermute ring, sizes, replica
+groups) and the per-device memory analysis, so the context-parallel
+claim rests on compiled-program facts rather than prose.
+
+Usage: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+       python tools/ring_aot.py [--seq 8192] [--out artifacts/ring_attention_aot.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="gpt3-1.3b")
+    ap.add_argument("--seq", type=int, default=8192)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--out", default="artifacts/ring_attention_aot.json")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from aot_analyze import analyze
+
+    # ring: sequence sharded over mp, k/v rotating by ppermute
+    ring = analyze(args.preset, (1, 1, 4), args.batch, args.seq, 1,
+                   ring_axis="mp")
+    # baseline: same mesh, Megatron-SP dense/flash attention (the
+    # reference's long-context answer — SURVEY §5)
+    sp = analyze(args.preset, (1, 1, 4), args.batch, args.seq, 1,
+                 ring_axis=None)
+
+    def trim(r):
+        return {
+            "mesh": r["mesh"], "seq": r["config"]["seq_len"],
+            "batch": r["batch_global"], "ring_axis": r["ring_axis"],
+            "memory_analysis_per_device": r["memory_analysis_per_device"],
+            "collectives_by_kind": r["collectives"]["by_kind"],
+            "collective_permutes": [
+                c for c in r["collectives"]["instances"]
+                if c["kind"] == "collective-permute"],
+        }
+
+    out = {
+        "purpose": ("ring attention (parallel/ring_attention.py) vs "
+                    "Megatron-SP dense attention: compiled 4-way "
+                    "long-context train-step programs"),
+        "preset": args.preset,
+        "ring": trim(ring),
+        "sp_dense": trim(sp),
+        "delta": {
+            "temp_bytes_ring": ring["memory_analysis_per_device"]["temp_bytes"],
+            "temp_bytes_sp": sp["memory_analysis_per_device"]["temp_bytes"],
+            "temp_ratio_sp_over_ring": round(
+                sp["memory_analysis_per_device"]["temp_bytes"]
+                / max(1, ring["memory_analysis_per_device"]["temp_bytes"]), 3),
+        },
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out["delta"]))
+    kinds = out["ring"]["collectives_by_kind"]
+    print("ring collectives:", json.dumps(kinds))
+
+
+if __name__ == "__main__":
+    main()
