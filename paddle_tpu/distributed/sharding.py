@@ -10,12 +10,14 @@ Re-design of the reference's three mechanisms (SURVEY.md §8.4):
 
 TPU translation: "owner rank holds the shard" = "array sharded over the
 sharding axis". Stage 1 shards each optimizer moment; stage 2 additionally
-keeps grads reduce-scattered (XLA emits ReduceScatter instead of AllReduce
-in the step program); stage 3 shards the parameters themselves and XLA
-all-gathers them at use sites (the per-layer gather hooks of the reference,
-chosen by the scheduler with overlap). The greedy per-param placement,
-broadcast-back of updated params, and per-layer hook machinery dissolve
-into sharding propagation.
+keeps grads reduced into shards — the partitioner emits a ReduceScatter or
+its all-reduce + per-shard dynamic-slice fusion depending on scale, but the
+contract (update math on 1/N shards, state never replicated) is asserted as
+compiled-program fact in tests/test_zero_memory_proof.py; stage 3 shards
+the parameters themselves and XLA all-gathers them at use sites (the
+per-layer gather hooks of the reference, chosen by the scheduler with
+overlap). The greedy per-param placement, broadcast-back of updated params,
+and per-layer hook machinery dissolve into sharding propagation.
 """
 
 from __future__ import annotations

@@ -48,6 +48,8 @@ total_steps = int(os.environ.get("ELASTIC_TOTAL_STEPS", "6"))
 die_rank = int(os.environ.get("ELASTIC_DIE_RANK", "1"))
 die_gen = int(os.environ.get("ELASTIC_DIE_GEN", "0"))
 die_after = int(os.environ.get("ELASTIC_DIE_AFTER", "3"))
+# scale-OUT tests stretch the step loop so a joining node lands mid-run
+step_sleep = float(os.environ.get("ELASTIC_STEP_SLEEP", "0"))
 
 # membership: one elastic node per process, named by STABLE node id so a
 # relaunched generation reuses the surviving nodes' identities
@@ -87,6 +89,12 @@ sharding = NamedSharding(mesh, P("dp"))
 X = jax.make_array_from_process_local_data(sharding, Xg[sl])
 Y = jax.make_array_from_process_local_data(sharding, Yg[sl])
 
+# a loaded checkpoint lands on the process-local device; the train step
+# consumes globally-replicated weights on the (possibly grown) mesh —
+# this IS the reshard-up of a scale-out resume
+state["w"] = Tensor(jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P()), np.asarray(state["w"]._data)))
+
 
 @jax.jit
 def train_step(w, x, y):
@@ -108,6 +116,10 @@ while step < total_steps:
     state = {"w": Tensor(w), "step": Tensor(jnp.asarray(step, jnp.int32))}
     save_state_dict(state, ckpt)
     print(f"STEP {step} LOSS {float(loss):.6f}", flush=True)
+    if step_sleep:
+        import time as _time
+
+        _time.sleep(step_sleep)
     if gen == die_gen and rank == die_rank and step >= die_after:
         print("SIMULATED_NODE_FAILURE", flush=True)
         os._exit(1)
