@@ -18,7 +18,8 @@ from ..core.tensor import Tensor
 
 __all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
            "segment_mean", "segment_max", "segment_min", "reindex_graph",
-           "sample_neighbors", "weighted_sample_neighbors"]
+           "reindex_heter_graph", "sample_neighbors",
+           "weighted_sample_neighbors"]
 
 def _segment(data, ids, num, pool):
     if pool == "sum":
@@ -112,28 +113,55 @@ segment_max = _make_segment_api("max")
 segment_min = _make_segment_api("min")
 
 
-def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
-                  name=None):
-    """reference geometric/reindex.py: compact global ids to local ids."""
+def _reindex_multi(x, neighbors_list, count_list):
+    """Shared-mapping reindex over one or more edge types: returns
+    (reindex_src, reindex_dst, out_nodes) numpy arrays — x first, then
+    neighbors in first-seen order (reference reindex.py contract)."""
     import numpy as np
 
     xs = np.asarray(x._data if isinstance(x, Tensor) else x)
-    nb = np.asarray(neighbors._data if isinstance(neighbors, Tensor)
-                    else neighbors)
     uniq = list(dict.fromkeys(xs.tolist()))
     mapping = {g: i for i, g in enumerate(uniq)}
     next_id = len(uniq)
     out_nodes = list(uniq)
-    reindexed = np.empty_like(nb)
-    for i, g in enumerate(nb.tolist()):
-        if g not in mapping:
-            mapping[g] = next_id
-            out_nodes.append(g)
-            next_id += 1
-        reindexed[i] = mapping[g]
-    return (Tensor(np.asarray(reindexed)), Tensor(np.asarray(out_nodes)),
-            Tensor(np.asarray(count._data if isinstance(count, Tensor)
-                              else count)))
+    srcs, dsts = [], []
+    for neighbors, count in zip(neighbors_list, count_list):
+        nb = np.asarray(neighbors._data if isinstance(neighbors, Tensor)
+                        else neighbors)
+        cnt = np.asarray(count._data if isinstance(count, Tensor)
+                         else count).astype(np.int64)
+        reindexed = np.empty_like(nb)
+        for i, g in enumerate(nb.tolist()):
+            if g not in mapping:
+                mapping[g] = next_id
+                out_nodes.append(g)
+                next_id += 1
+            reindexed[i] = mapping[g]
+        srcs.append(reindexed)
+        dsts.append(np.repeat(np.arange(len(cnt), dtype=nb.dtype), cnt))
+    return (np.concatenate(srcs) if srcs else np.empty((0,), np.int64),
+            np.concatenate(dsts) if dsts else np.empty((0,), np.int64),
+            np.asarray(out_nodes, xs.dtype))
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global node ids to local ids (reference
+    geometric/reindex.py:34): returns (reindex_src, reindex_dst,
+    out_nodes) with x first in out_nodes, neighbors appended in
+    first-seen order; reindex_dst repeats each local dst i count[i]
+    times."""
+    src, dst, nodes = _reindex_multi(x, [neighbors], [count])
+    return Tensor(src), Tensor(dst), Tensor(nodes)
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Multi-edge-type reindex (reference geometric/reindex.py:153): the
+    id mapping is SHARED across the per-graph neighbor lists, sources
+    and destinations concatenate in graph order."""
+    src, dst, nodes = _reindex_multi(x, list(neighbors), list(count))
+    return Tensor(src), Tensor(dst), Tensor(nodes)
 
 
 def _np_of(x):

@@ -76,7 +76,12 @@ class MoELayer(nn.Layer):
                 outs = []
                 with _autograd.no_grad():
                     for e, expert in enumerate(experts):
-                        outs.append(expert(Tensor(xin[e]))._data)
+                        # inside the moe_dispatch impl trace the nested
+                        # expert ops run RAW (dispatch reentrancy rule),
+                        # so the layer returns a bare array there and a
+                        # Tensor only in plain eager
+                        r = expert(Tensor(xin[e]))
+                        outs.append(r._data if isinstance(r, Tensor) else r)
                 eo = jnp.stack(outs, 0)
                 comb = disp * gatev[:, None, None].astype(x.dtype)
                 out = out + jnp.einsum("nec,ech->nh", comb, eo)

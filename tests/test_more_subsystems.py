@@ -189,3 +189,37 @@ def test_sample_neighbors_return_eids():
     assert list(count.numpy()) == [2, 1]
     np.testing.assert_array_equal(neigh.numpy(), [3, 7, 1])
     np.testing.assert_array_equal(out_eids.numpy(), [10, 11, 14])
+
+
+def test_reindex_graph_reference_contract():
+    """Pins the reference reindex.py:34 documented example: out_nodes
+    puts x first then neighbors in first-seen order; reindex_dst
+    repeats each local destination count[i] times."""
+    from paddle_tpu.geometric import reindex_graph
+
+    x = pt.to_tensor(np.array([0, 1, 2], np.int64))
+    neighbors = pt.to_tensor(np.array([8, 9, 0, 4, 7, 6, 7], np.int64))
+    count = pt.to_tensor(np.array([2, 3, 2], np.int32))
+    src, dst, nodes = reindex_graph(x, neighbors, count)
+    np.testing.assert_array_equal(src.numpy(), [3, 4, 0, 5, 6, 7, 6])
+    np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1, 1, 2, 2])
+    np.testing.assert_array_equal(nodes.numpy(), [0, 1, 2, 8, 9, 4, 7, 6])
+
+
+def test_reindex_heter_graph_reference_contract():
+    """Pins the reference reindex.py:153 documented example: the id
+    mapping is SHARED across the edge-type graphs."""
+    from paddle_tpu.geometric import reindex_heter_graph
+
+    x = pt.to_tensor(np.array([0, 1, 2], np.int64))
+    na = pt.to_tensor(np.array([8, 9, 0, 4, 7, 6, 7], np.int64))
+    ca = pt.to_tensor(np.array([2, 3, 2], np.int32))
+    nb = pt.to_tensor(np.array([0, 2, 3, 5, 1], np.int64))
+    cb = pt.to_tensor(np.array([1, 3, 1], np.int32))
+    src, dst, nodes = reindex_heter_graph(x, [na, nb], [ca, cb])
+    np.testing.assert_array_equal(
+        src.numpy(), [3, 4, 0, 5, 6, 7, 6, 0, 2, 8, 9, 1])
+    np.testing.assert_array_equal(
+        dst.numpy(), [0, 0, 1, 1, 1, 2, 2, 0, 1, 1, 1, 2])
+    np.testing.assert_array_equal(
+        nodes.numpy(), [0, 1, 2, 8, 9, 4, 7, 6, 3, 5])
