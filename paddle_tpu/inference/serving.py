@@ -116,6 +116,11 @@ class ServingEngine:
         self._decode = jax.jit(
             functools.partial(self._decode_n_impl, n=self.decode_quantum),
             donate_argnums=(1, 2))
+        # decode pipelining state (see step() docstring)
+        self._inflight = None              # (toks_dev [K, B], snapshot)
+        self._cur_tok_dev = None           # device-chained token vector
+        self._cur_patches: dict = {}       # slot -> first token (admits)
+        self._deferred_free: list[int] = []
         self.stats = {"decode_steps": 0, "prefills": 0,
                       "decode_slot_tokens": 0, "decode_active_tokens": 0}
 
@@ -159,11 +164,18 @@ class ServingEngine:
         logits = _mm(last, params["head"], cfg).astype(jnp.float32)
         return logits[:, 0], ks, vs
 
-    def _decode_n_impl(self, params, k_pages, v_pages, tokens, table,
-                       seq_lens, *, n):
+    def _decode_n_impl(self, params, k_pages, v_pages, tokens, patch_mask,
+                       patch_vals, table, seq_lens, *, n):
         """``n`` greedy decode ticks in ONE program: scan over the
         single-tick body, feeding each tick's argmax to the next.
-        Returns (toks [n, B], k_pages, v_pages)."""
+        ``tokens`` chains on-device from the previous quantum's output;
+        ``patch_mask``/``patch_vals`` ([B] bool/int32) overlay the first
+        tokens of slots admitted since — IN-program, so the pipelined
+        scheduler issues zero per-dispatch eager ops (each distinct
+        eager-op shape costs a fresh remote compile over the tunnel —
+        measured up to 12 s of compile stalls per serving run).
+        Returns (toks [n, B], last_tok [B], k_pages, v_pages)."""
+        tokens = jnp.where(patch_mask, patch_vals, tokens)
 
         def tick(carry, _):
             kp, vp, tok, sl = carry
@@ -172,9 +184,9 @@ class ServingEngine:
             nxt = jnp.argmax(logits, -1).astype(jnp.int32)
             return (kp, vp, nxt, sl + 1), nxt
 
-        (k_pages, v_pages, _, _), toks = lax.scan(
+        (k_pages, v_pages, last, _), toks = lax.scan(
             tick, (k_pages, v_pages, tokens, seq_lens), None, length=n)
-        return toks, k_pages, v_pages
+        return toks, last, k_pages, v_pages
 
     def _decode_impl(self, params, k_pages, v_pages, tokens, table,
                      seq_lens):
@@ -285,14 +297,20 @@ class ServingEngine:
             req.t_first = time.monotonic()
             self.seq_lens[slot] = T
             self.cur_tok[slot] = first
+            self._cur_patches[slot] = first
             self.stats["prefills"] += 1
             self._finish_if_done(slot)
 
-    def _finish_if_done(self, slot: int) -> None:
+    def _finish_if_done(self, slot: int, defer_free: bool = False) -> None:
         req = self.slots[slot]
         if req is not None and len(req.out_tokens) >= req.max_new_tokens:
             req.t_done = time.monotonic()
-            self.pool.release(self._slot_pages[slot])
+            if defer_free:
+                # an in-flight quantum dispatched before this harvest may
+                # still write junk into these pages; hold them one cycle
+                self._deferred_free.extend(self._slot_pages[slot])
+            else:
+                self.pool.release(self._slot_pages[slot])
             self._slot_pages[slot] = []
             self.table[slot] = 0           # sink
             self.seq_lens[slot] = 0
@@ -300,31 +318,131 @@ class ServingEngine:
             self.slots[slot] = None
 
     def step(self, now: Optional[float] = None) -> bool:
-        """Admissions + one decode tick. Returns True while work remains
-        (active slots or queued requests) — `while engine.step(): ...` is
-        the external drive contract; an idle tick runs no compute."""
+        """Admissions + dispatch of the next decode quantum + harvest of
+        the PREVIOUS one. Returns True while work remains — `while
+        engine.step(): ...` is the external drive contract; an idle tick
+        runs no compute.
+
+        Pipelined (round 5): the next quantum is dispatched BEFORE the
+        previous quantum's tokens are fetched, chained on the device
+        through its last-token vector — the ~100 ms host round-trip per
+        quantum over the remote-device tunnel overlaps device compute
+        instead of serializing with it. Consequences the scheduler
+        handles:
+
+        - a request's finish is discovered one quantum late; the extra
+          quantum decodes junk into its OWN pages (positions past its
+          allocation hit the sink page) and is discarded at harvest;
+        - freed pages go through ``_deferred_free`` for one harvest
+          cycle, so a page is never handed to a new request while an
+          in-flight program that still references it can write to it;
+        - a slot admitted while a quantum is in flight joins the NEXT
+          dispatch; its first token patches the device-chained token
+          vector.
+        """
         now = time.monotonic() if now is None else now
         self._admit(now)
+        prev = self._inflight
+        self._dispatch_next()
+        if prev is not None:
+            self._harvest(prev)
+        elif self._deferred_free:
+            # nothing was in flight: deferred pages are unreachable by
+            # any program — release now (pool-constrained admission
+            # would otherwise deadlock waiting for a harvest)
+            self.pool.release(self._deferred_free)
+            self._deferred_free = []
+        # predictive release: after the harvest above, the only pending
+        # tokens are the quantum just dispatched — any snapshot request
+        # it completes can give up its SLOT now (next step admits into
+        # it one quantum earlier); its tokens still land via the
+        # snapshot, its pages wait in _deferred_free
+        if self._inflight is not None:
+            for s, req in self._inflight[1]:
+                if (self.slots[s] is req and req.max_new_tokens
+                        - len(req.out_tokens) <= self.decode_quantum):
+                    self._deferred_free.extend(self._slot_pages[s])
+                    self._slot_pages[s] = []
+                    self.table[s] = 0
+                    self.seq_lens[s] = 0
+                    self.slots[s] = None
+        return (self._inflight is not None or bool(self.queue)
+                or any(s is not None for s in self.slots))
+
+    def _dispatch_next(self) -> None:
+        """Queue one decode quantum for the CURRENT slot state; does not
+        block. Positions advance at dispatch (the program computes
+        per-tick positions internally); token feed chains on-device from
+        the previous quantum's output, patched for newly admitted
+        slots."""
         active = [s for s in range(self.B) if self.slots[s] is not None]
         if not active:
-            return bool(self.queue)
+            return
+        cur = self._cur_tok_dev
+        mask = np.zeros((self.B,), bool)
+        vals = np.zeros((self.B,), np.int32)
+        if cur is None:
+            cur = jnp.asarray(self.cur_tok.copy())
+        else:
+            for s, tok in self._cur_patches.items():
+                mask[s] = True
+                vals[s] = tok
+        self._cur_patches = {}
         K = self.decode_quantum
-        toks, self.k_pages, self.v_pages = self._decode(
-            self.params, self.k_pages, self.v_pages,
-            jnp.asarray(self.cur_tok), jnp.asarray(self.table),
-            jnp.asarray(self.seq_lens))
-        toks = np.asarray(toks)                     # [K, B]
+        # .copy(): jnp.asarray can ALIAS a numpy buffer (zero-copy on the
+        # CPU backend), and this program executes asynchronously while
+        # the scheduler keeps mutating table/seq_lens — the in-flight
+        # program must see the dispatch-time snapshot (caught by
+        # test_serving_pipelined_page_recycling_exact)
+        toks, last, self.k_pages, self.v_pages = self._decode(
+            self.params, self.k_pages, self.v_pages, cur,
+            jnp.asarray(mask), jnp.asarray(vals),
+            jnp.asarray(self.table.copy()),
+            jnp.asarray(self.seq_lens.copy()))
+        # snapshot of (slot, request) pairs active at dispatch; how many
+        # tokens to keep is decided at harvest (the previous quantum's
+        # tokens land in out_tokens AFTER this dispatch, so a count taken
+        # here would overcount by up to one quantum)
+        snap = [(s, self.slots[s]) for s in active]
+        self._inflight = (toks, snap)
+        self._cur_tok_dev = last
+        for s in active:
+            self.seq_lens[s] += K
         self.stats["decode_steps"] += K
         self.stats["decode_slot_tokens"] += K * self.B
-        for s in active:
-            req = self.slots[s]
+
+    def _harvest(self, inflight) -> None:
+        """Fetch a completed quantum's tokens (the only host sync of the
+        decode path) and apply them; release pages freed one cycle ago —
+        no in-flight program can reference them anymore."""
+        toks_dev, snap = inflight
+        toks = np.asarray(toks_dev)                  # [K, B]
+        if self._inflight is not None and self._inflight[0] is toks_dev:
+            self._inflight = None
+        K = self.decode_quantum
+        self.pool.release(self._deferred_free)
+        self._deferred_free = []
+        for s, req in snap:
             take = min(K, req.max_new_tokens - len(req.out_tokens))
+            if take <= 0:
+                # defensive: with a single in-flight quantum, predictive
+                # release fires before a request could reach here fully
+                # served; kept for a future multi-deep pipeline
+                continue
             self.stats["decode_active_tokens"] += take
             req.out_tokens.extend(int(t) for t in toks[:take, s])
-            self.seq_lens[s] += K
-            self.cur_tok[s] = int(toks[-1, s])
-            self._finish_if_done(s)
-        return True
+            if self.slots[s] is req:
+                # still slot-resident: remaining exceeded one quantum
+                # (else predictive release would have freed the slot);
+                # _finish_if_done is defensive for the same reason
+                self.cur_tok[s] = int(toks[-1, s])
+                self._finish_if_done(s, defer_free=True)
+            elif len(req.out_tokens) >= req.max_new_tokens \
+                    and req.t_done is None:
+                # predictively released at dispatch: the slot may already
+                # belong to a newer request; only the completion time
+                # remains to record
+                req.t_done = time.monotonic()
 
     def run(self, requests: list[Request]) -> dict:
         """Drive all requests to completion against wall-clock arrivals;
@@ -333,9 +451,11 @@ class ServingEngine:
             self.submit(r)
         self.stats = {k: 0 for k in self.stats}   # per-run counters
         t0 = time.monotonic()
-        while any(s is not None for s in self.slots) or self.queue:
+        while (any(s is not None for s in self.slots) or self.queue
+               or self._inflight is not None):
             self.step(now=time.monotonic() - t0)
-            if not any(s is not None for s in self.slots) and self.queue:
+            if not any(s is not None for s in self.slots) \
+                    and self._inflight is None and self.queue:
                 # nothing active and next arrival is in the future (or
                 # admission is transiently pool-blocked): sleep, don't
                 # busy-spin — floor keeps the pool-blocked case off 100%

@@ -72,6 +72,36 @@ def test_serving_admission_respects_memory():
     assert len(engine.pool.free) == 6
 
 
+def test_serving_pipelined_page_recycling_exact():
+    """Round-5 pipelined scheduler hazards, pinned by exact-token
+    equality: a finish is discovered one quantum late (junk ticks must
+    not leak), freed pages sit in _deferred_free for one harvest (a page
+    must never reach a new request while an in-flight program can still
+    write it), and admissions join mid-flight via the patched token
+    vector. Small quantum + tight pool + staggered arrivals force all
+    three paths many times over."""
+    rng = np.random.RandomState(7)
+    engine = ServingEngine(CFG, max_batch=3, page_size=16, max_seq=128,
+                           n_pages=1 + 10,          # ~2.5 requests' worth
+                           prefill_buckets=(16, 32, 64),
+                           decode_quantum=2)
+    prompts = [rng.randint(1, 512, size=n).astype(np.int32)
+               for n in (9, 16, 23, 31, 12, 20, 7, 28)]
+    max_new = 11                  # not a multiple of the quantum
+    reqs = [Request(rid=i, prompt=p, max_new_tokens=max_new,
+                    arrival=0.03 * i)
+            for i, p in enumerate(prompts)]
+    stats = engine.run(reqs)
+
+    assert stats["total_new_tokens"] == len(prompts) * max_new
+    want = _isolated_reference(engine, prompts, max_new)
+    for r, w in zip(reqs, want):
+        assert r.out_tokens == w, (r.rid, r.out_tokens, w)
+    assert len(engine.pool.free) == 10       # deferred frees all drained
+    assert engine._deferred_free == []
+    assert engine._inflight is None
+
+
 def test_serving_rejects_oversized():
     engine = ServingEngine(CFG, max_batch=1, page_size=16, max_seq=64,
                            prefill_buckets=(16, 32, 64))
