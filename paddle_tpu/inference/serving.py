@@ -117,9 +117,13 @@ class ServingEngine:
             functools.partial(self._decode_n_impl, n=self.decode_quantum),
             donate_argnums=(1, 2))
         # decode pipelining state (see step() docstring)
-        self._inflight = None              # (toks_dev [K, B], snapshot)
+        self._inflight = None              # (toks_dev [K+1, B], snapshot)
         self._cur_tok_dev = None           # device-chained token vector
-        self._cur_patches: dict = {}       # slot -> first token (admits)
+        # _pending_first: slots whose prefill first token rides the next
+        # quantum's output row 0; _deferred_free: page ids held for one
+        # harvest cycle (an in-flight program may still write them)
+        self._cur_patches: dict = {}       # slot -> first-token dev scalar
+        self._pending_first: set = set()
         self._deferred_free: list[int] = []
         self.stats = {"decode_steps": 0, "prefills": 0,
                       "decode_slot_tokens": 0, "decode_active_tokens": 0}
@@ -162,7 +166,11 @@ class ServingEngine:
         x = rms_norm(x, params["final_norm"], cfg.rms_eps)
         last = lax.dynamic_slice_in_dim(x, n_valid - 1, 1, axis=1)
         logits = _mm(last, params["head"], cfg).astype(jnp.float32)
-        return logits[:, 0], ks, vs
+        # greedy first token computed IN-program: the scheduler never
+        # fetches prefill results (async admission — the token reaches
+        # the host as row 0 of the next quantum's output)
+        first = jnp.argmax(logits[:, 0], -1).astype(jnp.int32)[0]
+        return first, ks, vs
 
     def _decode_n_impl(self, params, k_pages, v_pages, tokens, patch_mask,
                        patch_vals, table, seq_lens, *, n):
@@ -174,7 +182,10 @@ class ServingEngine:
         scheduler issues zero per-dispatch eager ops (each distinct
         eager-op shape costs a fresh remote compile over the tunnel —
         measured up to 12 s of compile stalls per serving run).
-        Returns (toks [n, B], last_tok [B], k_pages, v_pages)."""
+        Returns (toks_all [n+1, B], last_tok [B], k_pages, v_pages):
+        row 0 of toks_all is the PATCHED input vector — for slots
+        admitted since the previous quantum that row carries the prefill
+        first token, so async admission needs no separate fetch."""
         tokens = jnp.where(patch_mask, patch_vals, tokens)
 
         def tick(carry, _):
@@ -186,7 +197,8 @@ class ServingEngine:
 
         (k_pages, v_pages, last, _), toks = lax.scan(
             tick, (k_pages, v_pages, tokens, seq_lens), None, length=n)
-        return toks, last, k_pages, v_pages
+        return (jnp.concatenate([tokens[None], toks], axis=0), last,
+                k_pages, v_pages)
 
     def _decode_impl(self, params, k_pages, v_pages, tokens, table,
                      seq_lens):
@@ -288,18 +300,17 @@ class ServingEngine:
             toks[0, :T] = req.prompt
             prefill_pages = jnp.asarray(
                 row[:(bucket + self.bs - 1) // self.bs])
-            logits, self.k_pages, self.v_pages = self._get_prefill(bucket)(
+            first, self.k_pages, self.v_pages = self._get_prefill(bucket)(
                 self.params, self.k_pages, self.v_pages,
                 jnp.asarray(toks), prefill_pages,
                 jnp.asarray(T, jnp.int32))
-            first = int(jnp.argmax(logits[0]))
-            req.out_tokens.append(first)
-            req.t_first = time.monotonic()
+            # fully async: `first` stays a device scalar — it patches the
+            # next quantum's token feed in-program and reaches the host
+            # as row 0 of that quantum's output at harvest
             self.seq_lens[slot] = T
-            self.cur_tok[slot] = first
             self._cur_patches[slot] = first
+            self._pending_first.add(slot)
             self.stats["prefills"] += 1
-            self._finish_if_done(slot)
 
     def _finish_if_done(self, slot: int, defer_free: bool = False) -> None:
         req = self.slots[slot]
@@ -358,9 +369,10 @@ class ServingEngine:
         # it one quantum earlier); its tokens still land via the
         # snapshot, its pages wait in _deferred_free
         if self._inflight is not None:
-            for s, req in self._inflight[1]:
+            for s, req, had_first in self._inflight[1]:
                 if (self.slots[s] is req and req.max_new_tokens
-                        - len(req.out_tokens) <= self.decode_quantum):
+                        - len(req.out_tokens) - (1 if had_first else 0)
+                        <= self.decode_quantum):
                     self._deferred_free.extend(self._slot_pages[s])
                     self._slot_pages[s] = []
                     self.table[s] = 0
@@ -379,14 +391,17 @@ class ServingEngine:
         if not active:
             return
         cur = self._cur_tok_dev
-        mask = np.zeros((self.B,), bool)
-        vals = np.zeros((self.B,), np.int32)
         if cur is None:
             cur = jnp.asarray(self.cur_tok.copy())
-        else:
-            for s, tok in self._cur_patches.items():
-                mask[s] = True
-                vals[s] = tok
+        mask = np.zeros((self.B,), bool)
+        for s in self._cur_patches:
+            mask[s] = True
+        vals = jnp.asarray(np.zeros((self.B,), np.int32))
+        for s, tok in self._cur_patches.items():
+            # tok is a DEVICE scalar from the async prefill; static-index
+            # scatter keeps every eager-op shape fixed (each distinct
+            # shape costs a remote compile over the tunnel)
+            vals = vals.at[s].set(tok)
         self._cur_patches = {}
         K = self.decode_quantum
         # .copy(): jnp.asarray can ALIAS a numpy buffer (zero-copy on the
@@ -399,11 +414,13 @@ class ServingEngine:
             jnp.asarray(mask), jnp.asarray(vals),
             jnp.asarray(self.table.copy()),
             jnp.asarray(self.seq_lens.copy()))
-        # snapshot of (slot, request) pairs active at dispatch; how many
-        # tokens to keep is decided at harvest (the previous quantum's
-        # tokens land in out_tokens AFTER this dispatch, so a count taken
-        # here would overcount by up to one quantum)
-        snap = [(s, self.slots[s]) for s in active]
+        # snapshot of (slot, request, carries-first-token) active at
+        # dispatch; how many tokens to keep is decided at harvest (the
+        # previous quantum's tokens land in out_tokens AFTER this
+        # dispatch, so a count taken here would overcount by a quantum)
+        snap = [(s, self.slots[s], s in self._pending_first)
+                for s in active]
+        self._pending_first.clear()
         self._inflight = (toks, snap)
         self._cur_tok_dev = last
         for s in active:
@@ -416,21 +433,25 @@ class ServingEngine:
         decode path) and apply them; release pages freed one cycle ago —
         no in-flight program can reference them anymore."""
         toks_dev, snap = inflight
-        toks = np.asarray(toks_dev)                  # [K, B]
+        toks_all = np.asarray(toks_dev)              # [K+1, B]: row 0 =
+        first_row, toks = toks_all[0], toks_all[1:]  # patched inputs
         if self._inflight is not None and self._inflight[0] is toks_dev:
             self._inflight = None
         K = self.decode_quantum
         self.pool.release(self._deferred_free)
         self._deferred_free = []
-        for s, req in snap:
+        now = time.monotonic()
+        for s, req, had_first in snap:
+            if had_first:
+                # async admission: the prefill's first token arrives here
+                # as the quantum's (patched) input row — first host
+                # observation, so TTFT is recorded now
+                req.out_tokens.append(int(first_row[s]))
+                req.t_first = now
             take = min(K, req.max_new_tokens - len(req.out_tokens))
-            if take <= 0:
-                # defensive: with a single in-flight quantum, predictive
-                # release fires before a request could reach here fully
-                # served; kept for a future multi-deep pipeline
-                continue
-            self.stats["decode_active_tokens"] += take
-            req.out_tokens.extend(int(t) for t in toks[:take, s])
+            if take > 0:
+                self.stats["decode_active_tokens"] += take
+                req.out_tokens.extend(int(t) for t in toks[:take, s])
             if self.slots[s] is req:
                 # still slot-resident: remaining exceeded one quantum
                 # (else predictive release would have freed the slot);
@@ -442,7 +463,7 @@ class ServingEngine:
                 # predictively released at dispatch: the slot may already
                 # belong to a newer request; only the completion time
                 # remains to record
-                req.t_done = time.monotonic()
+                req.t_done = now
 
     def run(self, requests: list[Request]) -> dict:
         """Drive all requests to completion against wall-clock arrivals;
