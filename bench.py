@@ -292,38 +292,48 @@ def _bench_loss_curve():
 
 def _bench_long_ctx():
     """Long context at d=128 (VERDICT r3 item 5): GPT-3 1.3B full AdamW
-    step at S=4096 — the d=64 VPU-softmax floor does not apply at this
-    head size; target >= 0.45 MFU."""
+    step at S=4096 AND S=8192 (keys gpt3_1p3b_s{4096,8192}_*) — the
+    d=64 VPU-softmax floor does not apply at this head size; target
+    >= 0.45 MFU. S=8192 requires remat="full" (save only flash
+    outputs): the dots-saveable policy's ~7 G of projection outputs
+    HBM-OOMs one v5e at that length."""
     import dataclasses
 
     from paddle_tpu.models.gpt import gpt_presets
     from paddle_tpu.parallel import make_sharded_train_step
     from paddle_tpu.distributed.process_mesh import build_mesh
 
-    cfg = dataclasses.replace(gpt_presets("gpt3-1.3b"), seq_len=4096,
-                              unroll=True, remat=True)
-    batch, steps = 1, 8
+    out = {}
     mesh = build_mesh((1, 1, 1), ("dp", "pp", "mp"))
-    step, params, opt_state = make_sharded_train_step(
-        cfg, mesh, lr=1e-4, zero1=False, m_dtype="bfloat16",
-        v_dtype="bfloat16", weights="sr-bf16")
     rng = np.random.RandomState(0)
-    toks = step.put_batch(rng.randint(0, cfg.vocab_size,
-                                      size=(batch, cfg.seq_len)))
-    labs = step.put_batch(rng.randint(0, cfg.vocab_size,
-                                      size=(batch, cfg.seq_len)))
-    for _ in range(3):
-        loss, params, opt_state = step(params, opt_state, toks, labs)
-    float(loss)
-    dt, win, _loss, params, opt_state = _min_windows(
-        step, params, opt_state, toks, labs, steps)
-    tok_s = batch * cfg.seq_len * win / dt
-    return {
-        "gpt3_1p3b_s4096_tokens_per_sec_per_chip": round(tok_s, 1),
-        "gpt3_1p3b_s4096_mfu": round(
-            _flops_per_token(cfg) * tok_s / _peak_flops(), 4),
-        "gpt3_1p3b_s4096_step_ms": round(dt / win * 1000, 2),
-    }
+    for S in (4096, 8192):
+        # S=8192 needs the deepest remat: the dots-saveable policy keeps
+        # ~7 G of projection outputs at this length (measured HBM OOM)
+        cfg = dataclasses.replace(gpt_presets("gpt3-1.3b"), seq_len=S,
+                                  unroll=True,
+                                  remat=True if S <= 4096 else "full")
+        batch, steps = 1, 8 if S == 4096 else 5
+        step, params, opt_state = make_sharded_train_step(
+            cfg, mesh, lr=1e-4, zero1=False, m_dtype="bfloat16",
+            v_dtype="bfloat16", weights="sr-bf16")
+        toks = step.put_batch(rng.randint(0, cfg.vocab_size,
+                                          size=(batch, cfg.seq_len)))
+        labs = step.put_batch(rng.randint(0, cfg.vocab_size,
+                                          size=(batch, cfg.seq_len)))
+        for _ in range(3):
+            loss, params, opt_state = step(params, opt_state, toks, labs)
+        float(loss)
+        dt, win, _loss, params, opt_state = _min_windows(
+            step, params, opt_state, toks, labs, steps)
+        tok_s = batch * cfg.seq_len * win / dt
+        out.update({
+            f"gpt3_1p3b_s{S}_tokens_per_sec_per_chip": round(tok_s, 1),
+            f"gpt3_1p3b_s{S}_mfu": round(
+                _flops_per_token(cfg) * tok_s / _peak_flops(), 4),
+            f"gpt3_1p3b_s{S}_step_ms": round(dt / win * 1000, 2),
+        })
+        del step, params, opt_state, toks, labs
+    return out
 
 
 def _bench_13b():

@@ -57,7 +57,9 @@ class GPTConfig:
     param_dtype: Any = jnp.float32     # master params
     tie_embeddings: bool = True
     use_flash: bool = True
-    remat: bool = True
+    # False | True (save dots + flash outputs) | "full" (save flash
+    # outputs only — long-context memory mode)
+    remat: bool | str = True
     # Unroll the layer loop instead of lax.scan: straight-line XLA code has
     # no dynamic-update-slice stacking of saves/grads and schedules ~10%
     # faster on v5e; costs compile time linear in depth (use for the
@@ -70,6 +72,13 @@ class GPTConfig:
     # reference, which has no context-parallel attention).
     ring_axis: Optional[str] = None
     eps: float = 1e-5
+
+    def __post_init__(self):
+        if self.remat not in (False, True, "full"):
+            raise ValueError(
+                f"remat must be False, True, or 'full'; got "
+                f"{self.remat!r} (a truthy unknown string would silently "
+                f"take the dots-saveable policy)")
 
     @property
     def head_dim(self) -> int:
@@ -290,14 +299,25 @@ def model_apply(params: dict, tokens, cfg: GPTConfig, sp_constraint=None,
         fn = functools.partial(block_apply, cfg=cfg,
                                sp_constraint=sp_constraint)
         if cfg.remat:
-            # save matmul outputs AND the flash-attention outputs (named in
-            # ops/pallas/flash_attention.py — pallas calls are not dots, so
-            # without the names the whole flash forward would run again in
-            # backward); recompute elementwise only.
-            pol = jax.checkpoint_policies.save_from_both_policies(
-                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-                jax.checkpoint_policies.save_only_these_names(
-                    "flash_o", "flash_lse"))
+            if cfg.remat == "full":
+                # deepest mode: save ONLY the flash outputs (recomputing
+                # flash in backward would double the most expensive
+                # kernel); every matmul recomputes. The dots-saveable
+                # policy below keeps ~300 MB/layer of projection outputs
+                # at 1.3B/S=8192 (~7 G total — measured HBM OOM on one
+                # v5e); this mode keeps ~35 MB/layer and fits.
+                pol = jax.checkpoint_policies.save_only_these_names(
+                    "flash_o", "flash_lse")
+            else:
+                # save matmul outputs AND the flash-attention outputs
+                # (named in ops/pallas/flash_attention.py — pallas calls
+                # are not dots, so without the names the whole flash
+                # forward would run again in backward); recompute
+                # elementwise only.
+                pol = jax.checkpoint_policies.save_from_both_policies(
+                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                    jax.checkpoint_policies.save_only_these_names(
+                        "flash_o", "flash_lse"))
             fn = jax.checkpoint(fn, policy=pol)
 
         if cfg.unroll:

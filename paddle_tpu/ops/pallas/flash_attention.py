@@ -409,12 +409,16 @@ def _flash_bwd_fused_kernel_native(qkv_qblk_ref, qkv_kfull_ref,
 
 def _fused_dqkv_ok(s: int, hd: int, itemsize: int = 2) -> bool:
     """Merged-kernel gate: one program holds FOUR full-sequence slabs
-    (k, v, q, do at [s, hp*d]) plus blocks and fp32 accumulators; cap
-    the slab set at 8 MB of the ~16 MB v5e VMEM. Larger configs take
-    the split two-kernel path (2 slabs each)."""
+    (k, v, q, do at [s, hp*d]) plus blocks, lse/delta rows, and fp32
+    accumulators; cap the slab set at 6 MB of the ~16 MB v5e VMEM.
+    Measured: a 4 MB slab set (1.3B, S=4096, d=128) compiles and runs;
+    an 8 MB slab set (S=8192, d=128) hits Mosaic's scoped-vmem limit at
+    18 MB total — the non-slab overhead is ~10 MB at that scale, so the
+    8 MB cap round 5 started with was too permissive. Larger configs
+    take the split two-kernel path (2 slabs each)."""
     bq, bk = _block_sizes(s)
     return bq == bk and bq >= _MIN_BLOCK \
-        and 4 * s * hd * itemsize <= 8 * 2 ** 20
+        and 4 * s * hd * itemsize <= 6 * 2 ** 20
 
 
 # ---------------------------------------------------------------------------
