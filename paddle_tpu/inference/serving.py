@@ -47,10 +47,53 @@ class Request:
     prompt: np.ndarray                 # [T] int32
     max_new_tokens: int
     arrival: float = 0.0               # seconds from engine start
+    # sampling (reference serving path: phi top_p_sampling fused kernel).
+    # temperature == 0 -> greedy; mixed greedy/sampled batches share ONE
+    # compiled program (per-slot params are data, not shape)
+    temperature: float = 0.0
+    top_p: float = 1.0
+    seed: int = 0
     # filled by the engine:
     out_tokens: list = dataclasses.field(default_factory=list)
     t_first: Optional[float] = None    # first-token wall time
     t_done: Optional[float] = None
+
+
+def _pick_tokens(logits, temps, topps, seeds, positions):
+    """Next-token selection for a batch of slots, IN-program.
+
+    temperature 0 -> greedy argmax; >0 -> top-p (nucleus) sampling at
+    that temperature (the reference serving path's fused top_p_sampling
+    kernel, phi/kernels/fusion/gpu/top_p_sampling.cu role). Greedy-only
+    batches skip the sort entirely through lax.cond — sampling params
+    are per-slot DATA, so mixed batches share one compiled program.
+    Randomness is keyed (seed, position-of-input-token): a request's
+    sample stream is reproducible and independent of quantum boundaries.
+    logits [B, V] fp32; temps/topps [B] fp32; seeds/positions [B] int32.
+    """
+
+    def greedy(_):
+        return jnp.argmax(logits, -1).astype(jnp.int32)
+
+    def sampled(_):
+        from ..ops.nucleus import nucleus_keep
+
+        lt = logits / jnp.maximum(temps, 1e-6)[:, None]
+        srt = jnp.sort(lt, axis=-1)[:, ::-1]
+        p = jax.nn.softmax(srt, axis=-1)
+        keep = nucleus_keep(p, topps)              # always keeps >= 1
+        kth = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1)
+        masked = jnp.where(lt >= kth[:, None], lt, -jnp.inf)
+
+        def one(seed, pos, row):
+            k = jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+            return row + jax.random.gumbel(k, row.shape)
+
+        noisy = jax.vmap(one)(seeds, positions, masked)
+        samp = jnp.argmax(noisy, -1).astype(jnp.int32)
+        return jnp.where(temps > 0, samp, greedy(None))
+
+    return lax.cond(jnp.any(temps > 0), sampled, greedy, operand=None)
 
 
 class _PagePool:
@@ -100,6 +143,10 @@ class ServingEngine:
         self.table = np.zeros((self.B, self.max_blocks), np.int32)  # sink
         self.seq_lens = np.zeros((self.B,), np.int32)
         self.cur_tok = np.zeros((self.B,), np.int32)
+        # per-slot sampling params (temperature 0 = greedy; idle slots 0)
+        self.samp_temp = np.zeros((self.B,), np.float32)
+        self.samp_topp = np.ones((self.B,), np.float32)
+        self.samp_seed = np.zeros((self.B,), np.int32)
         self.slots: list[Optional[Request]] = [None] * self.B
         self._slot_pages: list[list[int]] = [[] for _ in range(self.B)]
         self.pool = _PagePool(self.n_pages)
@@ -131,7 +178,7 @@ class ServingEngine:
     # -- compiled programs --------------------------------------------------
 
     def _prefill_impl(self, params, k_pages, v_pages, tokens, pages,
-                      n_valid):
+                      n_valid, temp, topp, seed):
         """One request's prompt (padded to a bucket) through the shared
         block_apply, k/v written straight into its pages; returns the
         last REAL token's logits. tokens [1, Tb]; pages [Tb//bs]."""
@@ -166,16 +213,21 @@ class ServingEngine:
         x = rms_norm(x, params["final_norm"], cfg.rms_eps)
         last = lax.dynamic_slice_in_dim(x, n_valid - 1, 1, axis=1)
         logits = _mm(last, params["head"], cfg).astype(jnp.float32)
-        # greedy first token computed IN-program: the scheduler never
-        # fetches prefill results (async admission — the token reaches
-        # the host as row 0 of the next quantum's output)
-        first = jnp.argmax(logits[:, 0], -1).astype(jnp.int32)[0]
+        # first token selected IN-program (greedy or sampled per the
+        # request): the scheduler never fetches prefill results (async
+        # admission — the token reaches the host as row 0 of the next
+        # quantum's output). Randomness keys on the LAST PROMPT position
+        # (n_valid - 1), matching the decode ticks' input-position keying.
+        first = _pick_tokens(logits[:, 0], temp[None], topp[None],
+                             seed[None], (n_valid - 1)[None])[0]
         return first, ks, vs
 
     def _decode_n_impl(self, params, k_pages, v_pages, tokens, patch_mask,
-                       patch_vals, table, seq_lens, *, n):
-        """``n`` greedy decode ticks in ONE program: scan over the
-        single-tick body, feeding each tick's argmax to the next.
+                       patch_vals, table, seq_lens, temps, topps, seeds,
+                       *, n):
+        """``n`` decode ticks in ONE program: scan over the single-tick
+        body, feeding each tick's selected token (greedy argmax or
+        per-slot top-p sample — _pick_tokens) to the next.
         ``tokens`` chains on-device from the previous quantum's output;
         ``patch_mask``/``patch_vals`` ([B] bool/int32) overlay the first
         tokens of slots admitted since — IN-program, so the pipelined
@@ -192,7 +244,7 @@ class ServingEngine:
             kp, vp, tok, sl = carry
             logits, kp, vp = self._decode_impl(params, kp, vp, tok, table,
                                                sl)
-            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            nxt = _pick_tokens(logits, temps, topps, seeds, sl)
             return (kp, vp, nxt, sl + 1), nxt
 
         (k_pages, v_pages, last, _), toks = lax.scan(
@@ -300,10 +352,16 @@ class ServingEngine:
             toks[0, :T] = req.prompt
             prefill_pages = jnp.asarray(
                 row[:(bucket + self.bs - 1) // self.bs])
+            self.samp_temp[slot] = req.temperature
+            self.samp_topp[slot] = req.top_p
+            self.samp_seed[slot] = req.seed
             first, self.k_pages, self.v_pages = self._get_prefill(bucket)(
                 self.params, self.k_pages, self.v_pages,
                 jnp.asarray(toks), prefill_pages,
-                jnp.asarray(T, jnp.int32))
+                jnp.asarray(T, jnp.int32),
+                jnp.asarray(req.temperature, jnp.float32),
+                jnp.asarray(req.top_p, jnp.float32),
+                jnp.asarray(req.seed, jnp.int32))
             # fully async: `first` stays a device scalar — it patches the
             # next quantum's token feed in-program and reaches the host
             # as row 0 of that quantum's output at harvest
@@ -326,6 +384,7 @@ class ServingEngine:
             self.table[slot] = 0           # sink
             self.seq_lens[slot] = 0
             self.cur_tok[slot] = 0
+            self.samp_temp[slot] = 0.0     # idle slots decode greedily
             self.slots[slot] = None
 
     def step(self, now: Optional[float] = None) -> bool:
@@ -377,6 +436,7 @@ class ServingEngine:
                     self._slot_pages[s] = []
                     self.table[s] = 0
                     self.seq_lens[s] = 0
+                    self.samp_temp[s] = 0.0
                     self.slots[s] = None
         return (self._inflight is not None or bool(self.queue)
                 or any(s is not None for s in self.slots))
@@ -413,7 +473,10 @@ class ServingEngine:
             self.params, self.k_pages, self.v_pages, cur,
             jnp.asarray(mask), jnp.asarray(vals),
             jnp.asarray(self.table.copy()),
-            jnp.asarray(self.seq_lens.copy()))
+            jnp.asarray(self.seq_lens.copy()),
+            jnp.asarray(self.samp_temp.copy()),
+            jnp.asarray(self.samp_topp.copy()),
+            jnp.asarray(self.samp_seed.copy()))
         # snapshot of (slot, request, carries-first-token) active at
         # dispatch; how many tokens to keep is decided at harvest (the
         # previous quantum's tokens land in out_tokens AFTER this

@@ -392,11 +392,15 @@ class LlamaForCausalLM:
             return jnp.argmax(logits, -1)
         logits = logits / jnp.maximum(jnp.asarray(temperature, jnp.float32),
                                       1e-6)
+        from ..ops.nucleus import nucleus_keep
+
         sorted_logits = jnp.sort(logits, -1)[..., ::-1]
         probs = jax.nn.softmax(sorted_logits, -1)
-        cum = jnp.cumsum(probs, -1)
-        cutoff_idx = jnp.sum(cum < top_p, -1, keepdims=True)
-        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, -1)
+        # shared boundary rule (ops/nucleus.py); cutoff = smallest kept
+        # sorted logit
+        keep = nucleus_keep(probs, jnp.asarray(top_p, jnp.float32))
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), -1,
+                         keepdims=True)
         logits = jnp.where(logits < cutoff, -1e30, logits)
         return jax.random.categorical(key, logits, -1)
 

@@ -477,11 +477,13 @@ def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
 
     @op("top_p_sampling")
     def _impl(x, ps, key):
+        from .nucleus import nucleus_keep
+
         sorted_p = jnp.sort(x, axis=-1)[:, ::-1]
         sorted_i = jnp.argsort(-x, axis=-1)
-        cum = jnp.cumsum(sorted_p, axis=-1)
-        # keep tokens strictly before the cumulative threshold, always >= 1
-        keep = cum - sorted_p < ps[:, None]
+        # minimal prefix reaching the cumulative threshold, always >= 1
+        # (shared boundary rule — ops/nucleus.py)
+        keep = nucleus_keep(sorted_p, ps)
         if threshold is not None:
             # minimum-probability filter (reference top_p_sampling
             # `threshold` input); the top token always stays

@@ -1,0 +1,29 @@
+"""Shared nucleus (top-p) keep rule.
+
+ONE definition of the top-p boundary, used by the three samplers — the
+``top_p_sampling`` op (ops/extra.py, the reference phi fused-kernel
+API), the compiled generate loop (models/llama.py), and the serving
+engine's in-program sampler (inference/serving.py) — so a boundary/tie
+fix cannot silently leave one path with different semantics.
+
+Rule (reference top_p_sampling contract): over DESCENDING-sorted
+probabilities, keep the minimal prefix whose cumulative mass reaches
+``top_p``; the crossing element is included and at least one token is
+always kept (``cum - p < top_p`` == "cumulative mass BEFORE this
+element is still under the threshold").
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["nucleus_keep"]
+
+
+def nucleus_keep(sorted_probs, top_p):
+    """Keep mask over descending-sorted probabilities.
+
+    sorted_probs: [..., V] descending; top_p: broadcastable to [...]
+    (scalar or per-row). Returns bool [..., V]."""
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    return cum - sorted_probs < jnp.asarray(top_p)[..., None]

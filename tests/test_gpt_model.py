@@ -18,6 +18,34 @@ CFG = GPTConfig(vocab_size=128, hidden=32, n_layers=4, n_heads=4, seq_len=16,
                 dtype=jnp.float32, use_flash=False, remat=False)
 
 
+def test_remat_modes_match_no_remat():
+    """remat=True (dots+flash saved) and remat="full" (flash only — the
+    long-context memory mode) must compute the same loss AND gradients
+    as the unrematerialized step; an unknown mode string must raise
+    rather than silently pick a policy."""
+    import dataclasses
+
+    params = init_params(CFG, jax.random.PRNGKey(1))
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 128, (2, 16)))
+    labs = jnp.asarray(rng.randint(0, 128, (2, 16)))
+
+    def lg(remat):
+        c = dataclasses.replace(CFG, remat=remat)
+        return jax.value_and_grad(lambda p: loss_fn(p, toks, labs, c))(
+            params)
+
+    loss0, g0 = jax.jit(lambda: lg(False))()
+    for mode in (True, "full"):
+        loss1, g1 = jax.jit(lambda mode=mode: lg(mode))()
+        np.testing.assert_allclose(float(loss0), float(loss1), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-5, atol=2e-6)
+    with pytest.raises(ValueError):
+        dataclasses.replace(CFG, remat="Full")
+
+
 def test_functional_forward_shapes():
     params = init_params(CFG, jax.random.PRNGKey(0))
     toks = jnp.zeros((2, 16), jnp.int32)

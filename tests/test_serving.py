@@ -102,6 +102,45 @@ def test_serving_pipelined_page_recycling_exact():
     assert engine._inflight is None
 
 
+def test_serving_sampling_contract():
+    """Per-request sampling (reference fused top_p_sampling role):
+    mixed greedy/sampled batches share one program; a sampled request's
+    stream is (seed, position)-keyed — reproducible across runs and
+    quantum sizes; top_p -> 0 keeps only the max token (== greedy); a
+    greedy request's tokens are unaffected by sampled neighbours."""
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(1, 512, size=n).astype(np.int32)
+               for n in (9, 16, 23)]
+    max_new = 9
+
+    def run(specs, quantum):
+        engine = ServingEngine(CFG, max_batch=2, page_size=16, max_seq=128,
+                               prefill_buckets=(16, 32, 64),
+                               decode_quantum=quantum)
+        reqs = [Request(rid=i, prompt=p, max_new_tokens=max_new,
+                        arrival=0.0, **spec)
+                for i, (p, spec) in enumerate(zip(prompts, specs))]
+        engine.run(reqs)
+        return [r.out_tokens for r in reqs], engine
+
+    greedy_specs = [{}, {}, {}]
+    base, engine = run(greedy_specs, 4)
+    want = _isolated_reference(engine, prompts, max_new)
+    assert base == [list(map(int, w)) for w in want]
+
+    mixed = [{"temperature": 0.9, "top_p": 0.8, "seed": 11}, {}, {}]
+    out1, _ = run(mixed, 4)
+    out2, _ = run(mixed, 3)          # different quantum boundaries
+    assert out1[0] == out2[0], "sampled stream must not depend on quantum"
+    assert out1[1] == base[1] and out1[2] == base[2], \
+        "greedy neighbours must be unaffected by a sampled request"
+    assert out1[0] != base[0], "hot sampling should diverge from greedy"
+
+    top1 = [{"temperature": 0.9, "top_p": 1e-6, "seed": 11}, {}, {}]
+    out3, _ = run(top1, 4)
+    assert out3[0] == base[0], "top_p -> 0 must reduce to greedy"
+
+
 def test_serving_rejects_oversized():
     engine = ServingEngine(CFG, max_batch=1, page_size=16, max_seq=64,
                            prefill_buckets=(16, 32, 64))
