@@ -114,13 +114,22 @@ _t = paddle.to_tensor
 SPECS: dict = {}
 
 
+def _stable_seed(name: str) -> int:
+    # NOT hash(): python randomizes str hashes per process, which made
+    # per-op input draws nondeterministic across runs — an op could pass
+    # for months then fail on an unlucky draw (observed: i0e)
+    import zlib
+
+    return zlib.crc32(name.encode()) % 1000
+
+
 def spec(name, fn, inputs, **opts):
     SPECS[name] = (fn, inputs, opts)
 
 
 def unary(names, gen, **kw):
     for n in names.split():
-        spec(n, C(n), [gen(2, 3, seed=abs(hash(n)) % 1000)], **kw)
+        spec(n, C(n), [gen(2, 3, seed=_stable_seed(n))], **kw)
 
 
 # smooth-anywhere unaries
@@ -778,7 +787,7 @@ _rnn_layers = {
 }
 for _name, _layer in _rnn_layers.items():
     spec(_name, functools.partial(lambda l, x: l(x), _layer),
-         [U(2, 3, 8, seed=abs(hash(_name)) % 1000)])
+         [U(2, 3, 8, seed=_stable_seed(_name))])
 _rnn_cells = {
     "rnn_cell_lstm": _pnn.LSTMCell(8, 8),
     "rnn_cell_gru": _pnn.GRUCell(8, 8),
@@ -787,7 +796,7 @@ _rnn_cells = {
 }
 for _name, _cell in _rnn_cells.items():
     spec(_name, functools.partial(lambda l, x: l(x), _cell),
-         [U(2, 8, seed=abs(hash(_name)) % 1000)])
+         [U(2, 8, seed=_stable_seed(_name))])
 
 spec("pairwise_distance",
      lambda x, y: _pnn.PairwiseDistance()(x, y),
