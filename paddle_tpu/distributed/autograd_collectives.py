@@ -21,10 +21,26 @@ from ..core.tensor import Tensor
 __all__ = ["reshard_op", "scatter_axis", "gather_axis"]
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=256)
+def _jit_reshard(sharding):
+    return jax.jit(lambda x: x, out_shardings=sharding)
+
+
 @op("reshard", amp="none")
 def _reshard(x, *, sharding):
-    # Works eagerly (resharding copy over ICI) and under trace (lowered to a
-    # sharding constraint); linear, so jax.vjp gives the reverse reshard.
+    # Multi-PROCESS: a jitted identity with out_shardings (XLA inserts
+    # the collective over the gloo/ICI backend; under an outer trace it
+    # nests as a sharding constraint) — device_put would need the
+    # cross-host DCN transfer server, which this jax version's CPU
+    # backend rejects (observed: eager pipeline stage-to-stage reshard
+    # under distributed.launch). Single-process keeps the cheaper
+    # device_put copy. Linear either way, so jax.vjp gives the reverse
+    # reshard.
+    if getattr(jax, "process_count", lambda: 1)() > 1:
+        return _jit_reshard(sharding)(x)
     return jax.device_put(x, sharding)
 
 

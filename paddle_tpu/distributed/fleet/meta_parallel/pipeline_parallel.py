@@ -25,6 +25,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ....core.tensor import Tensor
@@ -33,6 +34,174 @@ from ..meta_parallel.pp_layers import PipelineLayer
 
 __all__ = ["PipelineParallel", "PipelineParallelWithInterleave",
            "PipelineParallelZeroBubble"]
+
+
+_HOP_SEQ: dict = {}
+_HOP_EPOCH = [0]
+
+
+def _hop_epoch_advance():
+    """Called once per train_batch: namespaces the KV keys so sequence
+    state cannot collide across batches (a restarted rank rejoining the
+    SAME coordination service mid-run is still unsupported — its batch
+    counter restarts too; elastic restart flows go through the
+    checkpoint/relaunch path, not this eager runtime)."""
+    _HOP_EPOCH[0] += 1
+    _HOP_SEQ.clear()
+
+
+def _kv_key(stream: str) -> str:
+    n = _HOP_SEQ.get(stream, 0)
+    _HOP_SEQ[stream] = n + 1
+    return f"paddle_tpu/pp_hop/e{_HOP_EPOCH[0]}/{stream}/{n}"
+
+
+def _kv_send(key: str, arr):
+    """Self-describing payload: dtype NAME travels with the bytes
+    (np.save round-trips ml_dtypes bfloat16 as raw void '|V2' — the
+    dtype string via jnp.dtype restores it)."""
+    import base64
+    import json
+
+    import numpy as _np
+
+    from jax._src import distributed as _dist
+
+    arr = _np.asarray(arr)
+    hdr = json.dumps({"shape": list(arr.shape), "dtype": str(arr.dtype)})
+    payload = hdr.encode() + b"\0" + _np.ascontiguousarray(arr).tobytes()
+    _dist.global_state.client.key_value_set(
+        key, base64.b64encode(payload).decode())
+    return arr
+
+
+def _kv_recv(key: str, timeout_ms: int = 60_000):
+    import base64
+    import json
+
+    import numpy as _np
+
+    import jax.numpy as _jnp
+
+    from jax._src import distributed as _dist
+
+    client = _dist.global_state.client
+    raw = base64.b64decode(client.blocking_key_value_get(key, timeout_ms))
+    hdr, body = raw.split(b"\0", 1)
+    meta = json.loads(hdr.decode())
+    try:
+        client.key_value_delete(key)       # point-to-point: consumed once
+    except Exception:                      # noqa: BLE001 — best effort
+        pass
+    dt = _jnp.dtype(meta["dtype"])         # ml_dtypes-aware lookup
+    return _np.frombuffer(body, dtype=dt).reshape(meta["shape"])
+
+
+def _host_hop(t: Tensor, src_stage: int, dst_stage: int) -> Tensor:
+    """Differentiable point-to-point activation hop between the two
+    PROCESSES owning ``src_stage``/``dst_stage``, over the coordination
+    service KV store. Matching rule: per-(direction, stage-pair) stream
+    sequence numbers — identical program order per stream on both
+    endpoints (a single global sequence deadlocks when ranks' backward
+    orders interleave independent hops differently; observed). Ranks
+    that are neither endpoint pass the tensor through untouched — no
+    traffic, no tape node. Chosen over the alternatives measured to
+    fail on this backend: cross-host device_put needs a DCN transfer
+    server the CPU backend rejects, and broadcast_one_to_all's gloo
+    psum crashes rank>0 natively with stage-placed cross-process params
+    live. This class is the eager COMPAT runtime — the perf path is the
+    compiled pipeline."""
+    from ....autograd import PyLayer
+
+    me = jax.process_index()
+    if me not in (src_stage, dst_stage):
+        return t
+
+    class _Hop(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.src, ctx.dst = src_stage, dst_stage
+            xd = x._data if isinstance(x, Tensor) else x
+            ctx.in_shape = tuple(xd.shape)
+            ctx.in_dtype = xd.dtype
+            key = _kv_key(f"f{src_stage}-{dst_stage}")
+            if me == src_stage:
+                out = _kv_send(key, xd)
+            else:
+                out = _kv_recv(key)
+            return Tensor(out, stop_gradient=False)
+
+        @staticmethod
+        def backward(ctx, g):
+            import numpy as _np
+
+            key = _kv_key(f"b{ctx.src}-{ctx.dst}")
+            if me == ctx.dst:
+                _kv_send(key, g._data if isinstance(g, Tensor) else g)
+                # dst's local input chain upstream of the hop is dummy
+                return Tensor(_np.zeros(
+                    ctx.in_shape, _np.dtype(str(ctx.in_dtype))))
+            return Tensor(_kv_recv(key))
+
+    if isinstance(t, Tensor) and t.stop_gradient:
+        # the hop backward is a cross-rank RENDEZVOUS (the destination
+        # rank sends the cotangent the source rank's backward needs), so
+        # the node must be tape-recorded even when the local input chain
+        # carries no gradient — e.g. the first hop on a rank that
+        # skipped segment 0: its input is the stop_gradient microbatch
+        # (found as a 2-process deadlock: that rank never entered the
+        # hop's backward, starving the peer)
+        t = Tensor(t._data, stop_gradient=False)
+    return _Hop.apply(t)
+
+
+def _loss_input_bcast(t: Tensor, src_stage: int) -> Tensor:
+    """Broadcast the FINAL segment's output from its owner to every
+    rank, so loss_fn runs on the real activation everywhere (without
+    this, non-last ranks apply loss_fn to a stale pass-through x —
+    wrong loss, or a shape crash when the head changes shape).
+    Backward is local: every rank computed the same loss on the same
+    values, so the owner's local cotangent is already correct — no
+    communication; non-owners return shape-correct zeros."""
+    from ....autograd import PyLayer
+
+    me = jax.process_index()
+
+    class _Bcast(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            import numpy as _np
+
+            xd = x._data if isinstance(x, Tensor) else x
+            ctx.in_shape = tuple(xd.shape)
+            ctx.in_dtype = xd.dtype
+            key = _kv_key(f"loss-x{src_stage}")
+            if me == src_stage:
+                # one payload per receiving rank: keys are consumed
+                # (deleted) point-to-point
+                arr = None
+                for r in range(jax.process_count()):
+                    if r == src_stage:
+                        arr = _np.asarray(xd)
+                    else:
+                        _kv_send(f"{key}/to{r}", xd)
+                out = arr
+            else:
+                out = _kv_recv(f"{key}/to{me}")
+            return Tensor(out, stop_gradient=False)
+
+        @staticmethod
+        def backward(ctx, g):
+            import numpy as _np
+
+            if me == src_stage:
+                return g if isinstance(g, Tensor) else Tensor(g)
+            return Tensor(_np.zeros(ctx.in_shape,
+                                    _np.dtype(str(ctx.in_dtype))))
+
+    if isinstance(t, Tensor) and t.stop_gradient:
+        t = Tensor(t._data, stop_gradient=False)
+    return _Bcast.apply(t)
 
 
 class PipelineParallel:
@@ -49,9 +218,25 @@ class PipelineParallel:
         self.total_loss = None
 
     # -- helpers ------------------------------------------------------------
-    def _to_stage(self, t: Tensor, s: int) -> Tensor:
+    def _to_stage(self, t: Tensor, s: int, src: Optional[int] = None
+                  ) -> Tensor:
         """P2P hop: reshard activation onto stage s's submesh (the
-        translation of SendRecvMeta+isend/irecv, p2p_communication.py:51)."""
+        translation of SendRecvMeta+isend/irecv, p2p_communication.py:51).
+
+        Across PROCESS boundaries (one stage per process under
+        distributed.launch) the hop is a host-mediated broadcast from
+        the owning stage — this jax version's CPU backend has no
+        cross-host device_put, and eager per-process arrays cannot feed
+        a cross-process GSPMD computation. Differentiable via a PyLayer
+        whose backward broadcasts the cotangent the opposite way; the
+        schedule runs identically on every rank, so the collective
+        order matches."""
+        import jax as _jax
+
+        if _jax.process_count() > 1:
+            if src is None or src == s:
+                return t
+            return _host_hop(t, src_stage=src, dst_stage=s)
         mesh = self._layers.stage_mesh(s)
         if mesh is None:
             return t
@@ -68,15 +253,44 @@ class PipelineParallel:
                     entries[d] = kept if kept else None
         return reshard_op(t, mesh, P(*entries))
 
+    @property
+    def _proc_stage(self) -> Optional[int]:
+        """The stage whose submesh contains this PROCESS's device(s);
+        None on a single controller (every stage is local)."""
+        if "_proc_stage_c" not in self.__dict__:
+            own = None
+            if jax.process_count() > 1:
+                for s in range(self.num_stages):
+                    m = self._layers.stage_mesh(s)
+                    if m is not None and any(
+                            d.process_index == jax.process_index()
+                            for d in np.asarray(m.devices).flat):
+                        own = s
+                        break
+            self.__dict__["_proc_stage_c"] = own
+        return self.__dict__["_proc_stage_c"]
+
     def _forward_step(self, micro_input, labels=None):
         # segment walk covers both plain (V=1: segment g on stage g) and
         # interleaved VPP layouts (segment g on stage g % pp) — activations
-        # hop to the owning stage's submesh before each chunk
+        # hop to the owning stage's submesh before each chunk. Across
+        # processes, a rank computes ONLY its own segments (remote-placed
+        # params cannot be used eagerly); other segments pass x through
+        # and the next hop replaces it with the owner's real activation.
+        multi = jax.process_count() > 1
         x = micro_input
         for g in range(self._layers.num_segments):
-            x = self._to_stage(x, self._layers.segment_stage(g))
+            s = self._layers.segment_stage(g)
+            src = (self._layers.segment_stage(g - 1) if g > 0 else None)
+            x = self._to_stage(x, s, src=src)
+            if multi and s != self._proc_stage:
+                continue
             x = self._layers.forward_segment(x, g)
         if self._layers._loss_fn is not None and labels is not None:
+            if multi:
+                last = self._layers.segment_stage(
+                    self._layers.num_segments - 1)
+                x = _loss_input_bcast(x, last)
             return self._layers._loss_fn(x, labels)
         return x
 
@@ -114,6 +328,8 @@ class PipelineParallel:
         """1F1B (reference :565): warmup forwards, steady 1F1B, cooldown
         backwards. Host-side buffering mirrors the reference's input/output
         queues; backward of microbatch k frees its activations."""
+        if jax.process_count() > 1:
+            _hop_epoch_advance()
         inputs, labels = data if isinstance(data, tuple) and len(data) == 2 \
             else (data, None)
         micro_inputs = self._split_micro(inputs)
@@ -160,6 +376,8 @@ class PipelineParallel:
         return loss
 
     def eval_batch(self, data, compute_loss=True):
+        if jax.process_count() > 1:
+            _hop_epoch_advance()
         inputs, labels = data if isinstance(data, tuple) and len(data) == 2 \
             else (data, None)
         micro_inputs = self._split_micro(inputs)

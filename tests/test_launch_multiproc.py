@@ -219,7 +219,11 @@ def test_launch_2proc_interleaved_vpp_matches_serial(tmp_path):
             logs += f"--- rank {r}\n" + open(p).read()
     assert proc.returncode == 0, proc.stdout + proc.stderr + logs
     raw = re.findall(r"FINAL_LOSS ([\d.]+|nan|inf)", logs)
-    assert len(raw) >= 1, logs
+    # BOTH ranks must report the REAL loss (the final activation is
+    # broadcast from the last stage before loss_fn — without it a
+    # non-last rank computes loss on a stale pass-through activation)
+    assert len(raw) == 2, logs
+    assert raw[0] == raw[1], logs
     vpp = float(raw[-1])
 
     # numpy serial: same seeds/weights, 2-microbatch mean CE
