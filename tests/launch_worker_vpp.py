@@ -1,11 +1,12 @@
 """Worker for the multi-process INTERLEAVED pipeline (VPP) test.
 
-pp=2 across TWO processes, 2 virtual stages per rank (reference:
-test/collective/fleet hybrid_parallel_pp_interleave run under
-launch): each process owns model-order layers {rank, rank+2} — the
-interleave placement — and train_batch streams 2 microbatches through
-the 1F1B-with-virtual-stages schedule. Prints FINAL_LOSS for the test
-to compare with a numpy serial reference.
+pp = VPP_PP_DEGREE processes (default 2), 2 virtual stages per rank
+(reference: test/collective/fleet hybrid_parallel_pp_interleave run
+under launch): each process owns model-order layers {rank, rank+pp} —
+the interleave placement — and train_batch streams 2 microbatches
+through the 1F1B-with-virtual-stages schedule. Prints FINAL_LOSS for
+the test to compare with a numpy serial reference; pp>2 adds BYSTANDER
+ranks to every hop.
 """
 
 import os
@@ -34,13 +35,19 @@ from paddle_tpu.distributed.fleet.meta_parallel import (
     LayerDesc, PipelineLayer, PipelineParallelWithInterleave)
 from paddle_tpu.optimizer import SGD
 
+# PP degree is parameterized (default 2): pp>2 exercises BYSTANDER
+# ranks of the point-to-point hop (neither endpoint: no traffic, no
+# tape node, pass-through activation)
+PP = int(os.environ.get("VPP_PP_DEGREE", "2"))
+N_LAYERS = 2 * PP                      # 2 virtual stages per rank
+
 strat = fleet.DistributedStrategy()
-strat.hybrid_configs = {"dp_degree": 1, "pp_degree": 2}
+strat.hybrid_configs = {"dp_degree": 1, "pp_degree": PP}
 strat.pipeline_configs = {"accumulate_steps": 2, "micro_batch_size": 4}
 fleet.init(strategy=strat)
 
 rng = np.random.RandomState(0)
-Ws = [rng.randn(8, 8).astype(np.float32) * 0.4 for _ in range(4)]
+Ws = [rng.randn(8, 8).astype(np.float32) * 0.4 for _ in range(N_LAYERS)]
 X = rng.randn(8, 8).astype(np.float32)
 Y = rng.randint(0, 8, size=(8,))
 
@@ -49,7 +56,8 @@ def loss_fn(pred, label):
     return nn.functional.cross_entropy(pred, label)
 
 
-descs = [LayerDesc(nn.Linear, 8, 8, bias_attr=False) for _ in range(4)]
+descs = [LayerDesc(nn.Linear, 8, 8, bias_attr=False)
+         for _ in range(N_LAYERS)]
 pipe = PipelineLayer(descs, loss_fn=loss_fn,
                      num_virtual_pipeline_stages=2)
 for i, w in enumerate(Ws):

@@ -194,41 +194,37 @@ def test_launch_2proc_zero3_matches_serial(tmp_path):
     assert abs(losses[0] - serial) < 1e-4, (losses, serial)
 
 
-@pytest.mark.slow
-def test_launch_2proc_interleaved_vpp_matches_serial(tmp_path):
-    """Interleaved virtual-pipeline (VPP) across process boundaries
-    (reference hybrid_parallel_pp_interleave under launch): pp=2
-    processes, 2 virtual stages each — model-order layers alternate
-    ranks, so every microbatch crosses processes 4 times. Compared to a
-    numpy serial reference of the same 2-microbatch accumulation."""
+def _run_vpp(tmp_path, pp):
+    """Drive launch_worker_vpp.py at pp processes x 2 virtual stages and
+    compare against a numpy serial reference of the same 2-microbatch
+    accumulation. Every rank must report the identical REAL loss (the
+    final activation is broadcast from the last stage before loss_fn)."""
     worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "launch_worker_vpp.py")
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env.pop("JAX_PLATFORMS", None)
     env["PYTHONPATH"] = REPO
+    env["VPP_PP_DEGREE"] = str(pp)
     log_dir = str(tmp_path / "logs")
     proc = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
-         "--nprocs", "2", "--log_dir", log_dir, worker],
+         "--nprocs", str(pp), "--log_dir", log_dir, worker],
         env=env, cwd=REPO, capture_output=True, text=True, timeout=540)
     logs = ""
-    for r in range(2):
+    for r in range(pp):
         p = os.path.join(log_dir, f"worker.{r}.log")
         if os.path.exists(p):
             logs += f"--- rank {r}\n" + open(p).read()
     assert proc.returncode == 0, proc.stdout + proc.stderr + logs
     raw = re.findall(r"FINAL_LOSS ([\d.]+|nan|inf)", logs)
-    # BOTH ranks must report the REAL loss (the final activation is
-    # broadcast from the last stage before loss_fn — without it a
-    # non-last rank computes loss on a stale pass-through activation)
-    assert len(raw) == 2, logs
-    assert raw[0] == raw[1], logs
+    assert len(raw) == pp, logs
+    assert len(set(raw)) == 1, logs
     vpp = float(raw[-1])
 
     # numpy serial: same seeds/weights, 2-microbatch mean CE
     rng = np.random.RandomState(0)
-    Ws = [rng.randn(8, 8).astype(np.float32) * 0.4 for _ in range(4)]
+    Ws = [rng.randn(8, 8).astype(np.float32) * 0.4 for _ in range(2 * pp)]
     X = rng.randn(8, 8).astype(np.float32)
     Y = rng.randint(0, 8, size=(8,))
     tot = 0.0
@@ -240,6 +236,23 @@ def test_launch_2proc_interleaved_vpp_matches_serial(tmp_path):
         logp = z - np.log(np.exp(z).sum(-1, keepdims=True))
         tot += -logp[np.arange(4), Y[k * 4:(k + 1) * 4]].mean()
     np.testing.assert_allclose(vpp, tot / 2, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_launch_2proc_interleaved_vpp_matches_serial(tmp_path):
+    """Interleaved virtual-pipeline (VPP) across process boundaries
+    (reference hybrid_parallel_pp_interleave under launch): pp=2
+    processes, 2 virtual stages each — model-order layers alternate
+    ranks, so every microbatch crosses processes 4 times."""
+    _run_vpp(tmp_path, 2)
+
+
+@pytest.mark.slow
+def test_launch_4proc_interleaved_vpp_matches_serial(tmp_path):
+    """pp=4: every hop now has BYSTANDER ranks (neither endpoint), which
+    must pass activations through with no KV traffic and no tape node —
+    the point-to-point hop path that pp=2 cannot exercise."""
+    _run_vpp(tmp_path, 4)
 
 
 @pytest.mark.slow
