@@ -36,7 +36,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..models.llama import (LlamaConfig, apply_rope, block_apply,
-                            init_llama_params, rms_norm, rope_angles, _mm)
+                            init_llama_params, quantize_weights_int8,
+                            rms_norm, rope_angles, _mm)
 
 __all__ = ["Request", "ServingEngine"]
 
@@ -124,10 +125,19 @@ class ServingEngine:
                  seed: int = 0, max_batch: int = 8, page_size: int = 128,
                  max_seq: Optional[int] = None, n_pages: Optional[int] = None,
                  prefill_buckets: tuple = (128, 256, 512, 1024),
-                 decode_quantum: int = 8):
+                 decode_quantum: int = 8,
+                 weight_only_int8: bool = False):
         self.cfg = cfg
         self.params = params if params is not None else init_llama_params(
             cfg, jax.random.PRNGKey(seed))
+        if (weight_only_int8 or cfg.weight_only_int8) and not isinstance(
+                self.params["blocks"]["wq"], tuple):
+            # halves weight HBM (per-column absmax int8 + bf16 scales;
+            # embeddings/norms stay high precision) — every matmul in the
+            # prefill/decode programs flows through the tuple-aware _mm,
+            # so the compiled paths need no changes. The tuple check
+            # skips params that arrive already quantized.
+            self.params = quantize_weights_int8(self.params)
         self.B = max_batch
         self.bs = page_size
         self.max_seq = max_seq or cfg.max_seq_len

@@ -141,6 +141,29 @@ def test_serving_sampling_contract():
     assert out3[0] == base[0], "top_p -> 0 must reduce to greedy"
 
 
+def test_serving_weight_only_int8_matches_isolated_int8():
+    """Weight-only int8 serving (the reference weight_only_linear
+    serving config): the engine quantizes once at init and the compiled
+    prefill/decode paths run on (int8, scale) weights; exact-token
+    equality against the isolated int8 generation path on the SAME
+    quantized params."""
+    rng = np.random.RandomState(5)
+    engine = ServingEngine(CFG, max_batch=2, page_size=16, max_seq=256,
+                           prefill_buckets=(16, 32, 64),
+                           weight_only_int8=True)
+    assert isinstance(engine.params["blocks"]["wq"], tuple)
+    prompts = [rng.randint(1, 512, size=n).astype(np.int32)
+               for n in (9, 23, 14)]
+    max_new = 6
+    reqs = [Request(rid=i, prompt=p, max_new_tokens=max_new, arrival=0.0)
+            for i, p in enumerate(prompts)]
+    engine.run(reqs)
+
+    want = _isolated_reference(engine, prompts, max_new)
+    for r, w in zip(reqs, want):
+        assert r.out_tokens == [int(t) for t in w], (r.rid,)
+
+
 def test_serving_rejects_oversized():
     engine = ServingEngine(CFG, max_batch=1, page_size=16, max_seq=64,
                            prefill_buckets=(16, 32, 64))
