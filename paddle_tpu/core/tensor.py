@@ -157,6 +157,20 @@ class Tensor:
     def __int__(self):
         return int(self._mat())
 
+    def __index__(self):
+        # lets a 0-d int/bool tensor drive range()/indexing eagerly;
+        # under capture the materialization raises the concretization
+        # break error that triggers the dy2static for-range conversion.
+        # Float dtypes refuse (numpy semantics) instead of truncating.
+        import numpy as _np
+
+        if not (_np.issubdtype(_np.dtype(str(self.dtype)), _np.integer)
+                or _np.dtype(str(self.dtype)) == _np.bool_):
+            raise TypeError(
+                f"only integer tensors can be interpreted as an index, "
+                f"got dtype {self.dtype}")
+        return int(self._mat())
+
     def __bool__(self):
         return bool(self._mat())
 

@@ -448,8 +448,82 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                    make_branch(fname, node.orelse),
                    assign_out])
 
+    def visit_For(self, node):
+        """``for i in range(...)`` -> an equivalent while, which
+        visit_While then converts (lax.while_loop when the bound is a
+        tensor at runtime — the reference loop_transformer's
+        for-range path). Any other iterable stays a Python loop and
+        unrolls during trace; for/else and non-Name targets too."""
+        self.generic_visit(node)
+        it = node.iter
+        if (node.orelse or not isinstance(it, ast.Call)
+                or not isinstance(it.func, ast.Name)
+                or it.func.id != "range" or it.keywords
+                or not 1 <= len(it.args) <= 2
+                or any(isinstance(a, ast.Starred) for a in it.args)
+                or not isinstance(node.target, ast.Name)
+                or _forbidden(node.body)):
+            # incl. break/continue/return bodies: an unconverted Python
+            # for-range is always a valid fallback (unrolls at trace)
+            return node
+        # single-underscore names: these must ride the while CARRY like
+        # user variables (the __ptu_* namespace is region-local and
+        # excluded from operand tuples by _names_of)
+        _COUNTER[0] += 1
+        ivar = f"_ptufor_i_{_COUNTER[0]}"
+        _COUNTER[0] += 1
+        stopv = f"_ptufor_stop_{_COUNTER[0]}"
+        start = (ast.Constant(value=0) if len(it.args) == 1
+                 else it.args[0])
+        stop = it.args[-1]
+        # Python evaluates range(start, stop) left to right
+        pre = [
+            ast.Assign(targets=[ast.Name(id=ivar, ctx=ast.Store())],
+                       value=start),
+            ast.Assign(targets=[ast.Name(id=stopv, ctx=ast.Store())],
+                       value=stop),
+            # pre-bind the loop target IF UNBOUND so it rides the carry
+            # as a defined scalar (an UNDEF -> array transition cannot
+            # ride lax.while_loop); an existing binding is preserved.
+            # Divergence from Python: after a zero-iteration loop an
+            # otherwise-unbound target is bound to start.
+            ast.Try(
+                body=[ast.Expr(value=ast.Name(id=node.target.id,
+                                              ctx=ast.Load()))],
+                handlers=[ast.ExceptHandler(
+                    type=ast.Name(id="NameError", ctx=ast.Load()),
+                    name=None,
+                    body=[ast.Assign(
+                        targets=[ast.Name(id=node.target.id,
+                                          ctx=ast.Store())],
+                        value=ast.Name(id=ivar, ctx=ast.Load()))])],
+                orelse=[], finalbody=[]),
+        ]
+        body = ([ast.Assign(targets=[ast.Name(id=node.target.id,
+                                              ctx=ast.Store())],
+                            value=ast.Name(id=ivar, ctx=ast.Load()))]
+                + list(node.body)
+                + [ast.Assign(
+                    targets=[ast.Name(id=ivar, ctx=ast.Store())],
+                    value=ast.BinOp(
+                        left=ast.Name(id=ivar, ctx=ast.Load()),
+                        op=ast.Add(), right=ast.Constant(value=1)))])
+        wh = ast.While(
+            test=ast.Compare(left=ast.Name(id=ivar, ctx=ast.Load()),
+                             ops=[ast.Lt()],
+                             comparators=[ast.Name(id=stopv,
+                                                   ctx=ast.Load())]),
+            body=body, orelse=[])
+        # body statements were already visited above — go straight to
+        # the conversion core (visit_While would generic_visit again and
+        # double-convert nested Ifs)
+        return pre + self._convert_while(wh)
+
     def visit_While(self, node):
         self.generic_visit(node)
+        return self._convert_while(node)
+
+    def _convert_while(self, node):
         if node.orelse:
             raise UnsupportedControlFlow("while/else")
         bad = _forbidden(node.body)

@@ -201,3 +201,78 @@ def test_bucket_overflow_degrades_to_exact():
     got = float(fn(paddle.to_tensor(raw),
                    np.asarray(11, np.int32)).numpy())
     np.testing.assert_allclose(got, raw.mean(), rtol=1e-5, atol=1e-6)
+
+
+def test_for_range_tensor_bound_converts():
+    """``for i in range(n)`` with a TENSOR bound lowers through the
+    while rewrite to lax.while_loop (reference loop_transformer's
+    for-range path); the loop variable participates in the carry and
+    the result matches the eager computation."""
+
+    @pjit.to_static
+    def step(x, n):
+        acc = x * 0
+        for i in range(n):
+            acc = acc + x + i
+        return acc
+
+    x = paddle.to_tensor(np.ones((3,), np.float32))
+    n = paddle.to_tensor(np.asarray(4, np.int32))
+    out = step(x, n)
+    # sum_{i<4} (x + i) = 4*x + 6
+    np.testing.assert_allclose(out.numpy(), np.full((3,), 10.0))
+    assert step.ast_converted
+    # python-int bound: plain python loop semantics, same executable API
+    out2 = step(paddle.to_tensor(np.ones((3,), np.float32)),
+                paddle.to_tensor(np.asarray(2, np.int32)))
+    np.testing.assert_allclose(out2.numpy(), np.full((3,), 3.0))
+
+
+def test_for_range_start_stop_and_python_iterables_unrolled():
+    """Two-arg range over a tensor stop converts; a list iterable stays
+    a Python loop (unrolled during trace) — zero behavior change."""
+
+    @pjit.to_static
+    def step(x, n):
+        s = x * 0
+        for i in range(1, n):
+            s = s + i
+        for w in [0.5, 0.25]:          # python iterable: unrolls
+            s = s + w
+        return s
+
+    out = step(paddle.to_tensor(np.zeros((2,), np.float32)),
+               paddle.to_tensor(np.asarray(4, np.int32)))
+    # 1+2+3 + 0.75
+    np.testing.assert_allclose(out.numpy(), np.full((2,), 6.75))
+    assert step.ast_converted
+
+
+def test_for_range_preserves_existing_binding_and_break_loops():
+    """A pre-bound loop target must keep its value when the loop runs
+    zero iterations; a break-containing constant-range for stays a
+    Python loop (unrolls) without aborting conversion of the rest."""
+
+    @pjit.to_static
+    def step(x, n):
+        i = 99
+        for i in range(n):
+            x = x + i
+        s = x * 0
+        for j in range(3):
+            s = s + x
+            break                       # python loop: unrolled
+        if (x.sum() > 100):             # tensor-if keeps converting
+            s = s + 1
+        return s + i
+
+    out = step(paddle.to_tensor(np.zeros((2,), np.float32)),
+               paddle.to_tensor(np.asarray(0, np.int32)))
+    # zero iterations: i stays 99; break loop adds x once (= 0)
+    np.testing.assert_allclose(out.numpy(), np.full((2,), 99.0))
+    assert step.ast_converted
+
+
+def test_float_tensor_index_raises():
+    with pytest.raises(TypeError):
+        range(paddle.to_tensor(np.asarray(2.7, np.float32)))
