@@ -92,7 +92,7 @@ class features:
             n_frames = 1 + (arr.shape[-1] - self.n_fft) // self.hop
             idx = (jnp.arange(n_frames)[:, None] * self.hop
                    + jnp.arange(self.n_fft)[None, :])
-            frames = arr[..., idx] * jnp.asarray(self.window, arr.dtype)
+            frames = arr[..., idx] * jnp.asarray(self.window, arr.dtype)  # tpu-lint: disable=TPL002 -- window is write-once at construction, never mutated
             spec = jnp.abs(jnp.fft.rfft(frames, axis=-1)) ** self.power
             return Tensor(jnp.swapaxes(spec, -1, -2))
 

@@ -283,6 +283,14 @@ def op_call(opdef: OpDef, args, kwargs):
     return wrap(outs, 0)
 
 
+def unregister_op(name: str) -> None:
+    """Remove a registration (custom-op teardown — utils.cpp_extension
+    lifecycles, tests). Public wrappers close over their OpDef, so removal
+    only affects registry lookups (inventories, AMP name lists), which is
+    exactly what a transient custom op must not leak into."""
+    OP_REGISTRY.pop(name, None)
+
+
 def op(name: str | None = None, differentiable: bool = True, amp: str = "none"):
     """Register a framework op from a pure-jax implementation.
 

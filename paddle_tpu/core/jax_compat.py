@@ -1,0 +1,133 @@
+"""Version compatibility shims for the installed jax.
+
+The tree is written against the current jax surface (``jax.shard_map``
+with ``axis_names=``/``check_vma=`` and an ambient mesh, the
+``jax.sharding.set_mesh`` context, auto-imported ``jax.export``). On an
+older runtime (0.4.x) those spell differently:
+
+- ``jax.shard_map``            -> ``jax.experimental.shard_map.shard_map``
+  with ``mesh=`` required, ``check_rep=`` instead of ``check_vma=``, and
+  partial-manual expressed inversely (``auto=`` = mesh axes NOT manual
+  instead of ``axis_names=`` = axes that ARE manual)
+- ``jax.sharding.set_mesh``    -> entering the ``Mesh`` context (plus a
+  side channel here so the shard_map shim can resolve the ambient mesh)
+- ``jax.export``               -> exists but is not imported by
+  ``import jax``; one explicit import fixes attribute access
+
+``install()`` patches ONLY what is missing, so on a current jax it is a
+no-op and the real APIs are used untouched. Imported first thing by
+``paddle_tpu/__init__.py``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+# Ambient mesh stack maintained by the set_mesh shim (newer jax tracks
+# this inside jax.sharding; on 0.4.x nothing equivalent is exposed, and
+# thread_resources only holds a *physical* Mesh, never an AbstractMesh).
+_CTX_MESH: list = []
+
+# Which APIs install() had to patch. Tests gate on this: a shimmed
+# shard_map means the runtime predates native partial-manual lowering
+# (XLA CPU rejects the PartitionId it emits), so tests that require the
+# partial-manual pipeline skip rather than fail.
+PATCHED: set = set()
+
+
+def _ambient_mesh():
+    if _CTX_MESH:
+        return _CTX_MESH[-1]
+    try:
+        from jax._src.mesh import thread_resources
+
+        mesh = thread_resources.env.physical_mesh
+        if mesh is not None and mesh.axis_names:
+            return mesh
+    except Exception:
+        pass
+    return None
+
+
+def install() -> None:
+    try:  # attribute access like jax.export.serialize needs the submodule
+        import jax.export  # noqa: F401
+    except ImportError:  # pragma: no cover — very old jax
+        pass
+
+    if not hasattr(jax, "shard_map"):
+        import functools
+
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, *, mesh=None, in_specs, out_specs, axis_names=None,
+                      check_vma=None, check_rep=None):
+            def build(m):
+                kw = {}
+                if axis_names is not None:
+                    kw["auto"] = frozenset(m.axis_names) - frozenset(
+                        axis_names)
+                if check_vma is not None:
+                    kw["check_rep"] = check_vma
+                elif check_rep is not None:
+                    kw["check_rep"] = check_rep
+                return _shard_map(f, mesh=m, in_specs=in_specs,
+                                  out_specs=out_specs, **kw)
+
+            if mesh is not None:
+                return build(mesh)
+
+            # current-jax semantics: with no mesh argument the ambient
+            # mesh is resolved at FIRST TRACE, not at wrapping time —
+            # callers build the mapped fn once and trace it later inside
+            # a set_mesh context
+            @functools.wraps(f)
+            def deferred(*args, **kwargs):
+                m = _ambient_mesh()
+                if m is None:
+                    raise ValueError(
+                        "jax_compat.shard_map: no mesh passed and no "
+                        "ambient mesh set (wrap the call in "
+                        "jax.sharding.set_mesh(mesh))")
+                return build(m)(*args, **kwargs)
+
+            return deferred
+
+        jax.shard_map = shard_map
+        PATCHED.add("shard_map")
+
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+
+        def get_abstract_mesh():
+            # Best effort on 0.4.x: the abstract view of the ambient mesh
+            # set via the set_mesh shim. Callers in this tree treat None
+            # as "no context mesh" and fall back to their explicit mesh.
+            mesh = _CTX_MESH[-1] if _CTX_MESH else None
+            if mesh is None:
+                return None
+            return getattr(mesh, "abstract_mesh", mesh)
+
+        jax.sharding.get_abstract_mesh = get_abstract_mesh
+        PATCHED.add("get_abstract_mesh")
+
+    if not hasattr(jax.sharding, "set_mesh"):
+
+        @contextlib.contextmanager
+        def set_mesh(mesh):
+            _CTX_MESH.append(mesh)
+            try:
+                # a physical Mesh also enters the 0.4.x resource env so
+                # pjit/jit resolve named shardings; AbstractMesh has no
+                # context protocol there — the side channel above covers it
+                if isinstance(mesh, jax.sharding.Mesh):
+                    with mesh:
+                        yield mesh
+                else:
+                    yield mesh
+            finally:
+                _CTX_MESH.pop()
+
+        jax.sharding.set_mesh = set_mesh
+        PATCHED.add("set_mesh")

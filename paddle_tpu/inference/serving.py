@@ -360,14 +360,20 @@ class ServingEngine:
             self.table[slot] = row
             toks = np.zeros((1, bucket), np.int32)
             toks[0, :T] = req.prompt
-            prefill_pages = jnp.asarray(
+            # tpu-lint TPL002 audit: the prefill below is dispatched
+            # asynchronously, so every numpy operand is copied (jnp.array,
+            # not jnp.asarray) — `row` stays referenced via self.table and
+            # a zero-copy alias would see later scheduler writes. The
+            # scalar operands (T, temperature, top_p, seed) are python
+            # scalars: asarray cannot alias host memory for those.
+            prefill_pages = jnp.array(
                 row[:(bucket + self.bs - 1) // self.bs])
             self.samp_temp[slot] = req.temperature
             self.samp_topp[slot] = req.top_p
             self.samp_seed[slot] = req.seed
             first, self.k_pages, self.v_pages = self._get_prefill(bucket)(
                 self.params, self.k_pages, self.v_pages,
-                jnp.asarray(toks), prefill_pages,
+                jnp.array(toks), prefill_pages,
                 jnp.asarray(T, jnp.int32),
                 jnp.asarray(req.temperature, jnp.float32),
                 jnp.asarray(req.top_p, jnp.float32),
@@ -481,7 +487,7 @@ class ServingEngine:
         # test_serving_pipelined_page_recycling_exact)
         toks, last, self.k_pages, self.v_pages = self._decode(
             self.params, self.k_pages, self.v_pages, cur,
-            jnp.asarray(mask), jnp.asarray(vals),
+            jnp.array(mask), jnp.asarray(vals),
             jnp.asarray(self.table.copy()),
             jnp.asarray(self.seq_lens.copy()),
             jnp.asarray(self.samp_temp.copy()),

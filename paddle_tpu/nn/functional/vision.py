@@ -83,7 +83,7 @@ def affine_grid(theta, out_shape, align_corners: bool = True):
     """theta [N, 2, 3] -> sampling grid [N, H, W, 2] (reference
     affine_grid:34)."""
     if hasattr(out_shape, "tolist"):
-        out_shape = [int(v) for v in out_shape.tolist()]
+        out_shape = [int(v) for v in out_shape.tolist()]  # tpu-lint: disable=TPL001 -- out_shape is host shape metadata by contract (never a traced array)
     N, C, H, W = [int(v) for v in out_shape]
 
     def linspace(n):

@@ -14,7 +14,7 @@ from ..core.dispatch import op
 from ..core.tensor import Tensor
 
 __all__ = [
-    "polygamma", "nanmedian", "trapezoid", "cumulative_trapezoid", "ldexp",
+    "polygamma", "nanmedian", "trapezoid", "cumulative_trapezoid",
     "fmod", "fix", "renorm", "logdet", "vdot", "diagonal",
     "index_fill", "masked_scatter", "masked_select", "unique",
     "unique_consecutive", "nonzero", "isreal", "iscomplex", "signbit",
@@ -56,11 +56,6 @@ def cumulative_trapezoid(y, x=None, dx=None, axis: int = -1):
         widths = d
     avg = (y0[..., 1:] + y0[..., :-1]) / 2.0
     return jnp.moveaxis(jnp.cumsum(avg * widths, axis=-1), -1, axis)
-
-
-@op("ldexp")
-def ldexp(x, y):
-    return x * (2.0 ** y.astype(jnp.float32))
 
 
 @op("fmod")
@@ -260,23 +255,17 @@ atleast_3d = _atleast(3)
 
 # -- distances / losses -----------------------------------------------------
 
-@op("poisson_nll_loss")
 def poisson_nll_loss(input, label, log_input: bool = True,
                      full: bool = False, epsilon: float = 1e-8,
                      reduction: str = "mean"):
-    if log_input:
-        loss = jnp.exp(input) - label * input
-    else:
-        loss = input - label * jnp.log(input + epsilon)
-    if full:
-        stirling = label * jnp.log(label + epsilon) - label \
-            + 0.5 * jnp.log(2 * jnp.pi * (label + epsilon))
-        loss = loss + jnp.where(label > 1, stirling, 0.0)
-    if reduction == "mean":
-        return loss.mean()
-    if reduction == "sum":
-        return loss.sum()
-    return loss
+    # single registration lives in nn/functional/loss.py (tpu-lint TPL003
+    # deduplication: two @op("poisson_nll_loss") used to race for the
+    # registry entry); lazy import — nn.functional pulls in the layer
+    # stack, which imports this package at module scope
+    from ..nn.functional.loss import poisson_nll_loss as _impl
+
+    return _impl(input, label, log_input=log_input, full=full,
+                 epsilon=epsilon, reduction=reduction)
 
 
 @op("pdist")

@@ -90,7 +90,7 @@ def svdvals(x):
 @op("eig")
 def eig(x):
     # XLA eig is CPU-only; evaluate via host numpy for eager parity.
-    w, v = np.linalg.eig(np.asarray(x))
+    w, v = np.linalg.eig(np.asarray(x))  # tpu-lint: disable=TPL001 -- deliberate host LAPACK path (eager-only; complex output has no XLA lowering here)
     return jnp.asarray(w), jnp.asarray(v)
 
 
@@ -101,7 +101,7 @@ def eigh(x, UPLO="L"):
 
 @op("eigvals")
 def eigvals(x):
-    return jnp.asarray(np.linalg.eigvals(np.asarray(x)))
+    return jnp.asarray(np.linalg.eigvals(np.asarray(x)))  # tpu-lint: disable=TPL001 -- deliberate host LAPACK path, same contract as eig above
 
 
 @op("eigvalsh")

@@ -89,7 +89,7 @@ def split(x, num_or_sections, axis=0):
     if -1 in sections:
         known = sum(s for s in sections if s != -1)
         sections = [s if s != -1 else total - known for s in sections]
-    offsets = np.cumsum(sections)[:-1].tolist()
+    offsets = np.cumsum(sections)[:-1].tolist()  # tpu-lint: disable=TPL001 -- sections are host-static python ints (via _to_static_ints), not traced values
     return tuple(jnp.split(x, offsets, axis=axis))
 
 
@@ -471,7 +471,13 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
 # ---------------------------------------------------------------------------
 
 
-@op("pad")
+# Duplicate of nn/functional/common.py's @op("pad") kept deliberately: the
+# two lowerings implement different spatial-pair conventions (this one pads
+# trailing dims in given order; common.py reverses pairs last-dim-first per
+# the reference F.pad), and each is pinned by its own tests via its own
+# wrapper. Dispatch never consults the registry for wrapper calls, so the
+# name collision only affects registry introspection. Unification tracked.
+@op("pad")  # tpu-lint: disable=TPL003 -- deliberate dual lowering, see above
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):  # noqa: A002
     pad = _to_static_ints(pad)
     ndim = jnp.ndim(x)

@@ -24,6 +24,15 @@ def nucleus_keep(sorted_probs, top_p):
     """Keep mask over descending-sorted probabilities.
 
     sorted_probs: [..., V] descending; top_p: broadcastable to [...]
-    (scalar or per-row). Returns bool [..., V]."""
-    cum = jnp.cumsum(sorted_probs, axis=-1)
-    return cum - sorted_probs < jnp.asarray(top_p)[..., None]
+    (scalar or per-row). Returns bool [..., V].
+
+    "Mass before this element" is computed as an EXCLUSIVE cumsum (shift
+    then accumulate), not ``cumsum - p``: the subtraction form loses an
+    ulp after the inclusive sum rounds, which can flip the boundary
+    comparison and leak one extra token into the nucleus (observed for
+    [0.5, 0.3, 0.15, ...] at top_p=0.8, where 0.95000002 - 0.15000001
+    lands one ulp under 0.8 while 0.5 + 0.30000001 hits it exactly)."""
+    shifted = jnp.concatenate(
+        [jnp.zeros_like(sorted_probs[..., :1]), sorted_probs[..., :-1]],
+        axis=-1)
+    return jnp.cumsum(shifted, axis=-1) < jnp.asarray(top_p)[..., None]

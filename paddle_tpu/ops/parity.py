@@ -527,22 +527,18 @@ def identity_loss(x, reduction: str = "mean"):
     return x
 
 
-@op("kl_div")
 def kl_div(input, label, reduction: str = "mean", log_target: bool = False):
     """KL divergence loss matching reference kldiv_loss_op: input is
-    log-prob, label is prob (or log-prob with log_target)."""
-    if log_target:
-        out = jnp.exp(label) * (label - input)
-    else:
-        safe = jnp.where(label > 0, label, 1.0)
-        out = jnp.where(label > 0, label * (jnp.log(safe) - input), 0.0)
-    if reduction == "mean":
-        return out.mean()
-    if reduction == "batchmean":
-        return out.sum() / input.shape[0]
-    if reduction == "sum":
-        return out.sum()
-    return out
+    log-prob, label is prob (or log-prob with log_target).
+
+    Single registration lives in nn/functional/loss.py (tpu-lint TPL003
+    deduplication: two @op("kl_div") used to race for the registry entry,
+    with equivalent but independently-maintained math). Lazy import:
+    nn.functional pulls in the layer stack, which imports this package.
+    """
+    from ..nn.functional.loss import kl_div as _impl
+
+    return _impl(input, label, reduction=reduction, log_target=log_target)
 
 
 @op("huber_loss")

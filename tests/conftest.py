@@ -51,3 +51,17 @@ def _seed_everything():
     import gc
 
     gc.collect()
+
+
+def requires_native_partial_manual():
+    """Skip marker for tests that need jax's native partial-manual
+    shard_map lowering (jax.shard_map with axis_names a strict subset of
+    the mesh). The paddle_tpu.core.jax_compat shim makes those programs
+    *trace* on older jax, but XLA CPU then rejects the emitted
+    PartitionId ("not supported for SPMD partitioning")."""
+    from paddle_tpu.core import jax_compat
+
+    return pytest.mark.skipif(
+        "shard_map" in jax_compat.PATCHED,
+        reason="native jax.shard_map partial-manual lowering unavailable "
+               "on this jax; compat shim cannot emulate it on XLA CPU")

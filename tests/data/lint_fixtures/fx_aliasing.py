@@ -1,0 +1,27 @@
+"""TPL002 fixture: numpy buffers aliased into jnp.asarray (never imported)."""
+import jax.numpy as jnp
+import numpy as np
+
+
+class Sched:
+    def __init__(self):
+        self.table = np.zeros((4, 8), np.int32)
+
+    def dispatch(self):
+        buf = np.zeros((8,), np.int32)
+        a = jnp.asarray(buf)           # seeded violation: mutated below
+        buf[0] = 1
+        b = jnp.asarray(self.table)    # seeded violation: attr-held buffer
+        c = jnp.asarray(self.table.copy())   # ok: defensive copy (fresh)
+        d = jnp.array(buf)             # ok: jnp.array always copies
+        rng = np.random.RandomState(0)
+        e = jnp.asarray(rng.uniform(size=(3,)))  # ok: fresh call result
+        f = jnp.asarray(buf)  # tpu-lint: disable=TPL002 -- fixture: suppressed instance
+        buf[1] = 2
+        return a, b, c, d, e, f
+
+
+def immutable_local():
+    buf = np.zeros((8,), np.int32)
+    return jnp.asarray(buf)            # ok outside strict paths: buffer is
+    #                                    never written after the handoff

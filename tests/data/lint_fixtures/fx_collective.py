@@ -1,0 +1,26 @@
+"""TPL005 fixture: collective axis binding (never imported)."""
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def good(mesh, fn, x):
+    def inner(a):
+        s = lax.psum(a, "dp")          # ok: bound by shard_map below
+        return s + lax.axis_index("dp")
+
+    run = jax.shard_map(inner, mesh=mesh, in_specs=(P("dp"),),
+                        out_specs=P("dp"))
+    return run(x)
+
+
+def bad(x):
+    return lax.psum(x, "mp")           # seeded violation: 'mp' unbound
+
+
+def variable_axis(x, axis):
+    return lax.pmean(x, axis)          # ok: non-literal axis, out of reach
+
+
+def justified(x):
+    return lax.pmax(x, "tp")  # tpu-lint: disable=TPL005 -- fixture: suppressed instance

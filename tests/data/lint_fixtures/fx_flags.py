@@ -1,0 +1,17 @@
+"""TPL006 fixture: flag hygiene (never imported)."""
+from paddle_tpu.core.flags import GLOBAL_FLAGS, define_flag, get_flags
+
+define_flag("fx_unused", False, "never read anywhere")   # seeded violation
+
+define_flag("fx_read_get", False, "read via .get below")
+define_flag("fx_read_has", False, "read via .has below")
+define_flag("fx_read_api", False, "read via get_flags below")
+
+define_flag("fx_reserved", False, "parity")  # tpu-lint: disable=TPL006 -- fixture: suppressed instance
+
+
+def reads():
+    a = GLOBAL_FLAGS.get("fx_read_get")
+    b = GLOBAL_FLAGS.has("fx_read_has")
+    c = get_flags(["fx_read_api"])
+    return a, b, c

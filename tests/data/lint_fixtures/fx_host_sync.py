@@ -1,0 +1,31 @@
+"""TPL001 fixture: host syncs inside trace regions (never imported)."""
+import jax
+import numpy as np
+
+from paddle_tpu.core.dispatch import op
+
+
+@op("fx_sync_bad")
+def bad_lowering(x):
+    v = float(x)                       # seeded violation: concretize param
+    w = x.item()                       # seeded violation: host sync
+    h = np.asarray(x)                  # seeded violation: host materialize
+    return v + w + h
+
+
+@jax.jit
+def bad_jit(x):
+    return bool(x)                     # seeded violation: bool() in jit
+
+
+@op("fx_sync_ok")
+def ok_lowering(x, approximate: bool = False):
+    flag = bool(approximate)           # ok: annotated scalar config param
+    n = x.shape[0]
+    k = float(n)                       # ok: shape metadata is static
+    lead = float(x)  # tpu-lint: disable=TPL001 -- fixture: suppressed instance
+    return flag, k, lead
+
+
+def eager_helper(x):
+    return float(x)                    # ok: not a trace region

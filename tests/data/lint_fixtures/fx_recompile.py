@@ -1,0 +1,40 @@
+"""TPL004 fixture: recompile hazards under jit/to_static (never imported)."""
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def bad_clock(x):
+    t = time.time()                    # seeded violation: trace-time const
+    r = np.random.uniform()            # seeded violation: trace-time draw
+    return x + t + r
+
+
+def outer_capture(xs):
+    t0 = time.time()
+
+    @jax.jit
+    def traced(x):
+        return x + t0                  # seeded violation: hazard closure
+
+    for step in range(3):
+        @jax.jit
+        def per_iter(x):
+            return x + step            # ok: defined inside the loop body
+
+    @jax.jit
+    def stale(x):
+        return x * step                # seeded violation: loop var capture
+    #                                    from outside the loop body
+
+    @jax.jit
+    def justified(x):
+        return x + t0  # tpu-lint: disable=TPL004 -- fixture: suppressed instance
+
+    return traced, per_iter, stale, justified
+
+
+def eager_clock():
+    return time.time()                 # ok: not a trace region

@@ -1,0 +1,36 @@
+"""TPL003 fixture: registry consistency violations (never imported)."""
+from paddle_tpu.core.dispatch import OP_REGISTRY, op
+
+
+@op("fx_dup")
+def first(x):
+    return x
+
+
+@op("fx_dup")                          # seeded violation: duplicate name
+def second(x):
+    return x + 1
+
+
+@op("fx_uncovered")                    # seeded violation: differentiable,
+def uncovered(x):                      # not in the grad inventory fixture
+    return x * 2
+
+
+@op("fx_covered")
+def covered(x):                        # ok: spec'd in the inventory fixture
+    return x * 3
+
+
+@op("fx_nondiff", differentiable=False)
+def nondiff(x):                        # ok: not differentiable
+    return x > 0
+
+
+@op("fx_allowed")  # tpu-lint: disable=TPL003 -- fixture: suppressed instance
+def allowed(x):
+    return x * 5
+
+
+OP_REGISTRY["fx_raw"] = None           # seeded violation: raw mutation
+OP_REGISTRY.pop("fx_raw")              # seeded violation: raw mutation

@@ -1,0 +1,33 @@
+"""TPL003 fixture: a miniature grad-coverage inventory (never imported).
+
+The checker keys on the ``test_grad_coverage`` filename fragment and
+harvests spec()/unary() names, split-string loops, and the accounting
+containers — mirroring tests/test_grad_coverage.py's real structure."""
+
+SPECS: dict = {}
+
+
+def spec(name, fn, inputs, **opts):
+    SPECS[name] = (fn, inputs, opts)
+
+
+def unary(names, gen):
+    for n in names.split():
+        spec(n, None, [gen])
+
+
+spec("fx_covered", None, [1.0])
+spec("fx_dup", None, [1.0])
+# fx_allowed is deliberately ABSENT: its registration carries the
+# suppressed-instance comment for the TPL003 fixture contract.
+
+for n in "fx_loop_a fx_loop_b".split():
+    spec(n, None, [1.0])
+
+unary("fx_un_a fx_un_b", 1.0)
+
+NONDIFF_NATURE = {"fx_nature"}
+
+ALLOWLIST = {"fx_listed": "justification text"}
+
+STE_OPS = ("fx_ste_a fx_ste_b").split()

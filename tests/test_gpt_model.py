@@ -124,6 +124,10 @@ def test_pipeline_grads_match_dense():
                                    rtol=5e-3, atol=1e-4)
 
 
+from conftest import requires_native_partial_manual
+
+
+@requires_native_partial_manual()
 def test_hybrid_train_step_learns():
     cfg = GPTConfig(vocab_size=64, hidden=32, n_layers=4, n_heads=4,
                     seq_len=16, n_experts=2, n_moe_layers=1,

@@ -869,6 +869,10 @@ _chunks = [_names_sorted[i:i + CHUNK]
            for i in range(0, len(_names_sorted), CHUNK)]
 
 
+# The FD sweep itself is slow-tier (~200s of finite differences on one
+# CPU core); the INVENTORY gates below stay in tier-1/smoke — they are
+# what catches an unaccounted differentiable op at review time.
+@pytest.mark.slow
 @pytest.mark.parametrize("chunk_id", range(len(_chunks)))
 def test_fd_grad_chunk(chunk_id):
     failures = []

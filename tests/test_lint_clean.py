@@ -1,0 +1,34 @@
+"""The lint gate: the tree itself must be tpu-lint clean.
+
+This is the tier-1 enforcement of the static-analysis contract — every
+checker runs over paddle_tpu/, tests/, and tools/, and any unsuppressed
+finding fails the suite with the full diagnostic text. New code either
+satisfies the rules or carries an inline justified suppression
+(``# tpu-lint: disable=<rule> -- why``).
+
+Marked smoke: the whole sweep is pure-python AST work (~2s), and the
+critical-path tier is exactly where a regression in trace-safety or
+registry consistency should surface first.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.lint import run_lint  # noqa: E402
+from tools.lint.reporters import render_text  # noqa: E402
+
+
+@pytest.mark.smoke
+def test_tree_is_lint_clean():
+    findings = run_lint([os.path.join(REPO, "paddle_tpu"),
+                         os.path.join(REPO, "tests"),
+                         os.path.join(REPO, "tools")])
+    assert not findings, "\n" + render_text(findings)

@@ -7,6 +7,11 @@ import pytest
 import paddle_tpu as pt
 from paddle_tpu.vision import models as M
 
+# Model-zoo sweeps are the canonical slow tier (see pytest.ini): ~150s of
+# forward/train passes on 1 CPU core, with no coverage the per-family
+# smoke in test_models_vision.py doesn't already give the critical path.
+pytestmark = pytest.mark.slow
+
 
 def _run(net, size=64, multi_out=False):
     x = pt.to_tensor(np.random.RandomState(0).randn(

@@ -9,6 +9,8 @@ import pytest
 import paddle_tpu as pt
 
 
+@pytest.mark.skipif(not __import__("os").path.exists("/root/reference"),
+                    reason="reference checkout not present in this image")
 def test_surface_complete_vs_reference():
     import re
 

@@ -1,0 +1,1 @@
+"""Developer tooling for the paddle_tpu tree (lint, bench, profiling)."""

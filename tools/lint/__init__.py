@@ -1,0 +1,31 @@
+"""tpu-lint: AST-based static analysis for paddle_tpu's bug classes.
+
+Checks trace-safety (host syncs under capture), async aliasing of numpy
+buffers, op-registry consistency against the grad-coverage inventory,
+recompile hazards, collective axis binding, and flag hygiene.
+
+    python -m tools.lint paddle_tpu tests [--format=json]
+
+See ``tools/lint/checkers.py`` for the rule table and the README section
+"Static analysis (tpu-lint)" for suppression syntax and how to add a
+checker.
+"""
+
+from .checkers import ALL_CHECKERS
+from .cli import DEFAULT_EXCLUDES, iter_python_files, main, run_lint
+from .core import Checker, FileContext, Finding, Suppressions
+from .reporters import render_json, render_text
+
+__all__ = [
+    "ALL_CHECKERS",
+    "Checker",
+    "DEFAULT_EXCLUDES",
+    "FileContext",
+    "Finding",
+    "Suppressions",
+    "iter_python_files",
+    "main",
+    "render_json",
+    "render_text",
+    "run_lint",
+]

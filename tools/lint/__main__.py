@@ -1,0 +1,7 @@
+"""Entry point: ``python -m tools.lint paddle_tpu tests [--format=json]``."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,696 @@
+"""tpu-lint rules: the failure modes this codebase has actually shipped.
+
+Each checker encodes one class of bug from the round postmortems:
+
+TPL001 host-sync-in-trace    .item()/float()/np.asarray() on traced values
+TPL002 async-aliasing        jnp.asarray over mutable numpy buffers
+TPL003 op-registry           dup @op names, grad-spec coverage, raw mutation
+TPL004 recompile-hazard      time()/np.random/closure scalars under jit
+TPL005 collective-safety     lax.p* axis names unbound by any shard_map
+TPL006 flag-hygiene          define_flag() names that are never read
+
+The analyses are deliberately first-order (per-function taint, per-file
+axis sets, project-wide name sets) — precise enough to catch the shipped
+bug classes, simple enough that a false positive costs one suppression
+comment with a rationale, which doubles as documentation.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Checker, call_name, dotted_name, names_in, str_constants
+
+__all__ = ["ALL_CHECKERS"]
+
+
+# -- shared helpers ----------------------------------------------------------
+
+_JIT_DECORATORS = {"jit", "pjit", "to_static", "shard_map"}
+
+
+def _decorator_kind(dec: ast.AST) -> str | None:
+    """'op' for @op(...) registrations, 'jit' for jit/to_static-family."""
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    dotted = dotted_name(target)
+    tail = dotted.rsplit(".", 1)[-1] if dotted else ""
+    if tail == "op" and dotted in ("op", "dispatch.op"):
+        return "op"
+    if tail in _JIT_DECORATORS:
+        return "jit"
+    # functools.partial(jax.jit, static_argnums=...) used as a decorator
+    if isinstance(dec, ast.Call) and tail == "partial" and dec.args:
+        inner = dotted_name(dec.args[0]).rsplit(".", 1)[-1]
+        if inner in _JIT_DECORATORS:
+            return "jit"
+    return None
+
+
+def _trace_kind(fn: ast.FunctionDef) -> str | None:
+    for dec in fn.decorator_list:
+        kind = _decorator_kind(dec)
+        if kind:
+            return kind
+    return None
+
+
+_SCALAR_ANNOTATIONS = {"bool", "int", "float", "str"}
+
+
+def _param_names(fn: ast.FunctionDef) -> set[str]:
+    """Parameters that may carry traced arrays. Parameters annotated as
+    python scalars (``approximate: bool = False``) are static config —
+    concretizing them is fine."""
+    a = fn.args
+    names = set()
+    for p in a.posonlyargs + a.args + a.kwonlyargs:
+        ann = p.annotation
+        if isinstance(ann, ast.Name) and ann.id in _SCALAR_ANNOTATIONS:
+            continue
+        names.add(p.arg)
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    names.discard("self")
+    names.discard("cls")
+    return names
+
+
+def _propagate_taint(fn: ast.AST, seeds: set[str]) -> set[str]:
+    """Fixpoint over assignments: a name is tainted if its RHS mentions a
+    tainted name. First-order and flow-insensitive on purpose."""
+    tainted = set(seeds)
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets, value = [node.target], node.value
+            else:
+                continue
+            if value is None or not (names_in(value) & tainted):
+                continue
+            if _is_shape_query(value):
+                continue  # n = x.shape[0] is static under tracing
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name) and n.id not in tainted:
+                        tainted.add(n.id)
+                        changed = True
+    return tainted
+
+
+def _iter_scope(node: ast.AST):
+    """Walk a scope's statements without entering nested function/class
+    scopes (those are analyzed as their own scopes)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            continue
+        yield child
+        yield from _iter_scope(child)
+
+
+def _is_shape_query(node: ast.AST) -> bool:
+    """True if the expression concretizes static metadata (shape/ndim/
+    dtype, len()) rather than array *values* — safe under tracing."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in ("shape", "ndim",
+                                                       "dtype"):
+            return True
+        if isinstance(n, ast.Call) and call_name(n) == "len":
+            return True
+    return False
+
+
+_NP_ROOTS = ("np.", "numpy.")
+
+
+def _np_rooted(name: str) -> bool:
+    return name.startswith(_NP_ROOTS)
+
+
+# -- TPL001: host sync inside trace regions ----------------------------------
+
+class HostSyncInTrace(Checker):
+    """`.item()` / `float(t)` / `np.asarray(t)` reachable from an `@op`
+    lowering or a jit/to_static capture region forces a device→host sync
+    and graph-breaks whole-step capture (the `jit/capture.py` bug class)."""
+
+    rule = "TPL001"
+    name = "host-sync-in-trace"
+    description = ("host-synchronizing conversion of a traced value inside "
+                   "an @op lowering or jit/to_static region")
+
+    SYNC_METHODS = {"item", "numpy", "tolist"}
+    NP_CONVERTERS = {"np.asarray", "np.array", "np.ascontiguousarray",
+                     "numpy.asarray", "numpy.array"}
+    CONCRETIZERS = {"float", "bool"}
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        kind = _trace_kind(node)
+        if kind:
+            self._scan(node, kind)
+        else:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _scan(self, fn: ast.FunctionDef, kind: str):
+        where = ("@op lowering" if kind == "op"
+                 else "jit/to_static-traced function")
+        tainted = _propagate_taint(fn, _param_names(fn))
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            # x.item() / x.numpy() / x.tolist(): a sync on anything
+            # array-like; inside a trace region there is no safe variant
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self.SYNC_METHODS
+                    and not node.args):
+                self.report(node, f".{node.func.attr}() in {where} "
+                                  f"'{fn.name}' forces a device->host sync "
+                                  "and breaks program capture")
+                continue
+            cname = call_name(node)
+            if (cname in self.NP_CONVERTERS and node.args
+                    and names_in(node.args[0]) & tainted):
+                self.report(node, f"{cname}() materializes a traced value "
+                                  f"on host in {where} '{fn.name}'")
+            elif (cname in self.CONCRETIZERS and len(node.args) == 1
+                    and names_in(node.args[0]) & tainted
+                    and not _is_shape_query(node.args[0])):
+                self.report(node, f"{cname}() concretizes a traced value in "
+                                  f"{where} '{fn.name}' (host sync / "
+                                  "ConcretizationError under capture)")
+
+
+# -- TPL002: numpy buffers aliased into async dispatch -----------------------
+
+class AsyncAliasing(Checker):
+    """`jnp.asarray` over a live numpy buffer can be zero-copy: if the
+    buffer is later mutated while the dispatched program is still in
+    flight, the program reads torn data (the `tests/test_serving.py` bug
+    class). Requires a defensive copy (`jnp.array`) or a rationale."""
+
+    rule = "TPL002"
+    name = "async-aliasing"
+    description = ("jnp.asarray over a mutable numpy buffer may alias "
+                   "zero-copy into an async in-flight program")
+
+    ASARRAY = {"jnp.asarray", "jax.numpy.asarray"}
+    # Under these paths every direct buffer handoff is flagged: programs
+    # are dispatched asynchronously, so aliasing is live by construction.
+    STRICT_PATHS = ("paddle_tpu/inference/", "paddle_tpu/core/dispatch")
+    MUTATORS = {"fill", "sort", "put", "resize", "partition", "setflags"}
+
+    def check(self, ctx):
+        self.ctx = ctx
+        # attributes that hold numpy state anywhere in the file
+        # (self.table = np.zeros(...)): handing one to jnp.asarray is the
+        # exact serving-quantum aliasing pattern
+        self._np_attrs = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                    and _np_rooted(call_name(node.value)):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute):
+                        self._np_attrs.add(t.attr)
+                    elif isinstance(t, ast.Subscript) and isinstance(
+                            t.value, ast.Attribute):
+                        self._np_attrs.add(t.value.attr)
+        scopes = [ctx.tree] + [n for n in ast.walk(ctx.tree)
+                               if isinstance(n, (ast.FunctionDef,
+                                                 ast.AsyncFunctionDef))]
+        for scope in scopes:
+            self._scan_scope(scope)
+        self.ctx = None
+
+    @staticmethod
+    def _alias_chain(expr: ast.AST):
+        """Peel views (subscript/attribute) off an expression.  Returns
+        (root_name | None, attrs_along_chain).  A Call anywhere on the
+        spine means the argument is a *fresh* object (e.g.
+        ``rng.uniform(...)``, ``x.astype(...)``) that nobody else can
+        mutate — not an aliasing hazard."""
+        attrs = []
+        while True:
+            if isinstance(expr, ast.Subscript):
+                expr = expr.value
+            elif isinstance(expr, ast.Attribute):
+                attrs.append(expr.attr)
+                expr = expr.value
+            elif isinstance(expr, ast.Name):
+                return expr.id, attrs
+            else:
+                return None, attrs
+
+    def _scan_scope(self, scope: ast.AST):
+        # names bound to numpy buffers in THIS scope
+        np_locals: set[str] = set()
+        for node in _iter_scope(scope):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                if _np_rooted(call_name(node.value)):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            np_locals.add(t.id)
+        strict = any(p in self.ctx.path for p in self.STRICT_PATHS)
+        for node in _iter_scope(scope):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node) in self.ASARRAY and node.args):
+                continue
+            root, attrs = self._alias_chain(node.args[0])
+            if root is None:
+                continue
+            if root in np_locals:
+                what = root
+                held = False
+            elif set(attrs) & self._np_attrs:
+                what = ".".join([root] + list(reversed(attrs)))
+                held = True  # attribute-held: outlives the call by design
+            else:
+                continue
+            # Outside the async dispatch paths, a local buffer that is
+            # never written after the handoff cannot produce torn reads —
+            # only flag buffers that stay live and mutable.
+            if not strict and not held and not self._mutated_after(
+                    scope, root, node.lineno):
+                continue
+            self.report(node, f"jnp.asarray over live numpy buffer "
+                              f"'{what}' may alias zero-copy into an "
+                              "async dispatched program; use jnp.array "
+                              "(copies) or justify with a suppression")
+
+    def _mutated_after(self, scope: ast.AST, name: str, line: int) -> bool:
+        for node in _iter_scope(scope):
+            if getattr(node, "lineno", 0) <= line:
+                continue
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    r, _ = self._alias_chain(t)
+                    if r == name and not isinstance(t, ast.Name):
+                        return True  # buf[...] = / buf.x = after handoff
+            elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) \
+                    and node.func.attr in self.MUTATORS:
+                r, _ = self._alias_chain(node.func.value)
+                if r == name:
+                    return True
+        return False
+
+
+# -- TPL003: op-registry consistency -----------------------------------------
+
+class OpRegistryConsistency(Checker):
+    """Three invariants of the `@op` funnel (`core/dispatch.py`):
+    no duplicate names, no raw `OP_REGISTRY` mutation outside the
+    decorator, and every `differentiable=True` registration accounted for
+    by the machine-checked grad inventory (spec / NONDIFF_NATURE /
+    ALLOWLIST / STE_OPS in tests/test_grad_coverage.py)."""
+
+    rule = "TPL003"
+    name = "op-registry"
+    description = ("duplicate @op names, grad-coverage gaps, or registry "
+                   "mutation outside the decorator")
+
+    REGISTRY_NAMES = {"OP_REGISTRY"}
+    MUTATORS = {"pop", "update", "clear", "setdefault", "popitem"}
+    ACCOUNTING_SETS = {"NONDIFF_NATURE", "ALLOWLIST", "STE_OPS"}
+    GRAD_FILE_HINT = "test_grad_coverage"
+    DISPATCH_HOME = "core/dispatch.py"
+
+    def __init__(self):
+        super().__init__()
+        # name -> list of (path, line)
+        self.registrations: dict[str, list] = {}
+        # (name, path, line) for differentiable registrations
+        self.differentiable: list[tuple] = []
+        self.accounted: set[str] = set()
+        self.grad_file_seen = False
+        self._consumed: set[int] = set()
+
+    def check(self, ctx):
+        self.ctx = ctx
+        if self.GRAD_FILE_HINT in ctx.path.rsplit("/", 1)[-1]:
+            self.grad_file_seen = True
+            self._harvest_accounting(ctx.tree)
+        self._consumed = set()
+        self.visit(ctx.tree)
+        self.ctx = None
+
+    # -- registrations -------------------------------------------------------
+
+    def _record(self, name: str, node: ast.AST, diff: bool):
+        self.registrations.setdefault(name, []).append(
+            (self.ctx.path, node.lineno, node))
+        if diff:
+            self.differentiable.append((name, self.ctx.path, node.lineno,
+                                        node))
+
+    @staticmethod
+    def _op_call_parts(call: ast.Call):
+        """(name_literal | None, differentiable) for an op(...) call."""
+        name = None
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str):
+            name = call.args[0].value
+        diff = True
+        for kw in call.keywords:
+            if kw.arg == "differentiable" and isinstance(kw.value,
+                                                         ast.Constant):
+                diff = bool(kw.value.value)
+        return name, diff
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        for dec in node.decorator_list:
+            if _decorator_kind(dec) != "op":
+                continue
+            if isinstance(dec, ast.Call):
+                self._consumed.add(id(dec))
+                name, diff = self._op_call_parts(dec)
+                if name is None and dec.args:
+                    continue  # dynamic name (variable/f-string): out of
+                    # static reach — the runtime inventory still covers it
+                self._record(name or node.name, dec, diff)
+            else:  # bare @op
+                self._record(node.name, dec, True)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call):
+        if _decorator_kind(node) == "op" and id(node) not in self._consumed:
+            name, diff = self._op_call_parts(node)
+            if name is not None:  # dynamic names (loop registrations) are
+                self._record(name, node, diff)  # out of static reach
+        self._check_mutation(node)
+        self.generic_visit(node)
+
+    # -- raw registry mutation -----------------------------------------------
+
+    def _in_dispatch(self) -> bool:
+        return self.ctx.path.endswith(self.DISPATCH_HOME)
+
+    def _registry_subscript(self, node: ast.AST) -> bool:
+        return (isinstance(node, ast.Subscript)
+                and dotted_name(node.value).rsplit(".", 1)[-1]
+                in self.REGISTRY_NAMES)
+
+    def _check_mutation(self, call: ast.Call):
+        if self._in_dispatch():
+            return
+        f = call.func
+        if (isinstance(f, ast.Attribute) and f.attr in self.MUTATORS
+                and dotted_name(f.value).rsplit(".", 1)[-1]
+                in self.REGISTRY_NAMES):
+            self.report(call, f"OP_REGISTRY.{f.attr}() outside the @op "
+                              "decorator funnel (core/dispatch.py); register "
+                              "through @op so AMP/grad/consistency metadata "
+                              "stays attached")
+
+    def visit_Assign(self, node: ast.Assign):
+        if not self._in_dispatch():
+            for t in node.targets:
+                if self._registry_subscript(t):
+                    self.report(node, "direct OP_REGISTRY[...] assignment "
+                                      "outside the @op decorator funnel "
+                                      "(core/dispatch.py)")
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete):
+        if not self._in_dispatch():
+            for t in node.targets:
+                if self._registry_subscript(t):
+                    self.report(node, "del OP_REGISTRY[...] outside "
+                                      "core/dispatch.py")
+        self.generic_visit(node)
+
+    # -- grad accounting ------------------------------------------------------
+
+    def _harvest_accounting(self, tree: ast.AST):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                cname = call_name(node)
+                if cname == "spec" and node.args and isinstance(
+                        node.args[0], ast.Constant):
+                    self.accounted.add(node.args[0].value)
+                elif cname == "unary" and node.args and isinstance(
+                        node.args[0], ast.Constant):
+                    self.accounted.update(str(node.args[0].value).split())
+            elif isinstance(node, ast.For):
+                # `for n in "sum mean ...".split(): spec(n, ...)` and
+                # `for name, layer in [("relu", ...)]: spec(name, ...)`
+                body_specs = any(
+                    isinstance(n, ast.Call) and call_name(n) in ("spec",
+                                                                 "unary")
+                    for n in ast.walk(node))
+                if body_specs:
+                    for s in str_constants(node.iter):
+                        self.accounted.update(s.split())
+            elif isinstance(node, ast.Assign):
+                targets = {t.id for t in node.targets
+                           if isinstance(t, ast.Name)}
+                if targets & self.ACCOUNTING_SETS:
+                    if isinstance(node.value, ast.Dict):
+                        for k in node.value.keys:
+                            if isinstance(k, ast.Constant) and isinstance(
+                                    k.value, str):
+                                self.accounted.add(k.value)
+                    else:
+                        for s in str_constants(node.value):
+                            self.accounted.update(s.split())
+
+    def finalize(self):
+        for name, sites in sorted(self.registrations.items()):
+            if len(sites) > 1:
+                first = f"{sites[0][0]}:{sites[0][1]}"
+                for path, line, node in sites[1:]:
+                    self.report(node, f"duplicate @op registration '{name}' "
+                                      f"(first registered at {first}); "
+                                      "later registration silently wins",
+                                path=path, line=line)
+        if self.grad_file_seen:
+            for name, path, line, node in self.differentiable:
+                if name not in self.accounted:
+                    self.report(node, f"op '{name}' is registered "
+                                      "differentiable=True but has no grad "
+                                      "spec, NONDIFF_NATURE/ALLOWLIST/"
+                                      "STE_OPS entry in the grad-coverage "
+                                      "inventory", path=path, line=line)
+
+
+# -- TPL004: recompile hazards under jit/to_static ---------------------------
+
+class RecompileHazard(Checker):
+    """`time.time()` / `np.random.*` / loop-variable closure captures
+    inside jit/to_static regions either retrace every step or — worse —
+    bake a stale constant into the compiled program."""
+
+    rule = "TPL004"
+    name = "recompile-hazard"
+    description = ("impure host calls or mutable closure captures inside a "
+                   "jit/to_static region")
+
+    HAZARD_CALLS = {
+        "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+        "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    }
+    HAZARD_PREFIXES = ("np.random.", "numpy.random.", "random.")
+
+    def _is_hazard(self, cname: str) -> bool:
+        return cname in self.HAZARD_CALLS or (
+            cname.startswith(self.HAZARD_PREFIXES)
+            and not cname.startswith(("random.Random",)))
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        if _trace_kind(node):
+            self._scan_trace_fn(node, outer_hazards={}, loop_vars=set())
+        else:
+            self._scan_host_fn(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _scan_host_fn(self, fn: ast.FunctionDef):
+        """Record hazard-derived locals and loop variables, then inspect
+        nested trace-context functions for closure captures of them."""
+        hazards: dict[str, int] = {}
+        loops: list[tuple[ast.For, set[str]]] = []
+        for node in _iter_scope(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                if self._is_hazard(call_name(node.value)):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            hazards[t.id] = node.lineno
+        for node in ast.walk(fn):
+            if isinstance(node, ast.For):
+                loops.append((node, names_in(node.target)))
+        for node in ast.walk(fn):
+            if node is not fn and isinstance(node, (ast.FunctionDef,
+                                                    ast.AsyncFunctionDef)):
+                if _trace_kind(node):
+                    # a traced fn defined INSIDE the loop body is fresh
+                    # per iteration — capturing that iteration's variable
+                    # is the normal pattern, not a staleness hazard
+                    loop_vars = set()
+                    for for_node, targets in loops:
+                        if not any(n is node for n in ast.walk(for_node)):
+                            loop_vars |= targets
+                    self._scan_trace_fn(node, hazards, loop_vars)
+
+    def _scan_trace_fn(self, fn: ast.FunctionDef,
+                       outer_hazards: dict, loop_vars: set):
+        # everything bound inside the traced fn itself is local, including
+        # its own loop targets and comprehension variables
+        local = _param_names(fn) | {
+            n.id for n in ast.walk(fn)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store,
+                                                              ast.Del))}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                cname = call_name(node)
+                if self._is_hazard(cname):
+                    self.report(node, f"{cname}() inside jit/to_static "
+                                      f"region '{fn.name}' is evaluated at "
+                                      "trace time and baked in as a "
+                                      "constant (recompile/staleness "
+                                      "hazard)")
+            elif isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                           ast.Load):
+                if node.id in local:
+                    continue
+                if node.id in outer_hazards:
+                    self.report(node, f"closure capture of '{node.id}' "
+                                      "(derived from an impure host call at "
+                                      f"line {outer_hazards[node.id]}) in "
+                                      f"traced function '{fn.name}': the "
+                                      "value is frozen at trace time")
+                elif node.id in loop_vars:
+                    self.report(node, f"closure capture of loop variable "
+                                      f"'{node.id}' in traced function "
+                                      f"'{fn.name}': jit caches on "
+                                      "signature, not closure — iterations "
+                                      "after the first reuse a stale "
+                                      "constant")
+
+
+# -- TPL005: collective axis safety ------------------------------------------
+
+class CollectiveSafety(Checker):
+    """A `lax.p*` collective naming a mesh axis that no `shard_map` /
+    `Mesh` / `PartitionSpec` in the file binds fails at trace time deep
+    inside XLA with an unbound-axis error — or, if the literal drifts
+    from the binding site, silently reduces over the wrong axis."""
+
+    rule = "TPL005"
+    name = "collective-safety"
+    description = ("lax collective referencing a mesh axis not bound by "
+                   "any shard_map/Mesh/spec in the file")
+
+    COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "ppermute",
+                   "all_gather", "psum_scatter", "all_to_all",
+                   "axis_index", "pbroadcast", "pshuffle"}
+    BINDERS = {"shard_map", "Mesh", "make_mesh", "P", "PartitionSpec",
+               "pmap", "xmap"}
+    BINDER_KWARGS = {"axis_names", "axis_name", "in_specs", "out_specs"}
+
+    def check(self, ctx):
+        self.ctx = ctx
+        bound: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = call_name(node).rsplit(".", 1)[-1]
+            if tail in self.BINDERS:
+                bound |= str_constants(node)
+            else:
+                for kw in node.keywords:
+                    if kw.arg in self.BINDER_KWARGS:
+                        bound |= str_constants(kw.value)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            root, _, tail = cname.rpartition(".")
+            if tail not in self.COLLECTIVES or root not in ("lax",
+                                                            "jax.lax"):
+                continue
+            axis_pos = 0 if tail == "axis_index" else 1
+            axis_arg = None
+            if len(node.args) > axis_pos:
+                axis_arg = node.args[axis_pos]
+            for kw in node.keywords:
+                if kw.arg in ("axis_name", "axis"):
+                    axis_arg = kw.value
+            if axis_arg is None:
+                continue
+            for ax in sorted(str_constants(axis_arg)):
+                if ax not in bound:
+                    self.report(node, f"collective lax.{tail}('{ax}') "
+                                      "references mesh axis "
+                                      f"'{ax}' not bound by any shard_map/"
+                                      "Mesh/PartitionSpec in this file")
+
+
+# -- TPL006: flag hygiene ----------------------------------------------------
+
+class FlagHygiene(Checker):
+    """A `define_flag()` whose name is never read anywhere in the tree is
+    dead configuration surface: it silently accepts FLAGS_* env overrides
+    and set_flags() writes that change nothing."""
+
+    rule = "TPL006"
+    name = "flag-hygiene"
+    severity = "warning"
+    description = "defined runtime flag that no code ever reads"
+
+    def __init__(self):
+        super().__init__()
+        self.defines: dict[str, tuple] = {}   # name -> (path, line, node)
+        self.reads: set[str] = set()
+
+    def visit_Call(self, node: ast.Call):
+        cname = call_name(node)
+        tail = cname.rsplit(".", 1)[-1]
+        first = (node.args[0].value
+                 if node.args and isinstance(node.args[0], ast.Constant)
+                 and isinstance(node.args[0].value, str) else None)
+        if tail == "define_flag" and first is not None:
+            self.defines.setdefault(first, (self.ctx.path, node.lineno,
+                                            node))
+        elif tail == "define" and "FLAGS" in cname.upper() \
+                and first is not None:
+            self.defines.setdefault(first, (self.ctx.path, node.lineno,
+                                            node))
+        elif tail in ("get", "has") and first is not None:
+            # any .get("name")/.has("name") counts as a read — that also
+            # matches dict.get, which is deliberately conservative (a flag
+            # is only reported when NOTHING in the tree could read it)
+            self.reads.add(first)
+        elif tail == "get_flags" and node.args:
+            self.reads.update(str_constants(node.args[0]))
+        self.generic_visit(node)
+
+    def finalize(self):
+        for name, (path, line, node) in sorted(self.defines.items()):
+            if name not in self.reads:
+                self.report(node, f"flag '{name}' is defined but never "
+                                  "read by any code in the analyzed tree "
+                                  "(dead configuration surface)",
+                            path=path, line=line)
+
+
+ALL_CHECKERS = [
+    HostSyncInTrace,
+    AsyncAliasing,
+    OpRegistryConsistency,
+    RecompileHazard,
+    CollectiveSafety,
+    FlagHygiene,
+]

@@ -1,0 +1,113 @@
+"""tpu-lint driver: file discovery, checker orchestration, CLI.
+
+    python -m tools.lint paddle_tpu tests [--format=json] [--select=TPL001]
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .checkers import ALL_CHECKERS
+from .core import Finding, parse_file
+from .reporters import render_json, render_text
+
+__all__ = ["run_lint", "main", "iter_python_files"]
+
+# Fixture files contain *seeded* violations for the checker unit tests —
+# never part of a clean-tree run.
+DEFAULT_EXCLUDES = ("data/lint_fixtures",)
+
+
+def iter_python_files(paths: list[str],
+                      excludes: tuple = DEFAULT_EXCLUDES) -> list[str]:
+    out = []
+    for path in paths:
+        if os.path.isfile(path):
+            out.append(path)
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git"))
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(root, fn))
+    norm = [p.replace(os.sep, "/") for p in out]
+    return [p for p, n in zip(out, norm)
+            if not any(ex in n for ex in excludes)]
+
+
+def run_lint(paths: list[str], select: set[str] | None = None,
+             excludes: tuple = DEFAULT_EXCLUDES,
+             keep_suppressed: bool = False) -> list[Finding]:
+    """Run every (selected) checker over the python files under ``paths``
+    and return unsuppressed findings, sorted by location."""
+    checkers = [cls() for cls in ALL_CHECKERS
+                if select is None
+                or cls.rule in select or cls.name in select]
+    findings: list[Finding] = []
+    contexts = {}
+    for path in iter_python_files(paths, excludes):
+        display = path.replace(os.sep, "/")
+        ctx, err = parse_file(path, display)
+        if err is not None:
+            findings.append(err)
+            continue
+        contexts[display] = ctx
+        for checker in checkers:
+            checker.check(ctx)
+    for checker in checkers:
+        checker.finalize()
+        findings.extend(checker.findings)
+    if not keep_suppressed:
+        findings = [
+            f for f in findings
+            if f.path not in contexts
+            or not contexts[f.path].suppressions.matches(f)
+        ]
+    return sorted(findings, key=Finding.sort_key)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tools.lint",
+        description="tpu-lint: static trace-safety/aliasing/registry "
+                    "checks for the paddle_tpu tree.",
+    )
+    parser.add_argument("paths", nargs="*", default=["paddle_tpu", "tests"],
+                        help="files or directories to lint "
+                             "(default: paddle_tpu tests)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text", help="output format")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule ids/names to run "
+                             "(default: all)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    parser.add_argument("--no-default-excludes", action="store_true",
+                        help="also lint the seeded-violation fixtures")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for cls in ALL_CHECKERS:
+            print(f"{cls.rule}  {cls.name:<20} {cls.severity:<8} "
+                  f"{cls.description}")
+        return 0
+
+    paths = args.paths or ["paddle_tpu", "tests"]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"tpu-lint: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    select = ({s.strip() for s in args.select.split(",") if s.strip()}
+              if args.select else None)
+    excludes = () if args.no_default_excludes else DEFAULT_EXCLUDES
+    findings = run_lint(paths, select=select, excludes=excludes)
+    render = render_json if args.format == "json" else render_text
+    print(render(findings))
+    return 1 if findings else 0

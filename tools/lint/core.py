@@ -1,0 +1,229 @@
+"""tpu-lint checker framework: findings, suppressions, file contexts.
+
+The analysis model is deliberately small: every checker is an
+``ast.NodeVisitor`` fed one parsed file at a time via :meth:`Checker.check`,
+plus an optional :meth:`Checker.finalize` hook that runs once after the
+whole file set has been visited — that is where project-wide rules
+(duplicate op registrations, never-read flags) report, since they cannot
+be decided from a single file.
+
+Suppressions are source comments, pylint-style:
+
+    x = float(t)  # tpu-lint: disable=TPL001 -- why this is safe
+
+A ``disable=`` comment suppresses the named rules (id ``TPL001`` or slug
+``host-sync-in-trace``, comma-separated, or ``all``) for every finding
+whose reported node overlaps that physical line — so a trailing comment
+anywhere inside a multi-line call suppresses the whole call.  A
+``disable-file=`` comment suppresses the rules for the entire file.
+Everything after ``--`` is the human rationale and is ignored by the
+matcher (but please write one).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+__all__ = [
+    "Finding",
+    "FileContext",
+    "Checker",
+    "Suppressions",
+    "parse_file",
+]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpu-lint:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\- ]+)"
+)
+
+
+@dataclass
+class Finding:
+    """One diagnostic: rule id + slug, severity, location, message."""
+
+    rule: str          # "TPL001"
+    name: str          # "host-sync-in-trace"
+    severity: str      # "error" | "warning"
+    path: str          # repo-relative posix path
+    line: int          # 1-based, node start
+    col: int           # 0-based
+    message: str
+    end_line: int = 0  # node end (for multi-line suppression matching)
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "name": self.name,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule)
+
+
+class Suppressions:
+    """Per-file map of ``tpu-lint: disable`` comments.
+
+    Built from the token stream (not the AST) so comments on blank lines
+    and trailing comments are both seen.
+    """
+
+    def __init__(self):
+        self.by_line: dict[int, set[str]] = {}
+        self.file_level: set[str] = set()
+
+    @classmethod
+    def scan(cls, source: str) -> "Suppressions":
+        sup = cls()
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                kind, raw = m.group(1), m.group(2)
+                # strip the optional "-- rationale" tail and whitespace
+                rules = {
+                    r.strip()
+                    for r in raw.split("--")[0].split(",")
+                    if r.strip()
+                }
+                if kind == "disable-file":
+                    sup.file_level |= rules
+                else:
+                    sup.by_line.setdefault(tok.start[0], set()).update(rules)
+        except (tokenize.TokenError, IndentationError):
+            pass  # parse-level problems are reported separately
+        return sup
+
+    def matches(self, finding: Finding) -> bool:
+        keys = {finding.rule, finding.name, "all"}
+        if self.file_level & keys:
+            return True
+        end = max(finding.end_line, finding.line)
+        for ln in range(finding.line, end + 1):
+            if self.by_line.get(ln, set()) & keys:
+                return True
+        return False
+
+
+@dataclass
+class FileContext:
+    """Everything a checker may want to know about the file under analysis."""
+
+    path: str                  # repo-relative posix path
+    tree: ast.AST
+    source: str
+    suppressions: Suppressions = field(default_factory=Suppressions)
+
+
+def parse_file(path: str, display_path: str) -> tuple[FileContext | None, Finding | None]:
+    """Parse one file; returns (context, None) or (None, parse-error finding)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=display_path)
+    except (OSError, SyntaxError, ValueError) as e:
+        line = getattr(e, "lineno", None) or 1
+        return None, Finding(
+            rule="TPL000",
+            name="parse-error",
+            severity="error",
+            path=display_path,
+            line=line,
+            col=0,
+            message=f"could not parse file: {e}",
+        )
+    return FileContext(display_path, tree, source, Suppressions.scan(source)), None
+
+
+class Checker(ast.NodeVisitor):
+    """Base class for one lint rule.
+
+    Subclasses set ``rule`` / ``name`` / ``severity`` / ``description``
+    and implement the usual ``visit_*`` methods, calling :meth:`report`
+    on violations.  State that must span files (registries, read-sets)
+    lives on the instance; :meth:`finalize` turns it into findings after
+    the last file.
+    """
+
+    rule = "TPL999"
+    name = "unnamed"
+    severity = "error"
+    description = ""
+
+    def __init__(self):
+        self.findings: list[Finding] = []
+        self.ctx: FileContext | None = None
+
+    # -- driver API ---------------------------------------------------------
+
+    def check(self, ctx: FileContext) -> None:
+        self.ctx = ctx
+        self.visit(ctx.tree)
+        self.ctx = None
+
+    def finalize(self) -> None:
+        """Emit project-wide findings (after every file was visited)."""
+
+    # -- helpers for subclasses ---------------------------------------------
+
+    def report(self, node: ast.AST, message: str, *, path: str | None = None,
+               line: int | None = None) -> None:
+        self.findings.append(Finding(
+            rule=self.rule,
+            name=self.name,
+            severity=self.severity,
+            path=path if path is not None else self.ctx.path,
+            line=line if line is not None else getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) if node is not None else 0,
+            message=message,
+            end_line=getattr(node, "end_lineno", 0) or 0,
+        ))
+
+
+# -- shared AST utilities ----------------------------------------------------
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target, best effort: ``jax.lax.psum`` -> same,
+    ``f()`` -> ``f``; anything non-name-like -> ''."""
+    return dotted_name(node.func)
+
+
+def dotted_name(node: ast.AST) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def names_in(node: ast.AST) -> set[str]:
+    """All bare Name identifiers loaded anywhere inside an expression."""
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def str_constants(node: ast.AST) -> set[str]:
+    """All string literals anywhere inside a node."""
+    return {
+        n.value
+        for n in ast.walk(node)
+        if isinstance(n, ast.Constant) and isinstance(n.value, str)
+    }

@@ -1,0 +1,39 @@
+"""Finding reporters: human text and machine JSON."""
+
+from __future__ import annotations
+
+import json
+
+from .core import Finding
+
+__all__ = ["render_text", "render_json"]
+
+
+def render_text(findings: list[Finding]) -> str:
+    lines = [
+        f"{f.path}:{f.line}:{f.col}: {f.rule}[{f.name}] {f.severity}: "
+        f"{f.message}"
+        for f in sorted(findings, key=Finding.sort_key)
+    ]
+    n_err = sum(1 for f in findings if f.severity == "error")
+    n_warn = len(findings) - n_err
+    lines.append(
+        "tpu-lint: clean" if not findings
+        else f"tpu-lint: {n_err} error(s), {n_warn} warning(s)"
+    )
+    return "\n".join(lines)
+
+
+def render_json(findings: list[Finding]) -> str:
+    return json.dumps(
+        {
+            "findings": [f.as_dict()
+                         for f in sorted(findings, key=Finding.sort_key)],
+            "summary": {
+                "errors": sum(1 for f in findings if f.severity == "error"),
+                "warnings": sum(1 for f in findings
+                                if f.severity == "warning"),
+            },
+        },
+        indent=2,
+    )
