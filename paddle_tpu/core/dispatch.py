@@ -287,8 +287,14 @@ def unregister_op(name: str) -> None:
     """Remove a registration (custom-op teardown — utils.cpp_extension
     lifecycles, tests). Public wrappers close over their OpDef, so removal
     only affects registry lookups (inventories, AMP name lists), which is
-    exactly what a transient custom op must not leak into."""
-    OP_REGISTRY.pop(name, None)
+    exactly what a transient custom op must not leak into.
+
+    Unknown names raise ``KeyError``: silently "unregistering" an op that
+    was never there (typo'd teardown) would leave the real registration
+    leaking into the inventories the caller meant to clean."""
+    if name not in OP_REGISTRY:
+        raise KeyError(f"unregister_op: no registered op named '{name}'")
+    del OP_REGISTRY[name]
 
 
 def op(name: str | None = None, differentiable: bool = True, amp: str = "none"):

@@ -51,13 +51,46 @@ def _ambient_mesh():
     return None
 
 
-def install() -> None:
+def _legacy_shard_map_kwargs(mesh_axis_names, axis_names=None,
+                             check_vma=None, check_rep=None) -> dict:
+    """Map the current-jax shard_map surface onto 0.4.x kwargs.
+
+    - ``axis_names=`` (axes that ARE manual) inverts into ``auto=``
+      (mesh axes that are NOT manual),
+    - ``check_vma=`` is 0.4.x's ``check_rep=`` renamed; an explicit
+      ``check_rep=`` passes through when ``check_vma`` is absent.
+
+    Module-level (rather than a closure inside ``install``) so the
+    mapping is directly unit-testable — tests/test_jax_compat.py pins
+    it even on runtimes where the shim never installs.
+    """
+    kw = {}
+    if axis_names is not None:
+        kw["auto"] = frozenset(mesh_axis_names) - frozenset(axis_names)
+    if check_vma is not None:
+        kw["check_rep"] = check_vma
+    elif check_rep is not None:
+        kw["check_rep"] = check_rep
+    return kw
+
+
+def install(jax_mod=None) -> set:
+    """Patch whatever the runtime is missing; returns the names patched
+    in THIS call.  ``jax_mod`` defaults to the real jax module — tests
+    pass a stand-in namespace to exercise the no-op path without
+    touching global state.  The module-level ``PATCHED`` set only
+    records patches applied to the real jax."""
+    real = jax_mod is None
+    if jax_mod is None:
+        jax_mod = jax
+    patched: set = set()
     try:  # attribute access like jax.export.serialize needs the submodule
-        import jax.export  # noqa: F401
+        # (aliased so this import does not shadow the module-level jax)
+        import jax.export as _jax_export  # noqa: F401
     except ImportError:  # pragma: no cover — very old jax
         pass
 
-    if not hasattr(jax, "shard_map"):
+    if not hasattr(jax_mod, "shard_map"):
         import functools
 
         from jax.experimental.shard_map import shard_map as _shard_map
@@ -65,14 +98,9 @@ def install() -> None:
         def shard_map(f, *, mesh=None, in_specs, out_specs, axis_names=None,
                       check_vma=None, check_rep=None):
             def build(m):
-                kw = {}
-                if axis_names is not None:
-                    kw["auto"] = frozenset(m.axis_names) - frozenset(
-                        axis_names)
-                if check_vma is not None:
-                    kw["check_rep"] = check_vma
-                elif check_rep is not None:
-                    kw["check_rep"] = check_rep
+                kw = _legacy_shard_map_kwargs(
+                    m.axis_names, axis_names=axis_names,
+                    check_vma=check_vma, check_rep=check_rep)
                 return _shard_map(f, mesh=m, in_specs=in_specs,
                                   out_specs=out_specs, **kw)
 
@@ -95,10 +123,10 @@ def install() -> None:
 
             return deferred
 
-        jax.shard_map = shard_map
-        PATCHED.add("shard_map")
+        jax_mod.shard_map = shard_map
+        patched.add("shard_map")
 
-    if not hasattr(jax.sharding, "get_abstract_mesh"):
+    if not hasattr(jax_mod.sharding, "get_abstract_mesh"):
 
         def get_abstract_mesh():
             # Best effort on 0.4.x: the abstract view of the ambient mesh
@@ -109,10 +137,10 @@ def install() -> None:
                 return None
             return getattr(mesh, "abstract_mesh", mesh)
 
-        jax.sharding.get_abstract_mesh = get_abstract_mesh
-        PATCHED.add("get_abstract_mesh")
+        jax_mod.sharding.get_abstract_mesh = get_abstract_mesh
+        patched.add("get_abstract_mesh")
 
-    if not hasattr(jax.sharding, "set_mesh"):
+    if not hasattr(jax_mod.sharding, "set_mesh"):
 
         @contextlib.contextmanager
         def set_mesh(mesh):
@@ -129,5 +157,9 @@ def install() -> None:
             finally:
                 _CTX_MESH.pop()
 
-        jax.sharding.set_mesh = set_mesh
-        PATCHED.add("set_mesh")
+        jax_mod.sharding.set_mesh = set_mesh
+        patched.add("set_mesh")
+
+    if real:
+        PATCHED.update(patched)
+    return patched

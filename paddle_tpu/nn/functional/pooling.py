@@ -72,7 +72,7 @@ def _pool(x, kernel, stride, padding, n, channel_last, kind, ceil_mode=False,
         ones = jnp.ones_like(x)
         counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
         return summed / counts
-    return summed / float(np.prod(k))
+    return summed / float(np.prod(k))  # tpu-lint: disable=TPL101 -- kernel window sizes are static pooling config (ints/tuples), never traced arrays
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,

@@ -101,7 +101,6 @@ _BINARY = {
     "atan2": jnp.arctan2,
     "hypot": jnp.hypot,
     "copysign": jnp.copysign,
-    "nextafter": jnp.nextafter,
     "heaviside": jnp.heaviside,
     "logaddexp": jnp.logaddexp,
 }
@@ -109,6 +108,10 @@ for _name, _fn in _BINARY.items():
     globals()[_name] = op(_name)(lambda x, y, _f=_fn: _f(x, y))
 
 _BINARY_NONDIFF = {
+    # nextafter: float outputs, but jax defines no JVP/VJP for it (the
+    # grad inventory already lists it nondiff-by-nature) — registering
+    # it differentiable would only defer the abort to backward time
+    "nextafter": jnp.nextafter,
     "floor_divide": jnp.floor_divide,
     "mod": jnp.mod,
     "remainder": jnp.remainder,

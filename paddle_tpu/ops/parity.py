@@ -251,7 +251,7 @@ def _fractional_pool(x, output_size, spatial, random_u=None):
         alpha = dim_in / dim_out
         idx = jnp.floor(alpha * (jnp.arange(dim_out + 1) + u)).astype(int)
         idx = jnp.clip(idx, 0, dim_in)
-        idx = np.asarray(idx)
+        idx = np.asarray(idx)  # tpu-lint: disable=TPL101 -- segment boundaries are host-side by design (static given output_size and scalar u); under capture this op takes the deliberate graph break
         idx[0], idx[-1] = 0, dim_in
         axis = x.ndim - nd + i
         segs = [lax.slice_in_dim(res, int(idx[j]),

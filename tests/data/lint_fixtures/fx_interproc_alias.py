@@ -1,0 +1,24 @@
+"""TPL102 fixture: numpy buffer reaching jnp.asarray through a helper."""
+
+import numpy as np
+
+from fx_interproc_helpers import stage
+
+
+def serve():
+    buf = np.zeros((4,))
+    out = stage(buf)  # seeded violation TPL102 (buf mutated below)
+    buf[0] = 1.0
+    return out
+
+
+def serve_suppressed():
+    buf = np.zeros((4,))
+    out = stage(buf)  # tpu-lint: disable=TPL102 -- suppressed instance for the fixture contract
+    buf[0] = 1.0
+    return out
+
+
+def serve_safe():
+    buf = np.zeros((4,))
+    return stage(buf)  # never mutated after handoff: not reported

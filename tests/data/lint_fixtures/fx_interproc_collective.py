@@ -1,0 +1,16 @@
+"""TPL103 fixture: collective reached from a path with no axis binding.
+
+The helpers file binds 'fxmp' in its shard_map wrapper, so per-file
+TPL005 is quiet everywhere — only the chain walk sees that THIS entry
+path never binds the axis.
+"""
+
+from fx_interproc_helpers import allreduce
+
+
+def batch_stats(x):
+    return allreduce(x)  # seeded violation TPL103 (unbound 'fxmp' path)
+
+
+def batch_stats_suppressed(x):
+    return allreduce(x)  # tpu-lint: disable=TPL103 -- suppressed instance for the fixture contract

@@ -1,0 +1,60 @@
+"""Helper module for the interprocedural (TPL101-TPL103) fixtures.
+
+Nothing in THIS file is a per-file violation: the syncs/handoffs/
+collectives only become findings when a trace root / live buffer /
+unbound entry path in the sibling fixture files reaches them through
+the call graph.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+# -- TPL101 chain: deep_sync -> _inner -> .item() ----------------------------
+
+def _inner(x):
+    return x.item()
+
+
+def deep_sync(x):
+    return _inner(x)
+
+
+def eager_metric(x):
+    # called from eager-only fixture code: never reported
+    return deep_sync(x) + 1
+
+
+# -- TPL102 chain: stage -> _hand -> jnp.asarray -----------------------------
+
+def _hand(b):
+    return jnp.asarray(b)
+
+
+def stage(buf):
+    return _hand(buf)
+
+
+# -- TPL103 chain: allreduce -> _ar -> lax.psum('fxmp') ----------------------
+
+def _ar(x):
+    return lax.psum(x, "fxmp")
+
+
+def allreduce(x):
+    return _ar(x)
+
+
+def mapped(x):
+    # the in-file binding that keeps per-file TPL005 quiet: this is the
+    # path helpers were written for — TPL103 exists for the *other* one
+    return jax.shard_map(_ar, axis_names=("fxmp",),
+                         in_specs=None, out_specs=None)(x)
+
+
+def guarded_sync(x):
+    if isinstance(x, jax.core.Tracer):
+        return x
+    return np.asarray(x)  # eager-only branch: not a sync summary

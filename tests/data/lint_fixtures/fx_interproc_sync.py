@@ -1,0 +1,20 @@
+"""TPL101 fixture: host sync reachable from trace roots via call chains."""
+
+import jax
+
+from fx_interproc_helpers import deep_sync, eager_metric
+
+
+@jax.jit
+def traced_step(x):
+    return deep_sync(x)  # seeded violation TPL101 (2-hop chain)
+
+
+@jax.jit
+def traced_suppressed(x):
+    return deep_sync(x)  # tpu-lint: disable=TPL101 -- suppressed instance for the fixture contract
+
+
+def eager_driver(x):
+    # not a trace root: reaching a sync from here is fine
+    return eager_metric(x)

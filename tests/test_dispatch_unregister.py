@@ -1,0 +1,70 @@
+"""core.dispatch.unregister_op contract: re-registration works, unknown
+names fail loudly, and the grad-coverage inventory (the set of
+differentiable registrations) is left exactly as it was found."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.dispatch import (
+    OP_REGISTRY,
+    op,
+    op_call,
+    unregister_op,
+)
+
+
+def _diff_inventory():
+    return sorted(n for n, d in OP_REGISTRY.items() if d.differentiable)
+
+
+def test_unregister_then_reregister_picks_up_new_impl():
+    name = "fx_unreg_cycle"
+    assert name not in OP_REGISTRY
+    try:
+        op(name, differentiable=False)(lambda x: x * 2)
+        assert OP_REGISTRY[name].name == name
+        unregister_op(name)
+        assert name not in OP_REGISTRY
+        # re-registration after teardown must install the NEW lowering
+        op(name, differentiable=False)(lambda x: x * 3)
+        t = paddle.to_tensor(np.array([2.0], np.float32))
+        out = op_call(OP_REGISTRY[name], (t,), {})
+        np.testing.assert_allclose(np.asarray(out.numpy()), [6.0])
+    finally:
+        OP_REGISTRY.pop(name, None)  # tpu-lint: disable=TPL003 -- test teardown must not raise if the op never registered
+
+
+def test_unregister_unknown_name_raises_keyerror():
+    with pytest.raises(KeyError, match="no registered op named"):
+        unregister_op("fx_never_registered_op")
+    # and a typo'd teardown must not have removed anything real
+    assert "matmul" in OP_REGISTRY
+
+
+def test_unregister_keeps_grad_inventory_consistent():
+    before = _diff_inventory()
+    name = "fx_unreg_diff"
+    try:
+        op(name)(lambda x: x)  # differentiable=True default
+        assert name in _diff_inventory()
+        unregister_op(name)
+    finally:
+        OP_REGISTRY.pop(name, None)  # tpu-lint: disable=TPL003 -- test teardown must not raise if the op never registered
+    assert _diff_inventory() == before
+
+
+def test_wrapper_survives_unregistration():
+    # public wrappers close over their OpDef: callers holding a wrapper
+    # keep working; only registry lookups (inventories) see the removal
+    name = "fx_unreg_wrapper"
+    try:
+        wrapper = op(name, differentiable=False)(lambda x: x + 1)
+        unregister_op(name)
+        t = paddle.to_tensor(np.array([1.0], np.float32))
+        np.testing.assert_allclose(np.asarray(wrapper(t).numpy()), [2.0])
+        assert name not in OP_REGISTRY
+    finally:
+        OP_REGISTRY.pop(name, None)  # tpu-lint: disable=TPL003 -- test teardown must not raise if the op never registered

@@ -1,0 +1,153 @@
+"""Direct unit tests for paddle_tpu/core/jax_compat.py (PR 1 shipped it
+with only indirect coverage).
+
+Three contracts:
+1. PATCHED reflects exactly what this runtime was missing — and each
+   patched name really points at the shim (module check), each
+   un-patched name at native jax.
+2. The legacy kwarg mapping: ``axis_names=`` (axes that ARE manual)
+   inverts into 0.4.x ``auto=`` (mesh axes NOT manual); ``check_vma=``
+   renames to ``check_rep=`` and wins over an explicit ``check_rep=``.
+3. ``install()`` is a no-op on a current-jax surface (nothing present
+   is overwritten) and patches everything on a bare one — exercised
+   against stand-in namespaces so the test never mutates global jax.
+"""
+
+from __future__ import annotations
+
+import types
+
+import jax
+import pytest
+
+import paddle_tpu  # noqa: F401 -- triggers jax_compat.install() on real jax
+from paddle_tpu.core import jax_compat as jc
+
+SHIMMABLE = ("shard_map", "get_abstract_mesh", "set_mesh")
+
+
+def _version() -> tuple:
+    return tuple(int(x) for x in jax.__version__.split(".")[:2])
+
+
+def test_patched_contents_per_jax_version():
+    if _version() < (0, 5):
+        # 0.4.x spells all three differently: every shim must be live
+        assert jc.PATCHED == set(SHIMMABLE), jc.PATCHED
+    else:
+        # current jax: install() must not have replaced native APIs
+        assert jc.PATCHED == set(), jc.PATCHED
+
+
+def test_patched_names_point_at_shims_unpatched_at_native():
+    targets = {
+        "shard_map": getattr(jax, "shard_map", None),
+        "get_abstract_mesh": getattr(jax.sharding, "get_abstract_mesh",
+                                     None),
+        "set_mesh": getattr(jax.sharding, "set_mesh", None),
+    }
+    for name, obj in targets.items():
+        assert obj is not None, f"{name} missing even after install()"
+        is_shim = getattr(obj, "__module__", "") == jc.__name__
+        assert is_shim == (name in jc.PATCHED), (name, jc.PATCHED)
+
+
+def test_legacy_kwarg_mapping_axis_names_inverts_to_auto():
+    kw = jc._legacy_shard_map_kwargs(("dp", "tp", "pp"),
+                                     axis_names=("tp",))
+    assert kw == {"auto": frozenset({"dp", "pp"})}
+    # fully-manual: nothing left automatic
+    kw = jc._legacy_shard_map_kwargs(("dp",), axis_names=("dp",))
+    assert kw == {"auto": frozenset()}
+
+
+def test_legacy_kwarg_mapping_check_vma_renames_and_wins():
+    assert jc._legacy_shard_map_kwargs((), check_vma=False) == {
+        "check_rep": False}
+    assert jc._legacy_shard_map_kwargs((), check_rep=True) == {
+        "check_rep": True}
+    # explicit check_vma takes precedence over a check_rep passthrough
+    kw = jc._legacy_shard_map_kwargs((), check_vma=True, check_rep=False)
+    assert kw == {"check_rep": True}
+    # nothing requested -> nothing emitted (0.4.x defaults apply)
+    assert jc._legacy_shard_map_kwargs(()) == {}
+
+
+def _bare_namespace():
+    fake = types.SimpleNamespace()
+    fake.sharding = types.SimpleNamespace()
+    return fake
+
+
+def _current_namespace():
+    fake = _bare_namespace()
+    fake.shard_map = object()
+    fake.sharding.get_abstract_mesh = object()
+    fake.sharding.set_mesh = object()
+    return fake
+
+
+def test_install_is_noop_on_current_surface():
+    fake = _current_namespace()
+    before = {name: getattr(fake, name, None) for name in ("shard_map",)}
+    recorded = set(jc.PATCHED)
+    assert jc.install(fake) == set()
+    assert fake.shard_map is before["shard_map"]  # untouched
+    assert jc.PATCHED == recorded  # stand-ins never pollute the record
+
+
+def test_install_patches_bare_surface():
+    fake = _bare_namespace()
+    recorded = set(jc.PATCHED)
+    got = jc.install(fake)
+    assert got == set(SHIMMABLE)
+    assert callable(fake.shard_map)
+    assert callable(fake.sharding.set_mesh)
+    assert callable(fake.sharding.get_abstract_mesh)
+    assert jc.PATCHED == recorded  # real-jax record unchanged
+
+
+def test_install_patches_only_whats_missing():
+    fake = _current_namespace()
+    del fake.sharding.set_mesh
+    assert jc.install(fake) == {"set_mesh"}
+
+
+def test_shim_set_mesh_side_channel():
+    fake = _bare_namespace()
+    jc.install(fake)
+    mesh = jax.sharding.Mesh(
+        __import__("numpy").array(jax.devices("cpu")[:1]), ("fxdp",))
+    assert jc._ambient_mesh() is None or jc._ambient_mesh() is not mesh
+    with fake.sharding.set_mesh(mesh) as m:
+        assert m is mesh
+        assert jc._CTX_MESH[-1] is mesh
+        assert jc._ambient_mesh() is mesh
+        got = fake.sharding.get_abstract_mesh()
+        assert got is getattr(mesh, "abstract_mesh", mesh)
+    assert mesh not in jc._CTX_MESH
+
+
+def test_shim_shard_map_requires_ambient_mesh():
+    fake = _bare_namespace()
+    jc.install(fake)
+    deferred = fake.shard_map(lambda x: x, in_specs=None, out_specs=None)
+    assert jc._CTX_MESH == []  # precondition: no ambient mesh leaked in
+    with pytest.raises(ValueError, match="no mesh passed and no ambient"):
+        deferred(1.0)
+
+
+def test_shim_shard_map_runs_under_ambient_mesh():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from jax.sharding import PartitionSpec as P
+
+    fake = _bare_namespace()
+    jc.install(fake)
+    mesh = jax.sharding.Mesh(np.array(jax.devices("cpu")[:1]), ("fxdp",))
+    mapped = fake.shard_map(lambda x: x * 2, in_specs=P("fxdp"),
+                            out_specs=P("fxdp"))
+    with fake.sharding.set_mesh(mesh):
+        out = mapped(jnp.arange(4.0))
+    np.testing.assert_allclose(np.asarray(out), [0.0, 2.0, 4.0, 6.0])

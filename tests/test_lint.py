@@ -197,7 +197,7 @@ def test_reporters_shape():
 
 def test_rule_table_unique_and_documented():
     rules = [c.rule for c in ALL_CHECKERS]
-    assert len(rules) == len(set(rules)) == 6
+    assert len(rules) == len(set(rules)) == 9  # 6 per-file + 3 interproc
     assert all(c.description for c in ALL_CHECKERS)
     assert all(c.severity in ("error", "warning") for c in ALL_CHECKERS)
 
@@ -240,6 +240,41 @@ def test_default_excludes_skip_fixtures():
 
     files = iter_python_files([os.path.join(REPO, "tests")])
     assert not any("lint_fixtures" in p for p in files)
+
+
+def test_exclude_matching_is_component_anchored(tmp_path):
+    """Excludes match whole path components, not substrings: only the
+    exact ``data/lint_fixtures`` directory sequence is skipped —
+    look-alike names (``mydata/lint_fixtures_old``) are linted."""
+    from tools.lint import iter_python_files
+
+    layout = [
+        ("data/lint_fixtures/seeded.py", False),       # the real fixture dir
+        ("a/b/data/lint_fixtures/deep.py", False),     # anywhere in the path
+        ("mydata/lint_fixtures/near_miss.py", True),   # 'mydata' != 'data'
+        ("data/lint_fixtures_old/stale.py", True),     # suffixed component
+        ("data/lint_fixturesx/tricky.py", True),       # the old substring bug
+        ("src/ok.py", True),
+    ]
+    for rel, _ in layout:
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text("x = 1\n")
+    files = iter_python_files([str(tmp_path)])
+    for rel, included in layout:
+        hit = any(f.replace(os.sep, "/").endswith(rel) for f in files)
+        assert hit == included, (rel, files)
+
+
+def test_exclude_matching_helper_direct():
+    from tools.lint.cli import _is_excluded
+
+    ex = ("data/lint_fixtures",)
+    assert _is_excluded("tests/data/lint_fixtures/f.py", ex)
+    assert not _is_excluded("tests/mydata/lint_fixtures_b/f.py", ex)
+    assert not _is_excluded("tests/data/lint_fixturesx/f.py", ex)
+    assert not _is_excluded("data.py", ex)
+    assert not _is_excluded("anything.py", ())
 
 
 @pytest.mark.smoke

@@ -1,14 +1,24 @@
-"""The lint gate: the tree itself must be tpu-lint clean.
+"""The lint gate: the tree itself must be tpu-lint clean, and the
+abstract op-contract baseline must be current.
 
 This is the tier-1 enforcement of the static-analysis contract — every
-checker runs over paddle_tpu/, tests/, and tools/, and any unsuppressed
-finding fails the suite with the full diagnostic text. New code either
+checker (per-file TPL001-TPL006 and whole-program TPL101-TPL103) runs
+over paddle_tpu/, tests/, and tools/, and any unsuppressed finding
+fails the suite with the full diagnostic text. New code either
 satisfies the rules or carries an inline justified suppression
 (``# tpu-lint: disable=<rule> -- why``).
 
-Marked smoke: the whole sweep is pure-python AST work (~2s), and the
-critical-path tier is exactly where a regression in trace-safety or
-registry consistency should surface first.
+The contract-snapshot gate regenerates the abstract contracts for the
+whole dispatch registry (tools/lint/contracts.py) and diffs them
+against artifacts/op_contracts.json: an op whose output dtypes/shapes,
+vjp behavior, or x64 promotion changed — or a new/removed op — fails
+until the baseline is deliberately regenerated with
+
+    python -m tools.lint --contracts --baseline \
+        artifacts/op_contracts.json --write-baseline
+
+The lint sweep is marked smoke (pure AST, ~10s); the contract sweep
+traces every op abstractly (~15s) and runs in the normal tier.
 """
 
 from __future__ import annotations
@@ -25,6 +35,8 @@ if REPO not in sys.path:
 from tools.lint import run_lint  # noqa: E402
 from tools.lint.reporters import render_text  # noqa: E402
 
+BASELINE = os.path.join(REPO, "artifacts", "op_contracts.json")
+
 
 @pytest.mark.smoke
 def test_tree_is_lint_clean():
@@ -32,3 +44,26 @@ def test_tree_is_lint_clean():
                          os.path.join(REPO, "tests"),
                          os.path.join(REPO, "tools")])
     assert not findings, "\n" + render_text(findings)
+
+
+def test_contract_baseline_current():
+    """Runs in a fresh subprocess on purpose: the snapshot covers the
+    *import-time* registry (REGISTRY_MODULES), while the pytest process
+    accumulates call-time registrations (pool ops register on first
+    call) from whichever tests ran earlier — and the sweep's
+    jax_enable_x64 probes must not flip config under a live suite."""
+    import subprocess
+
+    assert os.path.exists(BASELINE), (
+        "no contract baseline; generate with: python -m tools.lint "
+        "--contracts --baseline artifacts/op_contracts.json "
+        "--write-baseline")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--contracts",
+         "--baseline", BASELINE],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (
+        "op contracts drifted from artifacts/op_contracts.json (or "
+        "unexplained violations) — if intended, regenerate with "
+        f"--write-baseline:\n{proc.stdout}\n{proc.stderr}")

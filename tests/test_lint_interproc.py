@@ -1,0 +1,434 @@
+"""tpu-lint interprocedural engine tests: call-graph construction,
+import/name resolution, taint fixpoints, and the TPL101-TPL103 rule
+contracts on multi-hop fixture chains.
+
+The fixture chains span two files (tests/data/lint_fixtures/
+fx_interproc_*.py import from fx_interproc_helpers.py), so these tests
+also pin cross-file resolution; the synthetic-tree tests build small
+projects under tmp_path to exercise specific resolver/guard behaviors
+in isolation.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.lint import run_lint  # noqa: E402
+from tools.lint.core import parse_file  # noqa: E402
+from tools.lint.interproc import (  # noqa: E402
+    ProjectIndex,
+    module_name_for,
+)
+
+FIXTURES = os.path.join(REPO, "tests", "data", "lint_fixtures")
+
+
+def fx(name):
+    return os.path.join(FIXTURES, name)
+
+
+def lint(files, rule, **kw):
+    return run_lint([fx(f) for f in files], select={rule}, excludes=(),
+                    **kw)
+
+
+def index_of(source: str, path="mod.py", tmp_path=None) -> ProjectIndex:
+    p = str(tmp_path / path)
+    os.makedirs(os.path.dirname(p), exist_ok=True)
+    with open(p, "w") as f:
+        f.write(textwrap.dedent(source))
+    ctx, err = parse_file(p, path)
+    assert err is None, err
+    idx = ProjectIndex()
+    idx.add_file(ctx)
+    return idx
+
+
+def func(idx: ProjectIndex, name: str):
+    return next(f for f in idx.functions if f.name == name)
+
+
+# -- TPL101 ------------------------------------------------------------------
+
+def test_tpl101_fires_on_two_hop_cross_file_chain():
+    src = open(fx("fx_interproc_sync.py")).read()
+    f = lint(["fx_interproc_sync.py", "fx_interproc_helpers.py"],
+             "TPL101")
+    assert len(f) == 1, [x.message for x in f]
+    assert "seeded violation" in src.splitlines()[f[0].line - 1]
+    assert f[0].path.endswith("fx_interproc_sync.py")
+    assert "traced_step -> deep_sync -> _inner" in f[0].message
+    assert ".item()" in f[0].message
+    assert "fx_interproc_helpers.py:18" in f[0].message
+
+
+def test_tpl101_suppressed_instance_respected():
+    live = lint(["fx_interproc_sync.py", "fx_interproc_helpers.py"],
+                "TPL101")
+    kept = lint(["fx_interproc_sync.py", "fx_interproc_helpers.py"],
+                "TPL101", keep_suppressed=True)
+    assert len(kept) == len(live) + 1  # the suppressed traced_suppressed
+
+
+def test_tpl101_unresolved_import_means_no_edge():
+    # helpers file absent: the chain cannot be resolved, no phantom edge
+    f = lint(["fx_interproc_sync.py"], "TPL101")
+    assert f == []
+
+
+def test_tpl101_eager_driver_not_reported():
+    f = lint(["fx_interproc_sync.py", "fx_interproc_helpers.py"],
+             "TPL101")
+    assert all("eager_driver" not in x.message for x in f)
+
+
+def test_tpl101_op_root_and_three_hops(tmp_path):
+    idx_file = tmp_path / "p.py"
+    idx_file.write_text(textwrap.dedent("""
+        from paddle_tpu.core.dispatch import op
+
+        def _c(v):
+            return v.item()
+
+        def _b(v):
+            return _c(v)
+
+        def _a(v):
+            return _b(v)
+
+        @op("fx_deep")
+        def fx_deep(x):
+            return _a(x)
+    """))
+    f = run_lint([str(idx_file)], select={"TPL101"}, excludes=())
+    assert len(f) == 1
+    assert "fx_deep -> _a -> _b -> _c" in f[0].message
+    assert "@op lowering" in f[0].message
+
+
+def test_tpl101_tensor_guard_is_eager_only(tmp_path):
+    p = tmp_path / "g.py"
+    p.write_text(textwrap.dedent("""
+        import jax
+        from paddle_tpu.core.tensor import Tensor
+
+        def _norm(v):
+            if isinstance(v, Tensor):
+                v = v.tolist()
+            return v
+
+        def _sync_after_divert(o):
+            if isinstance(o, jax.core.Tracer):
+                return o
+            return o.item()
+
+        @jax.jit
+        def traced(x):
+            return _norm(x) + _sync_after_divert(x)
+    """))
+    assert run_lint([str(p)], select={"TPL101"}, excludes=()) == []
+
+
+def test_tpl101_scalar_annotated_param_is_static(tmp_path):
+    p = tmp_path / "s.py"
+    p.write_text(textwrap.dedent("""
+        import jax
+
+        def _qmax(bits: int):
+            return float((1 << (bits - 1)) - 1)
+
+        def _qmax_untyped(bits):
+            return float(bits)
+
+        @jax.jit
+        def traced(x, bits):
+            return x * _qmax(bits) * _qmax_untyped(bits)
+    """))
+    f = run_lint([str(p)], select={"TPL101"}, excludes=())
+    assert len(f) == 1, [x.message for x in f]
+    assert "_qmax_untyped" in f[0].message
+
+
+def test_tpl101_sink_suppression_kills_all_chains(tmp_path):
+    p = tmp_path / "k.py"
+    p.write_text(textwrap.dedent("""
+        import jax
+
+        def _helper(v):
+            return v.item()  # tpu-lint: disable=TPL101 -- sink rationale
+
+        @jax.jit
+        def t1(x):
+            return _helper(x)
+
+        @jax.jit
+        def t2(x):
+            return _helper(x)
+    """))
+    # sink-line suppression removes the hazard at the source: nothing to
+    # report (and nothing for keep_suppressed to resurrect)
+    assert run_lint([str(p)], select={"TPL101"}, excludes=()) == []
+    assert run_lint([str(p)], select={"TPL101"}, excludes=(),
+                    keep_suppressed=True) == []
+
+
+# -- TPL102 ------------------------------------------------------------------
+
+def test_tpl102_fires_on_mutated_buffer_chain():
+    src = open(fx("fx_interproc_alias.py")).read()
+    f = lint(["fx_interproc_alias.py", "fx_interproc_helpers.py"],
+             "TPL102")
+    assert len(f) == 1, [x.message for x in f]
+    assert "seeded violation" in src.splitlines()[f[0].line - 1]
+    assert "stage -> _hand -> jnp.asarray" in f[0].message
+    assert "'buf'" in f[0].message
+
+
+def test_tpl102_suppressed_and_safe_instances():
+    kept = lint(["fx_interproc_alias.py", "fx_interproc_helpers.py"],
+                "TPL102", keep_suppressed=True)
+    assert len(kept) == 2  # serve + serve_suppressed; serve_safe silent
+
+
+def test_tpl102_strict_path_flags_unmutated_handoff(tmp_path):
+    pkg = tmp_path / "paddle_tpu" / "inference"
+    pkg.mkdir(parents=True)
+    (pkg / "mod.py").write_text(textwrap.dedent("""
+        import numpy as np
+        import jax.numpy as jnp
+
+        def _hand(b):
+            return jnp.asarray(b)
+
+        def serve():
+            buf = np.zeros((4,))
+            return _hand(buf)
+    """))
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        f = run_lint(["paddle_tpu"], select={"TPL102"}, excludes=())
+    finally:
+        os.chdir(cwd)
+    assert len(f) == 1 and "buf" in f[0].message
+
+
+def test_tpl102_attribute_held_buffer(tmp_path):
+    p = tmp_path / "h.py"
+    p.write_text(textwrap.dedent("""
+        import numpy as np
+        import jax.numpy as jnp
+
+        def _hand(b):
+            return jnp.asarray(b)
+
+        class Cache:
+            def __init__(self):
+                self.table = np.zeros((8,))
+
+            def get(self):
+                return _hand(self.table)
+    """))
+    f = run_lint([str(p)], select={"TPL102"}, excludes=())
+    assert len(f) == 1 and "self.table" in f[0].message
+
+
+# -- TPL103 ------------------------------------------------------------------
+
+def test_tpl103_fires_on_unbound_entry_path():
+    src = open(fx("fx_interproc_collective.py")).read()
+    f = lint(["fx_interproc_collective.py", "fx_interproc_helpers.py"],
+             "TPL103")
+    assert len(f) == 1, [x.message for x in f]
+    assert "seeded violation" in src.splitlines()[f[0].line - 1]
+    assert "batch_stats -> allreduce -> _ar" in f[0].message
+    assert "'fxmp'" in f[0].message
+
+
+def test_tpl103_suppressed_instance():
+    kept = lint(["fx_interproc_collective.py", "fx_interproc_helpers.py"],
+                "TPL103", keep_suppressed=True)
+    assert len(kept) == 2
+
+
+def test_tpl103_helpers_alone_are_quiet():
+    # the shard_map wrapper binds the axis for the in-file path; the
+    # helpers module has no unbound *entry* into the collective
+    f = lint(["fx_interproc_helpers.py"], "TPL103")
+    assert f == [], [x.message for x in f]
+
+
+def test_tpl103_entry_file_binding_dampens(tmp_path):
+    # the entry's own file binds the axis somewhere -> mesh context is
+    # clearly present, stay quiet (that situation is TPL005's turf)
+    p = tmp_path / "e.py"
+    p.write_text(textwrap.dedent("""
+        import jax
+        from jax import lax
+        from jax.sharding import Mesh
+
+        def _ar(x):
+            return lax.psum(x, "dpx")
+
+        def entry(x):
+            return _ar(x)
+
+        def context():
+            return Mesh([], ("dpx",))
+    """))
+    assert run_lint([str(p)], select={"TPL103"}, excludes=()) == []
+
+
+# -- ProjectIndex internals --------------------------------------------------
+
+def test_module_name_for_anchors_and_stems():
+    assert module_name_for("paddle_tpu/core/tensor.py") == (
+        "paddle_tpu.core.tensor", False)
+    assert module_name_for("/abs/prefix/paddle_tpu/nn/__init__.py") == (
+        "paddle_tpu.nn", True)
+    assert module_name_for("/tmp/xyz/standalone.py") == (
+        "standalone", False)
+    assert module_name_for("tests/data/lint_fixtures/fx_a.py") == (
+        "tests.data.lint_fixtures.fx_a", False)
+
+
+def test_relative_import_resolution(tmp_path):
+    pkg = tmp_path / "paddle_tpu" / "sub"
+    pkg.mkdir(parents=True)
+    (pkg / "helper.py").write_text("def h(x):\n    return x.item()\n")
+    (pkg / "user.py").write_text(
+        "import jax\nfrom .helper import h\n\n"
+        "@jax.jit\ndef traced(x):\n    return h(x)\n")
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        f = run_lint(["paddle_tpu"], select={"TPL101"}, excludes=())
+    finally:
+        os.chdir(cwd)
+    assert len(f) == 1 and "traced -> h" in f[0].message
+
+
+def test_self_method_resolution(tmp_path):
+    p = tmp_path / "c.py"
+    p.write_text(textwrap.dedent("""
+        import jax
+
+        class Step:
+            def _sync(self, v):
+                return v.item()
+
+            @jax.jit
+            def run(self, x):
+                return self._sync(x)
+    """))
+    f = run_lint([str(p)], select={"TPL101"}, excludes=())
+    assert len(f) == 1 and "run -> _sync" in f[0].message
+
+
+def test_nested_def_resolution(tmp_path):
+    p = tmp_path / "n.py"
+    p.write_text(textwrap.dedent("""
+        import jax
+
+        def outer():
+            def helper(v):
+                return v.item()
+
+            @jax.jit
+            def traced(x):
+                return helper(x)
+
+            return traced
+    """))
+    f = run_lint([str(p)], select={"TPL101"}, excludes=())
+    assert len(f) == 1 and "traced -> helper" in f[0].message
+
+
+def test_jit_wrapping_marks_trace_root(tmp_path):
+    p = tmp_path / "w.py"
+    p.write_text(textwrap.dedent("""
+        import jax
+
+        def _sync(v):
+            return v.item()
+
+        def step(x):
+            return _sync(x)
+
+        fast_step = jax.jit(step)
+    """))
+    f = run_lint([str(p)], select={"TPL101"}, excludes=())
+    assert len(f) == 1 and "step -> _sync" in f[0].message
+
+
+def test_taint_sources_attribution(tmp_path):
+    idx = index_of("""
+        import jax.numpy as jnp
+
+        def f(a, b):
+            x = a + 1
+            y = x * 2
+            return jnp.asarray(y), jnp.asarray(b)
+    """, tmp_path=tmp_path)
+    f = func(idx, "f")
+    assert set(f.asarray_params) == {"a", "b"}
+
+
+def test_call_site_arg_mapping(tmp_path):
+    idx = index_of("""
+        def g(p, q, r=None):
+            return p
+
+        def caller(buf):
+            return g(buf, 1, r=buf)
+    """, tmp_path=tmp_path)
+    idx.link()
+    caller = func(idx, "caller")
+    site = next(s for s in caller.calls if s.target == "g")
+    mapping = {param: getattr(expr, "id", None)
+               for param, expr in site.args_to_params()}
+    assert mapping["p"] == "buf"
+    assert mapping["r"] == "buf"
+
+
+def test_star_args_site_yields_no_mapping(tmp_path):
+    idx = index_of("""
+        def g(p):
+            return p
+
+        def caller(args):
+            return g(*args)
+    """, tmp_path=tmp_path)
+    idx.link()
+    caller = func(idx, "caller")
+    site = next(s for s in caller.calls if s.target == "g")
+    assert site.args_to_params() == []
+
+
+def test_module_level_code_is_an_entry(tmp_path):
+    p = tmp_path / "m.py"
+    p.write_text(textwrap.dedent("""
+        from jax import lax
+
+        def _ar(x):
+            return lax.pmean(x, "zz_axis")
+
+        result = _ar(1.0)
+    """))
+    f = run_lint([str(p)], select={"TPL103"}, excludes=())
+    assert len(f) == 1 and "<module>" in f[0].message
+
+
+def test_interproc_rules_inactive_when_not_selected():
+    # selecting only a per-file rule must not build or need the index
+    f = lint(["fx_interproc_sync.py", "fx_interproc_helpers.py"],
+             "TPL001")
+    assert f == []

@@ -1,0 +1,204 @@
+"""Unit tests for the abstract op-contract verifier (tools/lint/
+contracts.py) against toy OpDefs — fast, no full-registry sweep.  The
+full-tree snapshot gate (regenerate + diff against
+artifacts/op_contracts.json) lives in tests/test_lint_clean.py next to
+the lint-clean gate.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from paddle_tpu.core.dispatch import OpDef  # noqa: E402
+from tools.lint import contracts as C  # noqa: E402
+
+
+def probe(impl, differentiable=True, amp="none", name="fx"):
+    return C.probe_op(name, OpDef(name, impl, differentiable, amp))
+
+
+# -- case generation ---------------------------------------------------------
+
+def test_scalar_guesses():
+    assert C._scalar_guess("axis") == 0
+    assert C._scalar_guess("num_classes") == 2
+    assert C._scalar_guess("epsilon") == 0.5
+    assert C._scalar_guess("shape") == (2, 3)
+    assert C._scalar_guess("dtype") == "float32"
+    assert C._scalar_guess("transpose_x") is False
+    assert C._scalar_guess("x") is None  # arrays by default
+
+
+def test_required_params_varargs_become_two_arrays():
+    params = C._required_params(lambda *inputs: inputs)
+    assert [p.name for p in params] == ["args0", "args1"]
+    params = C._required_params(lambda x, y=1, **kw: x)
+    assert [p.name for p in params] == ["x"]
+
+
+def test_dt_leaf_spec_format():
+    assert C._dt(jax.ShapeDtypeStruct((2, 3), jnp.float32)) == "f32[2,3]"
+    assert C._dt(jax.ShapeDtypeStruct((), jnp.int32)) == "i32[]"
+
+
+# -- probe_op on toy ops -----------------------------------------------------
+
+def test_elementwise_op_contract_ok():
+    rec = probe(lambda x: x * 2)
+    assert rec["status"] == "ok"
+    assert rec["case"]["in"] == ["f32[2,3]"]
+    assert rec["case"]["out"] == ["f32[2,3]"]
+    assert rec["vjp"] == "ok"
+    assert rec["grads"] == ["f32[2,3]"]
+    assert rec["violations"] == []
+
+
+def test_scalar_config_params_recorded_static():
+    rec = probe(lambda x, axis, epsilon: jnp.sum(x, axis=axis) + epsilon)
+    assert rec["status"] == "ok"
+    assert rec["case"]["static"] == {"axis": "0", "epsilon": "0.5"}
+    assert rec["case"]["out"] == ["f32[3]"]
+
+
+def test_broadcast_probe_recorded():
+    rec = probe(lambda x, y: x + y)
+    assert rec["broadcast"] == ["f32[2,3]"]
+
+
+def test_weak_type_probe_recorded():
+    rec = probe(lambda x, y: x + y)
+    assert rec["weak"] == ["f32[2,3]"]  # weak scalar + f32 stays f32
+
+
+def test_x64_upcast_violation_detected():
+    # np.float64 constants are STRONG: under x64 they win the promotion
+    rec = probe(lambda x: x * np.float64(2.0))
+    kinds = [v["kind"] for v in rec["violations"]]
+    assert "x64-upcast" in kinds, rec
+    # well-behaved python-float scalars stay weak: no violation
+    rec = probe(lambda x: x * 2.0)
+    assert rec["violations"] == []
+
+
+def test_vjp_abort_violation_detected():
+    rec = probe(lambda x, y: jnp.nextafter(x, y))
+    assert rec["vjp"].startswith("error:")
+    assert [v["kind"] for v in rec["violations"]] == ["vjp-abort"]
+    # same impl registered non-differentiable: no vjp claim, no violation
+    rec = probe(lambda x, y: jnp.nextafter(x, y), differentiable=False)
+    assert rec["vjp"] == "skipped"
+    assert rec["violations"] == []
+
+
+def test_nondiff_output_is_not_a_violation():
+    rec = probe(lambda x: x > 0)
+    assert rec["vjp"] == "nondiff-output"
+    assert rec["violations"] == []
+
+
+def test_opaque_op_records_error_class():
+    def needs_concrete(x):
+        if bool(x.sum() > 0):  # concretization under eval_shape
+            return x
+        return -x
+
+    rec = probe(needs_concrete)
+    assert rec["status"] == "opaque"
+    assert "Concretization" in rec["error"] or "Tracer" in rec["error"]
+
+
+def test_grad_shape_mismatch_detected():
+    def bad_vjp_shape(x):
+        @jax.custom_vjp
+        def f(v):
+            return v.sum()
+
+        def fwd(v):
+            return f(v), None
+
+        def bwd(_, g):
+            return (jnp.zeros((5,), jnp.float32),)  # wrong shape
+
+        f.defvjp(fwd, bwd)
+        return f(x)
+
+    rec = probe(bad_vjp_shape)
+    kinds = [v["kind"] for v in rec["violations"]]
+    # jax itself may reject the bad cotangent shape (vjp-abort) or let
+    # the probe see it (grad-shape-mismatch) — either way it cannot pass
+    assert kinds, rec
+
+
+# -- explanations + baseline diff --------------------------------------------
+
+def _toy_contracts(**ops):
+    return {"schema": 1, "jax": jax.__version__, "op_count": len(ops),
+            "ops": dict(ops)}
+
+
+def test_unexplained_violations_filtering():
+    contracts = _toy_contracts(
+        a={"violations": [{"kind": "vjp-abort", "detail": "X"}]},
+        b={"violations": []},
+    )
+    assert C.unexplained_violations(contracts) == [
+        ("a", "vjp-abort", "X")]
+    try:
+        C.EXPLAINED["a"] = {"vjp-abort": "because"}
+        assert C.unexplained_violations(contracts) == []
+    finally:
+        del C.EXPLAINED["a"]
+
+
+def test_diff_baselines_reports_drift():
+    base = _toy_contracts(a={"case": {"out": ["f32[2,3]"]}},
+                          b={"case": {"out": ["f32[2,3]"]}})
+    cur = _toy_contracts(a={"case": {"out": ["f32[2,3,1]"]}},  # rank drift
+                         c={"case": {"out": ["i32[]"]}})       # new op
+    lines = C.diff_baselines(cur, base)
+    joined = "\n".join(lines)
+    assert "removed op: b" in joined
+    assert "new op: c" in joined
+    assert "contract drift: a (case)" in joined
+    assert C.diff_baselines(base, base) == []
+
+
+def test_write_and_load_baseline_roundtrip(tmp_path):
+    contracts = _toy_contracts(a={"case": {"out": ["f32[2,3]"]}})
+    path = str(tmp_path / "sub" / "baseline.json")
+    C.write_baseline(contracts, path)
+    assert C.load_baseline(path) == contracts
+
+
+def test_explained_entries_reference_registered_ops():
+    registry = C.load_registry()
+    for name in C.EXPLAINED:
+        assert name in registry, f"EXPLAINED entry for unknown op {name}"
+
+
+# -- CLI surface -------------------------------------------------------------
+
+def test_cli_baseline_missing_exit_code(tmp_path, capsys):
+    from tools.lint.cli import main
+
+    rc = main(["--contracts", "--baseline",
+               str(tmp_path / "nope.json")])
+    assert rc == 3
+    assert "missing" in capsys.readouterr().err
+
+
+def test_cli_write_baseline_requires_contracts(capsys):
+    from tools.lint.cli import main
+
+    assert main(["--write-baseline"]) == 2
+    assert main(["--write-baseline", "--contracts"]) == 2

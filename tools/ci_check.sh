@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# One-command CI gate: static analysis -> op-contract baseline -> tier-1.
+#
+#   bash tools/ci_check.sh
+#
+# Distinct exit codes per failing stage (stable; see
+# tools/lint/ARCHITECTURE.md):
+#   10  tpu-lint findings (or lint driver error)
+#   20  op-contract violations / baseline drift / missing baseline
+#   30  tier-1 tests failed (ROADMAP.md command)
+#    0  all gates green
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== gate 1/3: tpu-lint (per-file + interprocedural rules) =="
+python -m tools.lint paddle_tpu tests --format=json > /tmp/tpu_lint.json
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    cat /tmp/tpu_lint.json
+    echo "ci_check: tpu-lint gate failed (lint rc=$rc)" >&2
+    exit 10
+fi
+echo "tpu-lint: clean"
+
+echo "== gate 2/3: tpu-verify (abstract op-contract baseline) =="
+JAX_PLATFORMS=cpu python -m tools.lint --contracts \
+    --baseline artifacts/op_contracts.json
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "ci_check: contract gate failed (verify rc=$rc; regenerate" \
+         "deliberately with --write-baseline)" >&2
+    exit 20
+fi
+
+echo "== gate 3/3: tier-1 tests (ROADMAP.md) =="
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+if [ "$rc" -ne 0 ]; then
+    echo "ci_check: tier-1 gate failed (pytest rc=$rc)" >&2
+    exit 30
+fi
+
+echo "ci_check: all gates green"

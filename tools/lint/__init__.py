@@ -2,18 +2,24 @@
 
 Checks trace-safety (host syncs under capture), async aliasing of numpy
 buffers, op-registry consistency against the grad-coverage inventory,
-recompile hazards, collective axis binding, and flag hygiene.
+recompile hazards, collective axis binding, flag hygiene — plus the
+whole-program interprocedural rules (TPL101-TPL103, call-chain taint
+over the project import/call graph; tools/lint/interproc.py) and
+abstract op-contract verification (``--contracts``;
+tools/lint/contracts.py).
 
     python -m tools.lint paddle_tpu tests [--format=json]
+    python -m tools.lint --contracts --baseline artifacts/op_contracts.json
 
-See ``tools/lint/checkers.py`` for the rule table and the README section
-"Static analysis (tpu-lint)" for suppression syntax and how to add a
-checker.
+See ``tools/lint/checkers.py`` + ``tools/lint/interproc.py`` for the
+rule table, ``tools/lint/ARCHITECTURE.md`` for the call-graph/fixpoint
+design, and the README section "Static analysis (tpu-lint)" for
+suppression syntax and how to add a checker.
 """
 
-from .checkers import ALL_CHECKERS
-from .cli import DEFAULT_EXCLUDES, iter_python_files, main, run_lint
+from .cli import ALL_CHECKERS, DEFAULT_EXCLUDES, iter_python_files, main, run_lint
 from .core import Checker, FileContext, Finding, Suppressions
+from .interproc import INTERPROC_CHECKERS, ProjectIndex
 from .reporters import render_json, render_text
 
 __all__ = [
@@ -22,6 +28,8 @@ __all__ = [
     "DEFAULT_EXCLUDES",
     "FileContext",
     "Finding",
+    "INTERPROC_CHECKERS",
+    "ProjectIndex",
     "Suppressions",
     "iter_python_files",
     "main",

@@ -285,7 +285,9 @@ class AsyncAliasing(Checker):
                               "async dispatched program; use jnp.array "
                               "(copies) or justify with a suppression")
 
-    def _mutated_after(self, scope: ast.AST, name: str, line: int) -> bool:
+    @staticmethod
+    def _mutated_after(scope: ast.AST, name: str, line: int) -> bool:
+        chain = AsyncAliasing._alias_chain
         for node in _iter_scope(scope):
             if getattr(node, "lineno", 0) <= line:
                 continue
@@ -293,13 +295,13 @@ class AsyncAliasing(Checker):
                 targets = (node.targets if isinstance(node, ast.Assign)
                            else [node.target])
                 for t in targets:
-                    r, _ = self._alias_chain(t)
+                    r, _ = chain(t)
                     if r == name and not isinstance(t, ast.Name):
                         return True  # buf[...] = / buf.x = after handoff
             elif isinstance(node, ast.Call) and isinstance(
                     node.func, ast.Attribute) \
-                    and node.func.attr in self.MUTATORS:
-                r, _ = self._alias_chain(node.func.value)
+                    and node.func.attr in AsyncAliasing.MUTATORS:
+                r, _ = chain(node.func.value)
                 if r == name:
                     return True
         return False

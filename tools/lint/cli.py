@@ -1,8 +1,14 @@
 """tpu-lint driver: file discovery, checker orchestration, CLI.
 
     python -m tools.lint paddle_tpu tests [--format=json] [--select=TPL001]
+    python -m tools.lint --contracts --baseline artifacts/op_contracts.json
+    python -m tools.lint --contracts --baseline ... --write-baseline
 
-Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+Exit codes (stable; tools/ci_check.sh relies on them):
+  0  clean / baseline matches
+  1  lint findings, unexplained contract violations, or baseline drift
+  2  usage/internal error
+  3  --baseline file missing (run with --write-baseline first)
 """
 
 from __future__ import annotations
@@ -11,15 +17,32 @@ import argparse
 import os
 import sys
 
-from .checkers import ALL_CHECKERS
+from .checkers import ALL_CHECKERS as FILE_CHECKERS
 from .core import Finding, parse_file
+from .interproc import INTERPROC_CHECKERS, ProjectIndex
 from .reporters import render_json, render_text
 
-__all__ = ["run_lint", "main", "iter_python_files"]
+__all__ = ["ALL_CHECKERS", "run_lint", "main", "iter_python_files"]
+
+ALL_CHECKERS = list(FILE_CHECKERS) + list(INTERPROC_CHECKERS)
 
 # Fixture files contain *seeded* violations for the checker unit tests —
 # never part of a clean-tree run.
 DEFAULT_EXCLUDES = ("data/lint_fixtures",)
+
+
+def _is_excluded(norm_path: str, excludes: tuple) -> bool:
+    """Anchored on path components: ``data/lint_fixtures`` matches that
+    exact directory sequence anywhere in the path — but not substrings
+    of component names (``mydata/lint_fixtures_old`` stays included)."""
+    parts = norm_path.split("/")
+    for ex in excludes:
+        ex_parts = [p for p in ex.replace(os.sep, "/").split("/") if p]
+        n = len(ex_parts)
+        if n and any(parts[i:i + n] == ex_parts
+                     for i in range(len(parts) - n + 1)):
+            return True
+    return False
 
 
 def iter_python_files(paths: list[str],
@@ -35,19 +58,27 @@ def iter_python_files(paths: list[str],
             for fn in sorted(files):
                 if fn.endswith(".py"):
                     out.append(os.path.join(root, fn))
-    norm = [p.replace(os.sep, "/") for p in out]
-    return [p for p, n in zip(out, norm)
-            if not any(ex in n for ex in excludes)]
+    return [p for p in out
+            if not _is_excluded(p.replace(os.sep, "/"), excludes)]
 
 
 def run_lint(paths: list[str], select: set[str] | None = None,
              excludes: tuple = DEFAULT_EXCLUDES,
              keep_suppressed: bool = False) -> list[Finding]:
     """Run every (selected) checker over the python files under ``paths``
-    and return unsuppressed findings, sorted by location."""
+    and return unsuppressed findings, sorted by location.
+
+    Checkers with ``needs_project = True`` (tools/lint/interproc.py) get
+    a shared :class:`ProjectIndex` bound as ``checker.project``, fed one
+    summary per parsed file; they report whole-program findings from
+    ``finalize()``."""
     checkers = [cls() for cls in ALL_CHECKERS
                 if select is None
                 or cls.rule in select or cls.name in select]
+    project = ProjectIndex()
+    bound = [c for c in checkers if getattr(c, "needs_project", False)]
+    for checker in bound:
+        checker.project = project
     findings: list[Finding] = []
     contexts = {}
     for path in iter_python_files(paths, excludes):
@@ -57,6 +88,8 @@ def run_lint(paths: list[str], select: set[str] | None = None,
             findings.append(err)
             continue
         contexts[display] = ctx
+        if bound:
+            project.add_file(ctx)
         for checker in checkers:
             checker.check(ctx)
     for checker in checkers:
@@ -71,11 +104,49 @@ def run_lint(paths: list[str], select: set[str] | None = None,
     return sorted(findings, key=Finding.sort_key)
 
 
+def run_contracts(baseline: str | None, write: bool,
+                  fmt: str = "text") -> int:
+    """Abstract op-contract verification (tools/lint/contracts.py)."""
+    from . import contracts as C
+
+    if baseline and not write and not os.path.exists(baseline):
+        print(f"tpu-verify: baseline {baseline} missing "
+              "(run with --write-baseline)", file=sys.stderr)
+        return 3
+    current = C.build_contracts()
+    bad = C.unexplained_violations(current)
+    drift: list[str] = []
+    if baseline:
+        if write:
+            C.write_baseline(current, baseline)
+        else:
+            drift = C.diff_baselines(current, C.load_baseline(baseline))
+    if fmt == "json":
+        import json
+
+        print(json.dumps({"summary": current["summary"],
+                          "unexplained": [list(v) for v in bad],
+                          "drift": drift}, indent=2))
+    else:
+        for name, kind, detail in bad:
+            print(f"op '{name}': {kind}: {detail}")
+        for line in drift:
+            print(line)
+        s = current["summary"]
+        print(f"tpu-verify: {current['op_count']} ops, {s['ok']} "
+              f"abstractly evaluated, {s['opaque']} opaque, "
+              f"{len(bad)} unexplained violation(s), "
+              f"{len(drift)} baseline drift line(s)"
+              + (f" -> wrote {baseline}" if write and baseline else ""))
+    return 1 if bad or drift else 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="tools.lint",
         description="tpu-lint: static trace-safety/aliasing/registry "
-                    "checks for the paddle_tpu tree.",
+                    "checks plus abstract op-contract verification "
+                    "for the paddle_tpu tree.",
     )
     parser.add_argument("paths", nargs="*", default=["paddle_tpu", "tests"],
                         help="files or directories to lint "
@@ -89,6 +160,16 @@ def main(argv: list[str] | None = None) -> int:
                         help="print the rule table and exit")
     parser.add_argument("--no-default-excludes", action="store_true",
                         help="also lint the seeded-violation fixtures")
+    parser.add_argument("--contracts", action="store_true",
+                        help="run abstract op-contract verification over "
+                             "the dispatch registry instead of lint")
+    parser.add_argument("--baseline", default=None, metavar="PATH",
+                        help="with --contracts: compare against (or, with "
+                             "--write-baseline, regenerate) this JSON "
+                             "baseline")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="with --contracts --baseline: write the "
+                             "baseline instead of diffing")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -96,6 +177,19 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{cls.rule}  {cls.name:<20} {cls.severity:<8} "
                   f"{cls.description}")
         return 0
+
+    if args.write_baseline and not (args.contracts and args.baseline):
+        print("tpu-lint: --write-baseline requires --contracts and "
+              "--baseline PATH", file=sys.stderr)
+        return 2
+    if args.contracts:
+        try:
+            return run_contracts(args.baseline, args.write_baseline,
+                                 args.format)
+        except ImportError as e:
+            print(f"tpu-verify: registry import failed: {e}",
+                  file=sys.stderr)
+            return 2
 
     paths = args.paths or ["paddle_tpu", "tests"]
     missing = [p for p in paths if not os.path.exists(p)]

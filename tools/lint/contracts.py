@@ -1,0 +1,498 @@
+"""tpu-verify part B: abstract op-contract verification.
+
+Every op registered through ``core.dispatch.op`` declares a contract
+implicitly: its impl's signature is the schema, its jax lowering the
+kernel, its vjp the grad rule (dispatch.py docstring).  Nothing in the
+repo checked that those contracts actually *hold* under abstract
+evaluation — an op whose output dtype drifts, whose vjp aborts, or
+whose zero-bubble split rule produces misshapen grads only fails when
+a model happens to hit it on real hardware.
+
+This module runs ``jax.eval_shape`` over the whole registry with a
+generated matrix of abstract inputs and records, per op:
+
+- the canonical abstract case (input/output shapes + dtypes),
+- a broadcasting case for multi-array ops,
+- a weak-type case (python scalar in slot 0),
+- the same case under ``jax_enable_x64`` (dtype-promotion drift: a
+  well-behaved op keeps float32 results float32; impls that mix
+  np.float64 constants silently upcast — the drift only x64 exposes),
+- an abstract vjp probe for ``differentiable=True`` ops (shape-checked
+  against the inputs),
+- an abstract probe of the op's ``register_split_vjp`` rule, if any.
+
+Ops that cannot be abstractly evaluated with any generated case are
+recorded as ``opaque`` with the error class (``ConcretizationTypeError``
+is itself signal: the op graph-breaks under capture).  The result is a
+machine-readable baseline (``artifacts/op_contracts.json``); future PRs
+diff against it, so dtype/rank changes can never land silently.
+
+Checked violations (must be empty or explained in ``EXPLAINED``):
+
+- ``x64-upcast``        float32-in/float32-out op emits float64 under x64
+- ``vjp-abort``         differentiable op whose vjp dies abstractly
+- ``grad-shape-mismatch``  vjp grads disagree with input shapes
+- ``split-vjp-abort``   a register_split_vjp rule dies abstractly
+- ``split-grad-shape-mismatch``  split-rule grads disagree with inputs
+
+Import is lazy: ``tools.lint`` stays importable without jax.
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import json
+import os
+
+__all__ = [
+    "REGISTRY_MODULES",
+    "EXPLAINED",
+    "load_registry",
+    "build_contracts",
+    "unexplained_violations",
+    "diff_baselines",
+    "write_baseline",
+    "load_baseline",
+]
+
+# Every lazily-registering module, pinned so the registry is complete and
+# deterministic (same list as tests/test_grad_coverage.py).
+REGISTRY_MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.distributed.autograd_collectives",
+    "paddle_tpu.geometric",
+    "paddle_tpu.incubate.nn.functional",
+    "paddle_tpu.models.gpt",
+    "paddle_tpu.ops.parity",
+    "paddle_tpu.quantization",
+    "paddle_tpu.signal",
+    "paddle_tpu.text",
+    "paddle_tpu.vision.ops",
+]
+
+# Known, justified contract violations: op name -> {kind: rationale}.
+# The analog of a lint suppression comment — every entry documents WHY
+# the op is allowed to violate the abstract contract.
+EXPLAINED: dict = {
+    "qr": {
+        "vjp-abort":
+            "jax implements QR differentiation only for full-rank "
+            "tall/square inputs (m >= n); the canonical wide f32[2,3] "
+            "abstract case aborts upstream with NotImplementedError. "
+            "Square-case gradients are exercised concretely by the "
+            "grad inventory (tests/test_grad_coverage.py, SPD(3)).",
+    },
+}
+
+# Parameter-name heuristics for non-array required parameters.
+_AXIS_NAMES = {"axis", "dim", "start_axis", "stop_axis"}
+_INT_NAMES = {
+    "k", "n", "num", "depth", "repeats", "shifts", "decimals", "diagonal",
+    "offset", "groups", "num_classes", "num_heads", "blocks", "chunks",
+    "sections", "num_or_sections", "upscale_factor", "downscale_factor",
+    "kernel_size", "stride", "num_partitions", "world_size", "nranks",
+    "block_size", "max_len", "maxlen", "num_embeddings", "window_length",
+    "n_fft", "num_samples", "num_buckets", "bits",
+}
+_FLOAT_NAMES = {
+    "alpha", "beta", "eps", "epsilon", "rate", "scale", "min", "max",
+    "min_val", "max_val", "momentum", "negative_slope", "delta", "lambd",
+    "threshold", "value", "p", "q", "rcond", "tol", "dropout_rate",
+    "smooth", "label_smoothing", "temperature", "margin", "clip",
+}
+_SHAPE_NAMES = {"shape", "sizes", "size", "repeat_times", "out_shape",
+                "output_size", "perm", "dims", "axes"}
+
+
+def load_registry() -> dict:
+    """Import every registering module; return the live OP_REGISTRY."""
+    for mod in REGISTRY_MODULES:
+        importlib.import_module(mod)
+    from paddle_tpu.core.dispatch import OP_REGISTRY
+
+    return OP_REGISTRY
+
+
+def _dt(struct) -> str:
+    """Compact 'f32[2,3]' leaf spec (with weak-type marker)."""
+    import numpy as np
+
+    short = {
+        "float32": "f32", "float64": "f64", "float16": "f16",
+        "bfloat16": "bf16", "int32": "i32", "int64": "i64",
+        "int16": "i16", "int8": "i8", "uint8": "u8", "uint32": "u32",
+        "bool": "b1", "complex64": "c64", "complex128": "c128",
+    }.get(np.dtype(struct.dtype).name, str(np.dtype(struct.dtype).name))
+    shape = ",".join(str(d) for d in struct.shape)
+    weak = "*" if getattr(struct, "weak_type", False) else ""
+    return f"{short}[{shape}]{weak}"
+
+
+def _flat(out) -> list:
+    import jax
+
+    return [x for x in jax.tree_util.tree_leaves(out)
+            if hasattr(x, "shape") and hasattr(x, "dtype")]
+
+
+class _VarArg:
+    """Pseudo-parameter standing in for one *args slot."""
+
+    def __init__(self, i):
+        self.name = f"args{i}"
+
+
+def _required_params(impl) -> list | None:
+    try:
+        sig = inspect.signature(impl)
+    except (TypeError, ValueError):
+        return None
+    out = []
+    for p in sig.parameters.values():
+        if p.kind is p.VAR_POSITIONAL and not out:
+            # pure-varargs ops (block_diag(*inputs)): probe two arrays —
+            # zero args would exercise the degenerate empty case only
+            out.extend([_VarArg(0), _VarArg(1)])
+        elif p.default is inspect.Parameter.empty and p.kind in (
+                p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+            out.append(p)
+    return out
+
+
+def _scalar_guess(pname: str):
+    if pname in _AXIS_NAMES:
+        return 0
+    if pname in _INT_NAMES or pname.startswith(("num_", "n_")):
+        return 2
+    if pname in _FLOAT_NAMES:
+        return 0.5
+    if pname in _SHAPE_NAMES:
+        return (2, 3)
+    if pname == "dtype":
+        return "float32"
+    if pname == "equation":
+        return "ij,jk->ik"          # einsum-style; pairs with (3,3) cases
+    if pname in ("data_format", "format"):
+        return "NCHW"
+    if pname.startswith(("is_", "with_", "use_", "keep", "transpose_",
+                         "reverse", "exclusive", "hard", "approximate",
+                         "normalize", "training", "upscale")):
+        return False
+    return None  # treat as an abstract array
+
+
+def _case_matrix(params) -> list:
+    """Candidate abstract-argument tuples, tried in order.  Each entry is
+    a list of values: jax.ShapeDtypeStruct for arrays, concrete python
+    scalars for config parameters."""
+    import jax
+    import jax.numpy as jnp
+
+    S = jax.ShapeDtypeStruct
+    names = [p.name for p in params]
+
+    def build(shape, scalars=True, dtype=jnp.float32):
+        vals = []
+        for nm in names:
+            guess = _scalar_guess(nm) if scalars else None
+            vals.append(S(shape, dtype) if guess is None else guess)
+        return vals
+
+    cases = [
+        build((2, 3)),
+        build((3, 3)),
+        build((4,)),
+        build((2, 3, 4)),
+        build((2, 3), scalars=False),          # every param abstract
+        build((2, 2, 2)),
+        build((), ),
+        build((2, 3), dtype=jnp.int32),
+    ]
+    cases.append(build((4,), dtype=jnp.int32))   # 1-D integer data
+    if len(names) >= 2:
+        # embedding-style: integer ids in slot 0, float table in slot 1
+        mixed = build((2, 3))
+        mixed[0] = S((2, 3), jnp.int32)
+        cases.append(mixed)
+        # gather-style: integer index in the LAST array slot
+        gather = build((3, 3))
+        arr_slots = [i for i, v in enumerate(gather)
+                     if isinstance(v, S)]
+        if arr_slots:
+            gather[arr_slots[-1]] = S((2, 2), jnp.int32)
+            cases.append(gather)
+    return cases
+
+
+def _eval_case(impl, vals):
+    import jax
+
+    arr_idx = [i for i, v in enumerate(vals)
+               if isinstance(v, jax.ShapeDtypeStruct)]
+
+    def fn(*arrs):
+        full = list(vals)
+        for i, a in zip(arr_idx, arrs):
+            full[i] = a
+        return impl(*full)
+
+    out = jax.eval_shape(fn, *[vals[i] for i in arr_idx])
+    return fn, arr_idx, out
+
+
+def _vjp_probe(fn, structs):
+    """eval_shape over vjp + cotangent application; returns grad leaves."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def probe(*arrs):
+        out, vjp_fn = jax.vjp(fn, *arrs)
+        cts = jax.tree_util.tree_map(
+            lambda o: (jnp.ones(o.shape, o.dtype)
+                       if jnp.issubdtype(o.dtype, jnp.inexact)
+                       else np.zeros(o.shape, jax.dtypes.float0)),
+            out)
+        return vjp_fn(cts)
+
+    return _flat(jax.eval_shape(probe, *structs))
+
+
+def _split_vjp_probe(rule, structs, out_structs):
+    import jax
+
+    w_slots = tuple(range(1, len(structs)))
+
+    def probe(*arrs_and_cots):
+        arrs = list(arrs_and_cots[:len(structs)])
+        cots = list(arrs_and_cots[len(structs):])
+        res = rule(arrs, w_slots, {"_positional_extras": []}, cots)
+        if res is None:
+            return ()
+        in_grads, wgrad_fn = res
+        return ([g for g in in_grads if g is not None],
+                sorted(wgrad_fn().items()))
+
+    return jax.eval_shape(probe, *structs, *out_structs)
+
+
+def probe_op(name: str, opdef) -> dict:
+    """Abstract contract record for one op."""
+    import jax
+
+    rec = {"differentiable": bool(opdef.differentiable),
+           "amp": opdef.amp_policy}
+    params = _required_params(opdef.impl)
+    if params is None:
+        rec.update(status="opaque", error="uninspectable-signature")
+        return rec
+    rec["arity"] = len(params)
+
+    fn = arr_idx = out = vals = None
+    last_err = None
+    for case in _case_matrix(params):
+        try:
+            fn, arr_idx, out = _eval_case(opdef.impl, case)
+            vals = case
+            break
+        except Exception as e:  # abstract eval may die arbitrarily deep
+            last_err = type(e).__name__
+            fn = None
+    if fn is None:
+        rec.update(status="opaque", error=last_err or "no-case")
+        return rec
+
+    structs = [vals[i] for i in arr_idx]
+    rec["status"] = "ok"
+    rec["case"] = {"in": [_dt(s) for s in structs],
+                   "static": {params[i].name: repr(v)
+                              for i, v in enumerate(vals)
+                              if i not in arr_idx},
+                   "out": [_dt(o) for o in _flat(out)]}
+    violations = []
+
+    # broadcasting probe: first two arrays as (2,1) x (1,3)
+    if len(arr_idx) >= 2 and all(
+            tuple(s.shape) == (2, 3) for s in structs[:2]):
+        b = list(structs)
+        b[0] = jax.ShapeDtypeStruct((2, 1), b[0].dtype)
+        b[1] = jax.ShapeDtypeStruct((1, 3), b[1].dtype)
+        try:
+            rec["broadcast"] = [_dt(o) for o in _flat(
+                jax.eval_shape(fn, *b))]
+        except Exception as e:
+            rec["broadcast"] = f"error:{type(e).__name__}"
+
+    # weak-type probe: python scalar in slot 0
+    if len(arr_idx) >= 2:
+        try:
+            rec["weak"] = [_dt(o) for o in _flat(
+                jax.eval_shape(lambda *rest: fn(1.0, *rest),
+                               *structs[1:]))]
+        except Exception as e:
+            rec["weak"] = f"error:{type(e).__name__}"
+
+    # x64 drift probe: same abstract case with x64 enabled; a 32-bit
+    # contract that silently widens is exactly the promotion drift that
+    # only shows up when someone flips the flag (or moves to CPU golden
+    # checks) — catch it here instead.
+    prev = jax.config.jax_enable_x64
+    try:
+        jax.config.update("jax_enable_x64", True)
+        x64_out = [_dt(o) for o in _flat(jax.eval_shape(fn, *structs))]
+        rec["x64"] = x64_out
+        base_out = rec["case"]["out"]
+        if len(x64_out) == len(base_out):
+            for b32, b64 in zip(base_out, x64_out):
+                if b32.startswith("f32") and b64.startswith("f64"):
+                    violations.append(
+                        {"kind": "x64-upcast",
+                         "detail": f"{b32} -> {b64}"})
+                    break
+    except Exception as e:
+        rec["x64"] = f"error:{type(e).__name__}"
+    finally:
+        jax.config.update("jax_enable_x64", prev)
+
+    # abstract vjp probe
+    if not opdef.differentiable:
+        rec["vjp"] = "skipped"
+    else:
+        import jax.numpy as jnp
+
+        if not any(jnp.issubdtype(o.dtype, jnp.inexact)
+                   for o in _flat(out)):
+            rec["vjp"] = "nondiff-output"
+        else:
+            try:
+                grads = _vjp_probe(fn, structs)
+                rec["vjp"] = "ok"
+                rec["grads"] = [_dt(g) for g in grads]
+                if len(grads) == len(structs):
+                    for g, s in zip(grads, structs):
+                        if (g.dtype != jax.dtypes.float0
+                                and tuple(g.shape) != tuple(s.shape)):
+                            violations.append(
+                                {"kind": "grad-shape-mismatch",
+                                 "detail": f"grad {_dt(g)} vs input "
+                                           f"{_dt(s)}"})
+                            break
+            except Exception as e:
+                rec["vjp"] = f"error:{type(e).__name__}"
+                violations.append(
+                    {"kind": "vjp-abort",
+                     "detail": type(e).__name__})
+
+    rec["violations"] = violations
+    return rec
+
+
+def _probe_split_rules(registry, contracts) -> None:
+    """Abstract-run every register_split_vjp rule with matmul-shaped
+    inputs; grafts results into the owning op's record."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.dispatch import SPLIT_VJP
+
+    S = jax.ShapeDtypeStruct
+    shapes = {
+        2: [S((2, 3), jnp.float32), S((3, 4), jnp.float32)],
+        3: [S((2, 3), jnp.float32), S((3, 4), jnp.float32),
+            S((4,), jnp.float32)],
+    }
+    for name in sorted(SPLIT_VJP):
+        rec = contracts["ops"].get(name)
+        if rec is None:
+            rec = contracts["ops"][name] = {"status": "split-only",
+                                            "violations": []}
+        rule = SPLIT_VJP[name]
+        results = {}
+        for arity, structs in sorted(shapes.items()):
+            out_structs = [S((2, 4), jnp.float32)]
+            try:
+                res = _split_vjp_probe(rule, structs, out_structs)
+                leaves = _flat(res)
+                results[str(arity)] = [_dt(x) for x in leaves]
+                # first leaf is dx — must match input 0
+                if leaves and tuple(leaves[0].shape) != (2, 3):
+                    rec.setdefault("violations", []).append(
+                        {"kind": "split-grad-shape-mismatch",
+                         "detail": f"dx {_dt(leaves[0])} vs input "
+                                   "f32[2,3]"})
+            except Exception as e:
+                results[str(arity)] = f"error:{type(e).__name__}"
+                rec.setdefault("violations", []).append(
+                    {"kind": "split-vjp-abort",
+                     "detail": f"arity {arity}: {type(e).__name__}"})
+        rec["split_vjp"] = results
+
+
+def build_contracts(registry=None) -> dict:
+    """Full registry sweep -> deterministic, diffable contract dict."""
+    import jax
+
+    if registry is None:
+        registry = load_registry()
+    contracts = {
+        "schema": 1,
+        "jax": jax.__version__,
+        "op_count": len(registry),
+        "ops": {},
+    }
+    for name in sorted(registry):
+        contracts["ops"][name] = probe_op(name, registry[name])
+    _probe_split_rules(registry, contracts)
+    counts = {"ok": 0, "opaque": 0, "violations": 0}
+    for name, rec in contracts["ops"].items():
+        counts[rec.get("status", "ok")] = counts.get(
+            rec.get("status", "ok"), 0) + (1 if "status" in rec else 0)
+        counts["violations"] += len(rec.get("violations", []))
+    contracts["summary"] = {
+        **counts,
+        "unexplained": len(unexplained_violations(contracts)),
+    }
+    return contracts
+
+
+def unexplained_violations(contracts: dict) -> list:
+    """[(op, kind, detail)] for violations with no EXPLAINED rationale."""
+    out = []
+    for name, rec in sorted(contracts["ops"].items()):
+        for v in rec.get("violations", []):
+            if v["kind"] not in EXPLAINED.get(name, {}):
+                out.append((name, v["kind"], v["detail"]))
+    return out
+
+
+def diff_baselines(current: dict, baseline: dict) -> list:
+    """Human-readable drift lines between two contract dicts."""
+    lines = []
+    cur, base = current.get("ops", {}), baseline.get("ops", {})
+    for name in sorted(set(base) - set(cur)):
+        lines.append(f"removed op: {name}")
+    for name in sorted(set(cur) - set(base)):
+        lines.append(f"new op: {name} (regenerate the baseline)")
+    for name in sorted(set(cur) & set(base)):
+        if cur[name] != base[name]:
+            fields = sorted(
+                k for k in set(cur[name]) | set(base[name])
+                if cur[name].get(k) != base[name].get(k))
+            lines.append(f"contract drift: {name} ({', '.join(fields)})")
+    if current.get("jax") != baseline.get("jax"):
+        lines.append(f"jax version: baseline {baseline.get('jax')} "
+                     f"vs current {current.get('jax')}")
+    return lines
+
+
+def write_baseline(contracts: dict, path: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(contracts, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def load_baseline(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)

@@ -1,0 +1,853 @@
+"""tpu-lint interprocedural engine: whole-program call-graph taint rules.
+
+The per-file checkers (``checkers.py``) stop at function boundaries: a
+helper that calls ``.item()`` escapes TPL001 the moment it is called
+*indirectly* from an ``@op``/jit region.  This module closes that gap.
+
+Model
+-----
+``ProjectIndex`` is built once per lint run (``cli.run_lint`` feeds it
+every parsed file) and holds one :class:`FuncInfo` summary per function
+definition — plus a synthetic ``<module>`` function per file for
+module-level statements.  A summary records only what the rules need:
+
+- direct host-sync sites (``.item()``/``float(tainted)``/``np.asarray``),
+- call sites with their dotted target and argument→parameter mapping,
+- mesh axes bound by ``shard_map``/``Mesh``/spec calls in the body,
+- ``lax.p*`` collective sites and their axis-name literals,
+- parameters that flow into ``jnp.asarray`` (the aliasing sink).
+
+``link()`` resolves call targets through each module's import table
+(absolute, aliased, and relative imports; ``self.``/``cls.`` methods;
+nested defs via the enclosing-scope chain) into a project call graph.
+The three rules are then fixpoints over that graph:
+
+TPL101  host sync reachable from an @op/jit trace root through any call
+        chain (the transitive closure of the TPL001 taint),
+TPL102  a live numpy buffer handed to a helper that (transitively)
+        feeds it to ``jnp.asarray`` — aliasing through call chains,
+TPL103  a collective reachable from an entry point along a call path on
+        which no function binds the collective's mesh axis.
+
+All three report at the *call site* that enters the offending chain, so
+a suppression comment lands next to the code a reviewer would change.
+Findings name the full chain and the terminal site.
+
+Resolution is best-effort and deliberately first-order: a target that
+cannot be resolved statically (dynamic dispatch, getattr, re-export
+chains deeper than the import tables) simply contributes no edge.  The
+rules only ever report on *resolved* chains, so imprecision costs
+recall, never false positives from phantom edges.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+from dataclasses import dataclass, field
+
+from .checkers import (
+    AsyncAliasing,
+    CollectiveSafety,
+    HostSyncInTrace,
+    _is_shape_query,
+    _iter_scope,
+    _np_rooted,
+    _param_names,
+    _trace_kind,
+)
+from .core import Checker, call_name, dotted_name, names_in, str_constants
+
+__all__ = ["ProjectIndex", "FuncInfo", "CallSite", "INTERPROC_CHECKERS"]
+
+# Source-path anchors: the module name of a file is its path from the
+# first anchor component on (``/any/prefix/paddle_tpu/core/tensor.py``
+# -> ``paddle_tpu.core.tensor``); un-anchored files get their stem.
+_ANCHORS = ("paddle_tpu", "tests", "tools")
+
+# Wrapping calls that turn their first argument into a trace root
+# (``jit(f)``, ``to_static(step)``) or bind mesh axes around it
+# (``shard_map(f, axis_names=("tp",))``).
+_JIT_WRAPPERS = {"jit", "pjit", "to_static"}
+_MESH_WRAPPERS = {"shard_map", "pmap", "xmap"}
+
+# Identifiers whose presence in an ``if`` test marks the guarded branch
+# as eager-only (``isinstance(x, Tracer)``, ``trace_state_clean()``):
+# syncs under such guards never run while tracing.  ``Tensor`` belongs
+# here for a structural reason: dispatch unwraps Tensor leaves to raw
+# jax arrays before any impl runs, so inside a trace region an
+# ``isinstance(x, Tensor)`` branch is unreachable — tracers are never
+# Tensor instances.
+_TRACE_GUARDS = {"Tracer", "trace_state_clean", "is_tracing", "is_tracer",
+                 "Tensor"}
+
+
+@dataclass
+class CallSite:
+    """One resolved-or-not call edge out of a function body."""
+
+    node: ast.Call
+    target: str                      # dotted name as written at the site
+    caller: "FuncInfo"
+    is_wrap: bool = False            # shard_map(f, ...)-style wrapping
+    wrap_kind: str | None = None     # 'jit' | 'mesh' for wraps
+    wrap_axes: set = field(default_factory=set)
+    resolved: "FuncInfo | None" = None
+
+    def args_to_params(self) -> list:
+        """[(callee_param_name, caller_arg_expr)] for positional +
+        keyword arguments; empty when the mapping is unreliable
+        (*args/**kwargs at the site, unresolved callee)."""
+        g = self.resolved
+        if g is None:
+            return []
+        if any(isinstance(a, ast.Starred) for a in self.node.args) or any(
+                kw.arg is None for kw in self.node.keywords):
+            return []
+        params = g.params
+        # bound-method call (x.m(a)): the receiver consumes 'self',
+        # which FuncInfo.params already strips — indices line up.
+        out = list(zip(params, self.node.args))
+        by_name = {p: None for p in params}
+        for kw in self.node.keywords:
+            if kw.arg in by_name:
+                out.append((kw.arg, kw.value))
+        return out
+
+
+@dataclass
+class FuncInfo:
+    """Whole-program summary of one function (or module top level)."""
+
+    qual: str                        # module[.Class].name
+    name: str
+    module: str
+    path: str
+    node: ast.AST
+    cls: str | None = None           # enclosing class, if a method
+    parent: "FuncInfo | None" = None  # enclosing function, if nested
+    trace_kind: str | None = None    # 'op' | 'jit' from decorators
+    params: list = field(default_factory=list)
+    local_defs: dict = field(default_factory=dict)   # nested def name -> FuncInfo
+    calls: list = field(default_factory=list)        # [CallSite]
+    syncs: list = field(default_factory=list)        # [(node, description)]
+    binds: set = field(default_factory=set)          # mesh axes bound in body
+    collectives: list = field(default_factory=list)  # [(axis, node, opname)]
+    asarray_params: dict = field(default_factory=dict)  # param -> sink pointer
+    np_locals: set = field(default_factory=set)      # numpy-buffer locals
+
+    def __hash__(self):
+        return id(self)
+
+    def __eq__(self, other):
+        return self is other
+
+    @property
+    def is_module(self) -> bool:
+        return self.name == "<module>"
+
+    def display(self) -> str:
+        return self.qual
+
+
+def module_name_for(path: str) -> tuple[str, bool]:
+    """(module dotted name, is_package) for a repo-relative/absolute path."""
+    parts = [p for p in path.split("/") if p]
+    for i, p in enumerate(parts):
+        if p in _ANCHORS:
+            parts = parts[i:]
+            break
+    else:
+        parts = parts[-1:]
+    is_pkg = False
+    if parts and parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+        is_pkg = True
+    return ".".join(parts) or path, is_pkg
+
+
+def _is_trace_guard(test: ast.AST) -> bool:
+    ids = set()
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name):
+            ids.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            ids.add(n.attr)
+    return bool(ids & _TRACE_GUARDS)
+
+
+def _guard_diverts(stmt: ast.If) -> bool:
+    """True when the guard body unconditionally leaves the block
+    (``if isinstance(o, Tracer): continue``) — the *siblings after it*
+    are then eager-only."""
+    return bool(stmt.body) and isinstance(
+        stmt.body[-1], (ast.Continue, ast.Return, ast.Raise, ast.Break))
+
+
+def _taint_sources(fn: ast.AST, params: set) -> dict:
+    """name -> set of parameters it (transitively) derives from.
+    First-order and flow-insensitive, like checkers._propagate_taint,
+    but keeps per-parameter attribution for argument mapping."""
+    src = {p: {p} for p in params}
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets, value = [node.target], node.value
+            else:
+                continue
+            if value is None or _is_shape_query(value):
+                continue
+            feed = set()
+            for n in names_in(value):
+                feed |= src.get(n, set())
+            if not feed:
+                continue
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        have = src.setdefault(n.id, set())
+                        if not feed <= have:
+                            have |= feed
+                            changed = True
+    return src
+
+
+class ProjectIndex:
+    """Project-wide function summaries + import tables + call graph."""
+
+    def __init__(self):
+        self.functions: list[FuncInfo] = []
+        self.func_table: dict[str, FuncInfo] = {}
+        self._sup = None                               # current file's Suppressions
+        self.imports: dict[str, dict[str, str]] = {}   # module -> local -> qual
+        self.module_tails: dict[str, str] = {}         # stem -> module (unique)
+        self._tail_clash: set[str] = set()
+        self.module_funcs: dict[str, dict[str, FuncInfo]] = {}
+        self.class_methods: dict[tuple, dict[str, FuncInfo]] = {}
+        self.np_attrs: dict[str, set] = {}             # module -> numpy attrs
+        self.file_axes: dict[str, set] = {}            # module -> axes bound anywhere in file
+        self.jit_wrapped: set[FuncInfo] = set()
+        self._linked = False
+
+    # -- construction --------------------------------------------------------
+
+    def add_file(self, ctx) -> None:
+        module, is_pkg = module_name_for(ctx.path)
+        tail = module.rsplit(".", 1)[-1]
+        if tail in self.module_tails and self.module_tails[tail] != module:
+            self._tail_clash.add(tail)
+            self.module_tails.pop(tail, None)
+        elif tail not in self._tail_clash:
+            self.module_tails[tail] = module
+        self.imports.setdefault(module, {})
+        self._harvest_imports(ctx.tree, module, is_pkg)
+        self.np_attrs[module] = self._harvest_np_attrs(ctx.tree)
+        self.file_axes[module] = self._harvest_file_axes(ctx.tree)
+        # module-level pseudo-function, then every def (incl. nested)
+        self._sup = ctx.suppressions
+        top = FuncInfo(qual=f"{module}.<module>", name="<module>",
+                       module=module, path=ctx.path, node=ctx.tree)
+        self._summarize(top)
+        self.functions.append(top)
+        self._walk_defs(ctx.tree, module, ctx.path, cls=None, parent=None)
+        self._sup = None
+        self._linked = False
+
+    def _walk_defs(self, node: ast.AST, module: str, path: str,
+                   cls: str | None, parent: FuncInfo | None):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = (f"{module}.{cls}.{child.name}" if cls
+                        else f"{module}.{child.name}")
+                info = FuncInfo(
+                    qual=qual, name=child.name, module=module, path=path,
+                    node=child, cls=cls, parent=parent,
+                    trace_kind=_trace_kind(child),
+                    params=self._positional_params(child),
+                )
+                self._summarize(info)
+                self.functions.append(info)
+                self.func_table.setdefault(qual, info)
+                if parent is None and cls is None:
+                    self.module_funcs.setdefault(module, {})[child.name] = info
+                if cls is not None and parent is None:
+                    self.class_methods.setdefault(
+                        (module, cls), {})[child.name] = info
+                if parent is not None:
+                    parent.local_defs[child.name] = info
+                self._walk_defs(child, module, path, cls=None, parent=info)
+            elif isinstance(child, ast.ClassDef):
+                self._walk_defs(child, module, path, cls=child.name,
+                                parent=parent)
+            else:
+                self._walk_defs(child, module, path, cls=cls, parent=parent)
+
+    @staticmethod
+    def _positional_params(fn: ast.FunctionDef) -> list:
+        a = fn.args
+        names = [p.arg for p in a.posonlyargs + a.args]
+        return [n for n in names if n not in ("self", "cls")]
+
+    def _harvest_imports(self, tree: ast.AST, module: str, is_pkg: bool):
+        table = self.imports[module]
+        package = module if is_pkg else module.rsplit(".", 1)[0] \
+            if "." in module else ""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        table[alias.asname] = alias.name
+                    else:
+                        root = alias.name.split(".")[0]
+                        table[root] = root
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    up = package.split(".") if package else []
+                    cut = node.level - 1
+                    if cut:
+                        up = up[:-cut] if cut <= len(up) else []
+                    base = ".".join(up + ([node.module] if node.module
+                                          else []))
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    table[local] = f"{base}.{alias.name}" if base \
+                        else alias.name
+
+    @staticmethod
+    def _harvest_np_attrs(tree: ast.AST) -> set:
+        attrs = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call) \
+                    and _np_rooted(call_name(node.value)):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute):
+                        attrs.add(t.attr)
+                    elif isinstance(t, ast.Subscript) and isinstance(
+                            t.value, ast.Attribute):
+                        attrs.add(t.value.attr)
+        return attrs
+
+    @staticmethod
+    def _harvest_file_axes(tree: ast.AST) -> set:
+        bound = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = call_name(node).rsplit(".", 1)[-1]
+            if tail in CollectiveSafety.BINDERS:
+                bound |= str_constants(node)
+            else:
+                for kw in node.keywords:
+                    if kw.arg in CollectiveSafety.BINDER_KWARGS:
+                        bound |= str_constants(kw.value)
+        return bound
+
+    # -- per-function summaries ----------------------------------------------
+
+    def _sink_suppressed(self, node: ast.AST, rule: str, name: str) -> bool:
+        """A ``tpu-lint: disable=<rule>`` comment on a *sink* line (the
+        host sync, the jnp.asarray, the collective) removes that hazard
+        from the index entirely — one rationale next to the helper kills
+        every chain through it, instead of one suppression per caller."""
+        if self._sup is None:
+            return False
+        from .core import Finding
+
+        return self._sup.matches(Finding(
+            rule, name, "error", "", getattr(node, "lineno", 1), 0, "",
+            end_line=getattr(node, "end_lineno", 0) or 0))
+
+    def _summarize(self, f: FuncInfo) -> None:
+        self._collect_syncs(f)
+        self._collect_calls_binds_collectives(f)
+        self._collect_asarray_flow(f)
+
+    @staticmethod
+    def _taint_seeds(f: FuncInfo) -> set:
+        """Parameters that may carry traced arrays — scalar-annotated
+        parameters (``bit_length: int``) are static config, exactly as
+        TPL001 treats them."""
+        if isinstance(f.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return _param_names(f.node)
+        return set()
+
+    def _collect_syncs(self, f: FuncInfo) -> None:
+        """Direct host-sync sites, skipping eager-only guarded branches."""
+        tainted = _taint_sources(f.node, self._taint_seeds(f))
+
+        def scan_block(stmts, guarded):
+            for stmt in stmts:
+                if isinstance(stmt, ast.If) and _is_trace_guard(stmt.test):
+                    scan_block(stmt.orelse, guarded)
+                    if _guard_diverts(stmt):
+                        guarded = True  # siblings below never see tracers
+                    continue
+                scan_stmt(stmt, guarded)
+
+        def scan_stmt(stmt, guarded):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return
+            blocks = [(getattr(stmt, "body", None)),
+                      (getattr(stmt, "orelse", None)),
+                      (getattr(stmt, "finalbody", None))]
+            has_blocks = any(isinstance(b, list) for b in blocks)
+            if has_blocks:
+                if not guarded:
+                    for expr_field in ("test", "iter"):
+                        sub = getattr(stmt, expr_field, None)
+                        if isinstance(sub, ast.AST):
+                            self._sync_sites_in(sub, f, tainted)
+                for b in blocks:
+                    if isinstance(b, list):
+                        scan_block(b, guarded)
+                for h in getattr(stmt, "handlers", []):
+                    scan_block(h.body, guarded)
+            elif not guarded:
+                self._sync_sites_in(stmt, f, tainted)
+
+        body = (f.node.body if hasattr(f.node, "body")
+                and isinstance(f.node.body, list) else [])
+        scan_block(body, False)
+
+    @staticmethod
+    def _walk_no_defs(node: ast.AST):
+        """ast.walk that does not descend into nested defs/lambdas."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            yield n
+            for c in ast.iter_child_nodes(n):
+                if not isinstance(c, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda, ast.ClassDef)):
+                    stack.append(c)
+
+    def _sync_sites_in(self, node: ast.AST, f: FuncInfo, tainted: dict):
+        for n in self._walk_no_defs(node):
+            if not isinstance(n, ast.Call):
+                continue
+            if self._sink_suppressed(n, "TPL101", "host-sync-transitive"):
+                continue
+            if (isinstance(n.func, ast.Attribute)
+                    and n.func.attr in HostSyncInTrace.SYNC_METHODS
+                    and not n.args):
+                f.syncs.append((n, f".{n.func.attr}()"))
+                continue
+            cname = call_name(n)
+            if cname in HostSyncInTrace.NP_CONVERTERS and n.args:
+                feed = set()
+                for nm in names_in(n.args[0]):
+                    feed |= tainted.get(nm, set())
+                if feed:
+                    f.syncs.append((n, f"{cname}() over "
+                                       f"'{sorted(feed)[0]}'"))
+            elif (cname in HostSyncInTrace.CONCRETIZERS
+                    and len(n.args) == 1
+                    and not _is_shape_query(n.args[0])):
+                feed = set()
+                for nm in names_in(n.args[0]):
+                    feed |= tainted.get(nm, set())
+                if feed:
+                    f.syncs.append((n, f"{cname}() over "
+                                       f"'{sorted(feed)[0]}'"))
+
+    def _collect_calls_binds_collectives(self, f: FuncInfo) -> None:
+        for node in _iter_scope(f.node):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            tail = cname.rsplit(".", 1)[-1] if cname else ""
+            # mesh-axis binders (shard_map/Mesh/specs) bind for this body
+            if tail in CollectiveSafety.BINDERS:
+                f.binds |= str_constants(node)
+            else:
+                for kw in node.keywords:
+                    if kw.arg in CollectiveSafety.BINDER_KWARGS:
+                        f.binds |= str_constants(kw.value)
+            # collectives
+            root, _, ctail = cname.rpartition(".")
+            if ctail in CollectiveSafety.COLLECTIVES and root in (
+                    "lax", "jax.lax"):
+                axis_pos = 0 if ctail == "axis_index" else 1
+                axis_arg = None
+                if len(node.args) > axis_pos:
+                    axis_arg = node.args[axis_pos]
+                for kw in node.keywords:
+                    if kw.arg in ("axis_name", "axis"):
+                        axis_arg = kw.value
+                if axis_arg is not None and not self._sink_suppressed(
+                        node, "TPL103", "collective-unbound-path"):
+                    for ax in sorted(str_constants(axis_arg)):
+                        f.collectives.append((ax, node, ctail))
+            # wrapping: shard_map(g, ...) / jit(g) with a named first arg
+            if tail in (_JIT_WRAPPERS | _MESH_WRAPPERS) and node.args:
+                wrapped = dotted_name(node.args[0])
+                if wrapped:
+                    f.calls.append(CallSite(
+                        node=node, target=wrapped, caller=f, is_wrap=True,
+                        wrap_kind=("jit" if tail in _JIT_WRAPPERS
+                                   else "mesh"),
+                        wrap_axes=(str_constants(node)
+                                   if tail in _MESH_WRAPPERS else set()),
+                    ))
+            if cname:
+                f.calls.append(CallSite(node=node, target=cname, caller=f))
+            # numpy buffer locals (for TPL102 caller-side detection)
+        for node in _iter_scope(f.node):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call) \
+                    and _np_rooted(call_name(node.value)):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        f.np_locals.add(t.id)
+
+    def _collect_asarray_flow(self, f: FuncInfo) -> None:
+        """Parameters that flow directly into jnp.asarray in this body."""
+        if not f.params:
+            return
+        tainted = _taint_sources(f.node, set(f.params))
+        for node in _iter_scope(f.node):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node) in AsyncAliasing.ASARRAY
+                    and node.args):
+                continue
+            if self._sink_suppressed(node, "TPL102",
+                                     "async-aliasing-transitive"):
+                continue
+            root, _ = AsyncAliasing._alias_chain(node.args[0])
+            if root is None:
+                continue
+            for p in tainted.get(root, set()):
+                f.asarray_params.setdefault(p, ("direct", node))
+
+    # -- linking -------------------------------------------------------------
+
+    def link(self) -> None:
+        if self._linked:
+            return
+        for f in self.functions:
+            for site in f.calls:
+                site.resolved = self._resolve(site)
+                if (site.is_wrap and site.wrap_kind == "jit"
+                        and site.resolved is not None):
+                    self.jit_wrapped.add(site.resolved)
+        self._linked = True
+
+    def _resolve(self, site: CallSite) -> FuncInfo | None:
+        parts = site.target.split(".")
+        caller = site.caller
+        # self.m() / cls.m() within a class body
+        if parts[0] in ("self", "cls") and caller.cls and len(parts) == 2:
+            return self.class_methods.get(
+                (caller.module, caller.cls), {}).get(parts[1])
+        pkg = caller.module.rpartition(".")[0]
+        if len(parts) == 1:
+            name = parts[0]
+            scope = caller
+            while scope is not None:            # nested defs, innermost out
+                if name in scope.local_defs:
+                    return scope.local_defs[name]
+                scope = scope.parent
+            local = self.module_funcs.get(caller.module, {}).get(name)
+            if local is not None:
+                return local
+            qual = self.imports.get(caller.module, {}).get(name)
+            return self._resolve_qual(qual, pkg) if qual else None
+        first = self.imports.get(caller.module, {}).get(parts[0])
+        if first:
+            return self._resolve_qual(".".join([first] + parts[1:]), pkg)
+        return self._resolve_qual(site.target, pkg)
+
+    def _resolve_qual(self, qual: str, caller_pkg: str,
+                      _seen=None) -> FuncInfo | None:
+        if _seen is None:
+            _seen = set()
+        if qual in _seen:
+            return None
+        _seen.add(qual)
+        hit = self.func_table.get(qual)
+        if hit is not None:
+            return hit
+        parts = qual.split(".")
+        # re-export hop: module M with `from .x import f` makes M.f an
+        # alias for M.x.f — follow one import-table indirection (the
+        # next hop resolves relative to M's package)
+        mod, _, name = qual.rpartition(".")
+        target = self.imports.get(mod, {}).get(name)
+        if target and target != qual:
+            return self._resolve_qual(target, mod.rpartition(".")[0],
+                                      _seen)
+        # un-anchored module spelling (fixtures import a sibling by
+        # stem). Sibling-package restriction on purpose: python only
+        # resolves bare imports to tree files when they share the
+        # directory — without it, `import math` in ops/ would false-edge
+        # stdlib calls into paddle_tpu.ops.math.
+        for i in range(len(parts) - 1, 0, -1):
+            stem = parts[i - 1]
+            real = self.module_tails.get(stem)
+            if (real and real != ".".join(parts[:i])
+                    and real.rpartition(".")[0] == caller_pkg):
+                return self._resolve_qual(
+                    ".".join([real] + parts[i:]), caller_pkg, _seen)
+        return None
+
+    # -- graph queries shared by the rules -----------------------------------
+
+    def reverse_edges(self) -> dict:
+        rev: dict[FuncInfo, list] = {}
+        for f in self.functions:
+            for site in f.calls:
+                if site.resolved is not None and site.resolved is not f:
+                    rev.setdefault(site.resolved, []).append((f, site))
+        return rev
+
+    def trace_roots(self) -> list:
+        return [f for f in self.functions
+                if f.trace_kind or f in self.jit_wrapped]
+
+
+# -- rule base ---------------------------------------------------------------
+
+class InterprocChecker(Checker):
+    """Base for whole-program rules: ``cli.run_lint`` injects a shared
+    :class:`ProjectIndex` as ``self.project``; per-file ``check`` is a
+    no-op and all reporting happens in ``finalize``."""
+
+    needs_project = True
+
+    def __init__(self):
+        super().__init__()
+        self.project: ProjectIndex | None = None
+
+    def check(self, ctx) -> None:          # summaries are built centrally
+        return None
+
+
+def _chain_names(chain: list) -> str:
+    return " -> ".join(f.name for f in chain)
+
+
+# -- TPL101: transitive host sync under trace --------------------------------
+
+class TransitiveHostSync(InterprocChecker):
+    """A trace root (``@op`` lowering, jit/to_static function) calling a
+    helper that — through any chain — performs a host sync breaks
+    whole-program capture exactly like the direct TPL001 case, but the
+    per-file rule cannot see it."""
+
+    rule = "TPL101"
+    name = "host-sync-transitive"
+    description = ("host-synchronizing helper reachable from an @op/jit "
+                   "region through a call chain")
+
+    def finalize(self):
+        p = self.project
+        if p is None:
+            return
+        p.link()
+        rev = p.reverse_edges()
+        # BFS up from every function with a direct sync; next_hop[f]
+        # remembers the first edge of a shortest chain f -> ... -> sync
+        next_hop: dict[FuncInfo, tuple] = {}
+        queue = deque(f for f in p.functions if f.syncs)
+        seen = set(queue)
+        while queue:
+            g = queue.popleft()
+            for caller, site in rev.get(g, []):
+                if caller not in seen:
+                    seen.add(caller)
+                    next_hop[caller] = (site, g)
+                    queue.append(caller)
+        for root in p.trace_roots():
+            where = ("@op lowering" if root.trace_kind == "op"
+                     else "jit/to_static region")
+            for site in root.calls:
+                g = site.resolved
+                if g is None or g is root or g not in seen:
+                    continue
+                chain, cur = [root, g], g
+                while not cur.syncs:
+                    _, cur = next_hop[cur]
+                    chain.append(cur)
+                node, what = cur.syncs[0]
+                self.report(
+                    site.node,
+                    f"call chain {_chain_names(chain)} from {where} "
+                    f"'{root.name}' reaches a host sync: {what} at "
+                    f"{cur.path}:{node.lineno} forces a device->host "
+                    "sync under tracing",
+                    path=root.path)
+
+
+# -- TPL102: aliasing through helper call chains -----------------------------
+
+class TransitiveAsarrayAlias(InterprocChecker):
+    """A live numpy buffer passed to a helper whose parameter
+    (transitively) reaches ``jnp.asarray`` aliases zero-copy into async
+    dispatch just like the direct TPL002 case.  Same strictness model:
+    always flagged under the async-by-construction paths and for
+    attribute-held buffers, elsewhere only when the buffer is mutated
+    after the handoff."""
+
+    rule = "TPL102"
+    name = "async-aliasing-transitive"
+    description = ("numpy buffer reaching jnp.asarray through a helper "
+                   "call chain may alias into async dispatch")
+
+    def finalize(self):
+        p = self.project
+        if p is None:
+            return
+        p.link()
+        # fixpoint: param -> sink pointer, propagated through call sites
+        flow = {f: dict(f.asarray_params) for f in p.functions
+                if f.asarray_params}
+        changed = True
+        while changed:
+            changed = False
+            for f in p.functions:
+                for site in f.calls:
+                    g = site.resolved
+                    if g is None or g not in flow or site.is_wrap:
+                        continue
+                    for g_param, expr in site.args_to_params():
+                        if g_param not in flow[g]:
+                            continue
+                        root, _ = AsyncAliasing._alias_chain(expr)
+                        if (root in f.params
+                                and root not in flow.setdefault(f, {})):
+                            flow[f][root] = (site, g, g_param)
+                            changed = True
+        for f in p.functions:
+            strict = any(s in f.path for s in AsyncAliasing.STRICT_PATHS)
+            for site in f.calls:
+                g = site.resolved
+                if g is None or g not in flow or site.is_wrap:
+                    continue
+                for g_param, expr in site.args_to_params():
+                    if g_param not in flow[g]:
+                        continue
+                    root, attrs = AsyncAliasing._alias_chain(expr)
+                    if root is None:
+                        continue
+                    held = bool(set(attrs) & p.np_attrs.get(f.module,
+                                                            set()))
+                    local = root in f.np_locals
+                    if not held and not local:
+                        continue
+                    if not strict and not held and not (
+                            AsyncAliasing._mutated_after(
+                                f.node, root, site.node.lineno)):
+                        continue
+                    what = (".".join([root] + list(reversed(attrs)))
+                            if held else root)
+                    chain = self._chain(flow, g, g_param)
+                    self.report(
+                        site.node,
+                        f"numpy buffer '{what}' handed to "
+                        f"'{g.name}({g_param}=...)' reaches jnp.asarray "
+                        f"via {chain}; it may alias zero-copy into an "
+                        "async dispatched program — copy with jnp.array "
+                        "or justify with a suppression",
+                        path=f.path)
+
+    @staticmethod
+    def _chain(flow, g, g_param) -> str:
+        hops = [g.name]
+        ptr = flow[g][g_param]
+        while ptr[0] != "direct":
+            _, g, g_param = ptr
+            hops.append(g.name)
+            ptr = flow[g][g_param]
+        sink = ptr[1]
+        return (" -> ".join(hops)
+                + f" -> jnp.asarray at line {sink.lineno}")
+
+
+# -- TPL103: collectives on call paths with no axis binding ------------------
+
+class UnboundCollectivePath(InterprocChecker):
+    """TPL005 accepts a collective when *any* site in the same file binds
+    its axis — which is exactly how helpers get reused from a code path
+    that never enters the shard_map: the file looks safe, the new call
+    path traces with an unbound axis name and dies deep inside XLA.
+    This rule walks caller chains: an entry point (a function nobody in
+    the project calls, or module-level code) whose file binds nothing
+    for the axis, reaching a collective with no binder anywhere along
+    the chain, is reported at the entry's call site."""
+
+    rule = "TPL103"
+    name = "collective-unbound-path"
+    description = ("collective reachable through a call chain on which "
+                   "no caller binds the mesh axis")
+
+    def finalize(self):
+        p = self.project
+        if p is None:
+            return
+        p.link()
+        # need[f]: axis -> pointer into the chain towards the collective
+        need: dict[FuncInfo, dict] = {}
+        for f in p.functions:
+            for ax, node, ctail in f.collectives:
+                if ax not in f.binds:
+                    need.setdefault(f, {})[ax] = ("coll", node, ctail, f)
+        changed = True
+        while changed:
+            changed = False
+            for f in p.functions:
+                for site in f.calls:
+                    g = site.resolved
+                    if g is None or g is f or g not in need:
+                        continue
+                    for ax in need[g]:
+                        if ax in f.binds or ax in site.wrap_axes:
+                            continue
+                        if ax not in need.setdefault(f, {}):
+                            need[f][ax] = ("call", site, g)
+                            changed = True
+        has_callers = set()
+        for f in p.functions:
+            for site in f.calls:
+                if site.resolved is not None and site.resolved is not f:
+                    has_callers.add(site.resolved)
+        for f in p.functions:
+            if f in has_callers and not f.is_module:
+                continue                      # not an entry point
+            for ax, ptr in sorted(need.get(f, {}).items()):
+                if ptr[0] != "call":
+                    continue  # the entry owns the collective: TPL005 turf
+                if ax in p.file_axes.get(f.module, set()):
+                    continue  # entry's own file binds it somewhere
+                _, site, g = ptr
+                chain = [f]
+                cur = ptr
+                while cur[0] == "call":
+                    chain.append(cur[2])
+                    cur = need[cur[2]][ax]
+                _, node, ctail, owner = cur
+                self.report(
+                    site.node,
+                    f"lax.{ctail}('{ax}') at {owner.path}:{node.lineno} "
+                    f"is reachable via {_chain_names(chain)} from entry "
+                    f"'{f.display()}' with no shard_map/Mesh binding "
+                    f"of axis '{ax}' anywhere on the path",
+                    path=f.path)
+
+
+INTERPROC_CHECKERS = [
+    TransitiveHostSync,
+    TransitiveAsarrayAlias,
+    UnboundCollectivePath,
+]
