@@ -87,6 +87,8 @@ _LAZY_SUBMODULES = (
     "onnx",
     "signal",
     "inference",
+    "parallel",
+    "testing",
 )
 
 

@@ -156,3 +156,21 @@ define_flag(
     True,
     "Route scaled_dot_product_attention to the Pallas flash kernel on TPU.",
 )
+
+# -- self-healing runtime defaults (parallel/resilient_loop.py reads these
+#    when the caller passes None; FLAGS_* env overrides reach child
+#    workers through the launcher env like every other flag) --------------
+define_flag("resilient_max_bad_steps", 3,
+            "Consecutive NaN/Inf steps tolerated (skipped) before the "
+            "resilient loop rolls state back to the last good checkpoint.")
+define_flag("resilient_step_timeout", 120.0,
+            "Seconds a compiled step may block before the StepWatchdog "
+            "escalates (comm-task dump -> checkpoint -> elastic exit).")
+define_flag("resilient_keep_last_k", 3,
+            "Rotated checkpoints retained by the resilient loop "
+            "(save_checkpoint keep_last_k).")
+define_flag("resilient_retry_max", 5,
+            "Retry attempts for store/checkpoint IO in with_retries.")
+define_flag("resilient_retry_base_delay", 0.05,
+            "Base backoff seconds for with_retries (exponential, "
+            "full jitter).")

@@ -16,24 +16,60 @@ chunks overlap it — mesh/placement changes between save and load work
 exactly as the reference's overlap-resolution does (load_state_dict.py:467).
 Async save snapshots to host then writes on a background thread
 (save_state_dict.py:46 async queue).
+
+Integrity hardening (format v2, additive — v1 checkpoints still load,
+with a warning):
+
+- every chunk records a ``crc32`` of its raw bytes; load verifies and
+  raises :class:`CheckpointCorruption` on mismatch;
+- a per-rank **manifest** (``manifest_<rank>.json``, listing every file
+  the rank wrote with its size) is written *last*, so a save torn by a
+  mid-write kill is detectable: metadata without its manifest, a
+  truncated npz, or a size mismatch all fail :func:`verify_checkpoint`;
+- :func:`save_checkpoint` adds ``keep_last_k`` rotation under
+  ``<root>/step_<N>`` with an atomically-updated ``LATEST`` pointer, and
+  :func:`load_latest_valid` walks back from the newest step dir to the
+  first checkpoint passing integrity verification — the auto-resume
+  entry point of the self-healing runtime (parallel/resilient_loop.py).
+
+Chaos instrumentation: ``checkpoint.save`` (see
+paddle_tpu/testing/chaos.py for the kind catalog) — a no-op probe unless
+a fault plan is armed.
 """
 
 from __future__ import annotations
 
+import glob
 import json
+import logging
 import os
+import shutil
 import threading
 import time
+import zlib
 from typing import Optional
 
 import numpy as np
 
 import jax
 
+from ..testing import chaos as _chaos
+
 __all__ = ["save_state_dict", "load_state_dict", "flatten_state_dict",
-           "unflatten_state_dict"]
+           "unflatten_state_dict", "save_checkpoint", "load_latest_valid",
+           "verify_checkpoint", "latest_step", "CheckpointCorruption"]
+
+logger = logging.getLogger("paddle_tpu.distributed.checkpoint")
 
 _SEP = "."
+_FORMAT = 2                      # v2: crc32 chunks + manifest sentinel
+_STEP_PREFIX = "step_"
+_LATEST = "LATEST"
+
+
+class CheckpointCorruption(RuntimeError):
+    """A checkpoint failed integrity verification (crc mismatch, torn
+    file, missing manifest/metadata)."""
 
 
 def flatten_state_dict(state_dict, prefix=""):
@@ -68,15 +104,69 @@ def _to_array(v):
     return v
 
 
+def _crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes())
+
+
+# -- async-save failure surfacing -------------------------------------------
+#
+# A daemon writer that swallows its exception turns "checkpoint never
+# happened" into a silent fact discovered at restore time. Failures are
+# (a) re-raised from join() on the returned thread and (b) stored so the
+# NEXT save (sync or async) re-raises them — the reference's async queue
+# drains errors on the subsequent save_state_dict call.
+
+_async_errors: list[BaseException] = []
+_async_errors_lock = threading.Lock()
+
+
+class _AsyncSaveThread(threading.Thread):
+    def __init__(self, target):
+        super().__init__(daemon=True)
+        self._target_fn = target
+        self.exception: Optional[BaseException] = None
+        self._raised = False
+
+    def run(self):
+        try:
+            self._target_fn()
+        except BaseException as e:  # noqa: BLE001 — surfaced, not swallowed
+            self.exception = e
+            with _async_errors_lock:
+                _async_errors.append(e)
+            logger.error("async checkpoint save failed: %r", e)
+
+    def join(self, timeout=None):
+        super().join(timeout)
+        if self.exception is not None and not self._raised:
+            self._raised = True
+            with _async_errors_lock:
+                if self.exception in _async_errors:
+                    _async_errors.remove(self.exception)
+            raise RuntimeError("async checkpoint save failed") \
+                from self.exception
+
+
+def _raise_pending_async_error():
+    with _async_errors_lock:
+        if not _async_errors:
+            return
+        err, _async_errors[:] = _async_errors[0], []
+    raise RuntimeError("a previous async checkpoint save failed") from err
+
+
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     async_save: bool = False):
-    """Write shard files + metadata under directory ``path``."""
+    """Write shard files + metadata (+ the v2 manifest, last) under
+    directory ``path``."""
+    _raise_pending_async_error()
     os.makedirs(path, exist_ok=True)
     flat = {k: _to_array(v) for k, v in flatten_state_dict(state_dict).items()}
     rank = jax.process_index()
     fname = f"{rank}_0.npz"
 
-    meta = {"state_dict_metadata": {}, "storage_metadata": {}}
+    meta = {"format": _FORMAT, "state_dict_metadata": {},
+            "storage_metadata": {}}
     arrays_out = {}
     for key, arr in flat.items():
         if not hasattr(arr, "shape"):
@@ -97,6 +187,7 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     "local_shape": list(shard.data.shape),
                     "file": fname,
                     "array": name,
+                    "crc32": _crc(arrays_out[name]),
                 })
         else:
             np_arr = np.asarray(arr)
@@ -104,50 +195,149 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
             arrays_out[name] = np_arr
             chunks.append({"global_offset": [0] * np_arr.ndim,
                            "local_shape": list(np_arr.shape),
-                           "file": fname, "array": name})
+                           "file": fname, "array": name,
+                           "crc32": _crc(np_arr)})
         meta["state_dict_metadata"][key] = {
             "global_shape": list(arr.shape),
             "dtype": str(np.asarray(arrays_out[chunks[0]["array"]]).dtype),
             "chunks": chunks,
         }
 
+    # probe in the calling thread: overlapping async saves would otherwise
+    # race for the scheduled fault, making plans nondeterministic
+    fault = _chaos.fire("checkpoint.save")
+
     def _write():
+        if fault is not None and fault.kind == "raise":
+            raise _chaos.ChaosInjected("chaos: checkpoint write failed")
         # tmp + atomic rename: an elastic kill mid-save (launch controller
         # tearing down the fleet) must never leave a torn npz beside valid
         # metadata — the relaunched generation resumes from this file.
         # uniquified per-write: overlapping async saves from one process
         # must not interleave into the same tmp file
         uid = f"{os.getpid()}.{threading.get_ident()}.{time.monotonic_ns()}"
+        data_path = os.path.join(path, fname)
         tmp = os.path.join(path, f".{fname}.tmp.{uid}")
         with open(tmp, "wb") as f:
             np.savez(f, **arrays_out)
-        os.replace(tmp, os.path.join(path, fname))
+        os.replace(tmp, data_path)
+        if fault is not None and fault.kind == "torn":
+            # kill mid-npz-write: truncated data, no metadata/manifest
+            with open(data_path, "r+b") as f:
+                f.truncate(max(1, os.path.getsize(data_path) // 2))
+            return
+        if fault is not None and fault.kind == "corrupt":
+            nbytes = int(fault.args.get("nbytes", 4))
+            with open(data_path, "r+b") as f:
+                f.seek(max(0, os.path.getsize(data_path) // 2))
+                chunk = f.read(nbytes)
+                f.seek(-len(chunk), os.SEEK_CUR)
+                f.write(bytes(b ^ 0xFF for b in chunk))
         # every process writes its OWN chunk metadata (a coordinator-only
         # metadata file would silently drop other hosts' shards on load);
         # load merges all metadata_*.json files.
-        mtmp = os.path.join(path, f".metadata_{rank}.tmp.{uid}")
-        with open(mtmp, "w") as f:
-            json.dump(meta, f)
-        os.replace(mtmp, os.path.join(path, f"metadata_{rank}.json"))
+        if fault is None or fault.kind != "missing_meta":
+            mtmp = os.path.join(path, f".metadata_{rank}.tmp.{uid}")
+            with open(mtmp, "w") as f:
+                json.dump(meta, f)
+            os.replace(mtmp, os.path.join(path, f"metadata_{rank}.json"))
+        if fault is not None and fault.kind == "torn_manifest":
+            return   # kill between metadata fsync and manifest fsync
+        # manifest LAST: its presence asserts every file above is complete
+        listed = [fname, f"metadata_{rank}.json"]
+        manifest = {
+            "format": _FORMAT,
+            "files": {fn: os.path.getsize(os.path.join(path, fn))
+                      for fn in listed
+                      if os.path.exists(os.path.join(path, fn))},
+        }
+        ntmp = os.path.join(path, f".manifest_{rank}.tmp.{uid}")
+        with open(ntmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(ntmp, os.path.join(path, f"manifest_{rank}.json"))
 
     if async_save:
-        t = threading.Thread(target=_write, daemon=True)
+        t = _AsyncSaveThread(_write)
         t.start()
         return t
     _write()
+
+
+def verify_checkpoint(path) -> tuple[bool, list[str]]:
+    """Integrity check without loading into a model: manifest presence
+    (v2), file existence + sizes, and per-chunk crc32. Returns
+    ``(ok, problems)``; a v1 checkpoint (no crc/manifest anywhere)
+    verifies OK with a logged warning (format additivity)."""
+    problems: list[str] = []
+    metas = sorted(glob.glob(os.path.join(path, "metadata_*.json")))
+    if not metas:
+        return False, [f"no metadata_*.json under {path}"]
+    legacy = False
+    for mpath in metas:
+        try:
+            with open(mpath) as f:
+                meta = json.load(f)
+        except (OSError, ValueError) as e:
+            problems.append(f"{os.path.basename(mpath)}: unreadable ({e})")
+            continue
+        rank = os.path.basename(mpath)[len("metadata_"):-len(".json")]
+        v2 = meta.get("format", 1) >= 2
+        if not v2:
+            legacy = True
+        man_path = os.path.join(path, f"manifest_{rank}.json")
+        if v2:
+            if not os.path.exists(man_path):
+                problems.append(f"rank {rank}: manifest missing (torn save"
+                                " — killed before the final sentinel write)")
+            else:
+                with open(man_path) as f:
+                    manifest = json.load(f)
+                for fn, size in manifest.get("files", {}).items():
+                    full = os.path.join(path, fn)
+                    if not os.path.exists(full):
+                        problems.append(f"rank {rank}: file {fn} missing")
+                    elif os.path.getsize(full) != size:
+                        problems.append(
+                            f"rank {rank}: file {fn} size "
+                            f"{os.path.getsize(full)} != manifest {size}")
+        # crc over every chunk this rank recorded
+        npzs: dict = {}
+        try:
+            for key, info in meta["state_dict_metadata"].items():
+                for ch in info["chunks"]:
+                    if "crc32" not in ch:
+                        continue
+                    npz = npzs.get(ch["file"])
+                    if npz is None:
+                        npz = npzs[ch["file"]] = np.load(
+                            os.path.join(path, ch["file"]))
+                    if _crc(npz[ch["array"]]) != ch["crc32"]:
+                        problems.append(f"{key}: chunk {ch['array']} crc "
+                                        "mismatch (corrupt bytes)")
+        except Exception as e:  # torn zip / missing member
+            problems.append(f"rank {rank}: data file unreadable ({e})")
+        finally:
+            for npz in npzs.values():
+                npz.close()
+    if legacy and not problems:
+        logger.warning("checkpoint %s is format v1 (no crc/manifest); "
+                       "loading without integrity verification", path)
+    return not problems, problems
 
 
 def load_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, offload: bool = False):
     """Fill ``state_dict``'s tensors in place from a checkpoint dir,
     resharding as needed: each target tensor is assembled from every saved
-    chunk that overlaps it, then device_put back to its current sharding."""
-    import glob
-
+    chunk that overlaps it, then device_put back to its current sharding.
+    Chunks carrying a crc32 (format v2) are verified as they are read."""
     meta = {"state_dict_metadata": {}}
+    legacy = False
     for mpath in sorted(glob.glob(os.path.join(path, "metadata_*.json"))):
         with open(mpath) as f:
             part = json.load(f)
+        if part.get("format", 1) < 2:
+            legacy = True
         for key, info in part["state_dict_metadata"].items():
             cur = meta["state_dict_metadata"].get(key)
             if cur is None:
@@ -156,6 +346,9 @@ def load_state_dict(state_dict, path, process_group=None,
                 cur["chunks"].extend(info["chunks"])
     if not meta["state_dict_metadata"]:
         raise FileNotFoundError(f"no metadata_*.json under {path}")
+    if legacy:
+        logger.warning("checkpoint %s predates crc/manifest (format v1); "
+                       "loading without integrity verification", path)
     files: dict = {}
 
     def _file(fname):
@@ -165,27 +358,108 @@ def load_state_dict(state_dict, path, process_group=None,
 
     flat_target = flatten_state_dict(state_dict)
     missing = []
-    for key, target in flat_target.items():
-        info = meta["state_dict_metadata"].get(key)
-        if info is None:
-            missing.append(key)
-            continue
-        gshape = tuple(info["global_shape"])
-        buf = np.zeros(gshape, dtype=info["dtype"]) if gshape else \
-            np.zeros((), dtype=info["dtype"])
-        for ch in info["chunks"]:
-            data = _file(ch["file"])[ch["array"]]
-            sl = tuple(slice(o, o + s) for o, s in
-                       zip(ch["global_offset"], ch["local_shape"]))
-            buf[sl] = data
-        from ..core.tensor import Tensor
+    try:
+        for key, target in flat_target.items():
+            info = meta["state_dict_metadata"].get(key)
+            if info is None:
+                missing.append(key)
+                continue
+            gshape = tuple(info["global_shape"])
+            buf = np.zeros(gshape, dtype=info["dtype"]) if gshape else \
+                np.zeros((), dtype=info["dtype"])
+            for ch in info["chunks"]:
+                data = _file(ch["file"])[ch["array"]]
+                if "crc32" in ch and _crc(data) != ch["crc32"]:
+                    raise CheckpointCorruption(
+                        f"{key}: chunk {ch['array']} in {ch['file']} fails "
+                        f"crc32 verification (corrupt checkpoint bytes)")
+                sl = tuple(slice(o, o + s) for o, s in
+                           zip(ch["global_offset"], ch["local_shape"]))
+                buf[sl] = data
+            from ..core.tensor import Tensor
 
-        if isinstance(target, Tensor):
-            # set_value casts to the target dtype and preserves the live
-            # sharding => reshard-on-load
-            target.set_value(buf)
-        else:
-            raise TypeError(f"state_dict value for {key!r} must be a Tensor")
+            if isinstance(target, Tensor):
+                # set_value casts to the target dtype and preserves the
+                # live sharding => reshard-on-load
+                target.set_value(buf)
+            else:
+                raise TypeError(
+                    f"state_dict value for {key!r} must be a Tensor")
+    finally:
+        # NpzFiles hold an open fd each; a training run resuming many
+        # times must not leak one per load
+        for f in files.values():
+            f.close()
     if missing:
         raise KeyError(f"checkpoint at {path} is missing keys: {missing[:5]}"
                        f"{'...' if len(missing) > 5 else ''}")
+
+
+# -- rotation + auto-resume -------------------------------------------------
+
+def _step_dirs(root) -> list[tuple[int, str]]:
+    out = []
+    for name in os.listdir(root) if os.path.isdir(root) else []:
+        if name.startswith(_STEP_PREFIX):
+            try:
+                out.append((int(name[len(_STEP_PREFIX):]),
+                            os.path.join(root, name)))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
+def step_dir(root, step: int) -> str:
+    return os.path.join(root, f"{_STEP_PREFIX}{step:08d}")
+
+
+def latest_step(root) -> Optional[int]:
+    """The step the ``LATEST`` pointer names, or None."""
+    try:
+        with open(os.path.join(root, _LATEST)) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def save_checkpoint(state_dict, root, step: int, keep_last_k:
+                    Optional[int] = None, coordinator_rank: int = 0):
+    """Rotated save: write ``<root>/step_<step>``, atomically update the
+    ``LATEST`` pointer, prune to the newest ``keep_last_k`` step dirs
+    (None/0 = keep everything). Pointer update and pruning run on the
+    coordinator only."""
+    os.makedirs(root, exist_ok=True)
+    save_state_dict(state_dict, step_dir(root, step))
+    if jax.process_index() != coordinator_rank:
+        return
+    tmp = os.path.join(root, f".{_LATEST}.tmp.{os.getpid()}")
+    with open(tmp, "w") as f:
+        f.write(str(int(step)))
+    os.replace(tmp, os.path.join(root, _LATEST))
+    if keep_last_k and keep_last_k > 0:
+        dirs = _step_dirs(root)
+        for _, d in dirs[:-keep_last_k]:
+            shutil.rmtree(d, ignore_errors=True)
+
+
+def load_latest_valid(state_dict, root) -> Optional[int]:
+    """Auto-resume: walk step dirs newest-first (starting from the
+    ``LATEST`` pointer's target), load the first one that passes
+    integrity verification, and return its step; None when no valid
+    checkpoint exists. A torn/corrupt newest checkpoint (killed mid-save)
+    is skipped with a warning — training resumes from the last durable
+    state instead of crashing on it."""
+    for step, d in reversed(_step_dirs(root)):
+        ok, problems = verify_checkpoint(d)
+        if not ok:
+            logger.warning("skipping invalid checkpoint %s: %s", d,
+                           "; ".join(problems))
+            continue
+        try:
+            load_state_dict(state_dict, d)
+        except Exception as e:  # noqa: BLE001 — any load failure walks back
+            logger.warning("checkpoint %s verified but failed to load "
+                           "(%r); walking back", d, e)
+            continue
+        return step
+    return None

@@ -21,6 +21,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ...testing import chaos as _chaos
 from ..store import TCPStore
 
 __all__ = ["ElasticManager", "ElasticController", "ELASTIC_EXIT_CODE",
@@ -68,6 +69,9 @@ class ElasticManager:
         self._threads.append(t)
 
     def _beat(self):
+        fault = _chaos.fire("elastic.heartbeat")
+        if fault is not None and fault.kind == "drop":
+            return   # injected dropped beat: the lease goes stale
         self.store.set(f"elastic/beat/{self.host}", str(time.time()))
         self.store.add(f"elastic/beat_flag/{self.host}", 1)
 

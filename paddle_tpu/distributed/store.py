@@ -6,6 +6,11 @@ the reference's paddle.distributed TCPStore
 set/get (blocking)/add/wait + a counter-based barrier. Falls back to an
 in-process dict store when single-host (is_master and host == client) and
 the native lib is unavailable.
+
+Chaos instrumentation: ``store.connect`` / ``store.get`` / ``store.set``
+/ ``store.add`` probes (paddle_tpu/testing/chaos.py) let robustness
+tests inject refused connections, get timeouts, and flaky writes; each
+probe is a no-op global check unless a fault plan is armed.
 """
 
 from __future__ import annotations
@@ -14,6 +19,8 @@ import os
 import threading
 import time
 from typing import Optional
+
+from ..testing import chaos as _chaos
 
 __all__ = ["TCPStore", "Store"]
 
@@ -36,9 +43,10 @@ class Store:
 class _LocalStore(Store):
     """In-process fallback (single-host tests without the native lib)."""
 
-    def __init__(self):
+    def __init__(self, timeout: float = 900.0):
         self._kv: dict = {}
         self._cv = threading.Condition()
+        self._timeout = timeout
 
     def set(self, key, value):
         if isinstance(value, str):
@@ -49,7 +57,12 @@ class _LocalStore(Store):
 
     def get(self, key):
         with self._cv:
-            self._cv.wait_for(lambda: key in self._kv)
+            # honor the store timeout: a key a dead peer never sets must
+            # raise, not hang the (single-host) test until the global kill
+            if not self._cv.wait_for(lambda: key in self._kv,
+                                     timeout=self._timeout):
+                raise TimeoutError(
+                    f"store get({key!r}) timed out after {self._timeout}s")
             return self._kv[key]
 
     def add(self, key, amount):
@@ -85,7 +98,8 @@ class TCPStore(Store):
                 raise RuntimeError(
                     "TCPStore needs the native library for multi-process "
                     "rendezvous (g++ unavailable?)")
-            self._local = _LocalStore()
+            _chaos.raise_fault("store.connect")
+            self._local = _LocalStore(timeout=timeout)
             return
 
         if is_master:
@@ -98,6 +112,7 @@ class TCPStore(Store):
     def _get_fd(self) -> int:
         fd = getattr(self._tls, "fd", None)
         if fd is None:
+            _chaos.raise_fault("store.connect")
             fd = self._lib.pt_store_connect(self.host.encode(), self.port,
                                             self._timeout_ms)
             if fd < 0:
@@ -108,6 +123,7 @@ class TCPStore(Store):
 
     # -- ops ----------------------------------------------------------------
     def set(self, key: str, value) -> None:
+        _chaos.raise_fault("store.set")
         if self._local is not None:
             return self._local.set(key, value)
         if isinstance(value, str):
@@ -119,6 +135,7 @@ class TCPStore(Store):
             raise RuntimeError("TCPStore set failed")
 
     def get(self, key: str) -> bytes:
+        _chaos.raise_fault("store.get")
         if self._local is not None:
             return self._local.get(key)
         import ctypes
@@ -135,6 +152,7 @@ class TCPStore(Store):
             cap = n  # value larger than the buffer: refetch full-size
 
     def add(self, key: str, amount: int = 1) -> int:
+        _chaos.raise_fault("store.add")
         if self._local is not None:
             return self._local.add(key, amount)
         out = self._lib.pt_store_add(self._get_fd(), key.encode(),
@@ -145,15 +163,26 @@ class TCPStore(Store):
         """Counter barrier: arrive, then wait for everyone.
 
         Polls with add(key, 0) (non-blocking peek — a blocking get would
-        make the timeout unreachable when a peer dies before arriving)."""
-        arrived = self.add(f"{key}/count", 1)
+        make the timeout unreachable when a peer dies before arriving).
+
+        The counter is namespaced by a store-resident **epoch** so the
+        same barrier key is reusable: the last arriver bumps the epoch,
+        and a later use (e.g. the next elastic generation reusing the
+        rendezvous key) starts from a fresh counter instead of instantly
+        "passing" on the previous use's leftover count. A timed-out
+        barrier also bumps the epoch, poisoning its partial count."""
+        epoch = self.add(f"{key}/epoch", 0)
+        ckey = f"{key}/count/e{epoch}"
+        arrived = self.add(ckey, 1)
         if arrived >= world_size:
+            self.add(f"{key}/epoch", 1)   # exactly one caller sees this
             return
         deadline = time.time() + timeout
         while time.time() < deadline:
-            if self.add(f"{key}/count", 0) >= world_size:
+            if self.add(ckey, 0) >= world_size:
                 return
             time.sleep(0.01)
+        self.add(f"{key}/epoch", 1)       # abandon the partial count
         raise TimeoutError(f"barrier {key} timed out")
 
     def __del__(self):

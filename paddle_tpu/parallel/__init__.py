@@ -6,8 +6,10 @@ paddle_tpu.distributed.fleet.
 """
 
 from .pipeline import pipeline_blocks_fn
+from .resilient_loop import ResilientTrainLoop, with_retries
 from .ring_attention import ring_attention
 from .train_step import make_sharded_train_step, shard_gpt_params
 
 __all__ = ["pipeline_blocks_fn", "make_sharded_train_step",
-           "shard_gpt_params", "ring_attention"]
+           "shard_gpt_params", "ring_attention", "ResilientTrainLoop",
+           "with_retries"]

@@ -1,0 +1,14 @@
+"""Testing utilities: deterministic fault injection (chaos harness).
+
+The chaos harness is the adversarial counterpart of the robustness
+runtime (distributed/checkpoint hardening, parallel/resilient_loop):
+tests arm a seeded :class:`~paddle_tpu.testing.chaos.FaultPlan` and the
+instrumented subsystems (TCPStore, checkpoint save, elastic heartbeats,
+the resilient train loop) misbehave on cue — deterministically, in-process
+or across ``launch``/elastic child workers via env propagation.
+"""
+
+from . import chaos
+from .chaos import ChaosInjected, FaultPlan, FaultSpec
+
+__all__ = ["chaos", "FaultPlan", "FaultSpec", "ChaosInjected"]

@@ -1,0 +1,234 @@
+"""Deterministic fault-injection harness (chaos testing).
+
+Design (reference inspiration: the fault classes the production stack
+defends against — CommTaskManager hang tracking, the elastic manager's
+relaunch-on-failure, dedup'd sharded checkpointing): a *fault plan* is a
+seeded list of :class:`FaultSpec`s, each naming an **injection point**
+(a string like ``"store.get"``) plus *when* it fires (at the Nth
+invocation of that point, with a probability per invocation, or every
+time) and a site-interpreted *kind* (``"timeout"``, ``"torn"``,
+``"nan"``, ``"hang"``, ...). Production code is instrumented with cheap
+``chaos.fire(point)`` probes; with no plan armed the probe is a single
+global load + ``is None`` compare — zero-cost, nothing in a jitted
+program (all probes live in host code).
+
+Injection-point catalog (the instrumented sites and the kinds they
+honor):
+
+====================  ======================================================
+point                 kinds
+====================  ======================================================
+``store.connect``     ``refuse`` (ConnectionRefusedError on connect)
+``store.get``         ``timeout`` (TimeoutError), ``flaky`` (ConnectionError)
+``store.set``         ``flaky`` (ConnectionError)
+``store.add``         ``flaky`` (ConnectionError)
+``checkpoint.save``   ``torn`` (truncated npz, no metadata/manifest),
+                      ``torn_manifest`` (data+metadata written, manifest
+                      missing — the kill-between-fsyncs case),
+                      ``corrupt`` (chunk bytes flipped after write; crc
+                      catches it), ``missing_meta`` (metadata file never
+                      written), ``raise`` (write raises — exercises the
+                      async-save error surfacing)
+``elastic.heartbeat`` ``drop`` (beat silently skipped; lease goes stale)
+``train.step``        ``nan`` (loss poisoned to NaN), ``raise``
+                      (ChaosInjected out of the step), ``hang`` (sleep
+                      ``seconds`` inside the watchdog guard)
+====================  ======================================================
+
+Determinism: probabilistic faults draw from a ``random.Random`` seeded
+from ``(plan.seed, point, kind)``, and at-N faults count invocations per
+point — a given plan produces the same fault schedule every run.
+
+Env propagation: ``plan.to_env()`` returns ``{"PT_CHAOS_PLAN": <json>}``;
+child workers (``distributed.launch`` / elastic generations) arm
+automatically at import time when ``PT_CHAOS_PLAN`` is present, so
+multiprocess tests can arm faults in children they never import.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import zlib
+from dataclasses import dataclass, field
+from typing import Optional
+
+__all__ = ["FaultSpec", "FaultPlan", "ChaosInjected", "arm", "disarm",
+           "active", "fire", "raise_fault", "arm_from_env", "PLAN_ENV"]
+
+logger = logging.getLogger("paddle_tpu.testing.chaos")
+
+PLAN_ENV = "PT_CHAOS_PLAN"
+
+
+class ChaosInjected(Exception):
+    """An injected fault with no more specific exception type."""
+
+
+@dataclass
+class FaultSpec:
+    """One scheduled fault.
+
+    ``at``: fire at the Nth invocation of the point (0-based), once.
+    ``prob``: else fire per-invocation with this probability.
+    ``once``: at most one firing total (default True; ``False`` with
+    neither ``at`` nor ``prob`` means *every* invocation fires).
+    ``args``: site parameters (e.g. ``seconds`` for hangs).
+    """
+
+    point: str
+    kind: str
+    at: Optional[int] = None
+    prob: float = 0.0
+    once: bool = True
+    args: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"point": self.point, "kind": self.kind, "at": self.at,
+                "prob": self.prob, "once": self.once, "args": self.args}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultSpec":
+        return cls(point=d["point"], kind=d["kind"], at=d.get("at"),
+                   prob=d.get("prob", 0.0), once=d.get("once", True),
+                   args=d.get("args") or {})
+
+
+class FaultPlan:
+    """A named, seeded set of faults, serializable through one env var."""
+
+    def __init__(self, seed: int = 0, name: str = "chaos"):
+        self.seed = int(seed)
+        self.name = name
+        self.faults: list[FaultSpec] = []
+
+    def add(self, point: str, kind: str, at: Optional[int] = None,
+            prob: float = 0.0, once: bool = True, **args) -> "FaultPlan":
+        self.faults.append(FaultSpec(point, kind, at=at, prob=prob,
+                                     once=once, args=args))
+        return self
+
+    # -- serialization ----------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps({"seed": self.seed, "name": self.name,
+                           "faults": [f.to_dict() for f in self.faults]})
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        d = json.loads(text)
+        plan = cls(seed=d.get("seed", 0), name=d.get("name", "chaos"))
+        plan.faults = [FaultSpec.from_dict(f) for f in d.get("faults", [])]
+        return plan
+
+    def to_env(self) -> dict:
+        """Env mapping that arms this plan in a child process (pass as
+        ``env_extra`` to ``launch_procs``/``run_elastic``)."""
+        return {PLAN_ENV: self.to_json()}
+
+
+class _ArmedPlan:
+    """Runtime state of an armed plan: invocation counters per point,
+    fired-flags per spec, and a deterministic RNG per probabilistic
+    spec."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+        self._fired: set[int] = set()
+        self._rngs: dict[int, random.Random] = {}
+        self._by_point: dict[str, list[tuple[int, FaultSpec]]] = {}
+        for i, spec in enumerate(plan.faults):
+            self._by_point.setdefault(spec.point, []).append((i, spec))
+
+    def _rng(self, i: int, spec: FaultSpec) -> random.Random:
+        rng = self._rngs.get(i)
+        if rng is None:
+            salt = zlib.crc32(f"{spec.point}|{spec.kind}|{i}".encode())
+            rng = self._rngs[i] = random.Random(self.plan.seed ^ salt)
+        return rng
+
+    def check(self, point: str) -> Optional[FaultSpec]:
+        specs = self._by_point.get(point)
+        if specs is None:
+            return None
+        with self._lock:
+            n = self._counts.get(point, 0)
+            self._counts[point] = n + 1
+            for i, spec in specs:
+                if spec.once and i in self._fired:
+                    continue
+                if spec.at is not None:
+                    hit = n == spec.at
+                elif spec.prob > 0.0:
+                    hit = self._rng(i, spec).random() < spec.prob
+                else:
+                    hit = True
+                if hit:
+                    self._fired.add(i)
+                    logger.warning("chaos[%s]: firing %s(%s) at "
+                                   "invocation %d of %s", self.plan.name,
+                                   spec.kind, spec.args, n, point)
+                    return spec
+        return None
+
+
+_armed: Optional[_ArmedPlan] = None
+
+
+def arm(plan: FaultPlan) -> None:
+    """Activate ``plan`` process-wide (replaces any armed plan)."""
+    global _armed
+    _armed = _ArmedPlan(plan)
+
+
+def disarm() -> None:
+    global _armed
+    _armed = None
+
+
+def active() -> bool:
+    return _armed is not None
+
+
+def fire(point: str) -> Optional[FaultSpec]:
+    """The probe production code calls: returns the fault that fires at
+    this invocation of ``point``, or None. Zero-cost when disarmed."""
+    if _armed is None:
+        return None
+    return _armed.check(point)
+
+
+_EXC_FOR_KIND = {
+    "timeout": TimeoutError,
+    "refuse": ConnectionRefusedError,
+    "flaky": ConnectionError,
+}
+
+
+def raise_fault(point: str) -> None:
+    """Fire ``point`` and raise the exception matching the fault kind
+    (TimeoutError / ConnectionRefusedError / ConnectionError /
+    ChaosInjected); no-op when nothing fires."""
+    spec = fire(point)
+    if spec is None:
+        return
+    exc = _EXC_FOR_KIND.get(spec.kind, ChaosInjected)
+    raise exc(f"chaos: injected {spec.kind} at {point}")
+
+
+def arm_from_env() -> bool:
+    """Arm from ``PT_CHAOS_PLAN`` if set (child-worker path). Returns
+    whether a plan was armed."""
+    text = os.environ.get("PT_CHAOS_PLAN")
+    if not text:
+        return False
+    arm(FaultPlan.from_json(text))
+    return True
+
+
+# child workers launched with plan.to_env() arm automatically on import
+arm_from_env()

@@ -1,0 +1,72 @@
+"""Worker for the chaos end-to-end test: a ResilientTrainLoop-driven
+trainer supervised by run_elastic, with faults armed through the
+PT_CHAOS_PLAN env var.
+
+Generation 0 is killed mid-run by the armed plan (a torn checkpoint save
+followed by an injected step failure); the relaunched generation runs
+with the plan disarmed, auto-resumes via load_latest_valid (skipping the
+torn newest checkpoint), and trains to completion. Prints RESUMED/STEP/
+DONE markers the test asserts on (monotone step count across the kill).
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.parallel.resilient_loop import ResilientTrainLoop
+from paddle_tpu.testing import chaos
+
+gen = int(os.environ.get("PADDLE_ELASTIC_RESTART", "0"))
+ckpt = os.environ["CHAOS_CKPT_DIR"]
+total_steps = int(os.environ.get("CHAOS_TOTAL_STEPS", "8"))
+
+# the armed plan (auto-armed from PT_CHAOS_PLAN at import) targets the
+# FIRST generation only: the relaunch must heal, not re-crash
+if gen != 0:
+    chaos.disarm()
+
+rng = np.random.RandomState(0)
+X = rng.randn(8, 16).astype(np.float32)
+Y = (X @ rng.randn(16, 4) * 0.1).astype(np.float32)
+W0 = rng.randn(16, 4).astype(np.float32) * 0.01
+
+
+@jax.jit
+def _sgd(w, x, y):
+    def loss_fn(w):
+        return ((x @ w - y) ** 2).mean()
+
+    loss, g = jax.value_and_grad(loss_fn)(w)
+    return loss, w - 0.1 * g
+
+
+def step_fn(state, batch):
+    x, y = batch
+    loss, w = _sgd(state["w"]._data, x, y)
+    return loss, {"w": Tensor(w)}
+
+
+state = {"w": Tensor(jnp.asarray(W0))}
+loop = ResilientTrainLoop(step_fn, state, ckpt, save_every=1,
+                          keep_last_k=3, max_bad_steps=2, step_timeout=60.0,
+                          retries=2)
+resumed = loop.resume()
+print(f"RESUMED step={-1 if resumed is None else resumed}", flush=True)
+
+while loop.step < total_steps:
+    loss = loop.run_step((X, Y))
+    if loss is not None:
+        print(f"STEP {loop.step} LOSS {loss:.6f}", flush=True)
+
+print(f"DONE step={loop.step} final_loss={loss:.6f} "
+      f"stats={loop.stats}", flush=True)
+sys.exit(0)

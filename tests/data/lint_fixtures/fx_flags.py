@@ -1,4 +1,6 @@
 """TPL006 fixture: flag hygiene (never imported)."""
+import os
+
 from paddle_tpu.core.flags import GLOBAL_FLAGS, define_flag, get_flags
 
 define_flag("fx_unused", False, "never read anywhere")   # seeded violation
@@ -6,6 +8,7 @@ define_flag("fx_unused", False, "never read anywhere")   # seeded violation
 define_flag("fx_read_get", False, "read via .get below")
 define_flag("fx_read_has", False, "read via .has below")
 define_flag("fx_read_api", False, "read via get_flags below")
+define_flag("fx_read_env", False, "read via its FLAGS_ env override below")
 
 define_flag("fx_reserved", False, "parity")  # tpu-lint: disable=TPL006 -- fixture: suppressed instance
 
@@ -15,3 +18,13 @@ def reads():
     b = GLOBAL_FLAGS.has("fx_read_has")
     c = get_flags(["fx_read_api"])
     return a, b, c
+
+
+def env_surface(monkeypatch):
+    os.environ["PT_CHAOS_FX_DEAD"] = "1"     # seeded violation: never read
+    os.environ["PT_CHAOS_FX_USED"] = "1"
+    monkeypatch.setenv("PT_CHAOS_FX_PATCHED", "1")
+    d = os.environ.get("FLAGS_fx_read_env")
+    e = os.environ.get("PT_CHAOS_FX_USED")
+    f = os.environ["PT_CHAOS_FX_PATCHED"]
+    return d, e, f

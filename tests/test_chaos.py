@@ -1,0 +1,574 @@
+"""Chaos suite: fault injection + the self-healing runtime it exercises.
+
+One test (at least) per fault class from the robustness issue:
+torn/corrupt checkpoint -> detected + walked back by load_latest_valid;
+flaky store -> survived by with_retries; NaN/Inf step -> skipped then
+rolled back; hung step -> StepWatchdog escalation (comm-task dump ->
+checkpoint -> elastic exit); plus unit coverage for the FaultPlan
+scheduler, crc verification, rotation, barrier reuse, store timeouts,
+async-save error surfacing, and an end-to-end elastic kill/resume run.
+"""
+
+import json
+import os
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.testing import chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "chaos_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    chaos.disarm()
+
+
+def _free_port():
+    from paddle_tpu.distributed.launch import _free_port
+
+    return _free_port()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan scheduler semantics
+# ---------------------------------------------------------------------------
+
+def test_fire_is_noop_when_disarmed():
+    assert not chaos.active()
+    assert chaos.fire("store.get") is None
+    chaos.raise_fault("store.get")   # must not raise
+
+
+def test_fault_plan_at_and_once():
+    chaos.arm(chaos.FaultPlan(seed=0).add("p", "raise", at=2))
+    hits = [chaos.fire("p") for _ in range(5)]
+    assert [h is not None for h in hits] == [False, False, True, False,
+                                            False]
+
+
+def test_fault_plan_always_and_once():
+    chaos.arm(chaos.FaultPlan(seed=0).add("p", "drop", once=False))
+    assert all(chaos.fire("p") is not None for _ in range(4))
+    chaos.arm(chaos.FaultPlan(seed=0).add("p", "drop", once=True))
+    assert chaos.fire("p") is not None
+    assert chaos.fire("p") is None
+
+
+def test_fault_plan_prob_is_seed_deterministic():
+    def schedule(seed):
+        chaos.arm(chaos.FaultPlan(seed=seed).add("p", "flaky", prob=0.5,
+                                                 once=False))
+        return [chaos.fire("p") is not None for _ in range(32)]
+
+    a, b, c = schedule(7), schedule(7), schedule(8)
+    assert a == b
+    assert a != c and any(a) and not all(a)
+
+
+def test_fault_plan_env_roundtrip(monkeypatch):
+    plan = chaos.FaultPlan(seed=3, name="rt")
+    plan.add("train.step", "hang", at=1, seconds=0.25)
+    env = plan.to_env()
+    back = chaos.FaultPlan.from_json(env["PT_CHAOS_PLAN"])
+    assert back.seed == 3 and back.name == "rt"
+    assert back.faults[0].point == "train.step"
+    assert back.faults[0].kind == "hang"
+    assert back.faults[0].args == {"seconds": 0.25}
+    monkeypatch.setenv("PT_CHAOS_PLAN", env["PT_CHAOS_PLAN"])
+    assert chaos.arm_from_env()
+    assert chaos.fire("train.step") is None       # at=1: not yet
+    assert chaos.fire("train.step").kind == "hang"
+
+
+# ---------------------------------------------------------------------------
+# fault class: flaky store (+ store satellites)
+# ---------------------------------------------------------------------------
+
+def test_store_faults_and_retry_recovery():
+    from paddle_tpu.distributed.store import TCPStore
+    from paddle_tpu.parallel.resilient_loop import with_retries
+
+    store = TCPStore("127.0.0.1", _free_port(), is_master=True,
+                     world_size=1)
+    store.set("k", b"v")
+    chaos.arm(chaos.FaultPlan(seed=0)
+              .add("store.get", "timeout", at=0)
+              .add("store.set", "flaky", at=0))
+    with pytest.raises(TimeoutError):
+        store.get("k")
+    # with_retries survives the injected flake: first set raises, the
+    # retry lands
+    with_retries(store.set, "k2", b"w", retries=3, base_delay=0.01, seed=1)
+    chaos.disarm()
+    assert store.get("k2") == b"w"
+
+
+def test_store_connect_refused_injected(monkeypatch):
+    from paddle_tpu.core import native
+    from paddle_tpu.distributed.store import TCPStore
+
+    monkeypatch.setattr(native, "load", lambda: None)
+    chaos.arm(chaos.FaultPlan(seed=0).add("store.connect", "refuse", at=0))
+    with pytest.raises(ConnectionRefusedError):
+        TCPStore("127.0.0.1", 1, is_master=True, world_size=1)
+
+
+def test_local_store_get_honors_timeout(monkeypatch):
+    """Satellite regression: a key a dead peer never set must raise, not
+    block tier-1 until the global kill."""
+    from paddle_tpu.core import native
+    from paddle_tpu.distributed.store import TCPStore
+
+    monkeypatch.setattr(native, "load", lambda: None)
+    store = TCPStore("127.0.0.1", 1, is_master=True, world_size=1,
+                     timeout=0.2)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        store.get("never-set")
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_barrier_key_reuse_regression():
+    """Satellite regression: a reused barrier key must not instantly
+    "pass" on the previous use's leftover counter."""
+    from paddle_tpu.distributed.store import TCPStore
+
+    store = TCPStore("127.0.0.1", _free_port(), is_master=True,
+                     world_size=1)
+    errs = []
+
+    def arrive():
+        try:
+            store.barrier("b", 2, timeout=10.0)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=arrive) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=15)
+    assert not errs, errs
+    # generation 2 reuses the same key with only ONE arrival: it must
+    # time out (pre-fix: returned immediately on the stale count)
+    with pytest.raises(TimeoutError):
+        store.barrier("b", 2, timeout=0.4)
+    # and the timed-out partial count is abandoned: a full complement
+    # afterwards still works
+    ts = [threading.Thread(target=arrive) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=15)
+    assert not errs, errs
+
+
+# ---------------------------------------------------------------------------
+# fault class: torn / corrupt checkpoint
+# ---------------------------------------------------------------------------
+
+def _save_steps(root, upto, start=1):
+    t = pt.to_tensor(np.zeros((4, 4), np.float32))
+    from paddle_tpu.distributed.checkpoint import save_checkpoint
+
+    for s in range(start, upto + 1):
+        t.set_value(np.full((4, 4), float(s), np.float32))
+        save_checkpoint({"w": t}, root, s, keep_last_k=4)
+
+
+def test_checkpoint_rotation_and_latest_pointer(tmp_path):
+    from paddle_tpu.distributed.checkpoint import latest_step, \
+        save_checkpoint
+
+    root = str(tmp_path / "ck")
+    t = pt.to_tensor(np.ones((2, 2), np.float32))
+    for s in range(1, 6):
+        save_checkpoint({"w": t}, root, s, keep_last_k=3)
+    dirs = sorted(d for d in os.listdir(root) if d.startswith("step_"))
+    assert dirs == ["step_00000003", "step_00000004", "step_00000005"]
+    assert latest_step(root) == 5
+
+
+def test_crc_detects_corrupt_chunk(tmp_path):
+    """Flip bytes in a saved chunk while keeping the container valid: the
+    per-chunk crc32 (not the zip's own checksum) must catch it."""
+    from paddle_tpu.distributed.checkpoint import (CheckpointCorruption,
+                                                   load_state_dict,
+                                                   save_state_dict,
+                                                   verify_checkpoint)
+
+    d = str(tmp_path / "ck")
+    t = pt.to_tensor(np.ones((4, 4), np.float32))
+    save_state_dict({"w": t}, d)
+    # rewrite the npz as a VALID zip holding different bytes (same shape/
+    # dtype => same size, so only the recorded crc can tell)
+    with open(os.path.join(d, "0_0.npz"), "wb") as f:
+        np.savez(f, **{"w#0": np.full((4, 4), 7.0, np.float32)})
+    ok, problems = verify_checkpoint(d)
+    assert not ok and any("crc" in p for p in problems), problems
+    with pytest.raises(CheckpointCorruption):
+        load_state_dict({"w": pt.to_tensor(np.zeros((4, 4), np.float32))}, d)
+
+
+@pytest.mark.parametrize("kind", ["torn", "torn_manifest", "missing_meta",
+                                  "corrupt"])
+def test_torn_save_detected_and_walked_back(tmp_path, kind):
+    """Each torn-save shape is (a) flagged by verify_checkpoint and (b)
+    skipped by load_latest_valid, which resumes from the last good step."""
+    from paddle_tpu.distributed.checkpoint import (load_latest_valid,
+                                                   save_checkpoint,
+                                                   verify_checkpoint)
+
+    root = str(tmp_path / "ck")
+    _save_steps(root, 3)
+    chaos.arm(chaos.FaultPlan(seed=0).add("checkpoint.save", kind, at=0))
+    t = pt.to_tensor(np.full((4, 4), 99.0, np.float32))
+    save_checkpoint({"w": t}, root, 4, keep_last_k=4)
+    chaos.disarm()
+    ok, problems = verify_checkpoint(str(tmp_path / "ck" / "step_00000004"))
+    assert not ok, kind
+    target = pt.to_tensor(np.zeros((4, 4), np.float32))
+    assert load_latest_valid({"w": target}, root) == 3
+    np.testing.assert_array_equal(target.numpy(), 3.0)
+
+
+def test_load_latest_valid_none_when_empty(tmp_path):
+    from paddle_tpu.distributed.checkpoint import load_latest_valid
+
+    t = pt.to_tensor(np.zeros((2,), np.float32))
+    assert load_latest_valid({"w": t}, str(tmp_path / "nope")) is None
+
+
+def test_legacy_v1_checkpoint_still_loads(tmp_path):
+    """Format additivity: a pre-crc/manifest checkpoint verifies OK (with
+    a warning) and loads."""
+    from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                   save_state_dict,
+                                                   verify_checkpoint)
+
+    d = str(tmp_path / "ck")
+    t = pt.to_tensor(np.full((3, 3), 5.0, np.float32))
+    save_state_dict({"w": t}, d)
+    # strip the v2 additions: no manifest, no crc, no format marker
+    os.remove(os.path.join(d, "manifest_0.json"))
+    mp = os.path.join(d, "metadata_0.json")
+    with open(mp) as f:
+        meta = json.load(f)
+    meta.pop("format")
+    for info in meta["state_dict_metadata"].values():
+        for ch in info["chunks"]:
+            ch.pop("crc32")
+    with open(mp, "w") as f:
+        json.dump(meta, f)
+    ok, problems = verify_checkpoint(d)
+    assert ok, problems
+    target = pt.to_tensor(np.zeros((3, 3), np.float32))
+    load_state_dict({"w": target}, d)
+    np.testing.assert_array_equal(target.numpy(), 5.0)
+
+
+def test_async_save_failure_surfaces(tmp_path):
+    """Satellite regression: a failed background write must re-raise on
+    join() AND on the next save, not vanish in the daemon thread."""
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    t = pt.to_tensor(np.ones((2, 2), np.float32))
+    # (a) join() on the failed writer re-raises
+    chaos.arm(chaos.FaultPlan(seed=0).add("checkpoint.save", "raise", at=0))
+    th = ckpt.save_state_dict({"w": t}, str(tmp_path / "a"),
+                              async_save=True)
+    with pytest.raises(RuntimeError, match="async checkpoint save failed"):
+        th.join()
+    # the error is consumed by the raising join: later saves are clean
+    ckpt.save_state_dict({"w": t}, str(tmp_path / "b"))
+
+    # (b) with NOBODY joining, the next save surfaces it instead
+    chaos.arm(chaos.FaultPlan(seed=0).add("checkpoint.save", "raise", at=0))
+    th2 = ckpt.save_state_dict({"w": t}, str(tmp_path / "c"),
+                               async_save=True)
+    threading.Thread.join(th2)               # wait without consuming
+    with pytest.raises(RuntimeError, match="previous async checkpoint"):
+        ckpt.save_state_dict({"w": t}, str(tmp_path / "d"))
+    ckpt.save_state_dict({"w": t}, str(tmp_path / "e"))   # consumed
+
+
+def test_load_closes_npz_handles(tmp_path, monkeypatch):
+    """Satellite regression: load_state_dict must not leak one fd per
+    resume."""
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    d = str(tmp_path / "ck")
+    t = pt.to_tensor(np.ones((2, 2), np.float32))
+    ckpt.save_state_dict({"w": t}, d)
+    opened = []
+    real_load = np.load
+
+    def tracking_load(*a, **k):
+        f = real_load(*a, **k)
+        opened.append(f)
+        return f
+
+    monkeypatch.setattr(np, "load", tracking_load)
+    ckpt.load_state_dict({"w": t}, d)
+    assert opened
+    for f in opened:
+        assert f.zip is None, "NpzFile left open after load"
+
+
+# ---------------------------------------------------------------------------
+# fault class: NaN/Inf step (skip + rollback)
+# ---------------------------------------------------------------------------
+
+def _toy_loop(tmp_path, **kw):
+    import jax
+
+    from paddle_tpu.parallel.resilient_loop import ResilientTrainLoop
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 4).astype(np.float32)
+    Y = (X @ rng.randn(4, 2)).astype(np.float32)
+
+    @jax.jit
+    def sgd(w, x, y):
+        loss, g = jax.value_and_grad(
+            lambda w: ((x @ w - y) ** 2).mean())(w)
+        return loss, w - 0.05 * g
+
+    def step_fn(state, batch):
+        loss, w = sgd(state["w"]._data, *batch)
+        return loss, {"w": Tensor(w)}
+
+    state = {"w": Tensor(jnp.zeros((4, 2), jnp.float32))}
+    loop = ResilientTrainLoop(step_fn, state, str(tmp_path / "ck"),
+                              save_every=1, **kw)
+    return loop, (X, Y)
+
+
+def test_nan_step_skipped_then_rolled_back(tmp_path):
+    loop, batch = _toy_loop(tmp_path, keep_last_k=3, max_bad_steps=2,
+                            step_timeout=60.0)
+    # train.step invocations 3 and 4 produce NaN: step 4 is attempted
+    # twice poisoned -> skip, skip, rollback to the step-3 checkpoint
+    chaos.arm(chaos.FaultPlan(seed=0)
+              .add("train.step", "nan", at=3)
+              .add("train.step", "nan", at=4))
+    losses = []
+    while loop.step < 6:
+        out = loop.run_step(batch)
+        if out is not None:
+            losses.append(out)
+    assert loop.stats["skipped"] == 2
+    assert loop.stats["rollbacks"] == 1
+    assert loop.step == 6
+    assert losses[-1] < losses[0]
+    # the rollback reloaded real step-3 weights: training continued from
+    # a finite state, so every committed loss is finite
+    assert all(np.isfinite(losses))
+
+
+def test_rollback_restores_checkpointed_weights(tmp_path):
+    loop, batch = _toy_loop(tmp_path, keep_last_k=3, max_bad_steps=1,
+                            step_timeout=60.0)
+    for _ in range(3):
+        loop.run_step(batch)
+    w3 = loop.state["w"].numpy().copy()
+    # arm() resets invocation counters: at=0 is the NEXT step
+    chaos.arm(chaos.FaultPlan(seed=0).add("train.step", "nan", at=0))
+    assert loop.run_step(batch) is None          # poisoned -> rollback
+    chaos.disarm()
+    assert loop.step == 3
+    np.testing.assert_array_equal(loop.state["w"].numpy(), w3)
+
+
+def test_donated_step_restores_on_every_bad_step(tmp_path):
+    """With a donating jit the skipped step's OLD state is invalidated on
+    device; the sentinel must restore from checkpoint immediately, not
+    wait out max_bad_steps."""
+    loop, batch = _toy_loop(tmp_path, keep_last_k=3, max_bad_steps=5,
+                            step_timeout=60.0, donated_step=True)
+    for _ in range(2):
+        loop.run_step(batch)
+    chaos.arm(chaos.FaultPlan(seed=0).add("train.step", "nan", at=0))
+    assert loop.run_step(batch) is None
+    assert loop.stats["rollbacks"] == 1       # immediate, streak 1 < 5
+    assert loop.step == 2
+
+
+# ---------------------------------------------------------------------------
+# fault class: hung step (watchdog escalation)
+# ---------------------------------------------------------------------------
+
+def test_hung_step_escalates_with_comm_dump_and_checkpoint(tmp_path):
+    from paddle_tpu.distributed.comm_watchdog import comm_task_manager
+
+    seen = []
+    loop, batch = _toy_loop(tmp_path, keep_last_k=3, max_bad_steps=3,
+                            step_timeout=0.2,
+                            on_escalate=lambda tag, age: seen.append(tag))
+    loop.run_step(batch)                          # one good step + save
+    # a registered in-flight task exercises the escalation dump path
+    comm_task_manager.enabled = True
+    tid = comm_task_manager.register("allreduce(grads)")
+    chaos.arm(chaos.FaultPlan(seed=0)
+              .add("train.step", "hang", at=0, seconds=0.8))
+    t0 = time.monotonic()
+    loop.run_step(batch)
+    assert time.monotonic() - t0 >= 0.2
+    comm_task_manager.complete(tid)
+    comm_task_manager.enabled = False
+    assert seen == ["step1"]
+    assert loop.stats["hangs"] == 1
+    # escalation checkpointed the last good state before (simulated) exit
+    from paddle_tpu.distributed.checkpoint import load_latest_valid
+
+    target = {"w": Tensor(jnp.zeros((4, 2), jnp.float32))}
+    assert load_latest_valid(target, str(tmp_path / "ck")) >= 1
+
+
+def test_default_escalation_exits_with_elastic_code(tmp_path, monkeypatch):
+    from paddle_tpu.distributed.fleet.elastic import ELASTIC_EXIT_CODE
+
+    codes = []
+    monkeypatch.setattr(os, "_exit", lambda c: codes.append(c))
+    loop, batch = _toy_loop(tmp_path, keep_last_k=2, max_bad_steps=3,
+                            step_timeout=0.15)
+    chaos.arm(chaos.FaultPlan(seed=0)
+              .add("train.step", "hang", at=0, seconds=0.6))
+    loop.run_step(batch)
+    assert codes == [ELASTIC_EXIT_CODE]
+
+
+# ---------------------------------------------------------------------------
+# with_retries + flag-driven defaults
+# ---------------------------------------------------------------------------
+
+def test_with_retries_deadline_bounded():
+    from paddle_tpu.parallel.resilient_loop import with_retries
+
+    calls = []
+
+    def always_fails():
+        calls.append(1)
+        raise ConnectionError("down")
+
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError):
+        with_retries(always_fails, retries=100, base_delay=0.05,
+                     deadline=0.4, seed=0)
+    assert time.monotonic() - t0 < 3.0
+    assert len(calls) >= 2
+
+
+def test_with_retries_gives_up_after_retries():
+    from paddle_tpu.parallel.resilient_loop import with_retries
+
+    calls = []
+
+    def always_fails():
+        calls.append(1)
+        raise TimeoutError("nope")
+
+    with pytest.raises(TimeoutError):
+        with_retries(always_fails, retries=3, base_delay=0.001, seed=0)
+    assert len(calls) == 4       # first call + 3 retries
+
+
+def test_resilient_defaults_come_from_flags(tmp_path):
+    from paddle_tpu.core.flags import get_flags, set_flags
+    from paddle_tpu.parallel.resilient_loop import ResilientTrainLoop
+
+    saved = get_flags(["resilient_max_bad_steps", "resilient_keep_last_k",
+                       "resilient_step_timeout", "resilient_retry_max"])
+    try:
+        set_flags({"resilient_max_bad_steps": 7,
+                   "resilient_keep_last_k": 11,
+                   "resilient_step_timeout": 33.0,
+                   "resilient_retry_max": 2})
+        loop = ResilientTrainLoop(lambda s, b: (0.0, s), {},
+                                  str(tmp_path / "ck"))
+        assert loop.max_bad_steps == 7
+        assert loop.keep_last_k == 11
+        assert loop.watchdog.timeout == 33.0
+        assert loop.retries == 2
+    finally:
+        set_flags(saved)
+
+
+# ---------------------------------------------------------------------------
+# fault class: dropped heartbeats (lease expiry)
+# ---------------------------------------------------------------------------
+
+def test_dropped_heartbeats_expire_lease():
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    from paddle_tpu.distributed.store import TCPStore
+
+    store = TCPStore("127.0.0.1", _free_port(), is_master=True,
+                     world_size=1)
+    chaos.arm(chaos.FaultPlan(seed=0)
+              .add("elastic.heartbeat", "drop", once=False))
+    mgr = ElasticManager(host="nodeA", store=store, np=1, ttl=1.0,
+                         heartbeat_interval=0.1)
+    mgr.register()
+    assert mgr.live_hosts() == []        # every beat dropped: never live
+    mgr.exit()
+    chaos.disarm()
+    mgr._beat()
+    assert mgr.live_hosts() == ["nodeA"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: kill a worker mid-run, resume from last VALID checkpoint
+# ---------------------------------------------------------------------------
+
+def test_chaos_e2e_kill_resume_monotone(tmp_path):
+    """Generation 0 tears its step-3 save and then dies on an injected
+    step failure; run_elastic relaunches, and the healed generation
+    resumes from step 2 (the newest checkpoint passing verification) and
+    trains to completion with a monotone step count."""
+    from paddle_tpu.distributed.fleet.elastic import run_elastic
+
+    ckpt = str(tmp_path / "ckpt")
+    plan = chaos.FaultPlan(seed=0, name="e2e")
+    plan.add("checkpoint.save", "torn", at=2)    # the step-3 save
+    plan.add("train.step", "raise", at=3)        # die on the next step
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+
+    rc = run_elastic(
+        WORKER, [], nprocs=1, max_restarts=2,
+        log_dir=str(tmp_path / "logs"),
+        env_extra={"PYTHONPATH": REPO, "CHAOS_CKPT_DIR": ckpt,
+                   "CHAOS_TOTAL_STEPS": "8", **plan.to_env()})
+    assert rc == 0, rc
+
+    logs = {}
+    for gen in (0, 1):
+        p = tmp_path / "logs" / f"restart_{gen}" / "worker.0.log"
+        logs[gen] = p.read_text() if p.exists() else ""
+
+    # gen0: fresh start, died after step 3 (whose save was torn)
+    assert "RESUMED step=-1" in logs[0]
+    assert "chaos: train step failure" in logs[0]
+    g0 = [int(s) for s in re.findall(r"STEP (\d+) ", logs[0])]
+    assert g0 == [1, 2, 3]
+    # gen1: resumed from step 2 — step 3's checkpoint exists but is torn
+    assert "RESUMED step=2" in logs[1], logs[1]
+    g1 = [int(s) for s in re.findall(r"STEP (\d+) ", logs[1])]
+    assert g1 == list(range(3, 9))
+    assert "DONE step=8" in logs[1]
+    # training progressed: final loss below gen0's first loss
+    losses0 = [float(x) for x in re.findall(r"LOSS ([\d.]+)", logs[0])]
+    losses1 = [float(x) for x in re.findall(r"LOSS ([\d.]+)", logs[1])]
+    assert losses1[-1] < losses0[0]

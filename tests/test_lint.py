@@ -144,9 +144,17 @@ def test_tpl005_collective_fires_and_suppresses():
 def test_tpl006_flags_fire_and_suppress():
     src = open(fx("fx_flags.py")).read()
     f = lint(["fx_flags.py"], "TPL006")
-    assert len(f) == 1, [x.message for x in f]
-    assert "seeded violation" in src.splitlines()[f[0].line - 1]
-    assert "fx_unused" in f[0].message and f[0].severity == "warning"
+    assert len(f) == 2, [x.message for x in f]
+    for x in f:
+        assert "seeded violation" in src.splitlines()[x.line - 1]
+        assert x.severity == "warning"
+    msgs = " | ".join(x.message for x in f)
+    # the dead flag fires; the flags read only via their FLAGS_ env
+    # override and the consumed PT_CHAOS_* knobs do not
+    assert "fx_unused" in msgs
+    assert "PT_CHAOS_FX_DEAD" in msgs
+    assert "fx_read_env" not in msgs and "FX_USED" not in msgs \
+        and "FX_PATCHED" not in msgs
 
 
 # -- framework behaviors -----------------------------------------------------

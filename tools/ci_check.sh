@@ -1,5 +1,6 @@
 #!/usr/bin/env bash
-# One-command CI gate: static analysis -> op-contract baseline -> tier-1.
+# One-command CI gate: static analysis -> op-contract baseline -> chaos
+# suite -> tier-1.
 #
 #   bash tools/ci_check.sh
 #
@@ -7,12 +8,13 @@
 # tools/lint/ARCHITECTURE.md):
 #   10  tpu-lint findings (or lint driver error)
 #   20  op-contract violations / baseline drift / missing baseline
+#   40  chaos suite failed (fault injection / self-healing regressions)
 #   30  tier-1 tests failed (ROADMAP.md command)
 #    0  all gates green
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== gate 1/3: tpu-lint (per-file + interprocedural rules) =="
+echo "== gate 1/4: tpu-lint (per-file + interprocedural rules) =="
 python -m tools.lint paddle_tpu tests --format=json > /tmp/tpu_lint.json
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -22,7 +24,7 @@ if [ "$rc" -ne 0 ]; then
 fi
 echo "tpu-lint: clean"
 
-echo "== gate 2/3: tpu-verify (abstract op-contract baseline) =="
+echo "== gate 2/4: tpu-verify (abstract op-contract baseline) =="
 JAX_PLATFORMS=cpu python -m tools.lint --contracts \
     --baseline artifacts/op_contracts.json
 rc=$?
@@ -32,7 +34,17 @@ if [ "$rc" -ne 0 ]; then
     exit 20
 fi
 
-echo "== gate 3/3: tier-1 tests (ROADMAP.md) =="
+echo "== gate 3/4: chaos suite (fault injection -> self-healing) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "ci_check: chaos gate failed (pytest rc=$rc) — a fault class" \
+         "is no longer detected/recovered" >&2
+    exit 40
+fi
+
+echo "== gate 4/4: tier-1 tests (ROADMAP.md) =="
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -645,17 +645,42 @@ class CollectiveSafety(Checker):
 class FlagHygiene(Checker):
     """A `define_flag()` whose name is never read anywhere in the tree is
     dead configuration surface: it silently accepts FLAGS_* env overrides
-    and set_flags() writes that change nothing."""
+    and set_flags() writes that change nothing.
+
+    The same hygiene covers the two env-var config surfaces:
+
+    - an ``os.environ`` read of ``"FLAGS_<name>"`` counts as a read of
+      flag ``<name>`` (the env-override path IS a consumer);
+    - ``PT_CHAOS_*`` knobs (the chaos-harness env surface,
+      paddle_tpu/testing/chaos.py) that are *set* somewhere
+      (``os.environ["PT_CHAOS_X"] = ...`` / ``monkeypatch.setenv``) but
+      never read by any ``os.environ`` access are reported — an armed
+      fault knob nothing consumes is a test that silently stopped
+      injecting.
+    """
 
     rule = "TPL006"
     name = "flag-hygiene"
     severity = "warning"
-    description = "defined runtime flag that no code ever reads"
+    description = "defined runtime flag / chaos env knob that nothing reads"
+
+    _CHAOS_PREFIX = "PT_CHAOS_"
+    _FLAGS_PREFIX = "FLAGS_"
+    _ENV_READ_TAILS = {"environ.get", "getenv", "environ.pop",
+                       "environ.setdefault"}
 
     def __init__(self):
         super().__init__()
         self.defines: dict[str, tuple] = {}   # name -> (path, line, node)
         self.reads: set[str] = set()
+        self.env_defines: dict[str, tuple] = {}   # PT_CHAOS_* setters
+        self.env_reads: set[str] = set()
+
+    def _note_env_read(self, name: str):
+        if name.startswith(self._FLAGS_PREFIX):
+            self.reads.add(name[len(self._FLAGS_PREFIX):])
+        elif name.startswith(self._CHAOS_PREFIX):
+            self.env_reads.add(name)
 
     def visit_Call(self, node: ast.Call):
         cname = call_name(node)
@@ -677,6 +702,24 @@ class FlagHygiene(Checker):
             self.reads.add(first)
         elif tail == "get_flags" and node.args:
             self.reads.update(str_constants(node.args[0]))
+        if first is not None:
+            if any(cname.endswith(t) for t in self._ENV_READ_TAILS):
+                self._note_env_read(first)
+            elif tail == "setenv" and first.startswith(self._CHAOS_PREFIX):
+                self.env_defines.setdefault(first, (self.ctx.path,
+                                                    node.lineno, node))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        key = (node.slice.value if isinstance(node.slice, ast.Constant)
+               and isinstance(node.slice.value, str) else None)
+        if key is not None and dotted_name(node.value).endswith("environ"):
+            if isinstance(node.ctx, ast.Store) and \
+                    key.startswith(self._CHAOS_PREFIX):
+                self.env_defines.setdefault(key, (self.ctx.path,
+                                                  node.lineno, node))
+            else:
+                self._note_env_read(key)
         self.generic_visit(node)
 
     def finalize(self):
@@ -686,6 +729,12 @@ class FlagHygiene(Checker):
                                   "read by any code in the analyzed tree "
                                   "(dead configuration surface)",
                             path=path, line=line)
+        for name, (path, line, node) in sorted(self.env_defines.items()):
+            if name not in self.env_reads:
+                self.report(node, f"chaos env knob '{name}' is set but "
+                                  "never read by any os.environ access in "
+                                  "the analyzed tree (fault injection that "
+                                  "cannot fire)", path=path, line=line)
 
 
 ALL_CHECKERS = [
