@@ -160,6 +160,22 @@ define_flag(
 # -- self-healing runtime defaults (parallel/resilient_loop.py reads these
 #    when the caller passes None; FLAGS_* env overrides reach child
 #    workers through the launcher env like every other flag) --------------
+# -- serving-engine defaults (inference/serving.py reads these when the
+#    caller passes None) --------------------------------------------------
+define_flag("serving_prefill_budget", 512,
+            "Prompt tokens per chunked ragged-prefill dispatch (rounded "
+            "down to a page-size multiple; the serving engine packs "
+            "page-size chunks from any number of requests into ONE "
+            "compiled program per step).")
+define_flag("serving_prefix_cache", True,
+            "Content-hash full prompt pages and share them across "
+            "requests (each distinct prefix prefilled once; refcounted "
+            "pages, LRU-evicted under pool pressure).")
+define_flag("serving_prefix_cache_pages", 0,
+            "Max idle (refcount-0) pages the prefix cache retains; 0 = "
+            "no cap beyond pool pressure (idle cached pages are evicted "
+            "on demand when allocation would otherwise fail).")
+
 define_flag("resilient_max_bad_steps", 3,
             "Consecutive NaN/Inf steps tolerated (skipped) before the "
             "resilient loop rolls state back to the last good checkpoint.")

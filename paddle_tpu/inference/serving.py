@@ -5,26 +5,43 @@ reference's AnalysisPredictor + fused_multi_transformer serving path
 (fluid/inference/api/analysis_predictor.cc:1657; block_multi_head_attention
 for the paged cache). TPU design:
 
-- TWO compiled programs, static shapes: a per-bucket prefill (one request,
-  prompt padded to the bucket) and ONE batched decode step over all
-  ``max_batch`` slots. Requests at different positions/lengths share the
-  decode program — per-request state is data (block tables, seq_lens),
-  never shape.
+- TWO compiled programs, static shapes: ONE chunked ragged prefill over
+  a fixed token budget (prompts split into page-size chunks; each step
+  packs up to ``prefill_budget // page_size`` chunks from any number of
+  requests into a static ``[n_chunks, page_size]`` token grid, with
+  per-chunk slot/position indices as DATA — "Ragged Paged Attention",
+  arxiv 2604.15464) and ONE batched decode step over all ``max_batch``
+  slots. Requests at different positions/lengths share both programs —
+  per-request state is data (block tables, seq_lens, chunk indices),
+  never shape. A 1024-token prompt no longer monopolizes the device
+  between decode quanta: it contributes budget-sized slices that
+  interleave with other requests' chunks and decode quanta.
 - vLLM-style paged KV: per-layer page arrays, physical pages allocated
   per request from a free list and returned on completion; page 0 is a
   write sink for idle slots so the batched program needs no masking
   branches. k pages are d-major — the MXU decode kernel's native operand
   (ops/pallas/decode_attention.py paged_decode_attention_mxu).
+- Prefix caching: page-aligned prompt chunks are content-hashed
+  (cumulative chain, so a hit implies the whole prefix matches) and the
+  pool refcounts cached pages. A shared system prompt is prefilled ONCE;
+  later requests map the cached pages into their block tables and skip
+  those chunks entirely (the prefill-token counter proves zero redundant
+  FLOPs). Only the page holding the last prompt token is always
+  re-prefilled — its logits produce the first token. Copy-on-write is
+  implicit: the partial tail page is never cached, so every request owns
+  the page it appends to.
 - Continuous batching: the scheduler admits queued requests into free
-  slots between decode steps (prefill interleaves with decode), so a
-  long generation never blocks the queue — the reference gets this from
-  serving frameworks above the predictor; here it is the engine.
+  slots between decode quanta (admission is page-pool-bound only — no
+  prompt buckets), chunked prefill interleaves with decode, and a
+  pool-blocked large request is skipped (with an aging barrier) so it
+  cannot head-of-line-block smaller requests that fit.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
+import hashlib
 import math
 import time
 from typing import Optional
@@ -35,9 +52,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..models.llama import (LlamaConfig, apply_rope, block_apply,
-                            init_llama_params, quantize_weights_int8,
-                            rms_norm, rope_angles, _mm)
+from ..core.flags import GLOBAL_FLAGS
+from ..models.llama import (LlamaConfig, apply_rope, init_llama_params,
+                            quantize_weights_int8, rms_norm, rope_angles,
+                            _mm)
 
 __all__ = ["Request", "ServingEngine"]
 
@@ -58,6 +76,8 @@ class Request:
     out_tokens: list = dataclasses.field(default_factory=list)
     t_first: Optional[float] = None    # first-token wall time
     t_done: Optional[float] = None
+    aborted: bool = False
+    age: int = 0                       # pool-blocked admission skips
 
 
 def _pick_tokens(logits, temps, topps, seeds, positions):
@@ -69,7 +89,8 @@ def _pick_tokens(logits, temps, topps, seeds, positions):
     batches skip the sort entirely through lax.cond — sampling params
     are per-slot DATA, so mixed batches share one compiled program.
     Randomness is keyed (seed, position-of-input-token): a request's
-    sample stream is reproducible and independent of quantum boundaries.
+    sample stream is reproducible and independent of quantum AND prefill
+    chunk boundaries.
     logits [B, V] fp32; temps/topps [B] fp32; seeds/positions [B] int32.
     """
 
@@ -98,11 +119,29 @@ def _pick_tokens(logits, temps, topps, seeds, positions):
 
 
 class _PagePool:
-    """Free-list page allocator. Page 0 is reserved as the idle-slot
-    write sink and never handed out."""
+    """Refcounted free-list page allocator with a content-addressed
+    prefix cache. Page 0 is reserved as the idle-slot write sink and
+    never handed out.
 
-    def __init__(self, n_pages: int):
+    Cached-page lifecycle: ``insert`` registers a page at refcount 1
+    (the inserting request's own mapping); ``lookup`` increfs every hit;
+    ``decref`` at request teardown moves refcount-0 pages to a PENDING
+    list, and ``commit_evictable`` — called once no in-flight program
+    can still read them — promotes pending pages to the LRU evictable
+    set, where ``evict`` reclaims them for allocation (dropping their
+    hash entries)."""
+
+    def __init__(self, n_pages: int, cache_limit: int = 0):
+        self.n_pages = n_pages
         self.free = list(range(n_pages - 1, 0, -1))
+        self.cache: dict[bytes, int] = {}      # prefix hash -> page
+        self.ref: dict[int, int] = {}          # cached page -> refcount
+        self.hash_of: dict[int, bytes] = {}
+        self.evictable: dict[int, None] = {}   # insertion-ordered = LRU
+        self.pending_evict: list[int] = []
+        self.cache_limit = cache_limit
+        self.hits = 0
+        self.misses = 0
 
     def alloc(self, n: int) -> Optional[list[int]]:
         if len(self.free) < n:
@@ -112,20 +151,76 @@ class _PagePool:
     def release(self, pages: list[int]) -> None:
         self.free.extend(pages)
 
+    def lookup(self, hashes: list[bytes]) -> list[int]:
+        """Longest cached prefix of ``hashes``; increfs each hit (the
+        caller owns the mappings until it decrefs them back)."""
+        out: list[int] = []
+        for h in hashes:
+            p = self.cache.get(h)
+            if p is None:
+                break
+            self.ref[p] += 1
+            self.evictable.pop(p, None)
+            if p in self.pending_evict:
+                self.pending_evict.remove(p)
+            out.append(p)
+        self.hits += len(out)
+        self.misses += len(hashes) - len(out)
+        return out
+
+    def insert(self, h: bytes, page: int) -> bool:
+        """Register an (already-written) page under its prefix hash at
+        refcount 1; False if the hash is already cached (the caller
+        keeps its own copy)."""
+        if h in self.cache:
+            return False
+        self.cache[h] = page
+        self.ref[page] = 1
+        self.hash_of[page] = h
+        return True
+
+    def decref(self, pages: list[int]) -> None:
+        for p in pages:
+            self.ref[p] -= 1
+            if self.ref[p] == 0:
+                self.pending_evict.append(p)
+
+    def commit_evictable(self) -> None:
+        for p in self.pending_evict:
+            self.evictable[p] = None
+        self.pending_evict = []
+        if self.cache_limit and len(self.evictable) > self.cache_limit:
+            self.evict(len(self.evictable) - self.cache_limit)
+
+    def evict(self, n: int) -> int:
+        """Reclaim up to ``n`` LRU evictable pages into the free list."""
+        done = 0
+        while done < n and self.evictable:
+            p = next(iter(self.evictable))
+            del self.evictable[p]
+            del self.cache[self.hash_of.pop(p)]
+            del self.ref[p]
+            self.free.append(p)
+            done += 1
+        return done
+
 
 class ServingEngine:
     """Continuous-batching LLaMA serving over paged KV.
 
-    ``step()`` = admissions (prefill) + one batched decode tick;
-    ``run(requests)`` drives wall-clock arrivals to completion and
-    returns latency/throughput stats.
+    ``step()`` = admissions + one chunked ragged-prefill dispatch + one
+    batched decode tick; ``run(requests)`` drives wall-clock arrivals to
+    completion and returns latency/throughput/occupancy stats.
     """
 
     def __init__(self, cfg: LlamaConfig, params: Optional[dict] = None,
                  seed: int = 0, max_batch: int = 8, page_size: int = 128,
                  max_seq: Optional[int] = None, n_pages: Optional[int] = None,
-                 prefill_buckets: tuple = (128, 256, 512, 1024),
+                 prefill_budget: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 prefix_cache_pages: Optional[int] = None,
                  decode_quantum: int = 8,
+                 admit_aging: int = 64,
                  weight_only_int8: bool = False):
         self.cfg = cfg
         self.params = params if params is not None else init_llama_params(
@@ -143,8 +238,17 @@ class ServingEngine:
         self.max_seq = max_seq or cfg.max_seq_len
         self.max_blocks = (self.max_seq + page_size - 1) // page_size
         self.n_pages = n_pages or (1 + max_batch * self.max_blocks)
-        self.buckets = tuple(b for b in sorted(prefill_buckets)
-                             if b % page_size == 0 or b < page_size)
+        if prefill_budget is None:
+            prefill_budget = GLOBAL_FLAGS.get("serving_prefill_budget")
+        if prefix_cache is None:
+            prefix_cache = GLOBAL_FLAGS.get("serving_prefix_cache")
+        if prefix_cache_pages is None:
+            prefix_cache_pages = GLOBAL_FLAGS.get(
+                "serving_prefix_cache_pages")
+        self.n_chunks = max(1, prefill_budget // page_size)
+        self.prefill_budget = self.n_chunks * page_size
+        self._cache_on = bool(prefix_cache)
+        self.admit_aging = admit_aging
         L, nKV, d = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
         self.k_pages = jnp.zeros((L, self.n_pages, nKV, d, self.bs),
                                  cfg.dtype)
@@ -158,10 +262,21 @@ class ServingEngine:
         self.samp_topp = np.ones((self.B,), np.float32)
         self.samp_seed = np.zeros((self.B,), np.int32)
         self.slots: list[Optional[Request]] = [None] * self.B
-        self._slot_pages: list[list[int]] = [[] for _ in range(self.B)]
-        self.pool = _PagePool(self.n_pages)
+        # page ownership is split: owned pages return to the free list at
+        # teardown; shared pages are prefix-cache mappings and only lose
+        # a refcount. _full_rows is the request's REAL block-table row;
+        # self.table holds the DECODE view (sink row until the prefill
+        # flip, so mid-prefill slots write junk to page 0 only).
+        self._slot_owned: list[list[int]] = [[] for _ in range(self.B)]
+        self._slot_shared: list[list[int]] = [[] for _ in range(self.B)]
+        self._slot_hashes: list[list[bytes]] = [[] for _ in range(self.B)]
+        self._slot_nshared: list[int] = [0] * self.B
+        self._full_rows = np.zeros((self.B, self.max_blocks), np.int32)
+        # slot -> next prompt position to prefill; dict order = admission
+        # order, so chunk packing stays FIFO across requests
+        self._prefilling: dict[int, int] = {}
+        self.pool = _PagePool(self.n_pages, cache_limit=prefix_cache_pages)
         self.queue: list[Request] = []
-        self._prefills = {}
         # Decode runs in QUANTA of K steps per dispatch (one lax.scan
         # program): over remote-device links a host round-trip costs
         # ~100 ms, so per-token dispatch would bound throughput at
@@ -173,6 +288,8 @@ class ServingEngine:
         self._decode = jax.jit(
             functools.partial(self._decode_n_impl, n=self.decode_quantum),
             donate_argnums=(1, 2))
+        self._prefill = jax.jit(self._ragged_prefill_impl,
+                                donate_argnums=(1, 2))
         # decode pipelining state (see step() docstring)
         self._inflight = None              # (toks_dev [K+1, B], snapshot)
         self._cur_tok_dev = None           # device-chained token vector
@@ -182,55 +299,91 @@ class ServingEngine:
         self._cur_patches: dict = {}       # slot -> first-token dev scalar
         self._pending_first: set = set()
         self._deferred_free: list[int] = []
-        self.stats = {"decode_steps": 0, "prefills": 0,
-                      "decode_slot_tokens": 0, "decode_active_tokens": 0}
+        self.stats = {
+            "decode_steps": 0, "prefills": 0,
+            "prefill_tokens": 0, "prefill_grid_tokens": 0,
+            "prefill_cached_tokens": 0,
+            "decode_slot_tokens": 0, "decode_active_tokens": 0,
+            # slot_occupancy decomposition (all in slot-token units, so
+            # active + the four waste buckets == decode_slot_tokens):
+            "waste_prefill_slot_tokens": 0,        # slot mid-prefill
+            "waste_queue_empty_slot_tokens": 0,    # idle, nothing arrived
+            "waste_admission_blocked_slot_tokens": 0,  # idle, pool-blocked
+            "waste_overrun_slot_tokens": 0,        # mid-quantum finish
+        }
 
     # -- compiled programs --------------------------------------------------
 
-    def _prefill_impl(self, params, k_pages, v_pages, tokens, pages,
-                      n_valid, temp, topp, seed):
-        """One request's prompt (padded to a bucket) through the shared
-        block_apply, k/v written straight into its pages; returns the
-        last REAL token's logits. tokens [1, Tb]; pages [Tb//bs]."""
+    def _ragged_prefill_impl(self, params, k_pages, v_pages, tokens,
+                             ptable, chunk_slot, pos0, last_off, temps,
+                             topps, seeds):
+        """ONE chunked ragged prefill program: ``n_chunks`` page-size
+        chunks from ANY number of requests through the transformer, k/v
+        written whole-page into each chunk's own page, attention ragged
+        over each owning request's block-table row (ops/pallas/
+        ragged_prefill.py). All raggedness is data: tokens [C, bs];
+        ptable [B+1, max_blocks] (row B = sink row for idle chunks);
+        chunk_slot/pos0/last_off [C] int32; temps/topps/seeds [C].
+        Returns (first tokens [C] — only final chunks' entries are used
+        by the scheduler — and the updated page arrays)."""
         cfg = self.cfg
-        T = tokens.shape[1]
-        nblk = (T + self.bs - 1) // self.bs
-        pad = nblk * self.bs - T
-        x = params["wte"][tokens].astype(cfg.dtype)
-        cos, sin = rope_angles(cfg, jnp.arange(T))
-        cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+        C, bs = tokens.shape
+        nH, nKV, dH = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        from ..ops.pallas.ragged_prefill import ragged_prefill_attention
+
+        rows = ptable[chunk_slot]                        # [C, max_blocks]
+        page_idx = jnp.take_along_axis(rows, (pos0 // bs)[:, None],
+                                       axis=1)[:, 0]     # chunk's own page
+        x = params["wte"][tokens].astype(cfg.dtype)      # [C, bs, H]
+        positions = pos0[:, None] + jnp.arange(bs, dtype=jnp.int32)
+        cos, sin = rope_angles(cfg, positions)           # [C, bs, dH/2]
+        cos, sin = cos[:, :, None, :], sin[:, :, None, :]
+        sm_scale = 1.0 / math.sqrt(dH)
 
         def body(carry, inp):
             x = carry
             bp, kp, vp = inp
-            x, k, v = block_apply(bp, x, cfg, cos, sin, return_kv=True)
-            # [1, T, nKV, d] -> pages [nblk, nKV, d|bs, bs|d]; the pad
-            # tail (and any tokens past n_valid) is masked by seq_lens
-            # at every future read
-            if pad:
-                k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
-                v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
-            kb = k[0].reshape(nblk, self.bs, cfg.n_kv_heads, cfg.head_dim)
-            vb = v[0].reshape(nblk, self.bs, cfg.n_kv_heads, cfg.head_dim)
-            kp = kp.at[pages].set(
-                jnp.transpose(kb, (0, 2, 3, 1)).astype(kp.dtype))
-            vp = vp.at[pages].set(
-                jnp.transpose(vb, (0, 2, 1, 3)).astype(vp.dtype))
+            h = rms_norm(x, bp["attn_norm"], cfg.rms_eps)
+            q = _mm(h, bp["wq"], cfg).reshape(C, bs, nH, dH)
+            k = _mm(h, bp["wk"], cfg).reshape(C, bs, nKV, dH)
+            v = _mm(h, bp["wv"], cfg).reshape(C, bs, nKV, dH)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            # whole-page scatter (a chunk IS one page; write-before-
+            # attend, like the decode tick). Idle chunks all target the
+            # sink page — duplicate garbage writes there are harmless.
+            # Garbage k/v past a final chunk's last valid token lands in
+            # the request's OWN tail page, is masked (kpos <= qpos) for
+            # every valid query, and is overwritten by the decode tick
+            # before it could ever be attended.
+            kp = kp.at[page_idx].set(
+                jnp.transpose(k, (0, 2, 3, 1)).astype(kp.dtype))
+            vp = vp.at[page_idx].set(
+                jnp.transpose(v, (0, 2, 1, 3)).astype(vp.dtype))
+            o = ragged_prefill_attention(q, kp, vp, rows, pos0, sm_scale,
+                                         k_layout="d_major")
+            x = x + _mm(o.reshape(C, bs, nH * dH), bp["wo"], cfg)
+            h = rms_norm(x, bp["ffn_norm"], cfg.rms_eps)
+            x = x + _mm(jax.nn.silu(
+                _mm(h, bp["w_gate"], cfg).astype(jnp.float32)).astype(
+                    cfg.dtype) * _mm(h, bp["w_up"], cfg), bp["w_down"], cfg)
             return x, (kp, vp)
 
         x, (ks, vs) = lax.scan(body, x, (params["blocks"], k_pages,
                                          v_pages))
         x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-        last = lax.dynamic_slice_in_dim(x, n_valid - 1, 1, axis=1)
-        logits = _mm(last, params["head"], cfg).astype(jnp.float32)
+        last = x[jnp.arange(C), last_off]                # [C, H]
+        logits = _mm(last[:, None], params["head"], cfg).astype(
+            jnp.float32)[:, 0]
         # first token selected IN-program (greedy or sampled per the
         # request): the scheduler never fetches prefill results (async
         # admission — the token reaches the host as row 0 of the next
         # quantum's output). Randomness keys on the LAST PROMPT position
-        # (n_valid - 1), matching the decode ticks' input-position keying.
-        first = _pick_tokens(logits[:, 0], temp[None], topp[None],
-                             seed[None], (n_valid - 1)[None])[0]
-        return first, ks, vs
+        # (pos0 + last_off = T - 1 for a final chunk), matching the
+        # decode ticks' input-position keying — sampled streams are
+        # bit-identical across chunk/budget boundaries.
+        firsts = _pick_tokens(logits, temps, topps, seeds, pos0 + last_off)
+        return firsts, ks, vs
 
     def _decode_n_impl(self, params, k_pages, v_pages, tokens, patch_mask,
                        patch_vals, table, seq_lens, temps, topps, seeds,
@@ -306,12 +459,6 @@ class ServingEngine:
         logits = _mm(x, params["head"], cfg).astype(jnp.float32)
         return logits[:, 0], ks, vs
 
-    def _get_prefill(self, bucket: int):
-        if bucket not in self._prefills:
-            self._prefills[bucket] = jax.jit(self._prefill_impl,
-                                             donate_argnums=(1, 2))
-        return self._prefills[bucket]
-
     # -- scheduler ----------------------------------------------------------
 
     def submit(self, req: Request) -> None:
@@ -320,83 +467,217 @@ class ServingEngine:
                 f"request {req.rid}: prompt {len(req.prompt)} + "
                 f"{req.max_new_tokens} new tokens exceeds max_seq "
                 f"{self.max_seq}")
-        need = max(self._bucket_for(len(req.prompt)),
-                   len(req.prompt) + req.max_new_tokens)
-        n_blk = (need + self.bs - 1) // self.bs
+        n_blk = -(-(len(req.prompt) + req.max_new_tokens) // self.bs)
         if n_blk > self.n_pages - 1:       # page 0 is the sink
             raise ValueError(
                 f"request {req.rid}: needs {n_blk} pages but the pool "
                 f"holds {self.n_pages - 1} — it could never be admitted")
         self.queue.append(req)
 
-    def _bucket_for(self, n: int) -> int:
-        for b in self.buckets:
-            if n <= b:
-                return b
-        raise ValueError(f"prompt length {n} exceeds largest bucket "
-                         f"{self.buckets[-1]}")
+    def abort(self, rid: int) -> bool:
+        """Cancel a request by rid, wherever it is: queued (removed) or
+        slot-resident (pages released through the deferred-free path —
+        an in-flight quantum or this step's prefill may still write
+        them; tokens an in-flight quantum produces for it are discarded
+        at harvest). Returns False if the rid is unknown/already done."""
+        now = time.monotonic()
+        for i, r in enumerate(self.queue):
+            if r.rid == rid:
+                self.queue.pop(i)
+                r.aborted = True
+                r.t_done = now
+                return True
+        for s in range(self.B):
+            req = self.slots[s]
+            if req is not None and req.rid == rid:
+                req.aborted = True
+                req.t_done = now
+                self._release_slot_pages(s, defer=True)
+                self._prefilling.pop(s, None)
+                self._cur_patches.pop(s, None)
+                self._pending_first.discard(s)
+                self.table[s] = 0
+                self.seq_lens[s] = 0
+                self.cur_tok[s] = 0
+                self.samp_temp[s] = 0.0
+                self.slots[s] = None
+                return True
+        return False
+
+    def _page_hashes(self, prompt: np.ndarray) -> list[bytes]:
+        """Cumulative content hash per FULL prompt page: hash j covers
+        pages 0..j, so equal hash j implies the whole prefix matches —
+        one dict hit per page, no per-page prefix comparison."""
+        n_full = len(prompt) // self.bs
+        out: list[bytes] = []
+        h = hashlib.sha1(b"pt-prefix:%d" % self.bs)
+        for j in range(n_full):
+            h.update(np.ascontiguousarray(
+                prompt[j * self.bs:(j + 1) * self.bs],
+                dtype=np.int32).tobytes())
+            out.append(h.digest())
+        return out
+
+    def _alloc_pages(self, n: int) -> Optional[list[int]]:
+        """Free-list alloc, reclaiming idle (refcount-0) prefix-cache
+        pages on demand when the list runs short."""
+        if len(self.pool.free) < n:
+            self.pool.evict(n - len(self.pool.free))
+        return self.pool.alloc(n)
 
     def _admit(self, now: float) -> None:
-        for slot in range(self.B):
-            if self.slots[slot] is not None or not self.queue:
-                continue
-            i = next((i for i, r in enumerate(self.queue)
-                      if r.arrival <= now), None)
-            if i is None:
-                return
+        """Admit arrived requests into free slots, FIFO with skip: a
+        pool-blocked request is stepped over so smaller requests behind
+        it can run (no head-of-line blocking), but once its ``age``
+        (skip count) exceeds ``admit_aging`` it becomes a barrier —
+        nothing behind it is admitted, so every freed page goes to it
+        and it cannot starve. Admission maps cached prefix pages into
+        the block table (incref) and allocates only the rest."""
+        free_slots = [s for s in range(self.B) if self.slots[s] is None]
+        i = 0
+        while i < len(self.queue) and free_slots:
             req = self.queue[i]
+            if req.arrival > now:
+                i += 1
+                continue
             T = len(req.prompt)
-            bucket = self._bucket_for(T)
-            need = max(bucket, T + req.max_new_tokens)
-            n_blk = (need + self.bs - 1) // self.bs
-            pages = self.pool.alloc(n_blk)
+            n_blk = -(-(T + req.max_new_tokens) // self.bs)
+            # never look up the page holding the last prompt token: its
+            # chunk must run to produce the first-token logits
+            hashes = self._page_hashes(req.prompt) if self._cache_on else []
+            shared = self.pool.lookup(hashes[:(T - 1) // self.bs])
+            pages = self._alloc_pages(n_blk - len(shared))
             if pages is None:
-                return                     # no memory: keep queued
+                self.pool.decref(shared)
+                req.age += 1
+                if req.age > self.admit_aging:
+                    break                  # aged request becomes a barrier
+                i += 1
+                continue
             self.queue.pop(i)
+            slot = free_slots.pop(0)
+            n_shared = len(shared)
             self.slots[slot] = req
-            self._slot_pages[slot] = pages
+            self._slot_shared[slot] = shared
+            self._slot_owned[slot] = pages
+            self._slot_hashes[slot] = hashes
+            self._slot_nshared[slot] = n_shared
             row = np.zeros((self.max_blocks,), np.int32)
-            row[:n_blk] = pages
-            self.table[slot] = row
-            toks = np.zeros((1, bucket), np.int32)
-            toks[0, :T] = req.prompt
-            # tpu-lint TPL002 audit: the prefill below is dispatched
-            # asynchronously, so every numpy operand is copied (jnp.array,
-            # not jnp.asarray) — `row` stays referenced via self.table and
-            # a zero-copy alias would see later scheduler writes. The
-            # scalar operands (T, temperature, top_p, seed) are python
-            # scalars: asarray cannot alias host memory for those.
-            prefill_pages = jnp.array(
-                row[:(bucket + self.bs - 1) // self.bs])
-            self.samp_temp[slot] = req.temperature
-            self.samp_topp[slot] = req.top_p
-            self.samp_seed[slot] = req.seed
-            first, self.k_pages, self.v_pages = self._get_prefill(bucket)(
-                self.params, self.k_pages, self.v_pages,
-                jnp.array(toks), prefill_pages,
-                jnp.asarray(T, jnp.int32),
-                jnp.asarray(req.temperature, jnp.float32),
-                jnp.asarray(req.top_p, jnp.float32),
-                jnp.asarray(req.seed, jnp.int32))
-            # fully async: `first` stays a device scalar — it patches the
-            # next quantum's token feed in-program and reaches the host
-            # as row 0 of that quantum's output at harvest
-            self.seq_lens[slot] = T
-            self._cur_patches[slot] = first
-            self._pending_first.add(slot)
-            self.stats["prefills"] += 1
+            row[:n_shared] = shared
+            row[n_shared:n_blk] = pages
+            self._full_rows[slot] = row
+            self.table[slot] = 0           # decode view: sink until flip
+            self.seq_lens[slot] = 0
+            self.cur_tok[slot] = 0
+            # prefill resumes AFTER the cached prefix: a full-prefix hit
+            # costs zero redundant prefill FLOPs (prefill_tokens counts
+            # only tokens actually run)
+            self._prefilling[slot] = n_shared * self.bs
+            self.stats["prefill_cached_tokens"] += n_shared * self.bs
+
+    def _dispatch_prefill(self) -> None:
+        """Pack up to ``n_chunks`` page-size chunks from the prefilling
+        slots (FIFO) into ONE ragged prefill dispatch. A request whose
+        final chunk is in this dispatch FLIPS to decoding: its real
+        block-table row becomes the decode view, its first token patches
+        the next quantum's token feed, and its full prompt pages are
+        offered to the prefix cache."""
+        if not self._prefilling:
+            return
+        C = self.n_chunks
+        sched = []                         # (slot, pos, n_valid, final)
+        for slot in list(self._prefilling):
+            if len(sched) >= C:
+                break
+            req = self.slots[slot]
+            T = len(req.prompt)
+            pos = self._prefilling[slot]
+            while pos < T and len(sched) < C:
+                n = min(self.bs, T - pos)
+                sched.append((slot, pos, n, pos + n >= T))
+                pos += n
+            self._prefilling[slot] = pos
+        if not sched:
+            return
+        tokens = np.zeros((C, self.bs), np.int32)
+        cs = np.full((C,), self.B, np.int32)       # idle chunks -> sink row
+        p0 = np.zeros((C,), np.int32)
+        loff = np.zeros((C,), np.int32)
+        tt = np.zeros((C,), np.float32)
+        tp = np.ones((C,), np.float32)
+        ts = np.zeros((C,), np.int32)
+        for idx, (slot, pos, n, fin) in enumerate(sched):
+            req = self.slots[slot]
+            tokens[idx, :n] = req.prompt[pos:pos + n]
+            cs[idx] = slot
+            p0[idx] = pos
+            loff[idx] = n - 1
+            tt[idx] = req.temperature
+            tp[idx] = req.top_p
+            ts[idx] = req.seed
+        ptab = np.concatenate(
+            [self._full_rows, np.zeros((1, self.max_blocks), np.int32)])
+        # tpu-lint TPL002 audit: the prefill below is dispatched
+        # asynchronously while the scheduler keeps mutating its numpy
+        # state — every operand is a fresh local array here, but jnp.array
+        # (copying) keeps the handoff alias-free by construction.
+        firsts, self.k_pages, self.v_pages = self._prefill(
+            self.params, self.k_pages, self.v_pages, jnp.array(tokens),
+            jnp.array(ptab), jnp.array(cs), jnp.array(p0),
+            jnp.array(loff), jnp.array(tt), jnp.array(tp), jnp.array(ts))
+        for idx, (slot, pos, n, fin) in enumerate(sched):
+            req = self.slots[slot]
+            j = pos // self.bs
+            if (n == self.bs and j >= self._slot_nshared[slot]
+                    and j < len(self._slot_hashes[slot])):
+                # full prompt page this request prefilled itself: offer
+                # it to the cache. On success ownership transfers to the
+                # cache (refcount 1 = this request's mapping) — it
+                # outlives the request until evicted under pool pressure.
+                page = int(self._full_rows[slot][j])
+                if self.pool.insert(self._slot_hashes[slot][j], page):
+                    self._slot_owned[slot].remove(page)
+                    self._slot_shared[slot].append(page)
+            if fin:
+                del self._prefilling[slot]
+                self.table[slot] = self._full_rows[slot]
+                self.seq_lens[slot] = len(req.prompt)
+                self.samp_temp[slot] = req.temperature
+                self.samp_topp[slot] = req.top_p
+                self.samp_seed[slot] = req.seed
+                # fully async: the first token stays a device scalar — it
+                # patches the next quantum's token feed in-program and
+                # reaches the host as row 0 of that quantum's output.
+                # firsts[idx] is a static-index gather: one cached
+                # executable per idx value, C of them total.
+                self._cur_patches[slot] = firsts[idx]
+                self._pending_first.add(slot)
+            self.stats["prefill_tokens"] += n
+        self.stats["prefills"] += 1
+        self.stats["prefill_grid_tokens"] += C * self.bs
+
+    def _release_slot_pages(self, slot: int, defer: bool) -> None:
+        """Tear down a slot's page state: owned pages to the free list
+        (via _deferred_free when a program may still be in flight),
+        shared pages decref'd back to the cache. Refcount-0 cache pages
+        become evictable only once no in-flight program can read them
+        (commit_evictable at harvest / the idle-release branch)."""
+        owned, shared = self._slot_owned[slot], self._slot_shared[slot]
+        self._slot_owned[slot] = []
+        self._slot_shared[slot] = []
+        self.pool.decref(shared)
+        if defer:
+            self._deferred_free.extend(owned)
+        else:
+            self.pool.release(owned)
+            self.pool.commit_evictable()
+        self._full_rows[slot] = 0
 
     def _finish_if_done(self, slot: int, defer_free: bool = False) -> None:
         req = self.slots[slot]
         if req is not None and len(req.out_tokens) >= req.max_new_tokens:
             req.t_done = time.monotonic()
-            if defer_free:
-                # an in-flight quantum dispatched before this harvest may
-                # still write junk into these pages; hold them one cycle
-                self._deferred_free.extend(self._slot_pages[slot])
-            else:
-                self.pool.release(self._slot_pages[slot])
-            self._slot_pages[slot] = []
+            self._release_slot_pages(slot, defer=defer_free)
             self.table[slot] = 0           # sink
             self.seq_lens[slot] = 0
             self.cur_tok[slot] = 0
@@ -404,10 +685,10 @@ class ServingEngine:
             self.slots[slot] = None
 
     def step(self, now: Optional[float] = None) -> bool:
-        """Admissions + dispatch of the next decode quantum + harvest of
-        the PREVIOUS one. Returns True while work remains — `while
-        engine.step(): ...` is the external drive contract; an idle tick
-        runs no compute.
+        """Admissions + one chunked prefill dispatch + dispatch of the
+        next decode quantum + harvest of the PREVIOUS one. Returns True
+        while work remains — `while engine.step(): ...` is the external
+        drive contract; an idle tick runs no compute.
 
         Pipelined (round 5): the next quantum is dispatched BEFORE the
         previous quantum's tokens are fetched, chained on the device
@@ -428,16 +709,21 @@ class ServingEngine:
         """
         now = time.monotonic() if now is None else now
         self._admit(now)
+        self._dispatch_prefill()
         prev = self._inflight
-        self._dispatch_next()
+        self._dispatch_next(now)
         if prev is not None:
             self._harvest(prev)
-        elif self._deferred_free:
-            # nothing was in flight: deferred pages are unreachable by
-            # any program — release now (pool-constrained admission
-            # would otherwise deadlock waiting for a harvest)
+        elif self._deferred_free or self.pool.pending_evict:
+            # no decode quantum was in flight: deferred/pending pages can
+            # only be touched by programs already chained BEFORE any
+            # future consumer (the donated page arrays serialize every
+            # prefill and decode program), so reclaim now — pool-
+            # constrained admission would otherwise deadlock waiting
+            # for a harvest
             self.pool.release(self._deferred_free)
             self._deferred_free = []
+            self.pool.commit_evictable()
         # predictive release: after the harvest above, the only pending
         # tokens are the quantum just dispatched — any snapshot request
         # it completes can give up its SLOT now (next step admits into
@@ -448,8 +734,7 @@ class ServingEngine:
                 if (self.slots[s] is req and req.max_new_tokens
                         - len(req.out_tokens) - (1 if had_first else 0)
                         <= self.decode_quantum):
-                    self._deferred_free.extend(self._slot_pages[s])
-                    self._slot_pages[s] = []
+                    self._release_slot_pages(s, defer=True)
                     self.table[s] = 0
                     self.seq_lens[s] = 0
                     self.samp_temp[s] = 0.0
@@ -457,15 +742,28 @@ class ServingEngine:
         return (self._inflight is not None or bool(self.queue)
                 or any(s is not None for s in self.slots))
 
-    def _dispatch_next(self) -> None:
+    def _dispatch_next(self, now: float = 0.0) -> None:
         """Queue one decode quantum for the CURRENT slot state; does not
         block. Positions advance at dispatch (the program computes
         per-tick positions internally); token feed chains on-device from
         the previous quantum's output, patched for newly admitted
-        slots."""
-        active = [s for s in range(self.B) if self.slots[s] is not None]
-        if not active:
+        slots. Skipped entirely while no slot is decoding (pure-prefill
+        steps run only the prefill program). Each dispatched quantum
+        charges K tokens per slot to the occupancy ledger, classified
+        here for idle/prefilling slots and at harvest for decoding
+        ones."""
+        decoding = [s for s in range(self.B) if self.slots[s] is not None
+                    and s not in self._prefilling]
+        if not decoding:
             return
+        K = self.decode_quantum
+        n_pref = len(self._prefilling)
+        n_idle = self.B - len(decoding) - n_pref
+        self.stats["waste_prefill_slot_tokens"] += K * n_pref
+        if n_idle:
+            blocked = any(r.arrival <= now for r in self.queue)
+            self.stats["waste_admission_blocked_slot_tokens" if blocked
+                       else "waste_queue_empty_slot_tokens"] += K * n_idle
         cur = self._cur_tok_dev
         if cur is None:
             cur = jnp.asarray(self.cur_tok.copy())
@@ -479,7 +777,6 @@ class ServingEngine:
             # shape costs a remote compile over the tunnel)
             vals = vals.at[s].set(tok)
         self._cur_patches = {}
-        K = self.decode_quantum
         # .copy(): jnp.asarray can ALIAS a numpy buffer (zero-copy on the
         # CPU backend), and this program executes asynchronously while
         # the scheduler keeps mutating table/seq_lens — the in-flight
@@ -493,16 +790,16 @@ class ServingEngine:
             jnp.asarray(self.samp_temp.copy()),
             jnp.asarray(self.samp_topp.copy()),
             jnp.asarray(self.samp_seed.copy()))
-        # snapshot of (slot, request, carries-first-token) active at
+        # snapshot of (slot, request, carries-first-token) decoding at
         # dispatch; how many tokens to keep is decided at harvest (the
         # previous quantum's tokens land in out_tokens AFTER this
         # dispatch, so a count taken here would overcount by a quantum)
         snap = [(s, self.slots[s], s in self._pending_first)
-                for s in active]
+                for s in decoding]
         self._pending_first.clear()
         self._inflight = (toks, snap)
         self._cur_tok_dev = last
-        for s in active:
+        for s in decoding:
             self.seq_lens[s] += K
         self.stats["decode_steps"] += K
         self.stats["decode_slot_tokens"] += K * self.B
@@ -519,18 +816,24 @@ class ServingEngine:
         K = self.decode_quantum
         self.pool.release(self._deferred_free)
         self._deferred_free = []
+        self.pool.commit_evictable()
         now = time.monotonic()
         for s, req, had_first in snap:
+            if req.aborted:
+                # aborted after dispatch: its quantum tokens are junk
+                self.stats["waste_overrun_slot_tokens"] += K
+                continue
             if had_first:
                 # async admission: the prefill's first token arrives here
                 # as the quantum's (patched) input row — first host
                 # observation, so TTFT is recorded now
                 req.out_tokens.append(int(first_row[s]))
                 req.t_first = now
-            take = min(K, req.max_new_tokens - len(req.out_tokens))
+            take = max(0, min(K, req.max_new_tokens - len(req.out_tokens)))
             if take > 0:
                 self.stats["decode_active_tokens"] += take
                 req.out_tokens.extend(int(t) for t in toks[:take, s])
+            self.stats["waste_overrun_slot_tokens"] += K - take
             if self.slots[s] is req:
                 # still slot-resident: remaining exceeded one quantum
                 # (else predictive release would have freed the slot);
@@ -544,12 +847,32 @@ class ServingEngine:
                 # remains to record
                 req.t_done = now
 
+    def page_accounting(self) -> dict:
+        """Page census for the leak invariant: every non-sink page is in
+        exactly one of free / slot-owned / slot-shared (refcounted cache
+        mappings, deduplicated) / idle-cached (refcount 0, pending or
+        evictable) / deferred-free; the counts sum to n_pages - 1."""
+        owned = [p for lst in self._slot_owned for p in lst]
+        shared = {p for lst in self._slot_shared for p in lst}
+        cache_idle = [p for p, r in self.pool.ref.items() if r == 0]
+        counts = {
+            "free": len(self.pool.free),
+            "slot_owned": len(owned),
+            "slot_shared": len(shared),
+            "cache_idle": len(cache_idle),
+            "deferred_free": len(self._deferred_free),
+        }
+        counts["total"] = sum(counts.values())
+        return counts
+
     def run(self, requests: list[Request]) -> dict:
         """Drive all requests to completion against wall-clock arrivals;
-        returns throughput + p50/p99 latency stats."""
+        returns throughput + p50/p99 latency stats, the slot-occupancy
+        decomposition, and prefix-cache counters."""
         for r in sorted(requests, key=lambda r: r.arrival):
             self.submit(r)
         self.stats = {k: 0 for k in self.stats}   # per-run counters
+        hits0, misses0 = self.pool.hits, self.pool.misses
         t0 = time.monotonic()
         while (any(s is not None for s in self.slots) or self.queue
                or self._inflight is not None):
@@ -564,10 +887,25 @@ class ServingEngine:
                 wait = max(0.0, nxt - (time.monotonic() - t0))
                 time.sleep(min(max(wait, 0.001), 0.05))
         wall = time.monotonic() - t0
-        lat = [r.t_done - (t0 + r.arrival) for r in requests]
-        ttft = [r.t_first - (t0 + r.arrival) for r in requests]
+        if self._deferred_free or self.pool.pending_evict:
+            # nothing is in flight after the drive loop: settle deferred
+            # frees (e.g. a final-step abort) so page_accounting sees
+            # steady state
+            self.pool.release(self._deferred_free)
+            self._deferred_free = []
+            self.pool.commit_evictable()
+        done = [r for r in requests if not r.aborted]
+        lat = [r.t_done - (t0 + r.arrival) for r in done
+               if r.t_done is not None]
+        ttft = [r.t_first - (t0 + r.arrival) for r in done
+                if r.t_first is not None]
         total_new = sum(len(r.out_tokens) for r in requests)
-        q = lambda xs, p: float(np.percentile(np.asarray(xs), p))
+        hits = self.pool.hits - hits0
+        misses = self.pool.misses - misses0
+        st = self.stats
+        slot_tok = max(1, st["decode_slot_tokens"])
+        q = lambda xs, p: float(np.percentile(np.asarray(xs), p)) \
+            if xs else 0.0
         return {
             "n_requests": len(requests),
             "total_new_tokens": total_new,
@@ -578,7 +916,23 @@ class ServingEngine:
             "ttft_p50_s": round(q(ttft, 50), 3),
             "ttft_p99_s": round(q(ttft, 99), 3),
             "slot_occupancy": round(
-                self.stats["decode_active_tokens"]
-                / max(1, self.stats["decode_slot_tokens"]), 3),
-            **self.stats,
+                st["decode_active_tokens"] / slot_tok, 3),
+            # occupancy decomposition: fractions of decode slot-tokens
+            # lost per cause (active + these four == 1)
+            "occ_waste_queue_empty": round(
+                st["waste_queue_empty_slot_tokens"] / slot_tok, 3),
+            "occ_waste_admission_blocked": round(
+                st["waste_admission_blocked_slot_tokens"] / slot_tok, 3),
+            "occ_waste_prefill": round(
+                st["waste_prefill_slot_tokens"] / slot_tok, 3),
+            "occ_waste_overrun": round(
+                st["waste_overrun_slot_tokens"] / slot_tok, 3),
+            "prefill_padding_frac": round(
+                1.0 - st["prefill_tokens"]
+                / max(1, st["prefill_grid_tokens"]), 3),
+            "prefix_cache_hit_rate": round(
+                hits / (hits + misses), 3) if hits + misses else 0.0,
+            "prefix_cache_hits": hits,
+            "prefix_cache_misses": misses,
+            **st,
         }

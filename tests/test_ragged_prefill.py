@@ -1,0 +1,122 @@
+"""Ragged chunked-prefill attention: XLA path vs a dense numpy
+reference, and the Pallas MXU kernel (interpret mode on CPU) vs the XLA
+path — the two dispatch arms of ops/pallas/ragged_prefill.py must agree
+so the serving engine's numerics cannot depend on the backend."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas.ragged_prefill import (
+    _ragged_prefill_xla, ragged_prefill_attention,
+    ragged_prefill_attention_kernel, ragged_prefill_supported)
+
+
+def _make_case(rng, C, bs, nkv, nH, d, mb, n_pages, dtype=np.float32):
+    """One request spanning ``C`` chunks (pages 1..C of its row), plus
+    garbage entries in the unused tail of the block-table row — the
+    causal mask must make them unreachable."""
+    kt = rng.standard_normal((n_pages, nkv, d, bs)).astype(dtype)
+    v = rng.standard_normal((n_pages, nkv, bs, d)).astype(dtype)
+    q = rng.standard_normal((C, bs, nH, d)).astype(dtype)
+    row = np.zeros((mb,), np.int32)
+    row[:C] = np.arange(1, C + 1)
+    row[C:] = rng.integers(0, n_pages, size=mb - C)   # garbage, masked
+    rows = np.tile(row, (C, 1)).astype(np.int32)
+    pos0 = (np.arange(C) * bs).astype(np.int32)
+    return q, kt, v, rows, pos0
+
+
+def _dense_reference(q, kt, v, rows, pos0, sm_scale):
+    """Per-query masked softmax over the gathered context, numpy fp32."""
+    C, bs, nH, d = q.shape
+    nkv = kt.shape[1]
+    G = nH // nkv
+    mb = rows.shape[1]
+    out = np.zeros_like(q)
+    for c in range(C):
+        kg = kt[rows[c]].transpose(0, 1, 3, 2)        # [mb, nkv, bs, d]
+        kg = kg.transpose(1, 0, 2, 3).reshape(nkv, mb * bs, d)
+        vg = v[rows[c]].transpose(1, 0, 2, 3).reshape(nkv, mb * bs, d)
+        for i in range(bs):
+            qpos = pos0[c] + i
+            for h in range(nH):
+                kv = h // G
+                s = kg[kv, :qpos + 1] @ q[c, i, h] * sm_scale
+                p = np.exp(s - s.max())
+                p /= p.sum()
+                out[c, i, h] = p @ vg[kv, :qpos + 1]
+    return out
+
+
+def test_ragged_prefill_xla_matches_dense_reference():
+    rng = np.random.default_rng(0)
+    C, bs, nkv, nH, d, mb = 3, 8, 2, 4, 16, 5
+    q, kt, v, rows, pos0 = _make_case(rng, C, bs, nkv, nH, d, mb,
+                                      n_pages=7)
+    sm = 1.0 / np.sqrt(d)
+    got = np.asarray(_ragged_prefill_xla(
+        jnp.asarray(q), jnp.asarray(kt), jnp.asarray(v),
+        jnp.asarray(rows), jnp.asarray(pos0), sm, "d_major"))
+    want = _dense_reference(q, kt, v, rows, pos0, sm)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_prefill_xla_token_major_layout():
+    rng = np.random.default_rng(1)
+    C, bs, nkv, nH, d, mb = 2, 8, 2, 4, 16, 3
+    q, kt, v, rows, pos0 = _make_case(rng, C, bs, nkv, nH, d, mb,
+                                      n_pages=5)
+    k_tok = kt.transpose(0, 1, 3, 2).copy()           # [P, nkv, bs, d]
+    sm = 1.0 / np.sqrt(d)
+    got = np.asarray(ragged_prefill_attention(
+        jnp.asarray(q), jnp.asarray(k_tok), jnp.asarray(v),
+        jnp.asarray(rows), jnp.asarray(pos0), sm, k_layout="token_major"))
+    want = _dense_reference(q, kt, v, rows, pos0, sm)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_prefill_kernel_matches_xla():
+    """MXU kernel (interpret mode off-TPU) vs the XLA gather path on a
+    supported geometry, including GQA head grouping and a garbage tail
+    in the block-table row."""
+    rng = np.random.default_rng(2)
+    C, bs, nkv, nH, d, mb = 2, 128, 2, 8, 128, 3
+    assert ragged_prefill_supported((6, nkv, d, bs), nH, itemsize=4)
+    q, kt, v, rows, pos0 = _make_case(rng, C, bs, nkv, nH, d, mb,
+                                      n_pages=6)
+    sm = 1.0 / np.sqrt(d)
+    got = np.asarray(ragged_prefill_attention_kernel(
+        jnp.asarray(q), jnp.asarray(kt), jnp.asarray(v),
+        jnp.asarray(rows), jnp.asarray(pos0), sm))
+    want = np.asarray(_ragged_prefill_xla(
+        jnp.asarray(q), jnp.asarray(kt), jnp.asarray(v),
+        jnp.asarray(rows), jnp.asarray(pos0), sm, "d_major"))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_prefill_garbage_tail_pages_are_masked():
+    """Entries of the block-table row past the chunk's own page must not
+    influence the output (they are future/garbage pages)."""
+    rng = np.random.default_rng(3)
+    C, bs, nkv, nH, d, mb = 2, 8, 2, 4, 16, 4
+    q, kt, v, rows, pos0 = _make_case(rng, C, bs, nkv, nH, d, mb,
+                                      n_pages=6)
+    sm = 1.0 / np.sqrt(d)
+    alt = rows.copy()
+    alt[:, C:] = 0                                     # different garbage
+    a = np.asarray(_ragged_prefill_xla(
+        jnp.asarray(q), jnp.asarray(kt), jnp.asarray(v),
+        jnp.asarray(rows), jnp.asarray(pos0), sm, "d_major"))
+    b = np.asarray(_ragged_prefill_xla(
+        jnp.asarray(q), jnp.asarray(kt), jnp.asarray(v),
+        jnp.asarray(alt), jnp.asarray(pos0), sm, "d_major"))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_ragged_prefill_supported_gate():
+    assert ragged_prefill_supported((8, 2, 128, 128), 8)
+    assert not ragged_prefill_supported((8, 2, 64, 128), 8)    # d
+    assert not ragged_prefill_supported((8, 2, 128, 64), 8)    # bs
+    assert not ragged_prefill_supported((8, 3, 128, 128), 8)   # nh % nkv

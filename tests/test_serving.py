@@ -38,7 +38,7 @@ def test_serving_matches_isolated_generation():
     # 2 slots, 5 requests, staggered arrivals -> queueing + slot reuse +
     # page recycling while other requests are mid-decode
     engine = ServingEngine(CFG, max_batch=2, page_size=16, max_seq=256,
-                           prefill_buckets=(16, 32, 64))
+                           prefill_budget=64, prefix_cache=False)
     prompts = [rng.randint(1, 512, size=n).astype(np.int32)
                for n in (9, 16, 23, 31, 12)]
     max_new = 6
@@ -58,13 +58,13 @@ def test_serving_matches_isolated_generation():
 
 def test_serving_admission_respects_memory():
     engine = ServingEngine(CFG, max_batch=4, page_size=16, max_seq=256,
-                           n_pages=1 + 6,  # room for ~1.5 requests
-                           prefill_buckets=(16, 32, 64))
+                           n_pages=1 + 6,  # room for 2 requests
+                           prefill_budget=64, prefix_cache=False)
     rng = np.random.RandomState(1)
     prompts = [rng.randint(1, 512, size=20).astype(np.int32)
                for _ in range(3)]
-    # each request needs ceil((32... bucket 32)+4 /16) >= 3 pages
-    reqs = [Request(rid=i, prompt=p, max_new_tokens=4, arrival=0.0)
+    # each request needs ceil((20 + 13) / 16) = 3 pages
+    reqs = [Request(rid=i, prompt=p, max_new_tokens=13, arrival=0.0)
             for i, p in enumerate(prompts)]
     stats = engine.run(reqs)
     # all complete despite the pool forcing serialized admission
@@ -83,7 +83,7 @@ def test_serving_pipelined_page_recycling_exact():
     rng = np.random.RandomState(7)
     engine = ServingEngine(CFG, max_batch=3, page_size=16, max_seq=128,
                            n_pages=1 + 10,          # ~2.5 requests' worth
-                           prefill_buckets=(16, 32, 64),
+                           prefill_budget=32, prefix_cache=False,
                            decode_quantum=2)
     prompts = [rng.randint(1, 512, size=n).astype(np.int32)
                for n in (9, 16, 23, 31, 12, 20, 7, 28)]
@@ -115,7 +115,7 @@ def test_serving_sampling_contract():
 
     def run(specs, quantum):
         engine = ServingEngine(CFG, max_batch=2, page_size=16, max_seq=128,
-                               prefill_buckets=(16, 32, 64),
+                               prefill_budget=64,
                                decode_quantum=quantum)
         reqs = [Request(rid=i, prompt=p, max_new_tokens=max_new,
                         arrival=0.0, **spec)
@@ -149,7 +149,7 @@ def test_serving_weight_only_int8_matches_isolated_int8():
     quantized params."""
     rng = np.random.RandomState(5)
     engine = ServingEngine(CFG, max_batch=2, page_size=16, max_seq=256,
-                           prefill_buckets=(16, 32, 64),
+                           prefill_budget=64,
                            weight_only_int8=True)
     assert isinstance(engine.params["blocks"]["wq"], tuple)
     prompts = [rng.randint(1, 512, size=n).astype(np.int32)
@@ -166,7 +166,7 @@ def test_serving_weight_only_int8_matches_isolated_int8():
 
 def test_serving_rejects_oversized():
     engine = ServingEngine(CFG, max_batch=1, page_size=16, max_seq=64,
-                           prefill_buckets=(16, 32, 64))
+                           prefill_budget=64)
     with pytest.raises(ValueError):
         engine.submit(Request(rid=0, prompt=np.zeros(60, np.int32),
                               max_new_tokens=10))

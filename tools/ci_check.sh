@@ -9,12 +9,13 @@
 #   10  tpu-lint findings (or lint driver error)
 #   20  op-contract violations / baseline drift / missing baseline
 #   40  chaos suite failed (fault injection / self-healing regressions)
+#   50  serving smoke failed (scheduler completion / page-leak check)
 #   30  tier-1 tests failed (ROADMAP.md command)
 #    0  all gates green
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== gate 1/4: tpu-lint (per-file + interprocedural rules) =="
+echo "== gate 1/5: tpu-lint (per-file + interprocedural rules) =="
 python -m tools.lint paddle_tpu tests --format=json > /tmp/tpu_lint.json
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -24,7 +25,7 @@ if [ "$rc" -ne 0 ]; then
 fi
 echo "tpu-lint: clean"
 
-echo "== gate 2/4: tpu-verify (abstract op-contract baseline) =="
+echo "== gate 2/5: tpu-verify (abstract op-contract baseline) =="
 JAX_PLATFORMS=cpu python -m tools.lint --contracts \
     --baseline artifacts/op_contracts.json
 rc=$?
@@ -34,7 +35,7 @@ if [ "$rc" -ne 0 ]; then
     exit 20
 fi
 
-echo "== gate 3/4: chaos suite (fault injection -> self-healing) =="
+echo "== gate 3/5: chaos suite (fault injection -> self-healing) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 rc=$?
@@ -44,7 +45,16 @@ if [ "$rc" -ne 0 ]; then
     exit 40
 fi
 
-echo "== gate 4/4: tier-1 tests (ROADMAP.md) =="
+echo "== gate 4/5: serving smoke (scheduler completion + zero page leak) =="
+JAX_PLATFORMS=cpu python -m tools.serving_smoke
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "ci_check: serving smoke gate failed (rc=$rc) — the scheduler" \
+         "dropped a request or leaked pages" >&2
+    exit 50
+fi
+
+echo "== gate 5/5: tier-1 tests (ROADMAP.md) =="
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
