@@ -153,11 +153,16 @@ def _run_secondary_benches() -> dict:
     extra: dict = {}
     # resolved by NAME at call time so the contract tests can stub any
     # subset with monkeypatch.setattr(bench, "_bench_*", ...)
-    for fn_name, err_key in (("_bench_decode", "llama_decode_error"),
+    # chip probe first: it wants the device in its cleanest state (the
+    # r5 throttle forensic is a raw-clock measurement); phases last so
+    # its autotune counters cover the whole bench session
+    for fn_name, err_key in (("_bench_chip_probe", "chip_probe_error"),
+                             ("_bench_decode", "llama_decode_error"),
                              ("_bench_serving", "serving_error"),
                              ("_bench_loss_curve", "loss_curve_error"),
                              ("_bench_13b", "gpt3_1p3b_error"),
-                             ("_bench_long_ctx", "long_ctx_error")):
+                             ("_bench_long_ctx", "long_ctx_error"),
+                             ("_bench_phases", "phases_error")):
         try:
             extra.update(globals()[fn_name]())
         except Exception as e:  # noqa: BLE001
@@ -405,6 +410,109 @@ def _bench_13b():
         "gpt3_1p3b_step_ms": round(dt / win * 1000, 2),
         "gpt3_1p3b_loss": round(final, 4),
     }
+
+
+def _bench_chip_probe():
+    """Raw square-matmul clock probe (r5 forensics, PERF.md "Round 5"):
+    the program-invariant throughput floor. A chip-wide matmul-clock
+    throttle — the r5 regression mechanism — shows up here as
+    chip_probe_frac_peak sliding well below its historical level while
+    every compiled program is byte-identical; a software regression
+    leaves this number alone."""
+    n = 8192
+    a = jnp.ones((n, n), jnp.bfloat16)
+    b = jnp.ones((n, n), jnp.bfloat16)
+    f = jax.jit(lambda x, y: jnp.dot(x, y,
+                                     preferred_element_type=jnp.float32))
+    jax.block_until_ready(f(a, b))  # compile outside the window
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(a, b))
+        best = min(best, time.perf_counter() - t0)
+    tflops = 2 * n ** 3 / best / 1e12
+    return {
+        "chip_probe_tflops": round(tflops, 1),
+        "chip_probe_frac_peak": round(tflops * 1e12 / _peak_flops(), 4),
+    }
+
+
+def _bench_phases():
+    """Per-phase decomposition of the flagship step (ISSUE 6 satellite):
+    standalone fwd+bwd microbenches of each fused subsystem at the
+    flagship 350m/b16 shapes, plus a parameter-sized optimizer update.
+    These are isolated-phase timings (each phase alone on the chip), not
+    an additive partition of step_ms — overlap and remat recompute make
+    the step sum differ — but a regression in one subsystem moves
+    exactly one key. Runs LAST so the autotune counters it reports
+    cover every sweep/hit of the whole bench session."""
+    from paddle_tpu.models.gpt import gpt_presets
+    from paddle_tpu.ops.pallas import autotune
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_qkv_raw
+    from paddle_tpu.ops.pallas.fused_ce import fused_softmax_ce
+    from paddle_tpu.ops.pallas.fused_norm_epilogue import fused_norm_epilogue
+
+    cfg = gpt_presets("gpt3-350m")
+    B, S, H, V = 16, cfg.seq_len, cfg.hidden, cfg.vocab_size
+    N = B * S
+    rng = np.random.RandomState(0)
+
+    def best_ms(fn):
+        jax.block_until_ready(fn())  # compile + autotune outside the window
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            best = min(best, time.perf_counter() - t0)
+        return round(best * 1000, 3)
+
+    out = {}
+
+    qkv = jnp.asarray(rng.standard_normal((B, S, 3 * H)) * 0.02,
+                      jnp.bfloat16)
+    attn = jax.jit(jax.grad(lambda t: flash_attention_qkv_raw(
+        t, cfg.n_heads, causal=True).astype(jnp.float32).mean()))
+    out["phase_attention_ms"] = best_ms(lambda: attn(qkv))
+
+    x = jnp.asarray(rng.standard_normal((N, H)) * 0.02, jnp.bfloat16)
+    g = jnp.ones((H,), jnp.bfloat16)
+    be = jnp.zeros((H,), jnp.bfloat16)
+
+    def norm_loss(xx, ss):
+        r, y = fused_norm_epilogue(xx, sub=ss, gain=g, beta=be, norm="layer")
+        return (r.astype(jnp.float32).mean() + y.astype(jnp.float32).mean())
+
+    norm = jax.jit(jax.grad(norm_loss, argnums=(0, 1)))
+    out["phase_norm_epilogue_ms"] = best_ms(lambda: norm(x, x))
+
+    head = jnp.asarray(rng.standard_normal((H, V)) * 0.02, jnp.bfloat16)
+    labels = jnp.asarray(rng.randint(0, V, size=(N,)), jnp.int32)
+    ce = jax.jit(jax.grad(
+        lambda xx, hh: fused_softmax_ce(xx, hh, labels).mean(),
+        argnums=(0, 1)))
+    out["phase_ce_ms"] = best_ms(lambda: ce(x, head))
+
+    # parameter-sized fused AdamW update (fp32 master + bf16 moments,
+    # the flagship's optimizer memory layout)
+    n_params = _flops_per_token(cfg) // 6  # p_dense back out of the MFU fn
+    p = jnp.zeros((int(n_params),), jnp.float32)
+    m = jnp.zeros((int(n_params),), jnp.bfloat16)
+    v = jnp.zeros((int(n_params),), jnp.bfloat16)
+    gr = jnp.zeros((int(n_params),), jnp.bfloat16)
+
+    @jax.jit
+    def adamw(p, m, v, gr):
+        g32 = gr.astype(jnp.float32)
+        m32 = 0.9 * m.astype(jnp.float32) + 0.1 * g32
+        v32 = 0.999 * v.astype(jnp.float32) + 0.001 * g32 * g32
+        upd = m32 / (jnp.sqrt(v32) + 1e-8) + 0.01 * p
+        return (p - 1e-4 * upd, m32.astype(jnp.bfloat16),
+                v32.astype(jnp.bfloat16))
+
+    out["phase_optimizer_ms"] = best_ms(lambda: adamw(p, m, v, gr))
+
+    out.update(autotune.stats())
+    return out
 
 
 if __name__ == "__main__":

@@ -156,6 +156,28 @@ define_flag(
     True,
     "Route scaled_dot_product_attention to the Pallas flash kernel on TPU.",
 )
+define_flag("use_fused_rope_attention", True,
+            "Apply RoPE to Q/K tiles inside the Pallas flash kernel "
+            "(ops/pallas/fused_rope_attention.py) instead of a separate "
+            "rotary pass with its own HBM round-trip; 0 restores the "
+            "unfused apply_rope + flash composition.")
+define_flag("use_fused_norm_epilogue", True,
+            "Fuse residual-add + bias + RMSNorm/LayerNorm (+ optional "
+            "activation) into one VMEM-resident Pallas kernel for the "
+            "attention/FFN epilogues; 0 restores the unfused XLA ops.")
+
+# -- Pallas autotune registry (ops/pallas/autotune.py) --------------------
+define_flag("pallas_autotune", True,
+            "Route Pallas block/grid shape choices through the autotune "
+            "registry (cache lookup + default fallback); 0 pins every "
+            "kernel to its hand-tuned default config.")
+define_flag("pallas_autotune_sweep", "auto",
+            "When a tuned config is missing from the cache: 'auto' sweeps "
+            "candidates on TPU only (CPU/interpret always uses defaults), "
+            "'1' forces sweeping on any backend, '0' never sweeps.")
+define_flag("pallas_autotune_cache", "",
+            "Path of the persistent autotune JSON cache; empty uses "
+            "artifacts/pallas_autotune.json under the repo root.")
 
 # -- self-healing runtime defaults (parallel/resilient_loop.py reads these
 #    when the caller passes None; FLAGS_* env overrides reach child

@@ -222,10 +222,27 @@ def block_apply(bp: dict, x, cfg: GPTConfig, sp_constraint=None):
         v = v.reshape(B, T, cfg.n_heads, cfg.head_dim)
         o = _attention(q, k, v, cfg).reshape(B, T, H)
     o = jnp.einsum("bth,hk->btk", o, bp["proj_w"].astype(cfg.dtype))
-    x = x + o + bp["proj_b"].astype(cfg.dtype)
-    if sp_constraint is not None:
-        x = sp_constraint(x)
-    h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"], cfg.eps)
+    use_fused_norm = sp_constraint is None
+    if use_fused_norm:
+        from ..core.flags import GLOBAL_FLAGS
+
+        use_fused_norm = (GLOBAL_FLAGS.get("use_fused_norm_epilogue")
+                          if GLOBAL_FLAGS.has("use_fused_norm_epilogue")
+                          else True)
+    if use_fused_norm:
+        from ..ops.pallas.fused_norm_epilogue import fused_norm_epilogue
+
+        # residual + proj bias + ln2 in one VMEM pass; when the SP
+        # constraint reshards between the add and the norm the fusion
+        # cannot apply, so that path keeps the unfused composition
+        x, h = fused_norm_epilogue(x, sub=o, bias=bp["proj_b"],
+                                   gain=bp["ln2_g"], beta=bp["ln2_b"],
+                                   norm="layer", eps=cfg.eps)
+    else:
+        x = x + o + bp["proj_b"].astype(cfg.dtype)
+        if sp_constraint is not None:
+            x = sp_constraint(x)
+        h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"], cfg.eps)
     h = jnp.einsum("bth,hf->btf", h, bp["fc_w"].astype(cfg.dtype))
     h = jax.nn.gelu(h + bp["fc_b"].astype(cfg.dtype), approximate=True)
     h = jnp.einsum("btf,fh->bth", h, bp["fc2_w"].astype(cfg.dtype))

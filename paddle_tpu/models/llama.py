@@ -166,6 +166,23 @@ def _repeat_kv(x, n_rep):
     return jnp.repeat(x, n_rep, axis=2)
 
 
+def _use_fused_norm_epilogue() -> bool:
+    """Trace-time read of the epilogue routing flag (default on). The jit
+    cache does not key on flags, so this only steers tracing."""
+    from ..core.flags import GLOBAL_FLAGS
+
+    return (bool(GLOBAL_FLAGS.get("use_fused_norm_epilogue"))
+            if GLOBAL_FLAGS.has("use_fused_norm_epilogue") else True)
+
+
+def _use_fused_rope_attention() -> bool:
+    """Trace-time read of the fused rope+flash routing flag (default on)."""
+    from ..core.flags import GLOBAL_FLAGS
+
+    return (bool(GLOBAL_FLAGS.get("use_fused_rope_attention"))
+            if GLOBAL_FLAGS.has("use_fused_rope_attention") else True)
+
+
 def block_apply(bp, x, cfg: LlamaConfig, cos, sin, use_flash=True,
                 return_kv: bool = False):
     """Training/prefill block: full-sequence causal attention.
@@ -174,25 +191,63 @@ def block_apply(bp, x, cfg: LlamaConfig, cos, sin, use_flash=True,
     computation (no duplicated transformer math)."""
     B, T, H = x.shape
     nH, nKV, dH = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    h = rms_norm(x, bp["attn_norm"], cfg.rms_eps)
+    use_fused_norm = _use_fused_norm_epilogue()
+    if use_fused_norm:
+        from ..ops.pallas.fused_norm_epilogue import fused_norm_epilogue
+
+        # norm-only site (no residual add precedes it inside the block);
+        # the passthrough r is bitwise x
+        x, h = fused_norm_epilogue(x, gain=bp["attn_norm"], norm="rms",
+                                   eps=cfg.rms_eps)
+    else:
+        h = rms_norm(x, bp["attn_norm"], cfg.rms_eps)
     q = _mm(h, bp["wq"], cfg).reshape(B, T, nH, dH)
     k = _mm(h, bp["wk"], cfg).reshape(B, T, nKV, dH)
     v = _mm(h, bp["wv"], cfg).reshape(B, T, nKV, dH)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
-    kf = _repeat_kv(k, nH // nKV)
-    vf = _repeat_kv(v, nH // nKV)
     o = None
-    if use_flash:
-        from ..ops.pallas.flash_attention import (flash_attention_raw,
-                                                  supported)
+    if use_flash and _use_fused_rope_attention():
+        from ..ops.pallas.fused_rope_attention import (
+            fused_rope_flash_attention, fused_rope_supported)
 
-        if supported(q.shape, q.dtype):
-            o = flash_attention_raw(q, kf, vf, causal=True)
+        if fused_rope_supported((B, T, nH, dH), q.dtype):
+            if return_kv:
+                # the decode cache stores the ROTATED pre-repeat k, so
+                # rotate it once XLA-side and fuse only the q rotation
+                k = apply_rope(k, cos, sin)
+                o = fused_rope_flash_attention(
+                    q, _repeat_kv(k, nH // nKV), _repeat_kv(v, nH // nKV),
+                    cos, sin, causal=True, rope_k=False)
+            else:
+                # rope(repeat(k)) == repeat(rope(k)): the tables depend
+                # only on position, so rotating the repeated heads
+                # in-kernel is bitwise the pre-repeat rotation
+                o = fused_rope_flash_attention(
+                    q, _repeat_kv(k, nH // nKV), _repeat_kv(v, nH // nKV),
+                    cos, sin, causal=True)
     if o is None:
-        o = _sdpa(q, kf, vf)
-    x = x + _mm(o.reshape(B, T, nH * dH), bp["wo"], cfg)
-    h = rms_norm(x, bp["ffn_norm"], cfg.rms_eps)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        kf = _repeat_kv(k, nH // nKV)
+        vf = _repeat_kv(v, nH // nKV)
+        if use_flash:
+            from ..ops.pallas.flash_attention import (flash_attention_raw,
+                                                      supported)
+
+            if supported(q.shape, q.dtype):
+                o = flash_attention_raw(q, kf, vf, causal=True)
+        if o is None:
+            o = _sdpa(q, kf, vf)
+    attn_out = _mm(o.reshape(B, T, nH * dH), bp["wo"], cfg)
+    if use_fused_norm:
+        from ..ops.pallas.fused_norm_epilogue import fused_norm_epilogue
+
+        # the true epilogue fusion: attention residual add + ffn norm in
+        # one VMEM pass
+        x, h = fused_norm_epilogue(x, sub=attn_out, gain=bp["ffn_norm"],
+                                   norm="rms", eps=cfg.rms_eps)
+    else:
+        x = x + attn_out
+        h = rms_norm(x, bp["ffn_norm"], cfg.rms_eps)
     gate = _mm(h, bp["w_gate"], cfg)
     up = _mm(h, bp["w_up"], cfg)
     x = x + _mm(jax.nn.silu(gate.astype(jnp.float32)).astype(cfg.dtype) * up,

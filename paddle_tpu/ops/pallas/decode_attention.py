@@ -281,7 +281,7 @@ def paged_decode_attention_dma(q, k_pages, v_pages, block_table,
             pltpu.VMEM((nh, d), jnp.float32),
         ],
     )
-    return pl.pallas_call(
+    return pl.pallas_call(  # tpu-lint: disable=TPL007 -- blocks ARE the page geometry (bs fixed by the cache layout); nothing to sweep
         functools.partial(_paged_decode_dma_kernel, bs=bs,
                           max_blocks=max_blocks, sm_scale=sm_scale, gk=gk),
         grid_spec=grid_spec,
@@ -430,7 +430,7 @@ def paged_decode_attention_mxu(q, kt_pages, v_pages, block_table,
                         pltpu.VMEM((nh, d), jnp.float32),
                         pltpu.VMEM((nh, nkv * d), q.dtype)],
     )
-    return pl.pallas_call(
+    return pl.pallas_call(  # tpu-lint: disable=TPL007 -- blocks ARE the page geometry (bs fixed by the cache layout); nothing to sweep
         functools.partial(_paged_decode_mxu_kernel, bs=bs,
                           n_blocks=max_blocks, sm_scale=sm_scale,
                           k_per=k_per),
@@ -482,7 +482,7 @@ def paged_decode_attention_kernel(q, k_pages, v_pages, block_table,
                         pltpu.VMEM((8, nh), jnp.float32),
                         pltpu.VMEM((nh, d), jnp.float32)],
     )
-    return pl.pallas_call(
+    return pl.pallas_call(  # tpu-lint: disable=TPL007 -- blocks ARE the page geometry (bs fixed by the cache layout); nothing to sweep
         functools.partial(_paged_decode_kernel, bs=bs,
                           n_blocks=max_blocks, sm_scale=sm_scale,
                           k_per=k_per),
@@ -493,8 +493,47 @@ def paged_decode_attention_kernel(q, k_pages, v_pages, block_table,
       *([k_pages] * k_per), *([v_pages] * k_per))
 
 
-@functools.partial(jax.jit, static_argnames=("sm_scale",))
-def decode_attention(q, cache_k, cache_v, pos, sm_scale: float):
+_SRC = None
+
+
+def _autotune_source() -> str:
+    global _SRC
+    if _SRC is None:
+        from . import autotune
+
+        _SRC = autotune.source_hash(_decode_kernel, _online_softmax_page)
+    return _SRC
+
+
+def _tuned_block_s(B: int, nKV: int, G: int, S: int, d: int,
+                   dtype) -> int:
+    """Sequence-window size for the dense decode kernel via the autotune
+    registry; candidates[0] is the hand default min(BLOCK_S, S)."""
+    from . import autotune
+
+    default = min(BLOCK_S, S)
+    cands = [default] + [c for c in (256, 1024)
+                         if c != default and c <= S and S % c == 0]
+    if len(cands) < 2:
+        return default
+
+    def measure(bs):
+        qz = jnp.zeros((B, nKV * G, d), dtype)
+        kz = jnp.zeros((B, nKV, S, d), dtype)
+        pz = jnp.asarray(S - 1, jnp.int32)
+        fn = lambda: decode_attention(qz, kz, kz, pz, 1.0,  # noqa: E731
+                                      block_s=int(bs))
+        return autotune.time_candidate(fn)
+
+    return int(autotune.tuned("decode_attention",
+                              f"b{B}_kv{nKV}_g{G}_s{S}_d{d}",
+                              str(jnp.dtype(dtype)), cands, measure=measure,
+                              source=_autotune_source()))
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "block_s"))
+def decode_attention(q, cache_k, cache_v, pos, sm_scale: float,
+                     block_s: int | None = None):
     """q [B, nH, d] (one token); cache_k/v [B, nKV, S, d] (kv-head-major,
     the engine's native layout — no per-step transpose); pos scalar int32
     (last valid cache index). Returns o [B, nH, d]."""
@@ -506,7 +545,8 @@ def decode_attention(q, cache_k, cache_v, pos, sm_scale: float):
     G = nH // nKV
     qg = q.reshape(B, nKV, G, d)
     kt, vt = cache_k, cache_v
-    block_s = min(BLOCK_S, S)
+    if block_s is None:
+        block_s = _tuned_block_s(B, nKV, G, S, d, q.dtype)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,

@@ -407,7 +407,8 @@ def _flash_bwd_fused_kernel_native(qkv_qblk_ref, qkv_kfull_ref,
     dqkv_ref[:, 2, :] = dv_t
 
 
-def _fused_dqkv_ok(s: int, hd: int, itemsize: int = 2) -> bool:
+def _fused_dqkv_ok(s: int, hd: int, itemsize: int = 2,
+                   block: int | None = None) -> bool:
     """Merged-kernel gate: one program holds FOUR full-sequence slabs
     (k, v, q, do at [s, hp*d]) plus blocks, lse/delta rows, and fp32
     accumulators; cap the slab set at 6 MB of the ~16 MB v5e VMEM.
@@ -415,10 +416,66 @@ def _fused_dqkv_ok(s: int, hd: int, itemsize: int = 2) -> bool:
     an 8 MB slab set (S=8192, d=128) hits Mosaic's scoped-vmem limit at
     18 MB total — the non-slab overhead is ~10 MB at that scale, so the
     8 MB cap round 5 started with was too permissive. Larger configs
-    take the split two-kernel path (2 slabs each)."""
-    bq, bk = _block_sizes(s)
+    take the split two-kernel path (2 slabs each). ``block`` overrides
+    the default square block (autotuned callers)."""
+    bq, bk = (block, block) if block else _block_sizes(s)
     return bq == bk and bq >= _MIN_BLOCK \
         and 4 * s * hd * itemsize <= 6 * 2 ** 20
+
+
+_SRC = None
+
+
+def _autotune_source() -> str:
+    global _SRC
+    if _SRC is None:
+        from . import autotune
+
+        _SRC = autotune.source_hash(
+            _flash_fwd_kernel_native, _flash_bwd_dq_kernel_native,
+            _flash_bwd_dkv_kernel_native, _flash_bwd_fused_kernel_native)
+    return _SRC
+
+
+def _tuned_blocks(b: int, s: int, h: int, d: int, dtype, causal: bool,
+                  n_heads: int | None = None) -> tuple[int, int]:
+    """(block_q, block_k) via the autotune registry (ops/pallas/autotune.py).
+
+    candidates[0] is the hand default (_block_sizes caps at 512), so CPU
+    and no-sweep runs keep the legacy behavior bit-for-bit; on TPU the
+    first use of a (shape-bucket, dtype, device-kind) sweeps square
+    512/256/1024 alternatives on the native forward and persists the
+    winner.  Called from the raw entries (trace time, outside the jitted
+    wrappers) so the choice is baked in as a static arg — the same
+    contract as the flash flags."""
+    from . import autotune
+
+    default = _block_sizes(s)
+    if min(default) < _MIN_BLOCK or not _native_supported(h, d):
+        return default
+    cands = [list(default)]
+    for c in (512, 256, 1024):
+        if c <= s and s % c == 0 and [c, c] not in cands:
+            cands.append([c, c])
+
+    def measure(cand):
+        bq, bk = int(cand[0]), int(cand[1])
+        if n_heads is not None:
+            qz = jnp.zeros((b, s, 3 * n_heads * d), dtype)
+            fn = lambda: _flash_fwd(qz, None, None, causal, 1.0,  # noqa: E731
+                                    with_lse=True, n_heads=n_heads,
+                                    block_q=bq, block_k=bk)
+        else:
+            qz = jnp.zeros((b, s, h, d), dtype)
+            fn = lambda: _flash_fwd(qz, qz, qz, causal, 1.0,  # noqa: E731
+                                    with_lse=True, block_q=bq, block_k=bk)
+        return autotune.time_candidate(fn)
+
+    bucket = (f"b{b}_s{s}_h{h}_d{d}_c{int(causal)}"
+              + ("_qkv" if n_heads is not None else ""))
+    cfg = autotune.tuned("flash_attention", bucket, str(jnp.dtype(dtype)),
+                         cands, measure=measure, source=_autotune_source())
+    return int(cfg[0]), int(cfg[1])
 
 
 # ---------------------------------------------------------------------------
@@ -587,13 +644,16 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale",
                                              "with_lse", "native",
-                                             "n_heads"))
+                                             "n_heads", "block_q",
+                                             "block_k"))
 def _flash_fwd(q, k, v, causal: bool, sm_scale: float, with_lse: bool = False,
-               native: bool = True, n_heads: int | None = None):
+               native: bool = True, n_heads: int | None = None,
+               block_q: int | None = None, block_k: int | None = None):
     """``n_heads`` set => FUSED input mode: q IS the whole (b, s, 3*h*d)
     qkv projection output (k and v must be None) and the kernels read
     q/k/v through lane-block-offset index maps — the 3-way split copies
-    (~96 MB/layer at 350m/b16) never materialize."""
+    (~96 MB/layer at 350m/b16) never materialize.  ``block_q``/``block_k``
+    override the hand defaults (autotuned callers pass _tuned_blocks)."""
     import jax.experimental.pallas as pl
 
     fused = n_heads is not None
@@ -603,7 +663,8 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, with_lse: bool = False,
         d = hd3 // (3 * h)
     else:
         b, s, h, d = q.shape
-    block_q, block_k = _block_sizes(s)
+    if block_q is None or block_k is None:
+        block_q, block_k = _block_sizes(s)
     native = native and _native_supported(h, d)
     assert native or not fused, "fused qkv requires the native layout"
 
@@ -700,10 +761,12 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, with_lse: bool = False,
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "native",
-                                             "n_heads", "fused_dqkv"))
+                                             "n_heads", "fused_dqkv",
+                                             "block_q", "block_k"))
 def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float,
                native: bool = True, n_heads: int | None = None,
-               fused_dqkv: bool = True):
+               fused_dqkv: bool = True, block_q: int | None = None,
+               block_k: int | None = None):
     """Tiled backward: dq over q-blocks, dk/dv over k-blocks, never
     materializing the [S, S] score matrix (the role of the reference's
     flash_attn_bwd CUDA kernels, flash_attn_grad_kernel.cu). With
@@ -729,7 +792,8 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float,
     delta = jnp.transpose(delta, (0, 2, 1))                    # [b, h, s]
     delta = jnp.broadcast_to(delta[:, :, None, :], (b, h, 8, s))
 
-    block_q, block_k = _block_sizes(s)
+    if block_q is None or block_k is None:
+        block_q, block_k = _block_sizes(s)
 
     if native:
         hp = _heads_per_program(h, d)
@@ -749,9 +813,9 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, sm_scale: float,
             # fused_dqkv is a STATIC arg read by the caller OUTSIDE this
             # jit (the jit cache doesn't key on GLOBAL_FLAGS, so an
             # in-trace read would make in-process flag flips a no-op)
-            if fused_dqkv and _fused_dqkv_ok(
-                    s, hd, jnp.dtype(dtype).itemsize):
-                block = _block_sizes(s)[0]
+            if fused_dqkv and block_q == block_k and _fused_dqkv_ok(
+                    s, hd, jnp.dtype(dtype).itemsize, block=block_q):
+                block = block_q
                 blk = pl.BlockSpec((None, block, hd),
                                    lambda ib, ih, i: (ib, i, ih))
                 kblk = pl.BlockSpec(
@@ -976,16 +1040,26 @@ def flash_attention_raw(q, k, v, causal: bool = False, sm_scale: float | None = 
               if GLOBAL_FLAGS.has("flash_attention_native_layout")
               else True)
 
+    # Block shapes come from the autotune registry (trace-time choice,
+    # like the flags above); tuning only covers the native kernels, so
+    # the transpose A/B path keeps the hand defaults.
+    if native and len(q.shape) == 4 and supported(q.shape, q.dtype):
+        bq, bk = _tuned_blocks(q.shape[0], q.shape[1], q.shape[2],
+                               q.shape[3], q.dtype, causal)
+    else:
+        bq = bk = None
+
     @jax.custom_vjp
     def fa(q, k, v):
-        return _flash_fwd(q, k, v, causal, scale, native=native)
+        return _flash_fwd(q, k, v, causal, scale, native=native,
+                          block_q=bq, block_k=bk)
 
     if use_kernel_bwd:
         def fwd(q, k, v):
             from jax.ad_checkpoint import checkpoint_name
 
             o, lse = _flash_fwd(q, k, v, causal, scale, with_lse=True,
-                                native=native)
+                                native=native, block_q=bq, block_k=bk)
             # Under jax.checkpoint, pallas outputs are not "dots", so a
             # dots-saveable policy would recompute the whole flash forward
             # in backward. Naming them lets the model's remat policy save
@@ -997,7 +1071,7 @@ def flash_attention_raw(q, k, v, causal: bool = False, sm_scale: float | None = 
         def bwd(res, g):
             q, k, v, o, lse = res
             return _flash_bwd(q, k, v, o, lse, g, causal, scale,
-                              native=native)
+                              native=native, block_q=bq, block_k=bk)
     else:
         def fwd(q, k, v):
             return fa(q, k, v), (q, k, v)
@@ -1033,16 +1107,19 @@ def flash_attention_qkv_raw(qkv, n_heads: int, causal: bool = True,
     b, s, hd3 = qkv.shape
     d = hd3 // (3 * n_heads)
     scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+    bq, bk = _tuned_blocks(b, s, n_heads, d, qkv.dtype, causal,
+                           n_heads=n_heads)
 
     @jax.custom_vjp
     def fa(qkv):
-        return _flash_fwd(qkv, None, None, causal, scale, n_heads=n_heads)
+        return _flash_fwd(qkv, None, None, causal, scale, n_heads=n_heads,
+                          block_q=bq, block_k=bk)
 
     def fwd(qkv):
         from jax.ad_checkpoint import checkpoint_name
 
         o, lse = _flash_fwd(qkv, None, None, causal, scale, with_lse=True,
-                            n_heads=n_heads)
+                            n_heads=n_heads, block_q=bq, block_k=bk)
         o = checkpoint_name(o, "flash_o")
         lse = checkpoint_name(lse, "flash_lse")
         return o, (qkv, o, lse)
@@ -1054,7 +1131,8 @@ def flash_attention_qkv_raw(qkv, n_heads: int, causal: bool = True,
         merged = (_GF.get("flash_attention_fused_dqkv")
                   if _GF.has("flash_attention_fused_dqkv") else True)
         return (_flash_bwd(qkv, None, None, o, lse, g, causal, scale,
-                           n_heads=n_heads, fused_dqkv=bool(merged)),)
+                           n_heads=n_heads, fused_dqkv=bool(merged),
+                           block_q=bq, block_k=bk),)
 
     fa.defvjp(fwd, bwd)
     return fa(qkv)

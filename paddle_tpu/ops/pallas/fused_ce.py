@@ -40,19 +40,26 @@ _VMEM_BUDGET_FWD = 12 * 2 ** 20
 _VMEM_BUDGET_BWD = 11 * 2 ** 20
 
 
+_BV_LADDER = (2048, 1024, 512, 256, 128)
+
+
+def _bv_feasible(H: int, bv: int, is_bwd: bool) -> bool:
+    """VMEM feasibility of one vocab tile size."""
+    bt = BLOCK_T
+    # double-buffered x and h tiles + fp32 logits tile
+    est = 2 * (bt * H * 2 + H * bv * 2) + bt * bv * 4
+    if is_bwd:
+        # p/dl temps + the resident fp32 accumulator output block
+        est += bt * bv * 4 + 4 * max(bt * H, H * bv)
+        return est <= _VMEM_BUDGET_BWD
+    return est <= _VMEM_BUDGET_FWD
+
+
 def _pick_bv(H: int, is_bwd: bool) -> int:
     """Largest feasible vocab tile, or 0 when NO tile fits VMEM (wide
     hidden sizes: the bwd accumulator block alone is 4*bt*H bytes)."""
-    bt = BLOCK_T
-    for bv in (2048, 1024, 512, 256, 128):
-        # double-buffered x and h tiles + fp32 logits tile
-        est = 2 * (bt * H * 2 + H * bv * 2) + bt * bv * 4
-        if is_bwd:
-            # p/dl temps + the resident fp32 accumulator output block
-            est += bt * bv * 4 + 4 * max(bt * H, H * bv)
-            if est <= _VMEM_BUDGET_BWD:
-                return bv
-        elif est <= _VMEM_BUDGET_FWD:
+    for bv in _BV_LADDER:
+        if _bv_feasible(H, bv, is_bwd):
             return bv
     return 0
 
@@ -187,14 +194,14 @@ def _cdiv(a: int, b: int) -> int:
     return (a + b - 1) // b
 
 
-@functools.partial(jax.jit, static_argnames=())
-def _fused_ce_fwd(x, head, labels):
+@functools.partial(jax.jit, static_argnames=("bv",))
+def _fused_ce_fwd(x, head, labels, bv: int = 0):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     N, H = x.shape
     V = head.shape[1]
-    bt, bv = BLOCK_T, _pick_bv(H, False)
+    bt, bv = BLOCK_T, (bv or _pick_bv(H, False))
     if bv <= 0:
         raise ValueError(f"fused CE fwd has no VMEM-feasible tile for "
                          f"hidden={H}; gate with fused_ce_supported()")
@@ -221,13 +228,13 @@ def _fused_ce_fwd(x, head, labels):
     return nll[:, 0, :].reshape(N), lse[:, 0, :].reshape(N)
 
 
-@functools.partial(jax.jit, static_argnames=())
-def _fused_ce_bwd(x, head, labels, lse, g):
+@functools.partial(jax.jit, static_argnames=("bv",))
+def _fused_ce_bwd(x, head, labels, lse, g, bv: int = 0):
     import jax.experimental.pallas as pl
 
     N, H = x.shape
     V = head.shape[1]
-    bt, bv = BLOCK_T, _pick_bv(H, True)
+    bt, bv = BLOCK_T, (bv or _pick_bv(H, True))
     if bv <= 0:
         raise ValueError(f"fused CE bwd has no VMEM-feasible tile for "
                          f"hidden={H}; gate with fused_ce_supported()")
@@ -272,25 +279,79 @@ def _fused_ce_bwd(x, head, labels, lse, g):
     return dx.astype(x.dtype), dh[:, :V].astype(head.dtype)
 
 
+_SRC = None
+
+
+def _autotune_source() -> str:
+    global _SRC
+    if _SRC is None:
+        from . import autotune
+
+        _SRC = autotune.source_hash(_fwd_kernel, _bwd_dx_kernel,
+                                    _bwd_dh_kernel)
+    return _SRC
+
+
+def _tuned_bv(N: int, H: int, V: int, dtype, is_bwd: bool) -> int:
+    """Vocab tile via the autotune registry; candidates[0] is _pick_bv's
+    largest-feasible hand default, so no-sweep backends keep legacy
+    behavior.  Smaller tiles can win on real chips: the last partial
+    vocab tile wastes less MXU work and the fwd/bwd optima differ."""
+    from . import autotune
+
+    default = _pick_bv(H, is_bwd)
+    if default <= 0:
+        return 0
+    cands = [default] + [bv for bv in _BV_LADDER
+                         if bv != default and V >= bv
+                         and _bv_feasible(H, bv, is_bwd)]
+    if len(cands) < 2:
+        return default
+
+    def measure(bv):
+        xz = jnp.zeros((N, H), dtype)
+        hz = jnp.zeros((H, V), dtype)
+        lz = jnp.zeros((N,), jnp.int32)
+        if is_bwd:
+            lsez = jnp.zeros((N,), jnp.float32)
+            gz = jnp.ones((N,), jnp.float32)
+            fn = lambda: _fused_ce_bwd(xz, hz, lz, lsez, gz,  # noqa: E731
+                                       bv=int(bv))
+        else:
+            fn = lambda: _fused_ce_fwd(xz, hz, lz, bv=int(bv))  # noqa: E731
+        return autotune.time_candidate(fn)
+
+    kernel = "fused_ce_bwd" if is_bwd else "fused_ce_fwd"
+    return int(autotune.tuned(kernel, f"n{N}_h{H}_v{V}",
+                              str(jnp.dtype(dtype)), cands, measure=measure,
+                              source=_autotune_source()))
+
+
 def fused_softmax_ce(x, head, labels):
     """Per-token cross-entropy nll [N] (fp32) of softmax(x @ head) vs
     ``labels`` — differentiable w.r.t. x and head, O(bt*bv) live logits.
 
     x [N, H] (compute dtype), head [H, V], labels [N] int.
     """
+    N, H = x.shape
+    V = head.shape[1]
+    # trace-time choice, like the flash blocks: baked into the jitted
+    # wrappers as static args
+    bv_f = _tuned_bv(N, H, V, x.dtype, is_bwd=False)
+    bv_b = _tuned_bv(N, H, V, x.dtype, is_bwd=True)
 
     @jax.custom_vjp
     def ce(x, head, labels):
-        nll, _ = _fused_ce_fwd(x, head, labels)
+        nll, _ = _fused_ce_fwd(x, head, labels, bv=bv_f)
         return nll
 
     def fwd(x, head, labels):
-        nll, lse = _fused_ce_fwd(x, head, labels)
+        nll, lse = _fused_ce_fwd(x, head, labels, bv=bv_f)
         return nll, (x, head, labels, lse)
 
     def bwd(res, g):
         x, head, labels, lse = res
-        dx, dh = _fused_ce_bwd(x, head, labels, lse, g)
+        dx, dh = _fused_ce_bwd(x, head, labels, lse, g, bv=bv_b)
         return dx, dh, None
 
     ce.defvjp(fwd, bwd)

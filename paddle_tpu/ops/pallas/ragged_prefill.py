@@ -171,6 +171,51 @@ def _ragged_prefill_xla(q, k_pages, v_pages, rows, pos0, sm_scale,
     return o.reshape(C, bs, nH, d).astype(q.dtype)
 
 
+_SRC = None
+
+
+def _autotune_source() -> str:
+    global _SRC
+    if _SRC is None:
+        from . import autotune
+
+        _SRC = autotune.source_hash(_ragged_prefill_kernel,
+                                    ragged_prefill_attention_kernel,
+                                    _ragged_prefill_xla)
+    return _SRC
+
+
+def _tuned_impl(C: int, bs: int, nH: int, d: int, nkv: int, mb: int,
+                dtype) -> str:
+    """Impl choice via the autotune registry.  The ragged kernel has no
+    free block parameter (blocks ARE the page geometry), so the tunable
+    axis is the implementation itself: the MXU kernel wins when chunks
+    are deep (many pages re-read per chunk), the XLA gather path when
+    the prefill is shallow and the kernel's per-program latency
+    dominates.  candidates[0] = "kernel" keeps legacy behavior on
+    no-sweep backends."""
+    from . import autotune
+
+    def measure(impl):
+        qz = jnp.zeros((C, bs, nH, d), dtype)
+        ktz = jnp.zeros((1, nkv, d, bs), dtype)
+        vz = jnp.zeros((1, nkv, bs, d), dtype)
+        rz = jnp.zeros((C, mb), jnp.int32)
+        pz = jnp.zeros((C,), jnp.int32)
+        if impl == "kernel":
+            fn = lambda: ragged_prefill_attention_kernel(  # noqa: E731
+                qz, ktz, vz, rz, pz, 1.0)
+        else:
+            fn = lambda: _ragged_prefill_xla(qz, ktz, vz, rz, pz,  # noqa: E731
+                                             1.0, "d_major")
+        return autotune.time_candidate(fn)
+
+    return str(autotune.tuned("ragged_prefill",
+                              f"c{C}_bs{bs}_h{nH}_d{d}_kv{nkv}_mb{mb}",
+                              str(jnp.dtype(dtype)), ["kernel", "xla"],
+                              measure=measure, source=_autotune_source()))
+
+
 def ragged_prefill_attention(q, k_pages, v_pages, rows, pos0,
                              sm_scale: float, k_layout: str = "d_major"):
     """Ragged chunked-prefill attention: dispatches the MXU Pallas kernel
@@ -179,7 +224,11 @@ def ragged_prefill_attention(q, k_pages, v_pages, rows, pos0,
     if (k_layout == "d_major"
             and ragged_prefill_supported(k_pages.shape, q.shape[2],
                                          k_pages.dtype.itemsize)):
-        return ragged_prefill_attention_kernel(q, k_pages, v_pages, rows,
-                                               pos0, sm_scale)
+        C, bs, nH, d = q.shape
+        impl = _tuned_impl(C, bs, nH, d, k_pages.shape[1], rows.shape[1],
+                           q.dtype)
+        if impl == "kernel":
+            return ragged_prefill_attention_kernel(q, k_pages, v_pages,
+                                                   rows, pos0, sm_scale)
     return _ragged_prefill_xla(q, k_pages, v_pages, rows, pos0, sm_scale,
                                k_layout)

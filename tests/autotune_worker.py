@@ -1,0 +1,33 @@
+"""Subprocess worker for the autotune persistence round-trip test.
+
+Run as ``python tests/autotune_worker.py`` with
+``FLAGS_pallas_autotune_cache`` pointing at a temp file and
+``FLAGS_pallas_autotune_sweep=1``: asks the registry for one tuned
+config (sweeping on a miss), then prints the session stats as one JSON
+line. The test launches it twice — the first process sweeps and
+persists, the second must hit the cache without sweeping.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.ops.pallas import autotune  # noqa: E402
+
+
+def main():
+    def measure(cand):
+        # deterministic synthetic timings: candidate 3 always wins
+        return {1: 5.0, 2: 3.0, 3: 1.0}[cand]
+
+    cfg = autotune.tuned("worker_kernel", "b1_s128", "bfloat16", [1, 2, 3],
+                         measure=measure, source="worker-src-v1")
+    out = dict(autotune.stats())
+    out["config"] = cfg
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""TPL007 fixture: pallas_call sites vs the autotune registry.
+
+Seeded violations: a kernel wrapper with hardwired blocks that no
+tuned() entry point reaches, and a module-scope pallas_call. Clean
+cases: a wrapper reached from an autotune-consulting entry (directly
+and through custom_vjp/defvjp wiring), the GLOBAL_AUTOTUNE form, and a
+suppressed fixed-geometry kernel.
+"""
+
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+
+from paddle_tpu.ops.pallas import autotune
+from paddle_tpu.ops.pallas.autotune import GLOBAL_AUTOTUNE
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+# -- violations ---------------------------------------------------------------
+
+def fx_hardcoded_blocks(x):
+    return pl.pallas_call(  # seeded violation: nothing tuned reaches this
+        _kernel,
+        grid=(x.shape[0] // 256,),
+        in_specs=[pl.BlockSpec((256, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((256, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+fx_module_scope = pl.pallas_call(  # seeded violation: module-scope site
+    _kernel,
+    grid=(1,),
+    out_shape=jax.ShapeDtypeStruct((8, 128), "float32"),
+)
+
+
+# -- clean: blocks flow from a tuned() entry point ----------------------------
+
+def fx_tuned_entry(x):
+    bt = autotune.tuned("fx", "b1", "f32", [256], measure=None, source="s")
+    return fx_swept_wrapper(x, bt)
+
+
+def fx_swept_wrapper(x, bt):
+    return pl.pallas_call(
+        _kernel,
+        grid=(x.shape[0] // bt,),
+        in_specs=[pl.BlockSpec((bt, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((bt, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def fx_registry_entry(x):
+    cfg = GLOBAL_AUTOTUNE.tuned("fx2", "b1", "f32", [128])
+    return fx_vjp_front(x, cfg)
+
+
+@jax.custom_vjp
+def fx_vjp_front(x, cfg):
+    return fx_vjp_fwd(x, cfg)[0]
+
+
+def fx_vjp_fwd(x, cfg):
+    return pl.pallas_call(
+        _kernel,
+        grid=(x.shape[0] // cfg,),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x), None
+
+
+def fx_vjp_bwd(res, g):
+    return g, None
+
+
+fx_vjp_front.defvjp(fx_vjp_fwd, fx_vjp_bwd)
+
+
+# -- clean: deliberate fixed geometry, suppressed -----------------------------
+
+def fx_paged_fixed(x, bs):
+    return pl.pallas_call(  # tpu-lint: disable=TPL007 -- blocks ARE the page
+        functools.partial(_kernel),
+        grid=(x.shape[0] // bs,),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

@@ -1,0 +1,167 @@
+"""Persistent Pallas autotune registry tests (ISSUE 6 tentpole):
+hit/miss accounting, atomic persistence, source-hash and device-kind
+keying, sweep gating, and the fresh-subprocess round-trip that proves
+the cache actually survives process restart."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import jax
+
+from paddle_tpu.core.flags import GLOBAL_FLAGS
+from paddle_tpu.ops.pallas.autotune import (AutotuneRegistry, cache_path,
+                                            source_hash)
+
+pytestmark = pytest.mark.smoke
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def sweep_on():
+    old = (GLOBAL_FLAGS.get("pallas_autotune_sweep")
+           if GLOBAL_FLAGS.has("pallas_autotune_sweep") else "auto")
+    GLOBAL_FLAGS.set("pallas_autotune_sweep", "1")
+    yield
+    GLOBAL_FLAGS.set("pallas_autotune_sweep", old)
+
+
+def _measure(timings):
+    return lambda cand: timings[cand]
+
+
+def test_miss_sweeps_persists_then_hits(tmp_path, sweep_on):
+    path = str(tmp_path / "cache.json")
+    reg = AutotuneRegistry(path)
+    cfg = reg.tuned("k", "b1", "bf16", [256, 512],
+                    measure=_measure({256: 2.0, 512: 1.0}), source="s1")
+    assert cfg == 512
+    assert reg.misses == 1 and reg.sweeps == 1 and reg.hits == 0
+    # second lookup: in-memory hit, no re-sweep
+    cfg = reg.tuned("k", "b1", "bf16", [256, 512],
+                    measure=_measure({256: 2.0, 512: 1.0}), source="s1")
+    assert cfg == 512 and reg.hits == 1 and reg.sweeps == 1
+    # the winner is on disk, keyed by device kind
+    data = json.load(open(path))
+    (key,) = data["entries"].keys()
+    assert key == f"k|{jax.devices()[0].device_kind}|b1|bf16"
+    assert data["entries"][key]["config"] == 512
+    # a FRESH registry instance on the same file hits without sweeping
+    reg2 = AutotuneRegistry(path)
+    cfg = reg2.tuned("k", "b1", "bf16", [256, 512],
+                     measure=_measure({256: 2.0, 512: 1.0}), source="s1")
+    assert cfg == 512 and reg2.hits == 1 and reg2.sweeps == 0
+
+
+def test_source_hash_mismatch_is_clean_miss(tmp_path, sweep_on):
+    path = str(tmp_path / "cache.json")
+    reg = AutotuneRegistry(path)
+    assert reg.tuned("k", "b1", "bf16", [256, 512],
+                     measure=_measure({256: 2.0, 512: 1.0}),
+                     source="old") == 512
+    # edited kernel: same key, different source -> re-sweep, not reuse
+    cfg = reg.tuned("k", "b1", "bf16", [256, 512],
+                    measure=_measure({256: 1.0, 512: 2.0}), source="new")
+    assert cfg == 256
+    assert reg.misses == 2 and reg.sweeps == 2 and reg.hits == 0
+
+
+def test_no_sweep_returns_legacy_default(tmp_path, monkeypatch):
+    old = (GLOBAL_FLAGS.get("pallas_autotune_sweep")
+           if GLOBAL_FLAGS.has("pallas_autotune_sweep") else "auto")
+    GLOBAL_FLAGS.set("pallas_autotune_sweep", "0")
+    try:
+        reg = AutotuneRegistry(str(tmp_path / "cache.json"))
+        cfg = reg.tuned("k", "b1", "bf16", [256, 512],
+                        measure=_measure({256: 2.0, 512: 1.0}), source="s")
+        assert cfg == 256  # candidates[0] == pre-autotune behavior
+        assert reg.sweeps == 0 and not os.path.exists(
+            str(tmp_path / "cache.json"))
+    finally:
+        GLOBAL_FLAGS.set("pallas_autotune_sweep", old)
+
+
+def test_disabled_registry_returns_default(tmp_path, sweep_on):
+    old = (GLOBAL_FLAGS.get("pallas_autotune")
+           if GLOBAL_FLAGS.has("pallas_autotune") else True)
+    GLOBAL_FLAGS.set("pallas_autotune", False)
+    try:
+        reg = AutotuneRegistry(str(tmp_path / "cache.json"))
+        assert reg.tuned("k", "b1", "bf16", [256, 512],
+                         measure=_measure({256: 2.0, 512: 1.0}),
+                         source="s") == 256
+        assert reg.misses == 0 and reg.sweeps == 0
+    finally:
+        GLOBAL_FLAGS.set("pallas_autotune", old)
+
+
+def test_all_candidates_failing_returns_default(tmp_path, sweep_on):
+    def boom(cand):
+        raise RuntimeError("infeasible")
+
+    reg = AutotuneRegistry(str(tmp_path / "cache.json"))
+    assert reg.tuned("k", "b1", "bf16", [256, 512], measure=boom,
+                     source="s") == 256
+    # a failed sweep must not poison the cache
+    assert not os.path.exists(str(tmp_path / "cache.json"))
+
+
+def test_corrupt_cache_is_empty_cache(tmp_path, sweep_on):
+    path = str(tmp_path / "cache.json")
+    with open(path, "w") as f:
+        f.write("{not json")
+    reg = AutotuneRegistry(path)
+    assert reg.tuned("k", "b1", "bf16", [256, 512],
+                     measure=_measure({256: 2.0, 512: 1.0}),
+                     source="s") == 512
+
+
+def test_source_hash_is_stable_and_content_keyed():
+    a = source_hash(cache_path)
+    assert a == source_hash(cache_path)
+    assert a != source_hash(source_hash)
+    assert len(a) == 16
+
+
+def test_cache_path_flag_override(tmp_path):
+    old = (GLOBAL_FLAGS.get("pallas_autotune_cache")
+           if GLOBAL_FLAGS.has("pallas_autotune_cache") else "")
+    GLOBAL_FLAGS.set("pallas_autotune_cache", str(tmp_path / "x.json"))
+    try:
+        assert cache_path() == str(tmp_path / "x.json")
+    finally:
+        GLOBAL_FLAGS.set("pallas_autotune_cache", old)
+        assert cache_path().endswith(os.path.join("artifacts",
+                                                  "pallas_autotune.json"))
+
+
+def test_fresh_subprocess_round_trip(tmp_path):
+    """The acceptance pin: a second PROCESS skips the sweep entirely —
+    the cache is persistent, not per-process."""
+    cache = str(tmp_path / "cache.json")
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               FLAGS_pallas_autotune_sweep="1",
+               FLAGS_pallas_autotune_cache=cache)
+    worker = os.path.join(REPO, "tests", "autotune_worker.py")
+
+    def run():
+        proc = subprocess.run([sys.executable, worker], env=env,
+                              capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stderr
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    first = run()
+    assert first["config"] == 3  # the fastest candidate won
+    assert first["autotune_sweeps"] == 1
+    assert first["autotune_cache_hits"] == 0
+
+    second = run()
+    assert second["config"] == 3
+    assert second["autotune_sweeps"] == 0   # no re-sweep: read from disk
+    assert second["autotune_cache_misses"] == 0
+    assert second["autotune_cache_hits"] == 1

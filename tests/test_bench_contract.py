@@ -26,8 +26,9 @@ def bench(monkeypatch):
     # stub EVERY secondary (they target the real chip: 1B-class decode,
     # serving engine, 100-step loss curve — hours on the 1-core CPU CI
     # box); individual tests re-patch the ones they exercise
-    for name in ("_bench_decode", "_bench_serving", "_bench_loss_curve",
-                 "_bench_13b", "_bench_long_ctx"):
+    for name in ("_bench_chip_probe", "_bench_decode", "_bench_serving",
+                 "_bench_loss_curve", "_bench_13b", "_bench_long_ctx",
+                 "_bench_phases"):
         monkeypatch.setattr(b, name, lambda: {})
     return b
 

@@ -1,0 +1,171 @@
+"""Bit-parity pins for the fused residual+bias+norm epilogue (ISSUE 6).
+
+Contract (fused_norm_epilogue.py module docstring): the KERNEL arm is
+bit-identical to the EAGER unfused composition — the op-by-op graph the
+models used before the fusion — in both eager and jit regimes. The
+jitted XLA *fallback* arm is deliberately NOT a parity reference: XLA
+fma-contracts the fallback's own ``y * gain + beta``, drifting 1 bf16
+ulp from eager in a compiler-dependent way. Tests therefore always
+compare against the eager reference.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas.fused_norm_epilogue import (
+    fused_norm_epilogue, fused_norm_epilogue_supported)
+
+pytestmark = pytest.mark.smoke
+
+
+def _eager_ref(x, sub, bias, gain, beta, norm, eps=1e-5):
+    """The literal unfused model composition (models/llama.py rms_norm /
+    models/gpt.py _layer_norm), evaluated op-by-op."""
+    r = x
+    if sub is not None:
+        r = r + sub
+    if bias is not None:
+        r = r + bias.astype(x.dtype)
+    r32 = r.astype(jnp.float32)
+    if norm == "rms":
+        y = r32 * jax.lax.rsqrt((r32 * r32).mean(-1, keepdims=True) + eps)
+        y = (y * gain.astype(jnp.float32)).astype(x.dtype)
+    else:
+        mu = r32.mean(-1, keepdims=True)
+        var = r32.var(-1, keepdims=True)
+        y = (r32 - mu) * jax.lax.rsqrt(var + eps)
+        y = (y * gain.astype(jnp.float32)
+             + beta.astype(jnp.float32)).astype(x.dtype)
+    return r, y
+
+
+def _operands(n, h, dtype, with_beta, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 5)
+    x = jax.random.normal(ks[0], (n, h)).astype(dtype)
+    sub = jax.random.normal(ks[1], (n, h)).astype(dtype)
+    bias = (jax.random.normal(ks[2], (h,)) * 0.1).astype(jnp.float32)
+    gain = (1.0 + jax.random.normal(ks[3], (h,)) * 0.1).astype(dtype)
+    beta = ((jax.random.normal(ks[4], (h,)) * 0.1).astype(dtype)
+            if with_beta else None)
+    return x, sub, bias, gain, beta
+
+
+@pytest.mark.parametrize("norm", ["rms", "layer"])
+@pytest.mark.parametrize("n,h", [(256, 128), (512, 256)])
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float32])
+def test_kernel_bit_parity_vs_eager(norm, n, h, dtype):
+    x, sub, bias, gain, beta = _operands(n, h, dtype, norm == "layer")
+    assert fused_norm_epilogue_supported(n, h, dtype)
+    want_r, want_y = _eager_ref(x, sub, bias, gain, beta, norm)
+    r, y = fused_norm_epilogue(x, sub=sub, bias=bias, gain=gain, beta=beta,
+                               norm=norm, use_kernel=True)
+    np.testing.assert_array_equal(np.asarray(r, np.float32),
+                                  np.asarray(want_r, np.float32))
+    np.testing.assert_array_equal(np.asarray(y, np.float32),
+                                  np.asarray(want_y, np.float32))
+
+
+@pytest.mark.parametrize("norm", ["rms", "layer"])
+def test_kernel_bit_parity_under_jit(norm):
+    """The kernel arm stays pinned to the EAGER reference even when the
+    whole call is jitted (the opaque-one + reduce_precision guards)."""
+    dtype = jnp.bfloat16
+    x, sub, bias, gain, beta = _operands(512, 128, dtype, norm == "layer")
+    want_r, want_y = _eager_ref(x, sub, bias, gain, beta, norm)
+
+    @jax.jit
+    def f(x, sub, bias, gain, beta):
+        return fused_norm_epilogue(x, sub=sub, bias=bias, gain=gain,
+                                   beta=beta, norm=norm, use_kernel=True)
+
+    r, y = f(x, sub, bias, gain, beta)
+    np.testing.assert_array_equal(np.asarray(r, np.float32),
+                                  np.asarray(want_r, np.float32))
+    np.testing.assert_array_equal(np.asarray(y, np.float32),
+                                  np.asarray(want_y, np.float32))
+
+
+def test_fallback_arm_matches_eager_reference():
+    """use_kernel=False (eager) IS the unfused composition."""
+    x, sub, bias, gain, beta = _operands(256, 128, jnp.bfloat16, True)
+    want_r, want_y = _eager_ref(x, sub, bias, gain, beta, "layer")
+    r, y = fused_norm_epilogue(x, sub=sub, bias=bias, gain=gain, beta=beta,
+                               norm="layer", use_kernel=False)
+    np.testing.assert_array_equal(np.asarray(r, np.float32),
+                                  np.asarray(want_r, np.float32))
+    np.testing.assert_array_equal(np.asarray(y, np.float32),
+                                  np.asarray(want_y, np.float32))
+
+
+def test_norm_only_and_sub_only_variants():
+    """Operand subsets (no sub / no bias) stay bit-pinned too — the
+    llama wiring uses both shapes."""
+    x, sub, _, gain, _ = _operands(256, 128, jnp.bfloat16, False)
+    for s in (None, sub):
+        want_r, want_y = _eager_ref(x, s, None, gain, None, "rms")
+        r, y = fused_norm_epilogue(x, sub=s, gain=gain, norm="rms",
+                                   use_kernel=True)
+        np.testing.assert_array_equal(np.asarray(r, np.float32),
+                                      np.asarray(want_r, np.float32))
+        np.testing.assert_array_equal(np.asarray(y, np.float32),
+                                      np.asarray(want_y, np.float32))
+
+
+def test_activation_path_close():
+    """act='gelu' is allclose-pinned only (the tanh-gelu expression is
+    not replicated term-for-term in fp32)."""
+    x, sub, _, gain, _ = _operands(256, 128, jnp.bfloat16, False)
+    _, want_y = _eager_ref(x, sub, None, gain, None, "rms")
+    want_y = jax.nn.gelu(want_y, approximate=True)
+    _, y = fused_norm_epilogue(x, sub=sub, gain=gain, norm="rms",
+                               act="gelu", use_kernel=True)
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(want_y, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_gradients_match_unfused(n=256, h=128):
+    """Backward goes through jax.vjp of the reference expression: grads
+    agree with the unfused graph to bf16 reduction-order noise."""
+    x, sub, bias, gain, beta = _operands(n, h, jnp.bfloat16, True)
+
+    def fused_loss(x, sub, bias, gain, beta):
+        r, y = fused_norm_epilogue(x, sub=sub, bias=bias, gain=gain,
+                                   beta=beta, norm="layer", use_kernel=True)
+        return (r.astype(jnp.float32).mean() + y.astype(jnp.float32).mean())
+
+    def ref_loss(x, sub, bias, gain, beta):
+        r, y = _eager_ref(x, sub, bias, gain, beta, "layer")
+        return (r.astype(jnp.float32).mean() + y.astype(jnp.float32).mean())
+
+    got = jax.grad(fused_loss, argnums=(0, 1, 2, 3, 4))(x, sub, bias, gain,
+                                                        beta)
+    want = jax.grad(ref_loss, argnums=(0, 1, 2, 3, 4))(x, sub, bias, gain,
+                                                       beta)
+    names = ("x", "sub", "bias", "gain", "beta")
+    for nm, a, b in zip(names, got, want):
+        tol = 6e-2 if nm == "bias" else 2e-2
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=tol, atol=tol, err_msg=nm)
+
+
+def test_supported_gate():
+    assert fused_norm_epilogue_supported(256, 128, jnp.bfloat16)
+    assert not fused_norm_epilogue_supported(255, 128, jnp.bfloat16)  # rows
+    assert not fused_norm_epilogue_supported(256, 100, jnp.bfloat16)  # lanes
+    assert not fused_norm_epilogue_supported(256, 128, jnp.float16)   # dtype
+
+
+def test_error_cases():
+    x = jnp.zeros((256, 128), jnp.bfloat16)
+    g = jnp.ones((128,), jnp.bfloat16)
+    with pytest.raises(ValueError):
+        fused_norm_epilogue(x, norm="rms")           # no gain
+    with pytest.raises(ValueError):
+        fused_norm_epilogue(x, gain=g, norm="welford")
+    with pytest.raises(ValueError):
+        fused_norm_epilogue(x, gain=g, norm="layer")  # layer needs beta

@@ -1,0 +1,122 @@
+"""Bit-parity pins for the fused RoPE+flash kernel (ISSUE 6).
+
+The kernel arm is pinned bit-identical to the EAGER unfused composition
+(models/llama.py apply_rope + flash_attention_raw) in both eager and
+jit regimes; gradients are bitwise identical because both paths run the
+same flash backward on identically-rotated inputs. Comparisons are
+always against the eager reference — the jitted fallback fma-drifts
+(see fused_norm_epilogue test module docstring).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas.flash_attention import flash_attention_raw
+from paddle_tpu.ops.pallas.fused_rope_attention import (
+    fused_rope_flash_attention, fused_rope_supported)
+
+pytestmark = pytest.mark.smoke
+
+B, S, H, D = 1, 256, 2, 128
+
+
+def _operands(seed=0, s=S, d=D):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, s, H, d)).astype(jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, s, H, d)).astype(jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, s, H, d)).astype(jnp.bfloat16)
+    inv = 1.0 / (10000.0 ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    ang = jnp.arange(s, dtype=jnp.float32)[:, None] * inv
+    return q, k, v, jnp.cos(ang), jnp.sin(ang)
+
+
+def _apply_rope(x, cos, sin):
+    """models/llama.py apply_rope, broadcast form."""
+    cb, sb = cos[None, :, None, :], sin[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    o1 = x1 * cb - x2 * sb
+    o2 = x2 * cb + x1 * sb
+    return jnp.concatenate([o1, o2], -1).astype(x.dtype)
+
+
+def _ref(q, k, v, cos, sin, causal=True, rope_q=True, rope_k=True):
+    qr = _apply_rope(q, cos, sin) if rope_q else q
+    kr = _apply_rope(k, cos, sin) if rope_k else k
+    return flash_attention_raw(qr, kr, v, causal=causal,
+                               sm_scale=1.0 / (q.shape[-1] ** 0.5))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_bit_parity(causal):
+    q, k, v, cos, sin = _operands()
+    assert fused_rope_supported(q.shape, q.dtype)
+    want = _ref(q, k, v, cos, sin, causal=causal)
+    got = fused_rope_flash_attention(q, k, v, cos, sin, causal=causal,
+                                     use_kernel=True)
+    np.testing.assert_array_equal(np.asarray(got, np.float32),
+                                  np.asarray(want, np.float32))
+
+
+def test_forward_rope_k_false():
+    """Prefill with an externally-rotated KV cache rotates only q."""
+    q, k, v, cos, sin = _operands(1)
+    want = _ref(q, k, v, cos, sin, rope_k=False)
+    got = fused_rope_flash_attention(q, k, v, cos, sin, rope_k=False,
+                                     use_kernel=True)
+    np.testing.assert_array_equal(np.asarray(got, np.float32),
+                                  np.asarray(want, np.float32))
+
+
+def test_forward_bit_parity_under_jit():
+    q, k, v, cos, sin = _operands(2)
+    want = _ref(q, k, v, cos, sin)  # eager reference
+
+    @jax.jit
+    def f(q, k, v, cos, sin):
+        return fused_rope_flash_attention(q, k, v, cos, sin,
+                                          use_kernel=True)
+
+    got = f(q, k, v, cos, sin)
+    np.testing.assert_array_equal(np.asarray(got, np.float32),
+                                  np.asarray(want, np.float32))
+
+
+def test_gradients_bitwise_identical():
+    """Both paths run _flash_bwd on identically-rotated inputs, so the
+    cotangents agree BITWISE, not just allclose."""
+    q, k, v, cos, sin = _operands(3)
+
+    def fused_loss(q, k, v):
+        o = fused_rope_flash_attention(q, k, v, cos, sin, use_kernel=True)
+        return o.astype(jnp.float32).sum()
+
+    def ref_loss(q, k, v):
+        return _ref(q, k, v, cos, sin).astype(jnp.float32).sum()
+
+    got = jax.grad(fused_loss, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for nm, a, b in zip("qkv", got, want):
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32),
+                                      err_msg=f"d{nm}")
+
+
+def test_fallback_arm_matches_reference():
+    """use_kernel=False routes through apply_rope + flash — the literal
+    unfused composition."""
+    q, k, v, cos, sin = _operands(4)
+    want = _ref(q, k, v, cos, sin)
+    got = fused_rope_flash_attention(q, k, v, cos, sin, use_kernel=False)
+    np.testing.assert_array_equal(np.asarray(got, np.float32),
+                                  np.asarray(want, np.float32))
+
+
+def test_supported_gate():
+    assert fused_rope_supported((1, 256, 2, 128), jnp.bfloat16)
+    assert fused_rope_supported((1, 512, 1, 256), jnp.bfloat16)
+    assert not fused_rope_supported((1, 256, 2, 64), jnp.bfloat16)   # hp>1
+    assert not fused_rope_supported((1, 100, 2, 128), jnp.bfloat16)  # blocks
+    assert not fused_rope_supported((256, 2, 128), jnp.bfloat16)     # rank

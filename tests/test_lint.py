@@ -157,6 +157,23 @@ def test_tpl006_flags_fire_and_suppress():
         and "FX_PATCHED" not in msgs
 
 
+def test_tpl007_autotune_bypass_fires_and_suppresses():
+    src = open(fx("fx_pallas_autotune.py")).read()
+    f = lint(["fx_pallas_autotune.py"], "TPL007")
+    assert len(f) == 2, [(x.line, x.message) for x in f]
+    for x in f:
+        assert "seeded violation" in src.splitlines()[x.line - 1]
+        assert x.severity == "warning"
+    msgs = " | ".join(x.message for x in f)
+    # the unreached wrapper and the module-scope site fire ...
+    assert "fx_hardcoded_blocks" in msgs
+    assert "module-scope" in msgs
+    # ... while tuned()-reached wrappers (direct call, GLOBAL_AUTOTUNE +
+    # defvjp wiring) and the suppressed fixed-geometry kernel stay silent
+    for silent in ("fx_swept_wrapper", "fx_vjp_fwd", "fx_paged_fixed"):
+        assert silent not in msgs, silent
+
+
 # -- framework behaviors -----------------------------------------------------
 
 def test_suppression_syntax_variants():
@@ -205,7 +222,7 @@ def test_reporters_shape():
 
 def test_rule_table_unique_and_documented():
     rules = [c.rule for c in ALL_CHECKERS]
-    assert len(rules) == len(set(rules)) == 9  # 6 per-file + 3 interproc
+    assert len(rules) == len(set(rules)) == 10  # 7 per-file + 3 interproc
     assert all(c.description for c in ALL_CHECKERS)
     assert all(c.severity in ("error", "warning") for c in ALL_CHECKERS)
 

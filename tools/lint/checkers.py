@@ -8,6 +8,7 @@ TPL003 op-registry           dup @op names, grad-spec coverage, raw mutation
 TPL004 recompile-hazard      time()/np.random/closure scalars under jit
 TPL005 collective-safety     lax.p* axis names unbound by any shard_map
 TPL006 flag-hygiene          define_flag() names that are never read
+TPL007 pallas-autotune-bypass pallas_call sites no tuned() entry reaches
 
 The analyses are deliberately first-order (per-function taint, per-file
 axis sets, project-wide name sets) — precise enough to catch the shipped
@@ -737,6 +738,119 @@ class FlagHygiene(Checker):
                                   "cannot fire)", path=path, line=line)
 
 
+# -- TPL007: pallas_call sites that bypass the autotune registry -------------
+
+class PallasAutotuneBypass(Checker):
+    """A ``pl.pallas_call`` whose block/grid configuration is hardwired
+    and never consulted against the persistent autotune registry
+    (paddle_tpu/ops/pallas/autotune.py) freezes a hand-tuned shape choice
+    into the kernel: the sweep can never revisit it on a new device kind
+    or shape bucket, which is exactly how the pre-registry kernels ended
+    up with one-device block constants.
+
+    Module-local reachability analysis (first-order, like TPL005/006):
+    a function is "tuned" if its body calls anything whose dotted tail
+    contains ``tuned`` (``autotune.tuned``, ``_tuned_blocks``, ...) or
+    references ``GLOBAL_AUTOTUNE``; tuned-ness propagates along the
+    module's reference graph (a tuned entry point passes its swept
+    parameters into the wrappers and kernels it references, including
+    ``custom_vjp``/``defvjp`` wiring at module scope). A ``pallas_call``
+    in a function no tuned entry reaches — or at module scope — is
+    reported. Deliberate fixed-geometry kernels (e.g. paged decode
+    wrappers whose blocks ARE the page size) suppress with a rationale.
+    """
+
+    rule = "TPL007"
+    name = "pallas-autotune-bypass"
+    severity = "warning"
+    description = "pallas_call block/grid config bypasses the autotune registry"
+
+    def check(self, ctx):
+        self.ctx = ctx
+        funcs: dict[str, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.setdefault(node.name, node)
+
+        def is_tuned(body: ast.AST) -> bool:
+            for n in ast.walk(body):
+                if isinstance(n, ast.Call) and \
+                        "tuned" in call_name(n).rsplit(".", 1)[-1]:
+                    return True
+                if isinstance(n, ast.Name) and n.id == "GLOBAL_AUTOTUNE":
+                    return True
+                if isinstance(n, ast.Attribute) and \
+                        n.attr == "GLOBAL_AUTOTUNE":
+                    return True
+            return False
+
+        refs: dict[str, set[str]] = {}
+        tuned: set[str] = set()
+        sites: dict[str, list[ast.Call]] = {}
+        module_sites: list[ast.Call] = []
+
+        for name, fn in funcs.items():
+            refs[name] = {n.id for n in ast.walk(fn)
+                          if isinstance(n, ast.Name) and n.id != name
+                          and n.id in funcs} | \
+                         {n.attr for n in ast.walk(fn)
+                          if isinstance(n, ast.Attribute)
+                          and n.attr in funcs}
+            if is_tuned(fn):
+                tuned.add(name)
+
+        def owner(call: ast.Call) -> str | None:
+            best = None
+            for name, fn in funcs.items():
+                if (fn.lineno <= call.lineno <= (fn.end_lineno or fn.lineno)
+                        and (best is None
+                             or fn.lineno > funcs[best].lineno)):
+                    best = name
+            return best
+
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.Call) and \
+                    call_name(n).rsplit(".", 1)[-1] == "pallas_call":
+                own = owner(n)
+                if own is None:
+                    module_sites.append(n)
+                else:
+                    sites.setdefault(own, []).append(n)
+            # module-scope `X.defvjp(fwd, bwd)` wires fwd/bwd into X's
+            # reference graph even though no function body mentions them
+            if isinstance(n, ast.Call) and \
+                    call_name(n).rsplit(".", 1)[-1] == "defvjp":
+                root = call_name(n).rsplit(".", 1)[0]
+                if root in funcs:
+                    refs.setdefault(root, set()).update(
+                        a.id for a in n.args
+                        if isinstance(a, ast.Name) and a.id in funcs)
+
+        changed = True
+        while changed:
+            changed = False
+            for name in list(tuned):
+                for callee in refs.get(name, ()):
+                    if callee not in tuned:
+                        tuned.add(callee)
+                        changed = True
+
+        for name, calls in sorted(sites.items()):
+            if name in tuned:
+                continue
+            for call in calls:
+                self.report(call, f"pallas_call in '{name}' with hardcoded "
+                                  "block/grid config: no autotune-consulting "
+                                  "entry point reaches it — route the block "
+                                  "parameters through ops/pallas/autotune."
+                                  "tuned() or suppress with a rationale")
+        for call in module_sites:
+            self.report(call, "module-scope pallas_call with hardcoded "
+                              "block/grid config bypasses the autotune "
+                              "registry")
+        self.ctx = None
+
+
 ALL_CHECKERS = [
     HostSyncInTrace,
     AsyncAliasing,
@@ -744,4 +858,5 @@ ALL_CHECKERS = [
     RecompileHazard,
     CollectiveSafety,
     FlagHygiene,
+    PallasAutotuneBypass,
 ]
