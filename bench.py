@@ -231,68 +231,97 @@ def _bench_decode():
     return out
 
 
-def _bench_serving():
-    """Continuous-batching serving engine under a saturating shared-
-    prefix Poisson workload: request queue + chunked ragged prefill +
-    prefix caching + per-request page alloc/free over the paged MXU
-    decode kernel. Reference role: analysis_predictor serving path.
+def _serving_keys(m, spec_m=None):
+    """Pure mapping: loadgen metrics dict -> bench serving_* keys
+    (tests/test_bench_contract.py pins the key set). ``spec_m`` is the
+    speculative-decode arm's metrics when that arm ran."""
+    out = {
+        "serving_throughput_tok_s": m["throughput_tok_s"],
+        "serving_goodput": m["goodput_tok_s"],
+        "serving_latency_p50_s": m["e2e_p50_s"],
+        "serving_latency_p99_s": m["e2e_p99_s"],
+        "serving_ttft_p50": m["ttft_p50_s"],
+        "serving_ttft_p99": m["ttft_p99_s"],
+        "serving_tpot_p50": m["tpot_p50_s"],
+        "serving_tpot_p99": m["tpot_p99_s"],
+        "serving_occupancy": m["slot_occupancy"],
+        # occupancy decomposition: where the non-decoding slot-tokens
+        # went (queue empty vs pool-blocked vs mid-prefill vs overrun vs
+        # rejected drafts) — attributes any occupancy regression to its
+        # cause
+        "serving_occ_waste_queue_empty": m["occ_waste_queue_empty"],
+        "serving_occ_waste_admission_blocked":
+            m["occ_waste_admission_blocked"],
+        "serving_occ_waste_prefill": m["occ_waste_prefill"],
+        "serving_occ_waste_overrun": m["occ_waste_overrun"],
+        "serving_occ_waste_spec_rejected": m["occ_waste_spec_rejected"],
+        "serving_prefix_cache_hit_rate": m["prefix_cache_hit_rate"],
+        # speculative arm: accept rate + its throughput (0/absent keys
+        # mean the arm did not run, not that it ran poorly)
+        "serving_spec_accept_rate": (spec_m or m)["spec_accept_rate"],
+    }
+    if spec_m is not None:
+        out["serving_spec_throughput_tok_s"] = spec_m["throughput_tok_s"]
+    return out
 
-    Workload changed in r06 with the chunked-prefill/prefix-cache
-    rewrite: the r05 mix (24 reqs at ~6 req/s, 64 new tokens) was
-    ARRIVAL-bound — its 333 tok/s was within 12% of the workload's
-    theoretical ceiling, so no scheduler could have doubled it. This mix
-    (32 reqs at ~12 req/s, shared 512-token system prefix + random
-    tails, 96 new tokens) keeps the queue non-empty and exercises the
-    prefix cache, so throughput and the occupancy decomposition measure
-    the SCHEDULER; r05 numbers remain in BENCH_r05.json for reference
-    but are not directly comparable."""
+
+def _bench_serving():
+    """Continuous-batching serving engine under OPEN-LOOP load
+    (inference/loadgen): seeded Poisson arrivals at a rate chosen to
+    saturate, shared 512-token system prefix + lognormal long-tail user
+    prompts, mixed output lengths. Reference role: analysis_predictor
+    serving path.
+
+    Methodology changed in r07 with the unified-step/loadgen rewrite:
+    the r06 closed mix (32 reqs at ~12 req/s) was still partly
+    ARRIVAL-bound; this one keeps the queue deep for the whole run, so
+    throughput, TTFT/TPOT tails, and the occupancy decomposition measure
+    the SCHEDULER. r05/r06 numbers remain in their BENCH_r*.json files
+    but are not directly comparable. A second short run with
+    serving_speculative_k=4 reports the n-gram draft accept rate (the
+    decode stream itself is bit-identical by construction, so the arm
+    only reports rate + throughput)."""
     from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.inference.loadgen import (OpenLoopDriver,
+                                              WorkloadSpec, synthesize)
     from paddle_tpu.inference.serving import Request, ServingEngine
 
     cfg = LlamaConfig(vocab_size=32000, hidden=2048, n_layers=16,
                       n_heads=16, n_kv_heads=4, ffn_hidden=5504,
                       max_seq_len=2048, dtype=jnp.bfloat16)
-    # quantum 24 measured best under pipelined dispatch (8: 245, 16: 299,
-    # 24: 323, 32: 309, 48: 290 tok/s on the same chip state) — larger
-    # quanta amortize scheduling, smaller ones admit sooner; 24 balances
-    engine = ServingEngine(cfg, max_batch=8, page_size=128, max_seq=1536,
-                           prefill_budget=512, decode_quantum=24)
-    rng = np.random.RandomState(7)
-    n_req = 32
-    # shared system prefix (4 full pages): prefilled once, then mapped
-    # into every later request's block table by the prefix cache
-    prefix = rng.randint(1, cfg.vocab_size, size=512).astype(np.int32)
-    arrivals = np.cumsum(rng.exponential(1.0 / 12.0, n_req))  # ~12 req/s
-    tails = rng.choice([128, 256, 384, 512], n_req)
-    reqs = [Request(rid=i,
-                    prompt=np.concatenate(
-                        [prefix, rng.randint(1, cfg.vocab_size,
-                                             size=int(L)).astype(np.int32)]),
-                    max_new_tokens=96, arrival=float(t))
-            for i, (L, t) in enumerate(zip(tails, arrivals))]
-    # compile pass (ragged prefill grid + decode quantum) outside the
-    # timed run; the warm prompt spans multiple prefill dispatches
-    warm = [Request(rid=-1, prompt=np.ones(640, np.int32),
-                    max_new_tokens=2, arrival=0.0)]
-    engine.run(warm)
-    stats = engine.run(reqs)
-    return {
-        "serving_throughput_tok_s": stats["throughput_tok_s"],
-        "serving_latency_p50_s": stats["latency_p50_s"],
-        "serving_latency_p99_s": stats["latency_p99_s"],
-        "serving_ttft_p50_s": stats["ttft_p50_s"],
-        "serving_slot_occupancy": stats["slot_occupancy"],
-        # occupancy decomposition: where the non-decoding slot-tokens
-        # went (queue empty vs pool-blocked vs mid-prefill vs quantum
-        # overrun) — attributes any occupancy regression to its cause
-        "serving_occ_waste_queue_empty": stats["occ_waste_queue_empty"],
-        "serving_occ_waste_admission_blocked":
-            stats["occ_waste_admission_blocked"],
-        "serving_occ_waste_prefill": stats["occ_waste_prefill"],
-        "serving_occ_waste_overrun": stats["occ_waste_overrun"],
-        "serving_prefill_padding_frac": stats["prefill_padding_frac"],
-        "serving_prefix_cache_hit_rate": stats["prefix_cache_hit_rate"],
-    }
+
+    def mk_engine(**kw):
+        return ServingEngine(cfg, max_batch=8, page_size=128,
+                             max_seq=1536, prefill_budget=512, **kw)
+
+    spec = WorkloadSpec(n_requests=64, seed=7, vocab_size=cfg.vocab_size,
+                        process="poisson", rate=30.0,
+                        prefix_len=512, n_prefixes=1, shared_frac=0.9,
+                        tail_log_mean=5.3, tail_log_sigma=0.6,
+                        tail_min=32, tail_max=512,
+                        new_min=64, new_max=128, max_seq=1536)
+    reqs = synthesize(spec)
+    # compile pass (the unified grid) outside the timed run; the warm
+    # prompt spans multiple prefill rows and a decode row
+    def mk_warm():
+        return [Request(rid=-1, prompt=np.ones(640, np.int32),
+                        max_new_tokens=2, arrival=0.0)]
+
+    engine = mk_engine()
+    engine.run(mk_warm())
+    m = OpenLoopDriver(engine, clock="wall").run(reqs)
+    # speculative arm: same traffic shape, fewer requests — only the
+    # accept rate and throughput delta are the measurement
+    spec_wl = WorkloadSpec(n_requests=24, seed=7,
+                           vocab_size=cfg.vocab_size, process="poisson",
+                           rate=30.0, prefix_len=512, n_prefixes=1,
+                           shared_frac=0.9, tail_log_mean=5.3,
+                           tail_log_sigma=0.6, tail_min=32, tail_max=512,
+                           new_min=64, new_max=128, max_seq=1536)
+    eng2 = mk_engine(speculative_k=4)
+    eng2.run(mk_warm())
+    spec_m = OpenLoopDriver(eng2, clock="wall").run(synthesize(spec_wl))
+    return _serving_keys(m, spec_m)
 
 
 def _bench_loss_curve():

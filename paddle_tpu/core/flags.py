@@ -197,6 +197,18 @@ define_flag("serving_prefix_cache_pages", 0,
             "Max idle (refcount-0) pages the prefix cache retains; 0 = "
             "no cap beyond pool pressure (idle cached pages are evicted "
             "on demand when allocation would otherwise fail).")
+define_flag("serving_unified_qb", 16,
+            "Query-token width of one unified ragged-paged-attention row "
+            "(a decode step occupies 1 of its qb slots; a prefill chunk "
+            "fills up to qb). Need not divide the page size.")
+define_flag("serving_speculative_k", 0,
+            "Draft tokens verified per decode row via self-drafting "
+            "n-gram lookup (greedy-verify). 0 disables speculation; the "
+            "off path is bit-identical to the non-speculative engine.")
+define_flag("serving_spec_ngram", 3,
+            "Longest n-gram the speculative prompt-lookup proposer "
+            "matches against the request's history (falls back to "
+            "shorter grams down to 1).")
 
 define_flag("resilient_max_bad_steps", 3,
             "Consecutive NaN/Inf steps tolerated (skipped) before the "

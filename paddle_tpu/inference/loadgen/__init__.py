@@ -1,0 +1,22 @@
+"""Open-loop load generation for the serving engine.
+
+The serving bench was ARRIVAL-bound through round 5 (throughput within
+12% of the workload's own ceiling, occupancy 0.22) — a closed or
+under-provisioned generator measures the WORKLOAD, not the scheduler.
+This package owns the other side of the contract: seeded open-loop
+arrival processes (arrivals.py), realistic request mixes — shared
+prefixes, long-tail lengths, bursts (workload.py) — a driver that keeps
+the queue deep regardless of service rate and injects mid-run aborts
+(driver.py), and latency/goodput/occupancy reporting that reuses the
+engine's slot-token waste buckets (metrics.py).
+"""
+
+from .arrivals import burst_arrivals, gamma_arrivals, poisson_arrivals
+from .driver import OpenLoopDriver
+from .metrics import percentile, summarize
+from .workload import WorkloadSpec, synthesize
+
+__all__ = [
+    "OpenLoopDriver", "WorkloadSpec", "synthesize", "summarize",
+    "percentile", "poisson_arrivals", "gamma_arrivals", "burst_arrivals",
+]

@@ -1,0 +1,86 @@
+"""Open-loop driver: arrival-faithful traffic against a ServingEngine.
+
+Open loop means arrivals NEVER wait for the service side — every request
+is queued up front with its arrival stamp and the engine's admission
+sees it the moment the (wall or virtual) clock passes the stamp, however
+deep the backlog grows. This is the regime where a scheduler's occupancy
+and tail latency mean something; a closed loop self-throttles to the
+engine's pace and hides both.
+
+Two clocks:
+
+- ``wall`` (default): arrivals happen in real time — what a bench round
+  on hardware wants.
+- ``rush``: every request's arrival is treated as already-passed (the
+  driver passes now = +inf). The queue is maximally deep from step 0 —
+  deterministic saturation for CPU smoke tests, where real arrival
+  pacing would be noise.
+
+Abort injection: ``aborts`` maps a wall/step threshold to a rid; the
+driver fires each abort the first step after its threshold passes,
+exercising mid-flight teardown under load.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from .metrics import summarize
+
+__all__ = ["OpenLoopDriver"]
+
+
+class OpenLoopDriver:
+    def __init__(self, engine, clock: str = "wall"):
+        if clock not in ("wall", "rush"):
+            raise ValueError(f"unknown clock '{clock}'")
+        self.engine = engine
+        self.clock = clock
+
+    def run(self, requests, aborts: Optional[dict] = None,
+            max_steps: int = 0) -> dict:
+        """Drive ``requests`` to completion; returns metrics.summarize().
+
+        ``aborts``: {threshold: rid} — wall seconds ("wall" clock) or
+        step index ("rush" clock) after which the rid is aborted.
+        ``max_steps``: safety valve; 0 derives a generous bound from the
+        workload (smoke tests fail loudly instead of hanging)."""
+        eng = self.engine
+        for r in sorted(requests, key=lambda r: r.arrival):
+            eng.submit(r)
+        eng.stats = {k: 0 for k in eng.stats}
+        pending = sorted((aborts or {}).items())
+        if not max_steps:
+            total = sum(r.max_new_tokens + len(r.prompt)
+                        for r in requests)
+            max_steps = 200 + 4 * total
+        t0 = time.monotonic()
+        steps = 0
+        while True:
+            now = (1e18 if self.clock == "rush"
+                   else time.monotonic() - t0)
+            gate = steps if self.clock == "rush" else now
+            while pending and pending[0][0] <= gate:
+                eng.abort(pending.pop(0)[1])
+            if not eng.step(now=now):
+                break
+            steps += 1
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"open-loop driver: engine did not drain in "
+                    f"{max_steps} steps")
+            if self.clock == "wall" and not any(
+                    s is not None for s in eng.slots) \
+                    and eng._inflight is None and eng.queue:
+                nxt = min(r.arrival for r in eng.queue)
+                wait = max(0.0, nxt - (time.monotonic() - t0))
+                time.sleep(min(max(wait, 0.001), 0.05))
+        wall = time.monotonic() - t0
+        if eng._deferred_free or eng.pool.pending_evict:
+            eng.pool.release(eng._deferred_free)
+            eng._deferred_free = []
+            eng.pool.commit_evictable()
+        out = summarize(requests, eng, wall)
+        out["steps"] = steps
+        return out

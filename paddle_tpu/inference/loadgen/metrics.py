@@ -1,0 +1,80 @@
+"""Serving metrics over a completed open-loop run.
+
+Latency definitions (all relative to each request's ARRIVAL, the
+open-loop convention — queueing delay counts against the scheduler):
+
+- TTFT: first token wall time - arrival.
+- TPOT: (last token - first token) / (n_tokens - 1) — steady decode
+  pace, undefined (excluded) for 1-token requests.
+- e2e: completion - arrival.
+- goodput: completed-request tokens per second (aborted/incomplete
+  requests' tokens are excluded; raw throughput counts them).
+- occupancy: the engine's slot-token ledger, reused as-is — active
+  fraction plus the five waste buckets (queue-empty, admission-blocked,
+  prefill, overrun, spec-rejected) sum to 1 by construction, so a drop
+  in occupancy always carries its cause.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["percentile", "summarize"]
+
+
+def percentile(xs, p: float) -> float:
+    if not len(xs):
+        return 0.0
+    return float(np.percentile(np.asarray(xs, np.float64), p))
+
+
+def summarize(requests, engine, wall_s: float) -> dict:
+    """Aggregate per-request records + the engine's step ledger into the
+    bench-facing metric dict."""
+    done = [r for r in requests
+            if not r.aborted and r.t_done is not None
+            and len(r.out_tokens) >= r.max_new_tokens]
+    aborted = [r for r in requests if r.aborted]
+    ttft = [r.t_first - r.arrival for r in done if r.t_first is not None]
+    e2e = [r.t_done - r.arrival for r in done]
+    tpot = [(r.t_done - r.t_first) / (len(r.out_tokens) - 1)
+            for r in done
+            if r.t_first is not None and len(r.out_tokens) > 1]
+    total_tok = sum(len(r.out_tokens) for r in requests)
+    good_tok = sum(len(r.out_tokens) for r in done)
+    st = engine.stats
+    slot_tok = max(1, st["decode_slot_tokens"])
+    out = {
+        "n_requests": len(requests),
+        "n_completed": len(done),
+        "n_aborted": len(aborted),
+        "wall_s": round(wall_s, 3),
+        "total_new_tokens": total_tok,
+        "throughput_tok_s": round(total_tok / max(wall_s, 1e-9), 1),
+        "goodput_tok_s": round(good_tok / max(wall_s, 1e-9), 1),
+        "ttft_p50_s": round(percentile(ttft, 50), 4),
+        "ttft_p99_s": round(percentile(ttft, 99), 4),
+        "tpot_p50_s": round(percentile(tpot, 50), 5),
+        "tpot_p99_s": round(percentile(tpot, 99), 5),
+        "e2e_p50_s": round(percentile(e2e, 50), 4),
+        "e2e_p99_s": round(percentile(e2e, 99), 4),
+        "slot_occupancy": round(st["decode_active_tokens"] / slot_tok, 3),
+        "occ_waste_queue_empty": round(
+            st["waste_queue_empty_slot_tokens"] / slot_tok, 3),
+        "occ_waste_admission_blocked": round(
+            st["waste_admission_blocked_slot_tokens"] / slot_tok, 3),
+        "occ_waste_prefill": round(
+            st["waste_prefill_slot_tokens"] / slot_tok, 3),
+        "occ_waste_overrun": round(
+            st["waste_overrun_slot_tokens"] / slot_tok, 3),
+        "occ_waste_spec_rejected": round(
+            st["waste_spec_rejected_slot_tokens"] / slot_tok, 3),
+        "spec_accept_rate": round(
+            st["spec_accepted_tokens"] / st["spec_proposed_tokens"], 3)
+        if st["spec_proposed_tokens"] else 0.0,
+        "prefix_cache_hit_rate": round(
+            engine.pool.hits / (engine.pool.hits + engine.pool.misses),
+            3) if engine.pool.hits + engine.pool.misses else 0.0,
+        "unified_steps": st["unified_steps"],
+    }
+    return out

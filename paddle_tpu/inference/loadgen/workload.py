@@ -1,0 +1,101 @@
+"""Workload synthesis: seeded request mixes for the open-loop driver.
+
+A spec pins everything the scheduler is sensitive to — arrival process,
+shared-prefix structure (exercises the prefix cache and page refcounts),
+long-tail prompt lengths (exercises chunked prefill packing and the
+admission skip/aging path), output lengths, and the sampled/greedy mix
+(sampled rows exercise the in-program top-p path) — behind one seed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from ..serving import Request
+from .arrivals import burst_arrivals, gamma_arrivals, poisson_arrivals
+
+__all__ = ["WorkloadSpec", "synthesize"]
+
+
+@dataclasses.dataclass
+class WorkloadSpec:
+    """One reproducible traffic mix. Lengths are token counts."""
+
+    n_requests: int = 256
+    seed: int = 0
+    vocab_size: int = 32000
+    # arrival process: "poisson" | "gamma" | "burst"
+    process: str = "poisson"
+    rate: float = 10.0                   # mean req/s
+    cv: float = 2.0                      # gamma only
+    burst_size: int = 8                  # burst only
+    # shared prefixes: ``shared_frac`` of requests start with one of
+    # ``n_prefixes`` fixed prefixes of ``prefix_len`` tokens (system
+    # prompts); the rest are fully random
+    n_prefixes: int = 2
+    prefix_len: int = 0                  # 0 disables sharing
+    shared_frac: float = 0.7
+    # long-tail tail lengths: lognormal(mean of log, sigma) clamped to
+    # [tail_min, tail_max] — a heavy right tail, the realistic shape
+    tail_log_mean: float = 4.0           # exp(4) ~ 55 tokens median
+    tail_log_sigma: float = 0.8
+    tail_min: int = 4
+    tail_max: int = 512
+    # output lengths: uniform in [new_min, new_max]
+    new_min: int = 16
+    new_max: int = 96
+    # sampling mix
+    sampled_frac: float = 0.0
+    temperature: float = 0.8
+    top_p: float = 0.9
+    max_seq: Optional[int] = None        # clamp prompt+new when set
+
+
+def synthesize(spec: WorkloadSpec) -> list[Request]:
+    """Materialize the spec into arrival-stamped Requests (rid = arrival
+    order)."""
+    rng = np.random.RandomState(spec.seed)
+    n = spec.n_requests
+    if spec.process == "poisson":
+        arrivals = poisson_arrivals(spec.rate, n, spec.seed)
+    elif spec.process == "gamma":
+        arrivals = gamma_arrivals(spec.rate, spec.cv, n, spec.seed)
+    elif spec.process == "burst":
+        arrivals = burst_arrivals(spec.rate, n, spec.seed,
+                                  burst_size=spec.burst_size)
+    else:
+        raise ValueError(f"unknown arrival process '{spec.process}'")
+    prefixes = [rng.randint(1, spec.vocab_size,
+                            size=spec.prefix_len).astype(np.int32)
+                for _ in range(spec.n_prefixes)] if spec.prefix_len else []
+    reqs = []
+    for i in range(n):
+        tail_len = int(np.clip(
+            np.round(rng.lognormal(spec.tail_log_mean,
+                                   spec.tail_log_sigma)),
+            spec.tail_min, spec.tail_max))
+        tail = rng.randint(1, spec.vocab_size,
+                           size=tail_len).astype(np.int32)
+        if prefixes and rng.rand() < spec.shared_frac:
+            prompt = np.concatenate([prefixes[rng.randint(
+                len(prefixes))], tail])
+        else:
+            prompt = tail
+        max_new = int(rng.randint(spec.new_min, spec.new_max + 1))
+        if spec.max_seq is not None:
+            # clamp to engine capacity: trim the tail first, then new
+            over = len(prompt) + max_new - spec.max_seq
+            if over > 0:
+                keep = max(spec.tail_min, len(prompt) - over)
+                prompt = prompt[:keep]
+                max_new = min(max_new, spec.max_seq - len(prompt))
+        kw = {}
+        if rng.rand() < spec.sampled_frac:
+            kw = dict(temperature=spec.temperature, top_p=spec.top_p,
+                      seed=int(rng.randint(1 << 30)))
+        reqs.append(Request(rid=i, prompt=prompt, max_new_tokens=max_new,
+                            arrival=float(arrivals[i]), **kw))
+    return reqs

@@ -5,42 +5,43 @@ reference's AnalysisPredictor + fused_multi_transformer serving path
 (fluid/inference/api/analysis_predictor.cc:1657; block_multi_head_attention
 for the paged cache). TPU design:
 
-- TWO compiled programs, static shapes: ONE chunked ragged prefill over
-  a fixed token budget (prompts split into page-size chunks; each step
-  packs up to ``prefill_budget // page_size`` chunks from any number of
-  requests into a static ``[n_chunks, page_size]`` token grid, with
-  per-chunk slot/position indices as DATA — "Ragged Paged Attention",
-  arxiv 2604.15464) and ONE batched decode step over all ``max_batch``
-  slots. Requests at different positions/lengths share both programs —
-  per-request state is data (block tables, seq_lens, chunk indices),
-  never shape. A 1024-token prompt no longer monopolizes the device
-  between decode quanta: it contributes budget-sized slices that
-  interleave with other requests' chunks and decode quanta.
+- ONE compiled program per engine step, static shapes ("Ragged Paged
+  Attention", arxiv 2604.15464): a fixed ``[n_rows, qb]`` token grid
+  where every row is a chunk of ONE request — a decode step is simply a
+  chunk with one valid token, a prefill slice fills up to ``qb``, and a
+  speculative decode row verifies k drafts as a (k+1)-token chunk.
+  Arbitrary prefill/decode mixes share the program; per-request state
+  (block tables, start positions, valid counts, sampling params) is
+  DATA, never shape. There is no prefill-program/decode-quantum
+  boundary: decode tokens and prefill chunks pack into the same token
+  budget, so a 1024-token prompt contributes budget-sized slices that
+  ride the same dispatch as every other request's decode row.
 - vLLM-style paged KV: per-layer page arrays, physical pages allocated
   per request from a free list and returned on completion; page 0 is a
-  write sink for idle slots so the batched program needs no masking
-  branches. k pages are d-major — the MXU decode kernel's native operand
-  (ops/pallas/decode_attention.py paged_decode_attention_mxu).
+  write sink for idle rows and padding tokens so the batched program
+  needs no masking branches. k pages are d-major — the MXU kernel's
+  native operand (ops/pallas/ragged_paged_attention.py).
 - Prefix caching: page-aligned prompt chunks are content-hashed
   (cumulative chain, so a hit implies the whole prefix matches) and the
   pool refcounts cached pages. A shared system prompt is prefilled ONCE;
   later requests map the cached pages into their block tables and skip
-  those chunks entirely (the prefill-token counter proves zero redundant
+  those tokens entirely (the prefill-token counter proves zero redundant
   FLOPs). Only the page holding the last prompt token is always
-  re-prefilled — its logits produce the first token. Copy-on-write is
-  implicit: the partial tail page is never cached, so every request owns
-  the page it appends to.
+  re-prefilled — its logits produce the first token.
 - Continuous batching: the scheduler admits queued requests into free
-  slots between decode quanta (admission is page-pool-bound only — no
-  prompt buckets), chunked prefill interleaves with decode, and a
-  pool-blocked large request is skipped (with an aging barrier) so it
-  cannot head-of-line-block smaller requests that fit.
+  slots every step (admission is page-pool-bound only — no prompt
+  buckets), and a pool-blocked large request is skipped (with an aging
+  barrier) so it cannot head-of-line-block smaller requests that fit.
+- Speculative multi-token decode (``serving_speculative_k`` > 0): a
+  host-side n-gram prompt-lookup proposer drafts up to k tokens per
+  decode row; the unified step verifies them as a (k+1)-token chunk.
+  Greedy-accept + keyed sampling make the accepted stream bit-identical
+  to the non-speculative stream (inference/speculative.py).
 """
 
 from __future__ import annotations
 
 import dataclasses
-import functools
 import hashlib
 import math
 import time
@@ -81,17 +82,18 @@ class Request:
 
 
 def _pick_tokens(logits, temps, topps, seeds, positions):
-    """Next-token selection for a batch of slots, IN-program.
+    """Next-token selection for a batch of rows, IN-program.
 
     temperature 0 -> greedy argmax; >0 -> top-p (nucleus) sampling at
     that temperature (the reference serving path's fused top_p_sampling
     kernel, phi/kernels/fusion/gpu/top_p_sampling.cu role). Greedy-only
     batches skip the sort entirely through lax.cond — sampling params
-    are per-slot DATA, so mixed batches share one compiled program.
+    are per-row DATA, so mixed batches share one compiled program.
     Randomness is keyed (seed, position-of-input-token): a request's
-    sample stream is reproducible and independent of quantum AND prefill
-    chunk boundaries.
-    logits [B, V] fp32; temps/topps [B] fp32; seeds/positions [B] int32.
+    sample stream is reproducible and independent of chunk packing,
+    budget, AND speculative verification (a draft position's key is the
+    same whether it is verified speculatively or decoded one-by-one).
+    logits [N, V] fp32; temps/topps [N] fp32; seeds/positions [N] int32.
     """
 
     def greedy(_):
@@ -208,9 +210,11 @@ class _PagePool:
 class ServingEngine:
     """Continuous-batching LLaMA serving over paged KV.
 
-    ``step()`` = admissions + one chunked ragged-prefill dispatch + one
-    batched decode tick; ``run(requests)`` drives wall-clock arrivals to
-    completion and returns latency/throughput/occupancy stats.
+    ``step()`` = admissions + ONE unified ragged-paged-attention
+    dispatch (decode rows + prefill chunks in the same token grid) +
+    harvest of the previous dispatch; ``run(requests)`` drives
+    wall-clock arrivals to completion and returns latency/throughput/
+    occupancy stats.
     """
 
     def __init__(self, cfg: LlamaConfig, params: Optional[dict] = None,
@@ -221,7 +225,10 @@ class ServingEngine:
                  prefix_cache_pages: Optional[int] = None,
                  decode_quantum: int = 8,
                  admit_aging: int = 64,
-                 weight_only_int8: bool = False):
+                 weight_only_int8: bool = False,
+                 qb: Optional[int] = None,
+                 speculative_k: Optional[int] = None,
+                 spec_ngram: Optional[int] = None):
         self.cfg = cfg
         self.params = params if params is not None else init_llama_params(
             cfg, jax.random.PRNGKey(seed))
@@ -229,9 +236,9 @@ class ServingEngine:
                 self.params["blocks"]["wq"], tuple):
             # halves weight HBM (per-column absmax int8 + bf16 scales;
             # embeddings/norms stay high precision) — every matmul in the
-            # prefill/decode programs flows through the tuple-aware _mm,
-            # so the compiled paths need no changes. The tuple check
-            # skips params that arrive already quantized.
+            # unified program flows through the tuple-aware _mm, so the
+            # compiled path needs no changes. The tuple check skips
+            # params that arrive already quantized.
             self.params = quantize_weights_int8(self.params)
         self.B = max_batch
         self.bs = page_size
@@ -245,10 +252,33 @@ class ServingEngine:
         if prefix_cache_pages is None:
             prefix_cache_pages = GLOBAL_FLAGS.get(
                 "serving_prefix_cache_pages")
-        self.n_chunks = max(1, prefill_budget // page_size)
-        self.prefill_budget = self.n_chunks * page_size
+        if qb is None:
+            qb = GLOBAL_FLAGS.get("serving_unified_qb")
+        if speculative_k is None:
+            speculative_k = GLOBAL_FLAGS.get("serving_speculative_k")
+        if spec_ngram is None:
+            spec_ngram = GLOBAL_FLAGS.get("serving_spec_ngram")
+        # unified grid: n_rows chunks of qb tokens each. Every decoding
+        # slot gets one row per step, remaining rows carry prefill
+        # slices, so n_rows >= max_batch.
+        self.qb = max(1, qb)
+        self.n_rows = max(1, prefill_budget // self.qb, max_batch)
+        self.prefill_budget = self.n_rows * self.qb
+        self.n_chunks = self.n_rows       # historical alias (pre-PR 7)
+        # a decode row holds 1 input token + up to qb-1 verified drafts
+        self.spec_k = max(0, min(int(speculative_k), self.qb - 1))
+        if self.spec_k:
+            from .speculative import NgramProposer
+
+            self._proposer = NgramProposer(max_ngram=max(1, spec_ngram))
+        else:
+            self._proposer = None
         self._cache_on = bool(prefix_cache)
         self.admit_aging = admit_aging
+        # decode_quantum is accepted for API compatibility with the
+        # pre-unified engine (prefill program + decode quanta); the
+        # unified step has no quantum boundary, so it is unused.
+        self.decode_quantum = max(1, decode_quantum)
         L, nKV, d = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
         self.k_pages = jnp.zeros((L, self.n_pages, nKV, d, self.bs),
                                  cfg.dtype)
@@ -266,77 +296,87 @@ class ServingEngine:
         # teardown; shared pages are prefix-cache mappings and only lose
         # a refcount. _full_rows is the request's REAL block-table row;
         # self.table holds the DECODE view (sink row until the prefill
-        # flip, so mid-prefill slots write junk to page 0 only).
+        # flip, kept for abort/teardown compatibility).
         self._slot_owned: list[list[int]] = [[] for _ in range(self.B)]
         self._slot_shared: list[list[int]] = [[] for _ in range(self.B)]
         self._slot_hashes: list[list[bytes]] = [[] for _ in range(self.B)]
         self._slot_nshared: list[int] = [0] * self.B
+        self._slot_offered: list[int] = [0] * self.B
         self._full_rows = np.zeros((self.B, self.max_blocks), np.int32)
         # slot -> next prompt position to prefill; dict order = admission
         # order, so chunk packing stays FIFO across requests
         self._prefilling: dict[int, int] = {}
         self.pool = _PagePool(self.n_pages, cache_limit=prefix_cache_pages)
         self.queue: list[Request] = []
-        # Decode runs in QUANTA of K steps per dispatch (one lax.scan
-        # program): over remote-device links a host round-trip costs
-        # ~100 ms, so per-token dispatch would bound throughput at
-        # ~10 steps/s regardless of the kernels. The scheduler touches
-        # the batch (admissions/finishes) between quanta; a request
-        # finishing mid-quantum wastes at most K-1 slot-steps (its junk
-        # tokens write into its own or the sink pages and are discarded).
-        self.decode_quantum = max(1, decode_quantum)
-        self._decode = jax.jit(
-            functools.partial(self._decode_n_impl, n=self.decode_quantum),
-            donate_argnums=(1, 2))
-        self._prefill = jax.jit(self._ragged_prefill_impl,
+        self._unified = jax.jit(self._unified_step_impl,
                                 donate_argnums=(1, 2))
-        # decode pipelining state (see step() docstring)
-        self._inflight = None              # (toks_dev [K+1, B], snapshot)
-        self._cur_tok_dev = None           # device-chained token vector
-        # _pending_first: slots whose prefill first token rides the next
-        # quantum's output row 0; _deferred_free: page ids held for one
-        # harvest cycle (an in-flight program may still write them)
-        self._cur_patches: dict = {}       # slot -> first-token dev scalar
-        self._pending_first: set = set()
+        # pipelining state (see step() docstring): _inflight holds the
+        # dispatched-but-unharvested program's (output tokens, row
+        # snapshot); _prev_out_dev chains row outputs on-device into the
+        # next dispatch; _deferred_free holds page ids for one harvest
+        # cycle (an in-flight program may still write them)
+        self._inflight = None              # (out_dev [C, 1|qb], snapshot)
+        self._prev_out_dev = None
         self._deferred_free: list[int] = []
         self.stats = {
-            "decode_steps": 0, "prefills": 0,
+            "unified_steps": 0, "decode_steps": 0, "prefills": 0,
             "prefill_tokens": 0, "prefill_grid_tokens": 0,
             "prefill_cached_tokens": 0,
             "decode_slot_tokens": 0, "decode_active_tokens": 0,
             # slot_occupancy decomposition (all in slot-token units, so
-            # active + the four waste buckets == decode_slot_tokens):
+            # active + the five waste buckets == decode_slot_tokens):
             "waste_prefill_slot_tokens": 0,        # slot mid-prefill
             "waste_queue_empty_slot_tokens": 0,    # idle, nothing arrived
             "waste_admission_blocked_slot_tokens": 0,  # idle, pool-blocked
-            "waste_overrun_slot_tokens": 0,        # mid-quantum finish
+            "waste_overrun_slot_tokens": 0,        # aborted/over-produced
+            "waste_spec_rejected_slot_tokens": 0,  # rejected draft tokens
+            "spec_proposed_tokens": 0, "spec_accepted_tokens": 0,
         }
 
-    # -- compiled programs --------------------------------------------------
+    # -- compiled program ---------------------------------------------------
 
-    def _ragged_prefill_impl(self, params, k_pages, v_pages, tokens,
-                             ptable, chunk_slot, pos0, last_off, temps,
-                             topps, seeds):
-        """ONE chunked ragged prefill program: ``n_chunks`` page-size
-        chunks from ANY number of requests through the transformer, k/v
-        written whole-page into each chunk's own page, attention ragged
-        over each owning request's block-table row (ops/pallas/
-        ragged_prefill.py). All raggedness is data: tokens [C, bs];
-        ptable [B+1, max_blocks] (row B = sink row for idle chunks);
-        chunk_slot/pos0/last_off [C] int32; temps/topps/seeds [C].
-        Returns (first tokens [C] — only final chunks' entries are used
-        by the scheduler — and the updated page arrays)."""
+    def _unified_step_impl(self, params, k_pages, v_pages, tokens,
+                           prev_out, chain_mask, chain_row, ptable,
+                           row_slot, pos0, n_valid, temps, topps, seeds):
+        """THE engine step: one ``[n_rows, qb]`` unified ragged-paged-
+        attention program serving an arbitrary prefill/decode mix. Row c
+        holds n_valid[c] tokens of request row_slot[c] starting at
+        position pos0[c] — a decode row is n_valid == 1 (plus drafts
+        when speculating), a prefill slice up to qb, an idle row targets
+        the sink block-table row (row_slot == B). All raggedness is
+        data: tokens [C, qb]; ptable [B+1, max_blocks]; row_slot/pos0/
+        n_valid [C] int32; temps/topps/seeds [C].
+
+        ``chain_mask``/``chain_row`` splice the PREVIOUS dispatch's row
+        outputs into this dispatch's first-token column in-program, so
+        the pipelined scheduler feeds decode continuations (and the
+        prefill-final -> first-decode handoff) without a host round trip
+        — the ~100 ms remote-tunnel sync per step overlaps device
+        compute instead of serializing with it.
+
+        Returns (out, k_pages, v_pages): out [C, 1] — each row's pick
+        after its last valid token — or [C, qb] with per-position picks
+        when speculative verification needs the full ladder. Per-token
+        KV scatter: valid tokens write their own (page, offset), padding
+        tokens hit the sink page, so garbage never lands in request
+        pages (write-before-attend, per layer)."""
         cfg = self.cfg
-        C, bs = tokens.shape
+        C, qb = tokens.shape
         nH, nKV, dH = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-        from ..ops.pallas.ragged_prefill import ragged_prefill_attention
+        from ..ops.pallas.ragged_paged_attention import \
+            ragged_paged_attention
 
-        rows = ptable[chunk_slot]                        # [C, max_blocks]
-        page_idx = jnp.take_along_axis(rows, (pos0 // bs)[:, None],
-                                       axis=1)[:, 0]     # chunk's own page
-        x = params["wte"][tokens].astype(cfg.dtype)      # [C, bs, H]
-        positions = pos0[:, None] + jnp.arange(bs, dtype=jnp.int32)
-        cos, sin = rope_angles(cfg, positions)           # [C, bs, dH/2]
+        tok0 = jnp.where(chain_mask, prev_out[chain_row, 0], tokens[:, 0])
+        tokens = jnp.concatenate([tok0[:, None], tokens[:, 1:]], axis=1)
+        rows = ptable[row_slot]                      # [C, max_blocks]
+        positions = pos0[:, None] + jnp.arange(qb, dtype=jnp.int32)
+        valid = jnp.arange(qb, dtype=jnp.int32)[None, :] < n_valid[:, None]
+        blk = positions // self.bs
+        offs = (positions % self.bs).reshape(-1)
+        pages = jnp.where(valid, jnp.take_along_axis(rows, blk, axis=1),
+                          0).reshape(-1)             # padding -> sink
+        x = params["wte"][tokens].astype(cfg.dtype)  # [C, qb, H]
+        cos, sin = rope_angles(cfg, positions)       # [C, qb, dH/2]
         cos, sin = cos[:, :, None, :], sin[:, :, None, :]
         sm_scale = 1.0 / math.sqrt(dH)
 
@@ -344,25 +384,18 @@ class ServingEngine:
             x = carry
             bp, kp, vp = inp
             h = rms_norm(x, bp["attn_norm"], cfg.rms_eps)
-            q = _mm(h, bp["wq"], cfg).reshape(C, bs, nH, dH)
-            k = _mm(h, bp["wk"], cfg).reshape(C, bs, nKV, dH)
-            v = _mm(h, bp["wv"], cfg).reshape(C, bs, nKV, dH)
+            q = _mm(h, bp["wq"], cfg).reshape(C, qb, nH, dH)
+            k = _mm(h, bp["wk"], cfg).reshape(C, qb, nKV, dH)
+            v = _mm(h, bp["wv"], cfg).reshape(C, qb, nKV, dH)
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
-            # whole-page scatter (a chunk IS one page; write-before-
-            # attend, like the decode tick). Idle chunks all target the
-            # sink page — duplicate garbage writes there are harmless.
-            # Garbage k/v past a final chunk's last valid token lands in
-            # the request's OWN tail page, is masked (kpos <= qpos) for
-            # every valid query, and is overwritten by the decode tick
-            # before it could ever be attended.
-            kp = kp.at[page_idx].set(
-                jnp.transpose(k, (0, 2, 3, 1)).astype(kp.dtype))
-            vp = vp.at[page_idx].set(
-                jnp.transpose(v, (0, 2, 1, 3)).astype(vp.dtype))
-            o = ragged_prefill_attention(q, kp, vp, rows, pos0, sm_scale,
-                                         k_layout="d_major")
-            x = x + _mm(o.reshape(C, bs, nH * dH), bp["wo"], cfg)
+            kp = kp.at[pages, :, :, offs].set(
+                k.reshape(C * qb, nKV, dH).astype(kp.dtype))
+            vp = vp.at[pages, :, offs].set(
+                v.reshape(C * qb, nKV, dH).astype(vp.dtype))
+            o = ragged_paged_attention(q, kp, vp, rows, pos0, n_valid,
+                                       sm_scale, k_layout="d_major")
+            x = x + _mm(o.reshape(C, qb, nH * dH), bp["wo"], cfg)
             h = rms_norm(x, bp["ffn_norm"], cfg.rms_eps)
             x = x + _mm(jax.nn.silu(
                 _mm(h, bp["w_gate"], cfg).astype(jnp.float32)).astype(
@@ -372,92 +405,27 @@ class ServingEngine:
         x, (ks, vs) = lax.scan(body, x, (params["blocks"], k_pages,
                                          v_pages))
         x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-        last = x[jnp.arange(C), last_off]                # [C, H]
-        logits = _mm(last[:, None], params["head"], cfg).astype(
-            jnp.float32)[:, 0]
-        # first token selected IN-program (greedy or sampled per the
-        # request): the scheduler never fetches prefill results (async
-        # admission — the token reaches the host as row 0 of the next
-        # quantum's output). Randomness keys on the LAST PROMPT position
-        # (pos0 + last_off = T - 1 for a final chunk), matching the
-        # decode ticks' input-position keying — sampled streams are
-        # bit-identical across chunk/budget boundaries.
-        firsts = _pick_tokens(logits, temps, topps, seeds, pos0 + last_off)
-        return firsts, ks, vs
-
-    def _decode_n_impl(self, params, k_pages, v_pages, tokens, patch_mask,
-                       patch_vals, table, seq_lens, temps, topps, seeds,
-                       *, n):
-        """``n`` decode ticks in ONE program: scan over the single-tick
-        body, feeding each tick's selected token (greedy argmax or
-        per-slot top-p sample — _pick_tokens) to the next.
-        ``tokens`` chains on-device from the previous quantum's output;
-        ``patch_mask``/``patch_vals`` ([B] bool/int32) overlay the first
-        tokens of slots admitted since — IN-program, so the pipelined
-        scheduler issues zero per-dispatch eager ops (each distinct
-        eager-op shape costs a fresh remote compile over the tunnel —
-        measured up to 12 s of compile stalls per serving run).
-        Returns (toks_all [n+1, B], last_tok [B], k_pages, v_pages):
-        row 0 of toks_all is the PATCHED input vector — for slots
-        admitted since the previous quantum that row carries the prefill
-        first token, so async admission needs no separate fetch."""
-        tokens = jnp.where(patch_mask, patch_vals, tokens)
-
-        def tick(carry, _):
-            kp, vp, tok, sl = carry
-            logits, kp, vp = self._decode_impl(params, kp, vp, tok, table,
-                                               sl)
-            nxt = _pick_tokens(logits, temps, topps, seeds, sl)
-            return (kp, vp, nxt, sl + 1), nxt
-
-        (k_pages, v_pages, last, _), toks = lax.scan(
-            tick, (k_pages, v_pages, tokens, seq_lens), None, length=n)
-        return (jnp.concatenate([tokens[None], toks], axis=0), last,
-                k_pages, v_pages)
-
-    def _decode_impl(self, params, k_pages, v_pages, tokens, table,
-                     seq_lens):
-        """One decode tick for ALL slots: tokens [B] (idle slots: token 0
-        into the sink page), per-request positions = seq_lens. Returns
-        (logits [B, V], k_pages, v_pages)."""
-        cfg = self.cfg
-        B = tokens.shape[0]
-        nH, nKV, dH = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-        from ..incubate.nn.functional.fused_transformer import \
-            paged_decode_attention
-
-        x = params["wte"][tokens].astype(cfg.dtype)[:, None]   # [B, 1, H]
-        cos, sin = rope_angles(cfg, seq_lens)                  # [B, dH/2]
-        cos, sin = cos[:, None, None, :], sin[:, None, None, :]
-        blk = seq_lens // self.bs
-        off = seq_lens % self.bs
-        pages_b = jnp.take_along_axis(table, blk[:, None], axis=1)[:, 0]
-
-        def body(carry, inp):
-            x = carry
-            bp, kp, vp = inp
-            h = rms_norm(x, bp["attn_norm"], cfg.rms_eps)
-            q = _mm(h, bp["wq"], cfg).reshape(B, 1, nH, dH)
-            k = _mm(h, bp["wk"], cfg).reshape(B, 1, nKV, dH)
-            v = _mm(h, bp["wv"], cfg).reshape(B, 1, nKV, dH)
-            q = apply_rope(q, cos, sin)
-            k = apply_rope(k, cos, sin)
-            kp = kp.at[pages_b, :, :, off].set(k[:, 0].astype(kp.dtype))
-            vp = vp.at[pages_b, :, off].set(v[:, 0].astype(vp.dtype))
-            o = paged_decode_attention(q, kp, vp, table, seq_lens + 1,
-                                       k_layout="d_major")
-            x = x + _mm(o.reshape(B, 1, nH * dH), bp["wo"], cfg)
-            h = rms_norm(x, bp["ffn_norm"], cfg.rms_eps)
-            x = x + _mm(jax.nn.silu(
-                _mm(h, bp["w_gate"], cfg).astype(jnp.float32)).astype(
-                    cfg.dtype) * _mm(h, bp["w_up"], cfg), bp["w_down"], cfg)
-            return x, (kp, vp)
-
-        x, (ks, vs) = lax.scan(body, x, (params["blocks"], k_pages,
-                                         v_pages))
-        x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-        logits = _mm(x, params["head"], cfg).astype(jnp.float32)
-        return logits[:, 0], ks, vs
+        if self.spec_k:
+            # speculative verify needs the model's pick at EVERY draft
+            # position; keying on each input position keeps the accepted
+            # stream identical to one-token-at-a-time decoding
+            logits = _mm(x, params["head"], cfg).astype(jnp.float32)
+            picks = _pick_tokens(
+                logits.reshape(C * qb, -1), jnp.repeat(temps, qb),
+                jnp.repeat(topps, qb), jnp.repeat(seeds, qb),
+                positions.reshape(-1))
+            out = picks.reshape(C, qb)
+        else:
+            last = x[jnp.arange(C), n_valid - 1]     # [C, H]
+            logits = _mm(last[:, None], params["head"], cfg).astype(
+                jnp.float32)[:, 0]
+            # keyed on the LAST VALID input position (pos0 + n_valid - 1
+            # = T - 1 for a final prefill chunk, the input token's
+            # position for a decode row) — sampled streams are
+            # bit-identical across chunk/budget/packing boundaries
+            out = _pick_tokens(logits, temps, topps, seeds,
+                               pos0 + n_valid - 1)[:, None]
+        return out, ks, vs
 
     # -- scheduler ----------------------------------------------------------
 
@@ -477,9 +445,9 @@ class ServingEngine:
     def abort(self, rid: int) -> bool:
         """Cancel a request by rid, wherever it is: queued (removed) or
         slot-resident (pages released through the deferred-free path —
-        an in-flight quantum or this step's prefill may still write
-        them; tokens an in-flight quantum produces for it are discarded
-        at harvest). Returns False if the rid is unknown/already done."""
+        an in-flight program may still write them; tokens an in-flight
+        program produces for it are discarded at harvest). Returns False
+        if the rid is unknown/already done."""
         now = time.monotonic()
         for i, r in enumerate(self.queue):
             if r.rid == rid:
@@ -494,8 +462,6 @@ class ServingEngine:
                 req.t_done = now
                 self._release_slot_pages(s, defer=True)
                 self._prefilling.pop(s, None)
-                self._cur_patches.pop(s, None)
-                self._pending_first.discard(s)
                 self.table[s] = 0
                 self.seq_lens[s] = 0
                 self.cur_tok[s] = 0
@@ -562,6 +528,7 @@ class ServingEngine:
             self._slot_owned[slot] = pages
             self._slot_hashes[slot] = hashes
             self._slot_nshared[slot] = n_shared
+            self._slot_offered[slot] = n_shared
             row = np.zeros((self.max_blocks,), np.int32)
             row[:n_shared] = shared
             row[n_shared:n_blk] = pages
@@ -574,87 +541,6 @@ class ServingEngine:
             # only tokens actually run)
             self._prefilling[slot] = n_shared * self.bs
             self.stats["prefill_cached_tokens"] += n_shared * self.bs
-
-    def _dispatch_prefill(self) -> None:
-        """Pack up to ``n_chunks`` page-size chunks from the prefilling
-        slots (FIFO) into ONE ragged prefill dispatch. A request whose
-        final chunk is in this dispatch FLIPS to decoding: its real
-        block-table row becomes the decode view, its first token patches
-        the next quantum's token feed, and its full prompt pages are
-        offered to the prefix cache."""
-        if not self._prefilling:
-            return
-        C = self.n_chunks
-        sched = []                         # (slot, pos, n_valid, final)
-        for slot in list(self._prefilling):
-            if len(sched) >= C:
-                break
-            req = self.slots[slot]
-            T = len(req.prompt)
-            pos = self._prefilling[slot]
-            while pos < T and len(sched) < C:
-                n = min(self.bs, T - pos)
-                sched.append((slot, pos, n, pos + n >= T))
-                pos += n
-            self._prefilling[slot] = pos
-        if not sched:
-            return
-        tokens = np.zeros((C, self.bs), np.int32)
-        cs = np.full((C,), self.B, np.int32)       # idle chunks -> sink row
-        p0 = np.zeros((C,), np.int32)
-        loff = np.zeros((C,), np.int32)
-        tt = np.zeros((C,), np.float32)
-        tp = np.ones((C,), np.float32)
-        ts = np.zeros((C,), np.int32)
-        for idx, (slot, pos, n, fin) in enumerate(sched):
-            req = self.slots[slot]
-            tokens[idx, :n] = req.prompt[pos:pos + n]
-            cs[idx] = slot
-            p0[idx] = pos
-            loff[idx] = n - 1
-            tt[idx] = req.temperature
-            tp[idx] = req.top_p
-            ts[idx] = req.seed
-        ptab = np.concatenate(
-            [self._full_rows, np.zeros((1, self.max_blocks), np.int32)])
-        # tpu-lint TPL002 audit: the prefill below is dispatched
-        # asynchronously while the scheduler keeps mutating its numpy
-        # state — every operand is a fresh local array here, but jnp.array
-        # (copying) keeps the handoff alias-free by construction.
-        firsts, self.k_pages, self.v_pages = self._prefill(
-            self.params, self.k_pages, self.v_pages, jnp.array(tokens),
-            jnp.array(ptab), jnp.array(cs), jnp.array(p0),
-            jnp.array(loff), jnp.array(tt), jnp.array(tp), jnp.array(ts))
-        for idx, (slot, pos, n, fin) in enumerate(sched):
-            req = self.slots[slot]
-            j = pos // self.bs
-            if (n == self.bs and j >= self._slot_nshared[slot]
-                    and j < len(self._slot_hashes[slot])):
-                # full prompt page this request prefilled itself: offer
-                # it to the cache. On success ownership transfers to the
-                # cache (refcount 1 = this request's mapping) — it
-                # outlives the request until evicted under pool pressure.
-                page = int(self._full_rows[slot][j])
-                if self.pool.insert(self._slot_hashes[slot][j], page):
-                    self._slot_owned[slot].remove(page)
-                    self._slot_shared[slot].append(page)
-            if fin:
-                del self._prefilling[slot]
-                self.table[slot] = self._full_rows[slot]
-                self.seq_lens[slot] = len(req.prompt)
-                self.samp_temp[slot] = req.temperature
-                self.samp_topp[slot] = req.top_p
-                self.samp_seed[slot] = req.seed
-                # fully async: the first token stays a device scalar — it
-                # patches the next quantum's token feed in-program and
-                # reaches the host as row 0 of that quantum's output.
-                # firsts[idx] is a static-index gather: one cached
-                # executable per idx value, C of them total.
-                self._cur_patches[slot] = firsts[idx]
-                self._pending_first.add(slot)
-            self.stats["prefill_tokens"] += n
-        self.stats["prefills"] += 1
-        self.stats["prefill_grid_tokens"] += C * self.bs
 
     def _release_slot_pages(self, slot: int, defer: bool) -> None:
         """Tear down a slot's page state: owned pages to the free list
@@ -681,59 +567,65 @@ class ServingEngine:
             self.table[slot] = 0           # sink
             self.seq_lens[slot] = 0
             self.cur_tok[slot] = 0
-            self.samp_temp[slot] = 0.0     # idle slots decode greedily
+            self.samp_temp[slot] = 0.0     # idle rows pick greedily
             self.slots[slot] = None
 
     def step(self, now: Optional[float] = None) -> bool:
-        """Admissions + one chunked prefill dispatch + dispatch of the
-        next decode quantum + harvest of the PREVIOUS one. Returns True
-        while work remains — `while engine.step(): ...` is the external
-        drive contract; an idle tick runs no compute.
+        """Admissions + ONE unified dispatch (decode rows + prefill
+        chunks in the same grid) + harvest. Returns True while work
+        remains — `while engine.step(): ...` is the external drive
+        contract; an idle tick runs no compute.
 
-        Pipelined (round 5): the next quantum is dispatched BEFORE the
-        previous quantum's tokens are fetched, chained on the device
-        through its last-token vector — the ~100 ms host round-trip per
-        quantum over the remote-device tunnel overlaps device compute
+        Pipelined (speculation off): the next step is dispatched BEFORE
+        the previous step's tokens are fetched, chained on-device
+        through the previous output rows — the ~100 ms host round-trip
+        per step over the remote-device tunnel overlaps device compute
         instead of serializing with it. Consequences the scheduler
         handles:
 
-        - a request's finish is discovered one quantum late; the extra
-          quantum decodes junk into its OWN pages (positions past its
-          allocation hit the sink page) and is discarded at harvest;
-        - freed pages go through ``_deferred_free`` for one harvest
-          cycle, so a page is never handed to a new request while an
-          in-flight program that still references it can write to it;
-        - a slot admitted while a quantum is in flight joins the NEXT
-          dispatch; its first token patches the device-chained token
-          vector.
+        - a request's finish is predicted at dispatch (each row yields
+          exactly one token), so its SLOT is released immediately while
+          its pages wait in ``_deferred_free`` for one harvest cycle —
+          a page is never handed to a new request while an in-flight
+          program that still references it can write to it;
+        - a slot admitted while a step is in flight joins the NEXT
+          dispatch; the prefill-final -> first-decode handoff rides the
+          same chain as decode continuations.
+
+        Speculative (``serving_speculative_k`` > 0): synchronous —
+        drafts are proposed from host-side history, so each step is
+        harvested before the next dispatch; accepted counts advance
+        seq_lens at harvest (a rejected draft's k/v is masked by its
+        position and overwritten before it could ever be attended).
         """
         now = time.monotonic() if now is None else now
         self._admit(now)
-        self._dispatch_prefill()
         prev = self._inflight
-        self._dispatch_next(now)
-        if prev is not None:
+        self._dispatch_unified(now)
+        if self.spec_k:
+            if self._inflight is not None:
+                self._harvest(self._inflight)
+        elif prev is not None:
             self._harvest(prev)
-        elif self._deferred_free or self.pool.pending_evict:
-            # no decode quantum was in flight: deferred/pending pages can
-            # only be touched by programs already chained BEFORE any
-            # future consumer (the donated page arrays serialize every
-            # prefill and decode program), so reclaim now — pool-
-            # constrained admission would otherwise deadlock waiting
-            # for a harvest
+        if self._inflight is None and (self._deferred_free
+                                       or self.pool.pending_evict):
+            # nothing in flight: deferred/pending pages can only be
+            # touched by programs already chained BEFORE any future
+            # consumer (the donated page arrays serialize every
+            # dispatch), so reclaim now — pool-constrained admission
+            # would otherwise deadlock waiting for a harvest
             self.pool.release(self._deferred_free)
             self._deferred_free = []
             self.pool.commit_evictable()
-        # predictive release: after the harvest above, the only pending
-        # tokens are the quantum just dispatched — any snapshot request
-        # it completes can give up its SLOT now (next step admits into
-        # it one quantum earlier); its tokens still land via the
-        # snapshot, its pages wait in _deferred_free
-        if self._inflight is not None:
-            for s, req, had_first in self._inflight[1]:
-                if (self.slots[s] is req and req.max_new_tokens
-                        - len(req.out_tokens) - (1 if had_first else 0)
-                        <= self.decode_quantum):
+        # predictive release: each in-flight token-bearing row yields
+        # exactly one token (speculation off), so a request the just-
+        # dispatched step completes can give up its SLOT now — the next
+        # step admits into it one dispatch earlier; its token still
+        # lands via the snapshot, its pages wait in _deferred_free
+        if not self.spec_k and self._inflight is not None:
+            for idx, s, req, kind, m, _dr in self._inflight[1]:
+                if (kind != "mid" and self.slots[s] is req
+                        and req.max_new_tokens - len(req.out_tokens) <= 1):
                     self._release_slot_pages(s, defer=True)
                     self.table[s] = 0
                     self.seq_lens[s] = 0
@@ -742,106 +634,225 @@ class ServingEngine:
         return (self._inflight is not None or bool(self.queue)
                 or any(s is not None for s in self.slots))
 
-    def _dispatch_next(self, now: float = 0.0) -> None:
-        """Queue one decode quantum for the CURRENT slot state; does not
-        block. Positions advance at dispatch (the program computes
-        per-tick positions internally); token feed chains on-device from
-        the previous quantum's output, patched for newly admitted
-        slots. Skipped entirely while no slot is decoding (pure-prefill
-        steps run only the prefill program). Each dispatched quantum
-        charges K tokens per slot to the occupancy ledger, classified
-        here for idle/prefilling slots and at harvest for decoding
-        ones."""
+    def _dispatch_unified(self, now: float = 0.0) -> None:
+        """Build and dispatch one unified step for the CURRENT slot
+        state; does not block. Row assignment: every decoding slot gets
+        one row (1 input token + up to spec_k drafts), remaining rows
+        carry qb-token prefill slices (FIFO over admission order), the
+        rest idle against the sink. Charges the occupancy ledger one
+        slot-token per engaged slot (m for a speculative row) — the
+        decode/spec split is classified at harvest."""
+        C, qb = self.n_rows, self.qb
+        pref_entry = set(self._prefilling)
         decoding = [s for s in range(self.B) if self.slots[s] is not None
-                    and s not in self._prefilling]
-        if not decoding:
+                    and s not in pref_entry]
+        # previous dispatch's token-bearing rows, for in-program chaining
+        prev_rows: dict[int, int] = {}
+        if self._inflight is not None:
+            for idx, s, req, kind, m, _dr in self._inflight[1]:
+                if kind != "mid" and self.slots[s] is req:
+                    prev_rows[s] = idx
+        sched = []                         # (slot, kind, pos0, m, drafts)
+        for s in decoding:
+            req = self.slots[s]
+            pending = 1 if s in prev_rows else 0
+            remaining = req.max_new_tokens - len(req.out_tokens) - pending
+            drafts: list = []
+            if self.spec_k and remaining > 1:
+                hist = req.prompt.tolist() + req.out_tokens
+                drafts = self._proposer.propose(
+                    hist, min(self.spec_k, remaining - 1))
+            sched.append((s, "dec", int(self.seq_lens[s]),
+                          1 + len(drafts), drafts))
+        fin_slots = set()
+        pref_touched: dict[int, int] = {}
+        for slot in list(self._prefilling):
+            if len(sched) >= C:
+                break
+            req = self.slots[slot]
+            T = len(req.prompt)
+            pos = self._prefilling[slot]
+            while pos < T and len(sched) < C:
+                n = min(qb, T - pos)
+                sched.append((slot, "fin" if pos + n >= T else "mid",
+                              pos, n, None))
+                pos += n
+            self._prefilling[slot] = pos
+            pref_touched[slot] = pos
+        if not sched:
             return
-        K = self.decode_quantum
-        n_pref = len(self._prefilling)
-        n_idle = self.B - len(decoding) - n_pref
-        self.stats["waste_prefill_slot_tokens"] += K * n_pref
+        tokens = np.zeros((C, qb), np.int32)
+        rs = np.full((C,), self.B, np.int32)       # idle rows -> sink row
+        p0 = np.zeros((C,), np.int32)
+        nv = np.ones((C,), np.int32)
+        tt = np.zeros((C,), np.float32)
+        tp = np.ones((C,), np.float32)
+        tsd = np.zeros((C,), np.int32)
+        cmask = np.zeros((C,), bool)
+        crow = np.zeros((C,), np.int32)
+        snap = []
+        n_pf_rows = 0
+        for idx, (s, kind, pos, m, drafts) in enumerate(sched):
+            req = self.slots[s]
+            rs[idx] = s
+            p0[idx] = pos
+            nv[idx] = m
+            if kind == "dec":
+                if s in prev_rows:
+                    cmask[idx] = True
+                    crow[idx] = prev_rows[s]
+                else:
+                    tokens[idx, 0] = self.cur_tok[s]
+                if drafts:
+                    tokens[idx, 1:m] = drafts
+            else:
+                n_pf_rows += 1
+                tokens[idx, :m] = req.prompt[pos:pos + m]
+                if kind == "fin":
+                    fin_slots.add(s)
+            if kind != "mid":
+                tt[idx] = req.temperature
+                tp[idx] = req.top_p
+                tsd[idx] = req.seed
+            snap.append((idx, s, req, kind, m, drafts))
+        ptab = np.concatenate(
+            [self._full_rows, np.zeros((1, self.max_blocks), np.int32)])
+        prev_out = self._prev_out_dev
+        if prev_out is None:
+            prev_out = jnp.zeros((C, qb if self.spec_k else 1), jnp.int32)
+        # tpu-lint TPL002 audit: the program below is dispatched
+        # asynchronously while the scheduler keeps mutating its numpy
+        # state — every operand is a fresh local array here, but
+        # jnp.array (copying) keeps the handoff alias-free by
+        # construction.
+        out, self.k_pages, self.v_pages = self._unified(
+            self.params, self.k_pages, self.v_pages, jnp.array(tokens),
+            prev_out, jnp.array(cmask), jnp.array(crow), jnp.array(ptab),
+            jnp.array(rs), jnp.array(p0), jnp.array(nv), jnp.array(tt),
+            jnp.array(tp), jnp.array(tsd))
+        self._inflight = (out, snap)
+        self._prev_out_dev = out
+        # post-dispatch bookkeeping: prefix-cache offers for pages this
+        # step completed, prefill flips, decode position advance
+        for slot, pos_new in pref_touched.items():
+            hashes = self._slot_hashes[slot]
+            j1 = min(pos_new // self.bs, len(hashes))
+            for j in range(self._slot_offered[slot], j1):
+                # full prompt page this request prefilled itself: offer
+                # it to the cache. On success ownership transfers to the
+                # cache (refcount 1 = this request's mapping) — it
+                # outlives the request until evicted under pool pressure.
+                page = int(self._full_rows[slot][j])
+                if self.pool.insert(hashes[j], page):
+                    self._slot_owned[slot].remove(page)
+                    self._slot_shared[slot].append(page)
+            self._slot_offered[slot] = max(self._slot_offered[slot], j1)
+        for idx, s, req, kind, m, drafts in snap:
+            if kind == "fin":
+                del self._prefilling[s]
+                self.table[s] = self._full_rows[s]
+                self.seq_lens[s] = len(req.prompt)
+                self.samp_temp[s] = req.temperature
+                self.samp_topp[s] = req.top_p
+                self.samp_seed[s] = req.seed
+            if kind != "dec":
+                self.stats["prefill_tokens"] += m
+        if not self.spec_k:
+            for s in decoding:
+                self.seq_lens[s] += 1
+        # occupancy ledger: one slot-token per engaged slot this step
+        # (m for a speculative row); decode/fin rows are classified at
+        # harvest (active / spec-rejected / overrun)
+        n_idle = self.B - len(decoding) - len(pref_entry)
         if n_idle:
             blocked = any(r.arrival <= now for r in self.queue)
             self.stats["waste_admission_blocked_slot_tokens" if blocked
-                       else "waste_queue_empty_slot_tokens"] += K * n_idle
-        cur = self._cur_tok_dev
-        if cur is None:
-            cur = jnp.asarray(self.cur_tok.copy())
-        mask = np.zeros((self.B,), bool)
-        for s in self._cur_patches:
-            mask[s] = True
-        vals = jnp.asarray(np.zeros((self.B,), np.int32))
-        for s, tok in self._cur_patches.items():
-            # tok is a DEVICE scalar from the async prefill; static-index
-            # scatter keeps every eager-op shape fixed (each distinct
-            # shape costs a remote compile over the tunnel)
-            vals = vals.at[s].set(tok)
-        self._cur_patches = {}
-        # .copy(): jnp.asarray can ALIAS a numpy buffer (zero-copy on the
-        # CPU backend), and this program executes asynchronously while
-        # the scheduler keeps mutating table/seq_lens — the in-flight
-        # program must see the dispatch-time snapshot (caught by
-        # test_serving_pipelined_page_recycling_exact)
-        toks, last, self.k_pages, self.v_pages = self._decode(
-            self.params, self.k_pages, self.v_pages, cur,
-            jnp.array(mask), jnp.asarray(vals),
-            jnp.asarray(self.table.copy()),
-            jnp.asarray(self.seq_lens.copy()),
-            jnp.asarray(self.samp_temp.copy()),
-            jnp.asarray(self.samp_topp.copy()),
-            jnp.asarray(self.samp_seed.copy()))
-        # snapshot of (slot, request, carries-first-token) decoding at
-        # dispatch; how many tokens to keep is decided at harvest (the
-        # previous quantum's tokens land in out_tokens AFTER this
-        # dispatch, so a count taken here would overcount by a quantum)
-        snap = [(s, self.slots[s], s in self._pending_first)
-                for s in decoding]
-        self._pending_first.clear()
-        self._inflight = (toks, snap)
-        self._cur_tok_dev = last
-        for s in decoding:
-            self.seq_lens[s] += K
-        self.stats["decode_steps"] += K
-        self.stats["decode_slot_tokens"] += K * self.B
+                       else "waste_queue_empty_slot_tokens"] += n_idle
+        n_mid_slots = len(pref_entry) - len(fin_slots)
+        self.stats["waste_prefill_slot_tokens"] += n_mid_slots
+        self.stats["decode_slot_tokens"] += (
+            sum(m for _s, kind, _p, m, _d in sched if kind == "dec")
+            + len(fin_slots) + n_mid_slots + n_idle)
+        self.stats["unified_steps"] += 1
+        if decoding:
+            self.stats["decode_steps"] += 1
+        if n_pf_rows:
+            self.stats["prefills"] += 1
+            self.stats["prefill_grid_tokens"] += n_pf_rows * qb
 
     def _harvest(self, inflight) -> None:
-        """Fetch a completed quantum's tokens (the only host sync of the
-        decode path) and apply them; release pages freed one cycle ago —
-        no in-flight program can reference them anymore."""
-        toks_dev, snap = inflight
-        toks_all = np.asarray(toks_dev)              # [K+1, B]: row 0 =
-        first_row, toks = toks_all[0], toks_all[1:]  # patched inputs
-        if self._inflight is not None and self._inflight[0] is toks_dev:
+        """Fetch a completed step's row outputs (the only host sync of
+        the serving path) and apply them; release pages freed one cycle
+        ago — no in-flight program can reference them anymore."""
+        out_dev, snap = inflight
+        toks = np.asarray(out_dev)                   # [C, 1] or [C, qb]
+        if self._inflight is not None and self._inflight[0] is out_dev:
             self._inflight = None
-        K = self.decode_quantum
         self.pool.release(self._deferred_free)
         self._deferred_free = []
         self.pool.commit_evictable()
         now = time.monotonic()
-        for s, req, had_first in snap:
-            if req.aborted:
-                # aborted after dispatch: its quantum tokens are junk
-                self.stats["waste_overrun_slot_tokens"] += K
+        for idx, s, req, kind, m, drafts in snap:
+            if kind == "mid":
                 continue
-            if had_first:
-                # async admission: the prefill's first token arrives here
-                # as the quantum's (patched) input row — first host
-                # observation, so TTFT is recorded now
-                req.out_tokens.append(int(first_row[s]))
-                req.t_first = now
-            take = max(0, min(K, req.max_new_tokens - len(req.out_tokens)))
-            if take > 0:
+            if req.aborted:
+                # aborted after dispatch: its tokens are junk
+                self.stats["waste_overrun_slot_tokens"] += (
+                    m if kind == "dec" else 1)
+                continue
+            if kind == "fin":
+                # the prefill-final row's own output IS the first token
+                # (one program: no cross-program patching needed)
+                tok = int(toks[idx, m - 1] if self.spec_k else toks[idx, 0])
+                if len(req.out_tokens) < req.max_new_tokens:
+                    req.out_tokens.append(tok)
+                    self.stats["decode_active_tokens"] += 1
+                else:
+                    self.stats["waste_overrun_slot_tokens"] += 1
+                if req.t_first is None:
+                    req.t_first = now
+                if self.slots[s] is req:
+                    self.cur_tok[s] = tok
+                    self._finish_if_done(s, defer_free=True)
+            elif self.spec_k:
+                # greedy-verify: draft j survives iff it equals the pick
+                # after the tokens before it — the accepted stream is
+                # exactly the one-token-at-a-time stream
+                o = [int(t) for t in toks[idx, :m]]
+                a = 1
+                while a < m and drafts[a - 1] == o[a - 1]:
+                    a += 1
+                take = min(a, req.max_new_tokens - len(req.out_tokens))
+                req.out_tokens.extend(o[:take])
+                if req.t_first is None and take:
+                    req.t_first = now
                 self.stats["decode_active_tokens"] += take
-                req.out_tokens.extend(int(t) for t in toks[:take, s])
-            self.stats["waste_overrun_slot_tokens"] += K - take
-            if self.slots[s] is req:
-                # still slot-resident: remaining exceeded one quantum
-                # (else predictive release would have freed the slot);
-                # _finish_if_done is defensive for the same reason
-                self.cur_tok[s] = int(toks[-1, s])
-                self._finish_if_done(s, defer_free=True)
-            elif len(req.out_tokens) >= req.max_new_tokens \
-                    and req.t_done is None:
+                self.stats["waste_spec_rejected_slot_tokens"] += m - a
+                self.stats["waste_overrun_slot_tokens"] += a - take
+                self.stats["spec_proposed_tokens"] += m - 1
+                self.stats["spec_accepted_tokens"] += a - 1
+                if self.slots[s] is req:
+                    # seq_lens advances by the ACCEPTED count only — a
+                    # rejected draft's k/v sits past seq_lens, is masked
+                    # for every later query, and is overwritten by the
+                    # next row's own tokens before it could be attended
+                    self.seq_lens[s] += take
+                    if take:
+                        self.cur_tok[s] = o[take - 1]
+                    self._finish_if_done(s, defer_free=True)
+            else:
+                tok = int(toks[idx, 0])
+                if len(req.out_tokens) < req.max_new_tokens:
+                    req.out_tokens.append(tok)
+                    self.stats["decode_active_tokens"] += 1
+                else:
+                    self.stats["waste_overrun_slot_tokens"] += 1
+                if self.slots[s] is req:
+                    self.cur_tok[s] = tok
+                    self._finish_if_done(s, defer_free=True)
+            if (self.slots[s] is not req
+                    and len(req.out_tokens) >= req.max_new_tokens
+                    and req.t_done is None):
                 # predictively released at dispatch: the slot may already
                 # belong to a newer request; only the completion time
                 # remains to record
@@ -868,7 +879,8 @@ class ServingEngine:
     def run(self, requests: list[Request]) -> dict:
         """Drive all requests to completion against wall-clock arrivals;
         returns throughput + p50/p99 latency stats, the slot-occupancy
-        decomposition, and prefix-cache counters."""
+        decomposition, speculative-decode counters, and prefix-cache
+        counters."""
         for r in sorted(requests, key=lambda r: r.arrival):
             self.submit(r)
         self.stats = {k: 0 for k in self.stats}   # per-run counters
@@ -917,8 +929,8 @@ class ServingEngine:
             "ttft_p99_s": round(q(ttft, 99), 3),
             "slot_occupancy": round(
                 st["decode_active_tokens"] / slot_tok, 3),
-            # occupancy decomposition: fractions of decode slot-tokens
-            # lost per cause (active + these four == 1)
+            # occupancy decomposition: fractions of slot-tokens lost per
+            # cause (active + these five == 1)
             "occ_waste_queue_empty": round(
                 st["waste_queue_empty_slot_tokens"] / slot_tok, 3),
             "occ_waste_admission_blocked": round(
@@ -927,6 +939,12 @@ class ServingEngine:
                 st["waste_prefill_slot_tokens"] / slot_tok, 3),
             "occ_waste_overrun": round(
                 st["waste_overrun_slot_tokens"] / slot_tok, 3),
+            "occ_waste_spec_rejected": round(
+                st["waste_spec_rejected_slot_tokens"] / slot_tok, 3),
+            "spec_accept_rate": round(
+                st["spec_accepted_tokens"]
+                / st["spec_proposed_tokens"], 3)
+            if st["spec_proposed_tokens"] else 0.0,
             "prefill_padding_frac": round(
                 1.0 - st["prefill_tokens"]
                 / max(1, st["prefill_grid_tokens"]), 3),

@@ -1,0 +1,52 @@
+"""Self-drafting speculative proposer: prompt-lookup n-gram matching.
+
+Drafts come from the request's OWN token history (prompt + generated),
+so there is no draft model to load or keep resident — the unified
+ragged-paged-attention step verifies k drafts per decode row for the
+same page reads a 1-token row costs ("Ragged Paged Attention",
+arxiv 2604.15464; prompt-lookup decoding a la arxiv 2304.04487-style
+self-drafting).
+
+The proposer is pure host-side bookkeeping: given the history, find the
+most recent earlier occurrence of the trailing n-gram (longest n first)
+and propose the tokens that followed it.  Verification is greedy-
+accept: draft j survives iff it equals the model's pick at its
+position, so with the engine's keyed sampler the ACCEPTED stream is
+bit-identical to the non-speculative stream regardless of hit rate.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+__all__ = ["NgramProposer"]
+
+
+class NgramProposer:
+    """Longest-suffix n-gram lookup over a token history.
+
+    propose() scans for the most recent PRIOR occurrence of the
+    history's trailing n-gram, n = max_ngram down to 1, and returns up
+    to k tokens that followed the match.  Deterministic; O(n * |hist|)
+    worst case, cheap at serving history lengths.
+    """
+
+    def __init__(self, max_ngram: int = 3):
+        if max_ngram < 1:
+            raise ValueError("max_ngram must be >= 1")
+        self.max_ngram = max_ngram
+
+    def propose(self, history: Sequence[int], k: int) -> List[int]:
+        h = list(history)
+        if k <= 0 or len(h) < 2:
+            return []
+        for n in range(min(self.max_ngram, len(h) - 1), 0, -1):
+            tail = h[-n:]
+            # most recent earlier occurrence; the match must end before
+            # the final position so at least one follower exists
+            for start in range(len(h) - n - 1, -1, -1):
+                if h[start:start + n] == tail:
+                    follow = h[start + n:start + n + k]
+                    if follow:
+                        return follow
+        return []

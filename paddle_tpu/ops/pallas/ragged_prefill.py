@@ -1,234 +1,53 @@
-"""Pallas TPU ragged-prefill attention over the paged KV cache.
+"""Back-compat shim: ragged chunked-prefill attention as a special case
+of the unified ragged-paged-attention step.
 
-Serving-path companion to decode_attention.py: where the decode kernels
-score ONE query token per sequence against its pages, this kernel scores
-one page-size CHUNK of prompt tokens per grid row — the compute side of
-chunked ragged prefill ("Ragged Paged Attention", arxiv 2604.15464; the
-reference's block_multi_head_attention prefill branch,
-phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu).
-
-Contract shared by the kernel and the XLA fallback:
-
-- q [C, bs, nH, d]: C chunks of bs query tokens each. Chunk c holds the
-  prompt tokens at positions [pos0[c], pos0[c] + bs) of ONE request
-  (page-aligned, so a chunk maps to exactly one KV page); idle grid rows
-  point at the sink page with pos0 = 0.
-- k_pages [P, nKV, d, bs] d-major (the MXU decode kernel's native
-  layout) or [P, nKV, bs, d]; v_pages [P, nKV, bs, d]. The chunk's own
-  k/v must already be written to its page (write-before-attend, same
-  ordering the decode tick uses).
-- rows [C, max_blocks] int32: the owning request's FULL block-table row
-  per chunk. Pages past the chunk's position are masked by causality
-  (kpos <= qpos), so rows may carry future/garbage page ids.
-- pos0 [C] int32: absolute position of the chunk's first token.
-
-Returns o [C, bs, nH, d]. Rows whose token positions exceed the prompt
-length produce garbage attended against in-request pages only — the
-caller discards them (it reads logits at the last VALID offset).
+PR 7 generalized this module into ragged_paged_attention.py, where every
+grid row carries an explicit valid-token count (decode is a 1-token
+chunk).  A page-aligned prefill chunk is exactly the n_valid == qb case
+— the clamped mask qpos(i) = pos0 + min(i, n_valid - 1) degenerates to
+pos0 + i — so the historical entry points below simply delegate.  See
+ragged_paged_attention.py for the kernel, the XLA arm, and the full
+contract.
 """
 
 from __future__ import annotations
 
-import functools
-
-import jax
 import jax.numpy as jnp
 
-from .flash_attention import _interpret_mode
+from .ragged_paged_attention import (
+    _ragged_paged_xla,
+    ragged_paged_attention,
+    ragged_paged_attention_kernel,
+    ragged_paged_supported,
+)
 
 __all__ = ["ragged_prefill_attention", "ragged_prefill_supported"]
 
 
 def ragged_prefill_supported(kt_pages_shape, n_q_heads: int,
                              itemsize: int = 2) -> bool:
-    """Gate for the MXU ragged-prefill kernel: d-major pages with
-    MXU-tileable blocks — the score dot is [bs*G, d] x [d, bs] and the
-    value dot [bs*G, bs] x [bs, d] — plus a VMEM working-set bound
-    (q block + fp32 acc + double-buffered k/v pages)."""
-    _, nkv, d, bs = kt_pages_shape
-    if n_q_heads % nkv:
-        return False
-    G = n_q_heads // nkv
-    est = (2 * bs * G * d * (itemsize + 4)      # q block + fp32 acc
-           + 2 * 2 * 2 * d * bs * itemsize)     # double-buffered k+v
-    if est > 12 * 2 ** 20:
-        return False
-    return d in (128, 256) and bs % 128 == 0
+    """Historical gate: a prefill chunk is qb == page_size query tokens."""
+    return ragged_paged_supported(kt_pages_shape, n_q_heads,
+                                  kt_pages_shape[3], itemsize)
 
 
-def _ragged_prefill_kernel(rows_ref, pos0_ref, q_ref, k_ref, v_ref, o_ref,
-                           m_sc, l_sc, acc_sc, *, bs, G, n_blocks,
-                           sm_scale):
-    """One (chunk, kv-head, page) program: this chunk's bs*G query rows
-    (row r = query token r//G, group head r%G) against one table-selected
-    page, online-softmax accumulated in scratch over the page grid dim."""
-    import jax.experimental.pallas as pl
-
-    c = pl.program_id(0)
-    j = pl.program_id(2)
-
-    @pl.when(j == 0)
-    def _init():
-        m_sc[...] = jnp.full_like(m_sc[...], -1e30)
-        l_sc[...] = jnp.zeros_like(l_sc[...])
-        acc_sc[...] = jnp.zeros_like(acc_sc[...])
-
-    q = q_ref[...]                                 # [bs*G, d]
-    k = k_ref[...]                                 # [d, bs] (d-major page)
-    s = jax.lax.dot(q, k, preferred_element_type=jnp.float32) * sm_scale
-    qpos = pos0_ref[c] + jax.lax.iota(jnp.int32, bs * G) // G
-    kpos = j * bs + jax.lax.iota(jnp.int32, bs)
-    # causal ragged mask; every query row keeps >= 1 real key at j == 0
-    # (kpos 0 <= qpos always), so the -1e30 epoch never normalizes junk
-    s = s + jnp.where(kpos[None, :] <= qpos[:, None], 0.0, -1e30)
-    m_prev = m_sc[0, :]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
-    p = jnp.exp(s - m_new[:, None])                # [bs*G, bs]
-    alpha = jnp.exp(m_prev - m_new)
-    l_sc[0, :] = l_sc[0, :] * alpha + jnp.sum(p, axis=1)
-    m_sc[0, :] = m_new
-    v = v_ref[...]                                 # [bs, d]
-    pv = jax.lax.dot(p.astype(v.dtype), v,
-                     preferred_element_type=jnp.float32)
-    acc_sc[...] = acc_sc[...] * alpha[:, None] + pv
-
-    @pl.when(j == n_blocks - 1)
-    def _fin():
-        o_ref[...] = (acc_sc[...] /
-                      jnp.maximum(l_sc[0, :], 1e-30)[:, None]
-                      ).astype(o_ref.dtype)
+def _full_valid(q):
+    return jnp.full((q.shape[0],), q.shape[1], jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("sm_scale",))
 def ragged_prefill_attention_kernel(q, kt_pages, v_pages, rows, pos0,
                                     sm_scale: float):
-    """MXU ragged-prefill kernel (d-major k pages). See module docstring
-    for the contract; gate with ragged_prefill_supported()."""
-    import jax.experimental.pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    C, bs, nH, d = q.shape
-    nkv = kt_pages.shape[1]
-    G = nH // nkv
-    mb = rows.shape[1]
-    # row r of the [bs*G, d] q block = (query token r//G, group head r%G):
-    # GQA never inflates the page reads, matching the decode kernels
-    qg = q.reshape(C, bs, nkv, G, d).transpose(0, 2, 1, 3, 4)
-    qg = qg.reshape(C, nkv, bs * G, d)
-    rows_flat = rows.reshape(-1).astype(jnp.int32)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,                     # rows_flat, pos0
-        grid=(C, nkv, mb),
-        in_specs=[
-            pl.BlockSpec((None, None, bs * G, d),
-                         lambda c, h, j, rf, p0: (c, h, 0, 0)),
-            pl.BlockSpec((None, None, d, bs),
-                         lambda c, h, j, rf, p0: (rf[c * mb + j], h, 0, 0)),
-            pl.BlockSpec((None, None, bs, d),
-                         lambda c, h, j, rf, p0: (rf[c * mb + j], h, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, None, bs * G, d),
-                               lambda c, h, j, rf, p0: (c, h, 0, 0)),
-        scratch_shapes=[pltpu.VMEM((8, bs * G), jnp.float32),
-                        pltpu.VMEM((8, bs * G), jnp.float32),
-                        pltpu.VMEM((bs * G, d), jnp.float32)],
-    )
-    out = pl.pallas_call(
-        functools.partial(_ragged_prefill_kernel, bs=bs, G=G,
-                          n_blocks=mb, sm_scale=sm_scale),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((C, nkv, bs * G, d), q.dtype),
-        interpret=_interpret_mode(),
-    )(rows_flat, pos0.astype(jnp.int32), qg, kt_pages, v_pages)
-    return out.reshape(C, nkv, bs, G, d).transpose(0, 2, 1, 3, 4).reshape(
-        C, bs, nH, d)
+    return ragged_paged_attention_kernel(q, kt_pages, v_pages, rows,
+                                         pos0, _full_valid(q), sm_scale)
 
 
 def _ragged_prefill_xla(q, k_pages, v_pages, rows, pos0, sm_scale,
                         k_layout):
-    """XLA gather fallback (and the kernel's numerics reference): gather
-    each chunk's pages, one masked softmax over the flattened context."""
-    C, bs, nH, d = q.shape
-    nkv = k_pages.shape[1]
-    G = nH // nkv
-    mb = rows.shape[1]
-    kg = jnp.take(k_pages, rows, axis=0)           # [C, mb, nkv, ., .]
-    if k_layout == "d_major":
-        kg = jnp.swapaxes(kg, 3, 4)                # -> [C, mb, nkv, bs, d]
-    vg = jnp.take(v_pages, rows, axis=0)           # [C, mb, nkv, bs, d]
-    kg = jnp.swapaxes(kg, 1, 2).reshape(C, nkv, mb * bs, d)
-    vg = jnp.swapaxes(vg, 1, 2).reshape(C, nkv, mb * bs, d)
-    qg = q.reshape(C, bs, nkv, G, d)
-    s = jnp.einsum("cqhgd,chsd->chgqs", qg, kg,
-                   preferred_element_type=jnp.float32) * sm_scale
-    qpos = pos0[:, None] + jnp.arange(bs, dtype=jnp.int32)
-    kpos = jnp.arange(mb * bs, dtype=jnp.int32)
-    mask = kpos[None, None, :] <= qpos[:, :, None]  # [C, bs, S]
-    s = jnp.where(mask[:, None, None, :, :], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1).astype(vg.dtype)
-    o = jnp.einsum("chgqs,chsd->cqhgd", p, vg)
-    return o.reshape(C, bs, nH, d).astype(q.dtype)
-
-
-_SRC = None
-
-
-def _autotune_source() -> str:
-    global _SRC
-    if _SRC is None:
-        from . import autotune
-
-        _SRC = autotune.source_hash(_ragged_prefill_kernel,
-                                    ragged_prefill_attention_kernel,
-                                    _ragged_prefill_xla)
-    return _SRC
-
-
-def _tuned_impl(C: int, bs: int, nH: int, d: int, nkv: int, mb: int,
-                dtype) -> str:
-    """Impl choice via the autotune registry.  The ragged kernel has no
-    free block parameter (blocks ARE the page geometry), so the tunable
-    axis is the implementation itself: the MXU kernel wins when chunks
-    are deep (many pages re-read per chunk), the XLA gather path when
-    the prefill is shallow and the kernel's per-program latency
-    dominates.  candidates[0] = "kernel" keeps legacy behavior on
-    no-sweep backends."""
-    from . import autotune
-
-    def measure(impl):
-        qz = jnp.zeros((C, bs, nH, d), dtype)
-        ktz = jnp.zeros((1, nkv, d, bs), dtype)
-        vz = jnp.zeros((1, nkv, bs, d), dtype)
-        rz = jnp.zeros((C, mb), jnp.int32)
-        pz = jnp.zeros((C,), jnp.int32)
-        if impl == "kernel":
-            fn = lambda: ragged_prefill_attention_kernel(  # noqa: E731
-                qz, ktz, vz, rz, pz, 1.0)
-        else:
-            fn = lambda: _ragged_prefill_xla(qz, ktz, vz, rz, pz,  # noqa: E731
-                                             1.0, "d_major")
-        return autotune.time_candidate(fn)
-
-    return str(autotune.tuned("ragged_prefill",
-                              f"c{C}_bs{bs}_h{nH}_d{d}_kv{nkv}_mb{mb}",
-                              str(jnp.dtype(dtype)), ["kernel", "xla"],
-                              measure=measure, source=_autotune_source()))
+    return _ragged_paged_xla(q, k_pages, v_pages, rows, pos0,
+                             _full_valid(q), sm_scale, k_layout)
 
 
 def ragged_prefill_attention(q, k_pages, v_pages, rows, pos0,
                              sm_scale: float, k_layout: str = "d_major"):
-    """Ragged chunked-prefill attention: dispatches the MXU Pallas kernel
-    when the page geometry supports it, else the XLA gather path. See
-    module docstring for shapes."""
-    if (k_layout == "d_major"
-            and ragged_prefill_supported(k_pages.shape, q.shape[2],
-                                         k_pages.dtype.itemsize)):
-        C, bs, nH, d = q.shape
-        impl = _tuned_impl(C, bs, nH, d, k_pages.shape[1], rows.shape[1],
-                           q.dtype)
-        if impl == "kernel":
-            return ragged_prefill_attention_kernel(q, k_pages, v_pages,
-                                                   rows, pos0, sm_scale)
-    return _ragged_prefill_xla(q, k_pages, v_pages, rows, pos0, sm_scale,
-                               k_layout)
+    return ragged_paged_attention(q, k_pages, v_pages, rows, pos0,
+                                  _full_valid(q), sm_scale, k_layout)

@@ -81,6 +81,26 @@ def fx_vjp_bwd(res, g):
 fx_vjp_front.defvjp(fx_vjp_fwd, fx_vjp_bwd)
 
 
+# -- clean: impl-choice dispatch (ragged_paged_attention pattern: tuned()
+# picks WHICH implementation runs, and the pallas_call lives in the
+# kernel-arm wrapper the dispatcher reaches) ----------------------------------
+
+def fx_impl_choice_entry(x):
+    impl = autotune.tuned("fx3", "c1", "f32", ["kernel", "xla"],
+                          measure=None, source="s")
+    if impl == "kernel":
+        return fx_impl_kernel_arm(x)
+    return x
+
+
+def fx_impl_kernel_arm(x):
+    return pl.pallas_call(
+        _kernel,
+        grid=(1,),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
 # -- clean: deliberate fixed geometry, suppressed -----------------------------
 
 def fx_paged_fixed(x, bs):

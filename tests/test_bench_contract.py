@@ -65,6 +65,46 @@ def test_secondary_failure_is_visible_not_silent(bench, monkeypatch):
     assert order == ["d", "b"]
 
 
+def test_serving_key_contract(bench):
+    """_serving_keys is the pure loadgen-metrics -> bench-keys mapping;
+    the r07 serving metric surface (TTFT/TPOT percentiles, goodput,
+    occupancy decomposition incl. the spec bucket, spec accept rate)
+    must be present and correctly sourced."""
+    m = {"throughput_tok_s": 400.0, "goodput_tok_s": 380.0,
+         "e2e_p50_s": 1.0, "e2e_p99_s": 3.0,
+         "ttft_p50_s": 0.2, "ttft_p99_s": 0.9,
+         "tpot_p50_s": 0.02, "tpot_p99_s": 0.05,
+         "slot_occupancy": 0.85,
+         "occ_waste_queue_empty": 0.02,
+         "occ_waste_admission_blocked": 0.05,
+         "occ_waste_prefill": 0.06, "occ_waste_overrun": 0.01,
+         "occ_waste_spec_rejected": 0.01,
+         "prefix_cache_hit_rate": 0.7, "spec_accept_rate": 0.0}
+    spec_m = dict(m, spec_accept_rate=0.62, throughput_tok_s=450.0)
+    out = bench._serving_keys(m, spec_m)
+    for k in ("serving_ttft_p50", "serving_ttft_p99",
+              "serving_tpot_p50", "serving_tpot_p99",
+              "serving_goodput", "serving_occupancy",
+              "serving_spec_accept_rate", "serving_throughput_tok_s",
+              "serving_latency_p50_s", "serving_latency_p99_s",
+              "serving_occ_waste_queue_empty",
+              "serving_occ_waste_admission_blocked",
+              "serving_occ_waste_prefill", "serving_occ_waste_overrun",
+              "serving_occ_waste_spec_rejected",
+              "serving_prefix_cache_hit_rate"):
+        assert k in out, k
+    assert out["serving_goodput"] == 380.0
+    assert out["serving_ttft_p99"] == 0.9
+    assert out["serving_tpot_p50"] == 0.02
+    assert out["serving_occupancy"] == 0.85
+    assert out["serving_spec_accept_rate"] == 0.62   # from the spec arm
+    assert out["serving_spec_throughput_tok_s"] == 450.0
+    # without a speculative arm the rate comes from the main run (0.0)
+    solo = bench._serving_keys(m)
+    assert solo["serving_spec_accept_rate"] == 0.0
+    assert "serving_spec_throughput_tok_s" not in solo
+
+
 from conftest import requires_native_partial_manual
 
 

@@ -1,0 +1,104 @@
+"""Open-loop loadgen subsystem: arrival processes, workload synthesis,
+and the driver's end-to-end contract against a tiny engine."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.inference.loadgen import (OpenLoopDriver, WorkloadSpec,
+                                          burst_arrivals, gamma_arrivals,
+                                          percentile, poisson_arrivals,
+                                          synthesize)
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.models.llama import LlamaConfig
+
+CFG = LlamaConfig(vocab_size=256, hidden=64, n_layers=2, n_heads=4,
+                  n_kv_heads=2, ffn_hidden=128, max_seq_len=128,
+                  dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def test_arrival_processes_seeded_and_shaped():
+    a1 = poisson_arrivals(10.0, 500, seed=3)
+    a2 = poisson_arrivals(10.0, 500, seed=3)
+    assert np.array_equal(a1, a2)                 # byte-reproducible
+    assert np.all(np.diff(a1) >= 0)
+    # mean rate within 15% at n=500
+    assert abs(500 / a1[-1] - 10.0) < 1.5
+    g = gamma_arrivals(10.0, 1.0, 500, seed=3)    # cv=1 == Poisson-like
+    assert abs(500 / g[-1] - 10.0) < 1.5
+    bursty = gamma_arrivals(10.0, 4.0, 2000, seed=3)
+    smooth = gamma_arrivals(10.0, 0.25, 2000, seed=3)
+    cv = lambda x: np.std(np.diff(x)) / np.mean(np.diff(x))
+    assert cv(bursty) > 2.0 > 1.0 > cv(smooth)
+    b = burst_arrivals(10.0, 64, seed=1, burst_size=8)
+    assert len(b) == 64 and np.all(np.diff(b) >= 0)
+    # within a burst the gaps are ~1ms
+    assert np.diff(b)[:7].max() < 0.01
+    with pytest.raises(ValueError):
+        poisson_arrivals(0.0, 4)
+
+
+def test_workload_synthesis_contract():
+    spec = WorkloadSpec(n_requests=200, seed=5, vocab_size=256,
+                        prefix_len=16, n_prefixes=2, shared_frac=0.6,
+                        tail_max=48, new_min=2, new_max=6,
+                        sampled_frac=0.3, max_seq=96, rate=50.0)
+    reqs = synthesize(spec)
+    reqs2 = synthesize(spec)
+    assert len(reqs) == 200
+    assert all(np.array_equal(a.prompt, b.prompt)
+               and a.arrival == b.arrival and a.seed == b.seed
+               for a, b in zip(reqs, reqs2))      # deterministic
+    assert all(len(r.prompt) + r.max_new_tokens <= 96 for r in reqs)
+    heads = {r.prompt[:16].tobytes() for r in reqs
+             if len(r.prompt) >= 16}
+    # the two shared prefixes dominate the head population
+    shared = sum(1 for r in reqs if len(r.prompt) >= 16
+                 and sum(np.array_equal(r.prompt[:16], p.prompt[:16])
+                         for p in reqs) > 10)
+    assert shared > 60
+    n_sampled = sum(r.temperature > 0 for r in reqs)
+    assert 30 < n_sampled < 90
+    # long tail: visible on an UNCLAMPED spec (the clamped one above
+    # squashes the tail into tail_max by design)
+    free = synthesize(WorkloadSpec(n_requests=200, seed=5,
+                                   vocab_size=256, tail_max=4096))
+    lens = [len(r.prompt) for r in free]
+    assert max(lens) > 3 * int(np.median(lens))
+
+
+def test_driver_rush_clock_end_to_end():
+    """Deterministic saturation drive: every non-aborted request
+    completes, the abort fires mid-run, pages balance, and the
+    occupancy decomposition sums to 1."""
+    engine = ServingEngine(CFG, max_batch=2, page_size=16, max_seq=96,
+                           n_pages=1 + 12, prefill_budget=32, qb=8)
+    spec = WorkloadSpec(n_requests=24, seed=7, vocab_size=256,
+                        prefix_len=16, n_prefixes=1, shared_frac=0.5,
+                        tail_log_mean=2.5, tail_max=40, new_min=2,
+                        new_max=8, max_seq=96, rate=100.0)
+    reqs = synthesize(spec)
+    driver = OpenLoopDriver(engine, clock="rush")
+    m = driver.run(reqs, aborts={5: 11})
+    assert m["n_aborted"] == 1 and reqs[11].aborted
+    assert m["n_completed"] == 23
+    assert all(len(r.out_tokens) == r.max_new_tokens
+               for r in reqs if not r.aborted)
+    occ = (m["slot_occupancy"] + m["occ_waste_queue_empty"]
+           + m["occ_waste_admission_blocked"] + m["occ_waste_prefill"]
+           + m["occ_waste_overrun"] + m["occ_waste_spec_rejected"])
+    assert abs(occ - 1.0) < 0.01, m
+    assert m["goodput_tok_s"] <= m["throughput_tok_s"]
+    for k in ("ttft_p50_s", "ttft_p99_s", "tpot_p50_s", "tpot_p99_s",
+              "e2e_p50_s", "e2e_p99_s", "spec_accept_rate",
+              "prefix_cache_hit_rate", "unified_steps"):
+        assert k in m
+    acc = engine.page_accounting()
+    assert acc["total"] == engine.n_pages - 1
+    assert acc["slot_owned"] == 0 and acc["deferred_free"] == 0
+
+
+def test_percentile_helper():
+    assert percentile([], 99) == 0.0
+    assert percentile([1.0, 2.0, 3.0], 50) == 2.0

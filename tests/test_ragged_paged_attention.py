@@ -1,0 +1,197 @@
+"""Unified ragged-paged-attention: XLA arm vs dense reference, Pallas
+kernel (interpret mode) vs XLA arm, garbage-tail pinning, and the
+delegating ragged_prefill shim."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas.ragged_paged_attention import (
+    _ragged_paged_xla,
+    ragged_paged_attention,
+    ragged_paged_attention_kernel,
+    ragged_paged_supported,
+)
+from paddle_tpu.ops.pallas import ragged_prefill as shim
+
+
+def _dense_ref(q, k_pages, v_pages, rows, pos0, n_valid, sm_scale):
+    """Numpy reference: per valid token, softmax over its causal keys
+    gathered from the block table."""
+    C, qb, nH, d = q.shape
+    nkv = k_pages.shape[1]
+    G = nH // nkv
+    bs = k_pages.shape[3]
+    out = np.zeros_like(np.asarray(q, dtype=np.float32))
+    for c in range(C):
+        ks = np.asarray(k_pages)[rows[c]]           # [mb, nkv, d, bs]
+        ks = np.moveaxis(ks, 3, 1).reshape(-1, nkv, d)   # [mb*bs, nkv, d]
+        vs = np.asarray(v_pages)[rows[c]]           # [mb, nkv, bs, d]
+        vs = np.moveaxis(vs, 2, 1).reshape(-1, nkv, d)
+        for i in range(qb):
+            qpos = pos0[c] + min(i, n_valid[c] - 1)
+            n = qpos + 1
+            for h in range(nH):
+                s = (np.asarray(q)[c, i, h].astype(np.float32)
+                     @ ks[:n, h // G].T.astype(np.float32)) * sm_scale
+                p = np.exp(s - s.max())
+                p /= p.sum()
+                out[c, i, h] = p @ vs[:n, h // G].astype(np.float32)
+    return out
+
+
+def _mixed_case(seed=0, C=4, qb=8, nH=4, nkv=2, d=32, bs=16, mb=6,
+                n_pages=24):
+    """A mixed batch: one decode row (n_valid=1), one full prefill row,
+    one partial row, one idle-ish row — pos0 deliberately NOT
+    page-aligned for the partial rows."""
+    rng = np.random.default_rng(seed)
+    kp = jnp.asarray(rng.normal(size=(n_pages, nkv, d, bs)),
+                     jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(n_pages, nkv, bs, d)),
+                     jnp.float32)
+    q = jnp.asarray(rng.normal(size=(C, qb, nH, d)), jnp.float32)
+    rows = rng.integers(0, n_pages, size=(C, mb)).astype(np.int32)
+    pos0 = np.array([37, 0, 21, 3], np.int32)[:C]
+    n_valid = np.array([1, qb, 5, 2], np.int32)[:C]
+    return q, kp, vp, rows, pos0, n_valid
+
+
+def test_xla_arm_matches_dense_reference():
+    q, kp, vp, rows, pos0, n_valid = _mixed_case()
+    got = _ragged_paged_xla(q, kp, vp, jnp.asarray(rows),
+                            jnp.asarray(pos0), jnp.asarray(n_valid),
+                            0.35, "d_major")
+    ref = _dense_ref(q, kp, vp, rows, pos0, n_valid, 0.35)
+    np.testing.assert_allclose(np.asarray(got), ref, atol=2e-5, rtol=2e-5)
+
+
+def test_kernel_matches_xla_arm_mixed_batch():
+    # supported geometry: d=128, bs=128; interpret mode on CPU
+    rng = np.random.default_rng(1)
+    C, qb, nH, nkv, d, bs, mb, P = 3, 4, 4, 2, 128, 128, 3, 8
+    assert ragged_paged_supported((P, nkv, d, bs), nH, qb, 4)
+    kp = jnp.asarray(rng.normal(size=(P, nkv, d, bs)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(P, nkv, bs, d)), jnp.float32)
+    q = jnp.asarray(rng.normal(size=(C, qb, nH, d)), jnp.float32)
+    rows = jnp.asarray(rng.integers(0, P, size=(C, mb)), jnp.int32)
+    pos0 = jnp.asarray([200, 0, 131], jnp.int32)
+    n_valid = jnp.asarray([1, qb, 3], jnp.int32)
+    got = ragged_paged_attention_kernel(q, kp, vp, rows, pos0, n_valid,
+                                        0.5)
+    ref = _ragged_paged_xla(q, kp, vp, rows, pos0, n_valid, 0.5,
+                            "d_major")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("arm", ["xla", "kernel"])
+def test_garbage_tail_pinned(arm):
+    """Outputs (padding rows INCLUDED) must be invariant to garbage
+    beyond the last valid position: future page ids in the table and
+    key/value content past the mask."""
+    rng = np.random.default_rng(2)
+    if arm == "kernel":
+        C, qb, nH, nkv, d, bs, mb, P = 2, 2, 4, 2, 128, 128, 3, 8
+    else:
+        C, qb, nH, nkv, d, bs, mb, P = 2, 6, 4, 2, 32, 16, 4, 12
+    kp = np.asarray(rng.normal(size=(P, nkv, d, bs)), np.float32)
+    vp = np.asarray(rng.normal(size=(P, nkv, bs, d)), np.float32)
+    q = jnp.asarray(rng.normal(size=(C, qb, nH, d)), jnp.float32)
+    # disjoint pages per row so tail scrambles can't hit another row's
+    # (or an earlier table slot's) live keys
+    rows = rng.permutation(P)[:C * mb].reshape(C, mb).astype(np.int32)
+    pos0 = np.array([bs + 3, 0], np.int32)
+    n_valid = np.array([2, 1], np.int32)
+
+    def run(kpx, vpx, rowsx):
+        a = (ragged_paged_attention_kernel if arm == "kernel"
+             else lambda *x: _ragged_paged_xla(*x, "d_major"))
+        return np.asarray(a(q, jnp.asarray(kpx), jnp.asarray(vpx),
+                            jnp.asarray(rowsx), jnp.asarray(pos0),
+                            jnp.asarray(n_valid), 0.4))
+
+    base = run(kp, vp, rows)
+    # scramble table entries for pages wholly past each row's last pos
+    rows2 = rows.copy()
+    for c in range(C):
+        first_dead = (pos0[c] + n_valid[c] - 1) // bs + 1
+        rows2[c, first_dead:] = rng.integers(0, P, size=mb - first_dead)
+    # scramble k/v content past the last valid offset within live pages
+    kp2, vp2 = kp.copy(), vp.copy()
+    for c in range(C):
+        last = int(pos0[c] + n_valid[c] - 1)
+        pg, off = rows[c, last // bs], last % bs
+        kp2[pg, :, :, off + 1:] = rng.normal(
+            size=kp2[pg, :, :, off + 1:].shape)
+        vp2[pg, :, off + 1:, :] = rng.normal(
+            size=vp2[pg, :, off + 1:, :].shape)
+    assert np.array_equal(base, run(kp2, vp2, rows2))
+
+
+def test_shim_delegates_bit_equal():
+    """ragged_prefill (n_valid == qb) must be the unified arm exactly."""
+    q, kp, vp, rows, pos0, _ = _mixed_case(seed=3)
+    rows, pos0 = jnp.asarray(rows), jnp.asarray(pos0 * 0 + 16)
+    full = jnp.full((q.shape[0],), q.shape[1], jnp.int32)
+    a = shim._ragged_prefill_xla(q, kp, vp, rows, pos0, 0.3, "d_major")
+    b = _ragged_paged_xla(q, kp, vp, rows, pos0, full, 0.3, "d_major")
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_supported_gate():
+    assert ragged_paged_supported((8, 2, 128, 128), 4, 4, 4)
+    assert not ragged_paged_supported((8, 2, 64, 128), 4, 4, 4)   # d
+    assert not ragged_paged_supported((8, 2, 128, 16), 4, 4, 4)   # bs
+    assert not ragged_paged_supported((8, 3, 128, 128), 4, 4, 4)  # GQA
+    assert not ragged_paged_supported((8, 2, 128, 128), 4, 3, 4)  # rows%8
+    # shim gate: qb == page_size
+    assert shim.ragged_prefill_supported((8, 2, 128, 128), 4, 4)
+    assert not shim.ragged_prefill_supported((8, 2, 128, 16), 4, 4)
+
+
+def test_dispatcher_respects_autotune_impl_choice(monkeypatch):
+    """The impl axis ('kernel' vs 'xla') flows through the autotune
+    registry: whatever the registry answers is what runs."""
+    import paddle_tpu.ops.pallas.ragged_paged_attention as mod
+
+    rng = np.random.default_rng(5)
+    C, qb, nH, nkv, d, bs, mb, P = 2, 4, 4, 2, 128, 128, 2, 5
+    kp = jnp.asarray(rng.normal(size=(P, nkv, d, bs)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(P, nkv, bs, d)), jnp.float32)
+    q = jnp.asarray(rng.normal(size=(C, qb, nH, d)), jnp.float32)
+    rows = jnp.asarray(rng.integers(0, P, size=(C, mb)), jnp.int32)
+    pos0 = jnp.asarray([130, 0], jnp.int32)
+    n_valid = jnp.asarray([1, qb], jnp.int32)
+    asked = []
+
+    def pin(impl):
+        def fake(C_, qb_, *a, **k):
+            asked.append((C_, qb_))
+            return impl
+        monkeypatch.setattr(mod, "_tuned_impl", fake)
+
+    pin("xla")
+    got = mod.ragged_paged_attention(q, kp, vp, rows, pos0, n_valid, 0.5)
+    want = _ragged_paged_xla(q, kp, vp, rows, pos0, n_valid, 0.5,
+                             "d_major")
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+    pin("kernel")
+    got = mod.ragged_paged_attention(q, kp, vp, rows, pos0, n_valid, 0.5)
+    want = ragged_paged_attention_kernel(q, kp, vp, rows, pos0, n_valid,
+                                         0.5)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+    assert asked == [(C, qb), (C, qb)]   # registry consulted per call
+
+
+def test_dispatcher_uses_xla_on_unsupported_geometry():
+    q, kp, vp, rows, pos0, n_valid = _mixed_case(seed=4)
+    got = ragged_paged_attention(q, kp, vp, jnp.asarray(rows),
+                                 jnp.asarray(pos0),
+                                 jnp.asarray(n_valid), 0.35)
+    ref = _ragged_paged_xla(q, kp, vp, jnp.asarray(rows),
+                            jnp.asarray(pos0), jnp.asarray(n_valid),
+                            0.35, "d_major")
+    assert np.array_equal(np.asarray(got), np.asarray(ref))

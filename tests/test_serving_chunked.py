@@ -181,7 +181,8 @@ def test_page_accounting_invariant_randomized():
         st["decode_active_tokens"] + st["waste_prefill_slot_tokens"]
         + st["waste_queue_empty_slot_tokens"]
         + st["waste_admission_blocked_slot_tokens"]
-        + st["waste_overrun_slot_tokens"]), st
+        + st["waste_overrun_slot_tokens"]
+        + st["waste_spec_rejected_slot_tokens"]), st
     done = [r for r in reqs if not r.aborted]
     assert done and all(
         len(r.out_tokens) == r.max_new_tokens for r in done)
@@ -266,7 +267,8 @@ def test_run_reports_occupancy_decomposition():
     stats = engine.run(reqs)
     parts = (stats["slot_occupancy"] + stats["occ_waste_queue_empty"]
              + stats["occ_waste_admission_blocked"]
-             + stats["occ_waste_prefill"] + stats["occ_waste_overrun"])
+             + stats["occ_waste_prefill"] + stats["occ_waste_overrun"]
+             + stats["occ_waste_spec_rejected"])
     assert abs(parts - 1.0) < 0.01, stats
     assert 0.0 <= stats["prefill_padding_frac"] < 1.0
     assert "prefix_cache_hit_rate" in stats

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # One-command CI gate: static analysis -> op-contract baseline -> chaos
-# suite -> kernel parity -> tier-1.
+# suite -> serving smoke -> kernel parity -> loadgen smoke -> tier-1.
 #
 #   bash tools/ci_check.sh
 #
@@ -11,12 +11,13 @@
 #   40  chaos suite failed (fault injection / self-healing regressions)
 #   50  serving smoke failed (scheduler completion / page-leak check)
 #   60  kernel parity failed (fused kernel != unfused composition)
+#   70  loadgen smoke failed (open-loop saturation / occupancy ledger)
 #   30  tier-1 tests failed (ROADMAP.md command)
 #    0  all gates green
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== gate 1/6: tpu-lint (per-file + interprocedural rules) =="
+echo "== gate 1/7: tpu-lint (per-file + interprocedural rules) =="
 python -m tools.lint paddle_tpu tests --format=json > /tmp/tpu_lint.json
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -26,7 +27,7 @@ if [ "$rc" -ne 0 ]; then
 fi
 echo "tpu-lint: clean"
 
-echo "== gate 2/6: tpu-verify (abstract op-contract baseline) =="
+echo "== gate 2/7: tpu-verify (abstract op-contract baseline) =="
 JAX_PLATFORMS=cpu python -m tools.lint --contracts \
     --baseline artifacts/op_contracts.json
 rc=$?
@@ -36,7 +37,7 @@ if [ "$rc" -ne 0 ]; then
     exit 20
 fi
 
-echo "== gate 3/6: chaos suite (fault injection -> self-healing) =="
+echo "== gate 3/7: chaos suite (fault injection -> self-healing) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 rc=$?
@@ -46,7 +47,7 @@ if [ "$rc" -ne 0 ]; then
     exit 40
 fi
 
-echo "== gate 4/6: serving smoke (scheduler completion + zero page leak) =="
+echo "== gate 4/7: serving smoke (scheduler completion + zero page leak) =="
 JAX_PLATFORMS=cpu python -m tools.serving_smoke
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -55,7 +56,7 @@ if [ "$rc" -ne 0 ]; then
     exit 50
 fi
 
-echo "== gate 5/6: kernel parity (fused megakernels, CPU fallback arms) =="
+echo "== gate 5/7: kernel parity (fused megakernels, CPU fallback arms) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_fused_norm_epilogue.py \
     tests/test_fused_rope_attention.py tests/test_autotune.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
@@ -66,7 +67,17 @@ if [ "$rc" -ne 0 ]; then
     exit 60
 fi
 
-echo "== gate 6/6: tier-1 tests (ROADMAP.md) =="
+echo "== gate 6/7: loadgen smoke (open-loop saturation, >=200 arrivals) =="
+JAX_PLATFORMS=cpu python -m tools.loadgen_smoke
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "ci_check: loadgen smoke gate failed (rc=$rc) — the open-loop" \
+         "driver dropped work, leaked pages, or the occupancy ledger" \
+         "no longer closes" >&2
+    exit 70
+fi
+
+echo "== gate 7/7: tier-1 tests (ROADMAP.md) =="
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

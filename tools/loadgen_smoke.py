@@ -1,0 +1,74 @@
+"""Loadgen smoke gate (ci_check.sh exit 70): the open-loop traffic
+subsystem end to end on CPU — >= 200 seeded Poisson arrivals with a
+shared-prefix mix through the unified-step engine under the rush clock,
+one mid-run abort. Must complete every non-aborted request, return every
+page, and close the occupancy ledger (active + waste buckets == 1).
+Catches regressions in arrivals/workload/driver/metrics AND in the
+unified scheduler under sustained saturation before a TPU bench round.
+
+Usage:  JAX_PLATFORMS=cpu python -m tools.loadgen_smoke
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main() -> int:
+    import jax.numpy as jnp
+
+    from paddle_tpu.inference.loadgen import (OpenLoopDriver,
+                                              WorkloadSpec, synthesize)
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig(vocab_size=256, hidden=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_hidden=128, max_seq_len=128,
+                      dtype=jnp.float32, param_dtype=jnp.float32)
+    engine = ServingEngine(cfg, max_batch=3, page_size=16, max_seq=96,
+                           n_pages=1 + 16, prefill_budget=32, qb=8)
+    spec = WorkloadSpec(n_requests=200, seed=0, vocab_size=256,
+                        process="poisson", rate=100.0,
+                        prefix_len=16, n_prefixes=2, shared_frac=0.6,
+                        tail_log_mean=2.6, tail_log_sigma=0.7,
+                        tail_min=2, tail_max=48, new_min=2, new_max=6,
+                        sampled_frac=0.25, max_seq=96)
+    reqs = synthesize(spec)
+    driver = OpenLoopDriver(engine, clock="rush")
+    try:
+        m = driver.run(reqs, aborts={5: 17})
+    except RuntimeError as e:
+        print(f"loadgen_smoke: FAIL — {e}", file=sys.stderr)
+        return 1
+    if m["n_aborted"] != 1 or not reqs[17].aborted:
+        print("loadgen_smoke: FAIL — mid-run abort did not fire",
+              file=sys.stderr)
+        return 1
+    incomplete = [r.rid for r in reqs if not r.aborted
+                  and (len(r.out_tokens) != r.max_new_tokens
+                       or r.t_done is None)]
+    if incomplete:
+        print(f"loadgen_smoke: FAIL — incomplete requests {incomplete}",
+              file=sys.stderr)
+        return 1
+    acc = engine.page_accounting()
+    if (acc["total"] != engine.n_pages - 1 or acc["slot_owned"]
+            or acc["deferred_free"]):
+        print(f"loadgen_smoke: FAIL — page leak: {acc}", file=sys.stderr)
+        return 1
+    occ = (m["slot_occupancy"] + m["occ_waste_queue_empty"]
+           + m["occ_waste_admission_blocked"] + m["occ_waste_prefill"]
+           + m["occ_waste_overrun"] + m["occ_waste_spec_rejected"])
+    if abs(occ - 1.0) > 0.01:
+        print(f"loadgen_smoke: FAIL — occupancy ledger does not close: "
+              f"{occ} != 1 ({m})", file=sys.stderr)
+        return 1
+    print(f"loadgen_smoke: OK — {m['n_completed']}/{m['n_requests']} "
+          f"requests (+1 abort) in {m['steps']} steps, occupancy "
+          f"{m['slot_occupancy']}, goodput {m['goodput_tok_s']} tok/s, "
+          f"no leak")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
