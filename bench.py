@@ -228,13 +228,36 @@ def _bench_decode():
     tp16 = min(timed16(1), timed16(1))
     dt16 = min(timed16(n), timed16(n)) - tp16
     out["llama1b_decode_b16_tokens_per_sec"] = round(16 * (n - 1) / dt16, 1)
+    del m16
+
+    # weight-only int8 arm (ISSUE 8): same b8 workload with per-channel
+    # int8 weights and the epilogue-dequant matmul — decode at this
+    # batch is weight-roofline-bound, so the ratio vs the b8 key above
+    # IS the HBM-read saving
+    cfgq = LlamaConfig(vocab_size=32000, hidden=2048, n_layers=16,
+                       n_heads=16, n_kv_heads=4, ffn_hidden=5504,
+                       max_seq_len=2048, dtype=jnp.bfloat16,
+                       weight_only_int8=True)
+    mq = LlamaForCausalLM(cfgq, max_batch=8, max_seq_len=2048)
+
+    def timedq(k):
+        t0 = time.perf_counter()
+        mq.generate(prompt8, max_new_tokens=k)
+        return time.perf_counter() - t0
+
+    timedq(n); timedq(1)
+    tpq = min(timedq(1), timedq(1))
+    dtq = min(timedq(n), timedq(n)) - tpq
+    out["decode_weight_quant_tok_s"] = round(8 * (n - 1) / dtq, 1)
     return out
 
 
-def _serving_keys(m, spec_m=None):
+def _serving_keys(m, spec_m=None, kvq_m=None):
     """Pure mapping: loadgen metrics dict -> bench serving_* keys
     (tests/test_bench_contract.py pins the key set). ``spec_m`` is the
-    speculative-decode arm's metrics when that arm ran."""
+    speculative-decode arm's metrics when that arm ran; ``kvq_m`` the
+    serving_kv_quant arm's (loadgen metrics plus ``kv_bytes_per_token``
+    and ``quality_delta`` injected by _bench_serving)."""
     out = {
         "serving_throughput_tok_s": m["throughput_tok_s"],
         "serving_goodput": m["goodput_tok_s"],
@@ -259,9 +282,21 @@ def _serving_keys(m, spec_m=None):
         # speculative arm: accept rate + its throughput (0/absent keys
         # mean the arm did not run, not that it ran poorly)
         "serving_spec_accept_rate": (spec_m or m)["spec_accept_rate"],
+        # int8 KV plane: bytes/token of the MAIN run's pool, and whether
+        # that run stored quantized pages (0.0/1.0 — a float like every
+        # other bench value)
+        "serving_kv_bytes_per_token": m.get("kv_bytes_per_token", 0.0),
+        "serving_kv_quant_enabled": float(bool(m.get("kv_quant_enabled"))),
     }
     if spec_m is not None:
         out["serving_spec_throughput_tok_s"] = spec_m["throughput_tok_s"]
+    if kvq_m is not None:
+        out["serving_kv_quant_tok_s"] = kvq_m["throughput_tok_s"]
+        out["serving_kv_quant_bytes_per_token"] = \
+            kvq_m["kv_bytes_per_token"]
+        # greedy-token disagreement vs the fp engine on a fixed probe
+        # (0.0 = streams identical)
+        out["serving_kv_quant_quality_delta"] = kvq_m["quality_delta"]
     return out
 
 
@@ -318,10 +353,37 @@ def _bench_serving():
                            shared_frac=0.9, tail_log_mean=5.3,
                            tail_log_sigma=0.6, tail_min=32, tail_max=512,
                            new_min=64, new_max=128, max_seq=1536)
+    m = dict(m, kv_bytes_per_token=float(engine.kv_bytes_per_token()),
+             kv_quant_enabled=engine._kv_quant)
     eng2 = mk_engine(speculative_k=4)
     eng2.run(mk_warm())
     spec_m = OpenLoopDriver(eng2, clock="wall").run(synthesize(spec_wl))
-    return _serving_keys(m, spec_m)
+
+    # int8-KV arm (ISSUE 8): same short traffic shape through a
+    # kv_quant engine; quality delta = greedy-token disagreement vs the
+    # fp engine on a fixed probe (both engines are already compiled)
+    eng3 = mk_engine(kv_quant=True)
+    eng3.run(mk_warm())
+    kvq_m = dict(OpenLoopDriver(eng3, clock="wall").run(
+        synthesize(spec_wl)))
+    kvq_m["kv_bytes_per_token"] = float(eng3.kv_bytes_per_token())
+
+    def probe(eng):
+        rngp = np.random.RandomState(5)
+        reqs = [Request(rid=1000 + i,
+                        prompt=rngp.randint(1, cfg.vocab_size,
+                                            size=48).astype(np.int32),
+                        max_new_tokens=16, arrival=0.0)
+                for i in range(4)]
+        eng.run(reqs)
+        return [r.out_tokens for r in reqs]
+
+    fp_toks, q_toks = probe(engine), probe(eng3)
+    n_tok = sum(len(t) for t in fp_toks)
+    n_diff = sum(a != b for fa, qa in zip(fp_toks, q_toks)
+                 for a, b in zip(fa, qa))
+    kvq_m["quality_delta"] = round(n_diff / max(n_tok, 1), 4)
+    return _serving_keys(m, spec_m, kvq_m)
 
 
 def _bench_loss_curve():

@@ -209,6 +209,17 @@ define_flag("serving_spec_ngram", 3,
             "Longest n-gram the speculative prompt-lookup proposer "
             "matches against the request's history (falls back to "
             "shorter grams down to 1).")
+define_flag("serving_kv_quant", False,
+            "Store KV pages as symmetric int8 with a per-page, per-head "
+            "fp32 scale plane ([L, n_pages, n_kv_heads]); dequant is "
+            "fused into both ragged-paged-attention arms. Halves KV "
+            "bytes per token (~2x sequences per pool). Off = bit-"
+            "identical bf16/fp32 pages.")
+define_flag("decode_weight_quant", False,
+            "Weight-only int8 for the decode path: per-output-channel "
+            "absmax scales with dequant fused into the matmul epilogue "
+            "(ops/pallas/quant_matmul.py; XLA fallback elsewhere). Off "
+            "= full-precision weights, bit-identical.")
 
 define_flag("resilient_max_bad_steps", 3,
             "Consecutive NaN/Inf steps tolerated (skipped) before the "

@@ -45,6 +45,7 @@ import dataclasses
 import hashlib
 import math
 import time
+import warnings
 from typing import Optional
 
 import numpy as np
@@ -223,15 +224,27 @@ class ServingEngine:
                  prefill_budget: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
                  prefix_cache_pages: Optional[int] = None,
-                 decode_quantum: int = 8,
+                 decode_quantum: Optional[int] = None,
                  admit_aging: int = 64,
-                 weight_only_int8: bool = False,
+                 weight_only_int8: Optional[bool] = None,
                  qb: Optional[int] = None,
                  speculative_k: Optional[int] = None,
-                 spec_ngram: Optional[int] = None):
+                 spec_ngram: Optional[int] = None,
+                 kv_quant: Optional[bool] = None):
+        if decode_quantum is not None:
+            # the unified step (PR 7) has no decode-quantum boundary;
+            # the kwarg was previously swallowed silently
+            warnings.warn(
+                "ServingEngine(decode_quantum=...) is deprecated and has "
+                "no effect: the unified ragged-paged-attention step has "
+                "no decode-quantum boundary", DeprecationWarning,
+                stacklevel=2)
+        self.decode_quantum = max(1, decode_quantum or 8)  # legacy attr
         self.cfg = cfg
         self.params = params if params is not None else init_llama_params(
             cfg, jax.random.PRNGKey(seed))
+        if weight_only_int8 is None:
+            weight_only_int8 = bool(GLOBAL_FLAGS.get("decode_weight_quant"))
         if (weight_only_int8 or cfg.weight_only_int8) and not isinstance(
                 self.params["blocks"]["wq"], tuple):
             # halves weight HBM (per-column absmax int8 + bf16 scales;
@@ -258,6 +271,9 @@ class ServingEngine:
             speculative_k = GLOBAL_FLAGS.get("serving_speculative_k")
         if spec_ngram is None:
             spec_ngram = GLOBAL_FLAGS.get("serving_spec_ngram")
+        if kv_quant is None:
+            kv_quant = GLOBAL_FLAGS.get("serving_kv_quant")
+        self._kv_quant = bool(kv_quant)
         # unified grid: n_rows chunks of qb tokens each. Every decoding
         # slot gets one row per step, remaining rows carry prefill
         # slices, so n_rows >= max_batch.
@@ -275,15 +291,21 @@ class ServingEngine:
             self._proposer = None
         self._cache_on = bool(prefix_cache)
         self.admit_aging = admit_aging
-        # decode_quantum is accepted for API compatibility with the
-        # pre-unified engine (prefill program + decode quanta); the
-        # unified step has no quantum boundary, so it is unused.
-        self.decode_quantum = max(1, decode_quantum)
         L, nKV, d = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        # serving_kv_quant: pages are symmetric int8 with a per-page,
+        # per-head fp32 scale plane per layer — KV bytes per token drop
+        # from 2*itemsize*nKV*dH to 2*nKV*dH (+ amortized scales), so a
+        # fixed-byte pool holds ~2x the sequences (kv_bytes_per_token()).
+        page_dtype = jnp.int8 if self._kv_quant else cfg.dtype
         self.k_pages = jnp.zeros((L, self.n_pages, nKV, d, self.bs),
-                                 cfg.dtype)
+                                 page_dtype)
         self.v_pages = jnp.zeros((L, self.n_pages, nKV, self.bs, d),
-                                 cfg.dtype)
+                                 page_dtype)
+        if self._kv_quant:
+            self.k_scales = jnp.zeros((L, self.n_pages, nKV), jnp.float32)
+            self.v_scales = jnp.zeros((L, self.n_pages, nKV), jnp.float32)
+        else:
+            self.k_scales = self.v_scales = None
         self.table = np.zeros((self.B, self.max_blocks), np.int32)  # sink
         self.seq_lens = np.zeros((self.B,), np.int32)
         self.cur_tok = np.zeros((self.B,), np.int32)
@@ -308,8 +330,12 @@ class ServingEngine:
         self._prefilling: dict[int, int] = {}
         self.pool = _PagePool(self.n_pages, cache_limit=prefix_cache_pages)
         self.queue: list[Request] = []
-        self._unified = jax.jit(self._unified_step_impl,
-                                donate_argnums=(1, 2))
+        if self._kv_quant:
+            self._unified = jax.jit(self._unified_step_impl_q,
+                                    donate_argnums=(1, 2, 3, 4))
+        else:
+            self._unified = jax.jit(self._unified_step_impl,
+                                    donate_argnums=(1, 2))
         # pipelining state (see step() docstring): _inflight holds the
         # dispatched-but-unharvested program's (output tokens, row
         # snapshot); _prev_out_dev chains row outputs on-device into the
@@ -427,6 +453,120 @@ class ServingEngine:
                                pos0 + n_valid - 1)[:, None]
         return out, ks, vs
 
+    def _unified_step_impl_q(self, params, k_pages, v_pages, k_scales,
+                             v_scales, tokens, prev_out, chain_mask,
+                             chain_row, ptable, row_slot, pos0, n_valid,
+                             temps, topps, seeds):
+        """``serving_kv_quant`` variant of the unified step: pages are
+        int8, each layer's scatter writes quantized pages and maintains
+        the per-page, per-head scale plane, and the attention call
+        dequantizes in-kernel (both RPA arms).
+
+        A page fills incrementally, so its scale is a *running absmax*:
+
+        1. scatter-max the plane with this chunk's token absmaxes
+           (commutative — deterministic under duplicate page ids);
+        2. rescale the previously written int8 content of every page a
+           chunk straddles onto the new scale (exact no-op when the
+           scale did not grow; duplicate writes across rows of one
+           request produce identical bytes, so order cannot matter);
+        3. quantize the new tokens against the updated scale and
+           scatter them per (page, offset) exactly like the bf16 path.
+
+        Speculative rollback and aborts need no extra handling: a
+        rejected draft's or reused page's *content* is overwritten
+        before it can be attended (same argument as the bf16 path), and
+        a page's scale plane entry is reset to 0 when the allocator
+        hands the page to a new request (_admit), so stale absmaxes
+        cannot degrade a later tenant's precision."""
+        cfg = self.cfg
+        C, qb = tokens.shape
+        nH, nKV, dH = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        from ..ops.pallas.ragged_paged_attention import \
+            ragged_paged_attention
+        from ..ops.quant import (kv_scale_update, quantize_to_scale,
+                                 rescale_int8)
+
+        tok0 = jnp.where(chain_mask, prev_out[chain_row, 0], tokens[:, 0])
+        tokens = jnp.concatenate([tok0[:, None], tokens[:, 1:]], axis=1)
+        rows = ptable[row_slot]                      # [C, max_blocks]
+        positions = pos0[:, None] + jnp.arange(qb, dtype=jnp.int32)
+        valid = jnp.arange(qb, dtype=jnp.int32)[None, :] < n_valid[:, None]
+        blk = positions // self.bs
+        offs = (positions % self.bs).reshape(-1)
+        pages = jnp.where(valid, jnp.take_along_axis(rows, blk, axis=1),
+                          0).reshape(-1)             # padding -> sink
+        # every page this step's chunks might straddle (per row: the
+        # first written page plus any the qb-token span can spill
+        # into); entries past a row's span hit its future pages or the
+        # sink, where rescaling is the exact no-op described above
+        npw = (qb - 1) // self.bs + 2
+        blk_rw = jnp.clip(
+            pos0[:, None] // self.bs
+            + jnp.arange(npw, dtype=jnp.int32)[None, :],
+            0, self.max_blocks - 1)
+        pages_rw = jnp.take_along_axis(rows, blk_rw, axis=1).reshape(-1)
+        x = params["wte"][tokens].astype(cfg.dtype)  # [C, qb, H]
+        cos, sin = rope_angles(cfg, positions)       # [C, qb, dH/2]
+        cos, sin = cos[:, :, None, :], sin[:, :, None, :]
+        sm_scale = 1.0 / math.sqrt(dH)
+
+        def body(carry, inp):
+            x = carry
+            bp, kp, vp, ksc, vsc = inp
+            h = rms_norm(x, bp["attn_norm"], cfg.rms_eps)
+            q = _mm(h, bp["wq"], cfg).reshape(C, qb, nH, dH)
+            k = _mm(h, bp["wk"], cfg).reshape(C, qb, nKV, dH)
+            v = _mm(h, bp["wv"], cfg).reshape(C, qb, nKV, dH)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            kf = k.reshape(C * qb, nKV, dH).astype(jnp.float32)
+            vf = v.reshape(C * qb, nKV, dH).astype(jnp.float32)
+            ksc_new = kv_scale_update(
+                ksc, pages, jnp.max(jnp.abs(kf), axis=-1) / 127.0)
+            vsc_new = kv_scale_update(
+                vsc, pages, jnp.max(jnp.abs(vf), axis=-1) / 127.0)
+            kp = kp.at[pages_rw].set(rescale_int8(
+                kp[pages_rw],
+                jnp.take(ksc, pages_rw, axis=0)[:, :, None, None],
+                jnp.take(ksc_new, pages_rw, axis=0)[:, :, None, None]))
+            vp = vp.at[pages_rw].set(rescale_int8(
+                vp[pages_rw],
+                jnp.take(vsc, pages_rw, axis=0)[:, :, None, None],
+                jnp.take(vsc_new, pages_rw, axis=0)[:, :, None, None]))
+            kp = kp.at[pages, :, :, offs].set(quantize_to_scale(
+                kf, jnp.take(ksc_new, pages, axis=0)[:, :, None]))
+            vp = vp.at[pages, :, offs].set(quantize_to_scale(
+                vf, jnp.take(vsc_new, pages, axis=0)[:, :, None]))
+            o = ragged_paged_attention(q, kp, vp, rows, pos0, n_valid,
+                                       sm_scale, k_layout="d_major",
+                                       k_scales=ksc_new, v_scales=vsc_new)
+            x = x + _mm(o.reshape(C, qb, nH * dH), bp["wo"], cfg)
+            h = rms_norm(x, bp["ffn_norm"], cfg.rms_eps)
+            x = x + _mm(jax.nn.silu(
+                _mm(h, bp["w_gate"], cfg).astype(jnp.float32)).astype(
+                    cfg.dtype) * _mm(h, bp["w_up"], cfg), bp["w_down"], cfg)
+            return x, (kp, vp, ksc_new, vsc_new)
+
+        x, (ks, vs, kss, vss) = lax.scan(
+            body, x, (params["blocks"], k_pages, v_pages, k_scales,
+                      v_scales))
+        x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+        if self.spec_k:
+            logits = _mm(x, params["head"], cfg).astype(jnp.float32)
+            picks = _pick_tokens(
+                logits.reshape(C * qb, -1), jnp.repeat(temps, qb),
+                jnp.repeat(topps, qb), jnp.repeat(seeds, qb),
+                positions.reshape(-1))
+            out = picks.reshape(C, qb)
+        else:
+            last = x[jnp.arange(C), n_valid - 1]     # [C, H]
+            logits = _mm(last[:, None], params["head"], cfg).astype(
+                jnp.float32)[:, 0]
+            out = _pick_tokens(logits, temps, topps, seeds,
+                               pos0 + n_valid - 1)[:, None]
+        return out, ks, vs, kss, vss
+
     # -- scheduler ----------------------------------------------------------
 
     def submit(self, req: Request) -> None:
@@ -476,7 +616,17 @@ class ServingEngine:
         one dict hit per page, no per-page prefix comparison."""
         n_full = len(prompt) // self.bs
         out: list[bytes] = []
-        h = hashlib.sha1(b"pt-prefix:%d" % self.bs)
+        # the hash preimage covers everything that determines a cached
+        # page's bytes: the prefix tokens, the page size, and the KV
+        # representation. Under serving_kv_quant the stored bytes are
+        # the quantized page + its scale-plane entries — a deterministic
+        # function of the prefix tokens given the quant mode — so
+        # tagging the seed keeps int8 and bf16 page content from ever
+        # aliasing in the cache.
+        seed = b"pt-prefix:%d" % self.bs
+        if self._kv_quant:
+            seed += b":kvq8"
+        h = hashlib.sha1(seed)
         for j in range(n_full):
             h.update(np.ascontiguousarray(
                 prompt[j * self.bs:(j + 1) * self.bs],
@@ -489,7 +639,17 @@ class ServingEngine:
         pages on demand when the list runs short."""
         if len(self.pool.free) < n:
             self.pool.evict(n - len(self.pool.free))
-        return self.pool.alloc(n)
+        pages = self.pool.alloc(n)
+        if self._kv_quant and pages:
+            # a reused page's stale running-absmax would quantize the
+            # new tenant's tokens against a garbage (possibly inflated)
+            # scale; zeroing at allocation makes the first write set a
+            # fresh scale. Chained after any in-flight step's donated
+            # output, so programs already dispatched are unaffected.
+            pg = jnp.asarray(pages, jnp.int32)
+            self.k_scales = self.k_scales.at[:, pg].set(0.0)
+            self.v_scales = self.v_scales.at[:, pg].set(0.0)
+        return pages
 
     def _admit(self, now: float) -> None:
         """Admit arrived requests into free slots, FIFO with skip: a
@@ -725,11 +885,20 @@ class ServingEngine:
         # state — every operand is a fresh local array here, but
         # jnp.array (copying) keeps the handoff alias-free by
         # construction.
-        out, self.k_pages, self.v_pages = self._unified(
-            self.params, self.k_pages, self.v_pages, jnp.array(tokens),
-            prev_out, jnp.array(cmask), jnp.array(crow), jnp.array(ptab),
-            jnp.array(rs), jnp.array(p0), jnp.array(nv), jnp.array(tt),
-            jnp.array(tp), jnp.array(tsd))
+        if self._kv_quant:
+            (out, self.k_pages, self.v_pages, self.k_scales,
+             self.v_scales) = self._unified(
+                self.params, self.k_pages, self.v_pages, self.k_scales,
+                self.v_scales, jnp.array(tokens), prev_out,
+                jnp.array(cmask), jnp.array(crow), jnp.array(ptab),
+                jnp.array(rs), jnp.array(p0), jnp.array(nv),
+                jnp.array(tt), jnp.array(tp), jnp.array(tsd))
+        else:
+            out, self.k_pages, self.v_pages = self._unified(
+                self.params, self.k_pages, self.v_pages, jnp.array(tokens),
+                prev_out, jnp.array(cmask), jnp.array(crow), jnp.array(ptab),
+                jnp.array(rs), jnp.array(p0), jnp.array(nv), jnp.array(tt),
+                jnp.array(tp), jnp.array(tsd))
         self._inflight = (out, snap)
         self._prev_out_dev = out
         # post-dispatch bookkeeping: prefix-cache offers for pages this
@@ -857,6 +1026,24 @@ class ServingEngine:
                 # belong to a newer request; only the completion time
                 # remains to record
                 req.t_done = now
+
+    def kv_bytes_per_page(self) -> float:
+        """HBM bytes one KV page costs across all layers, including the
+        page's share of the scale planes. The structural capacity
+        argument for serving_kv_quant: at a fixed page-pool byte budget
+        the pool holds bytes_bf16/bytes_int8 ~ 2x the pages, hence ~2x
+        the concurrent sequences."""
+        cfg = self.cfg
+        L, nKV, d = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        per = L * nKV * d * self.bs * (self.k_pages.dtype.itemsize
+                                       + self.v_pages.dtype.itemsize)
+        if self._kv_quant:
+            per += 2 * L * nKV * self.k_scales.dtype.itemsize
+        return float(per)
+
+    def kv_bytes_per_token(self) -> float:
+        """Amortized KV bytes per cached token (page bytes / page size)."""
+        return self.kv_bytes_per_page() / self.bs
 
     def page_accounting(self) -> dict:
         """Page census for the leak invariant: every non-sink page is in

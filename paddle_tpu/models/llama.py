@@ -132,11 +132,17 @@ def _deq(w, scale):
 
 def _mm(x, w, cfg):
     """Matmul with optional weight-only int8 (reference: weight_only_linear,
-    incubate/nn/functional; scale per output column)."""
+    incubate/nn/functional; scale per output column). Quantized weights
+    route through quant_matmul: per-output-channel scales commute with
+    the contraction, so dequant is fused into the matmul epilogue (one
+    fp32 row multiply on the accumulator) instead of materializing a
+    bf16 weight copy — the autotune-registered Pallas kernel on TPU,
+    the same-algebra XLA path elsewhere."""
     if isinstance(w, tuple):  # (int8 weights, scales)
+        from ..ops.pallas.quant_matmul import quant_matmul
+
         wq, scale = w
-        return jnp.einsum("...h,hk->...k", x, _deq(wq, scale),
-                          preferred_element_type=jnp.float32).astype(cfg.dtype)
+        return quant_matmul(x, wq, scale).astype(cfg.dtype)
     return jnp.einsum("...h,hk->...k", x, w.astype(cfg.dtype),
                       preferred_element_type=jnp.float32).astype(cfg.dtype)
 
@@ -164,6 +170,17 @@ def _repeat_kv(x, n_rep):
         return x
     B, T, nKV, dH = x.shape
     return jnp.repeat(x, n_rep, axis=2)
+
+
+def _decode_weight_quant_flag() -> bool:
+    """Init-time read of the decode weight-quant flag (default off):
+    flips the decode engines onto per-output-channel int8 weights with
+    epilogue dequant (ops/pallas/quant_matmul.py) without a config
+    change, mirroring cfg.weight_only_int8."""
+    from ..core.flags import GLOBAL_FLAGS
+
+    return (bool(GLOBAL_FLAGS.get("decode_weight_quant"))
+            if GLOBAL_FLAGS.has("decode_weight_quant") else False)
 
 
 def _use_fused_norm_epilogue() -> bool:
@@ -358,8 +375,8 @@ class LlamaForCausalLM:
         self.cfg = cfg
         self.params = params if params is not None else init_llama_params(
             cfg, jax.random.PRNGKey(seed))
-        if cfg.weight_only_int8 and not isinstance(
-                self.params["blocks"]["wq"], tuple):
+        if (cfg.weight_only_int8 or _decode_weight_quant_flag()) \
+                and not isinstance(self.params["blocks"]["wq"], tuple):
             self.params = quantize_weights_int8(self.params)
         self.max_batch = max_batch
         self.max_seq = max_seq_len or cfg.max_seq_len

@@ -67,10 +67,14 @@ def decode_attention_supported(cache_shape, head_dim: int,
     return (S % 128 == 0) if S <= BLOCK_S else (S % BLOCK_S == 0)
 
 
-def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_s,
-                   seq_len, sm_scale):
+def _decode_kernel(pos_ref, *refs, block_s, seq_len, sm_scale,
+                   quant=False):
     import jax.experimental.pallas as pl
 
+    if quant:
+        q_ref, k_ref, v_ref, ksc_ref, vsc_ref, o_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref = refs
     pos = pos_ref[0]
     q = q_ref[...]                       # [G, d] — this kv-head's q group
     G, d = q.shape
@@ -85,6 +89,13 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_s,
         m_i, l_i, acc = carry
         k = k_ref[pl.dslice(sb * block_s, block_s), :]      # [bs, d]
         v = v_ref[pl.dslice(sb * block_s, block_s), :]
+        if quant:
+            # per-position dequant (same fp32-multiply-then-cast contract
+            # as ops/quant.py::dequantize_int8)
+            ks = ksc_ref[0, pl.dslice(sb * block_s, block_s)]
+            vs = vsc_ref[0, pl.dslice(sb * block_s, block_s)]
+            k = (k.astype(jnp.float32) * ks[:, None]).astype(q.dtype)
+            v = (v.astype(jnp.float32) * vs[:, None]).astype(q.dtype)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         offs = sb * block_s + jax.lax.iota(jnp.int32, block_s)
         s = jnp.where((offs <= pos)[None, :], s, -1e30)
@@ -533,12 +544,25 @@ def _tuned_block_s(B: int, nKV: int, G: int, S: int, d: int,
 
 @functools.partial(jax.jit, static_argnames=("sm_scale", "block_s"))
 def decode_attention(q, cache_k, cache_v, pos, sm_scale: float,
-                     block_s: int | None = None):
+                     block_s: int | None = None,
+                     k_scale=None, v_scale=None):
     """q [B, nH, d] (one token); cache_k/v [B, nKV, S, d] (kv-head-major,
     the engine's native layout — no per-step transpose); pos scalar int32
-    (last valid cache index). Returns o [B, nH, d]."""
+    (last valid cache index). Returns o [B, nH, d].
+
+    int8 caches: pass per-position fp32 scales k_scale/v_scale
+    [B, nKV, S]; dequant is fused into the kernel's k/v tile loads (the
+    dense cache appends one token per step, so per-position scales need
+    no rescue of previously written content — unlike the paged plane's
+    running per-page absmax)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    if cache_k.dtype == jnp.int8 and (k_scale is None or v_scale is None):
+        raise ValueError(
+            "decode_attention: int8 caches require k_scale and v_scale "
+            "([B, nKV, S] fp32)")
+    quant = k_scale is not None
 
     B, nKV, S, d = cache_k.shape
     nH = q.shape[1]
@@ -548,22 +572,28 @@ def decode_attention(q, cache_k, cache_v, pos, sm_scale: float,
     if block_s is None:
         block_s = _tuned_block_s(B, nKV, G, S, d, q.dtype)
 
+    _bcast = lambda ib, ih, *_: (ib, ih, 0, 0)  # noqa: E731
+    in_specs = [
+        pl.BlockSpec((None, None, G, d), _bcast),
+        pl.BlockSpec((None, None, S, d), _bcast),
+        pl.BlockSpec((None, None, S, d), _bcast),
+    ]
+    operands = [qg, kt, vt]
+    if quant:
+        in_specs += [pl.BlockSpec((None, None, 1, S), _bcast)] * 2
+        operands += [k_scale.astype(jnp.float32).reshape(B, nKV, 1, S),
+                     v_scale.astype(jnp.float32).reshape(B, nKV, 1, S)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B, nKV),
-        in_specs=[
-            pl.BlockSpec((None, None, G, d), lambda ib, ih, *_: (ib, ih, 0, 0)),
-            pl.BlockSpec((None, None, S, d), lambda ib, ih, *_: (ib, ih, 0, 0)),
-            pl.BlockSpec((None, None, S, d), lambda ib, ih, *_: (ib, ih, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, None, G, d),
-                               lambda ib, ih, *_: (ib, ih, 0, 0)),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((None, None, G, d), _bcast),
     )
     out = pl.pallas_call(
         functools.partial(_decode_kernel, block_s=block_s, seq_len=S,
-                          sm_scale=sm_scale),
+                          sm_scale=sm_scale, quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, nKV, G, d), q.dtype),
         interpret=_interpret_mode(),
-    )(jnp.asarray(pos, jnp.int32).reshape(1), qg, kt, vt)
+    )(jnp.asarray(pos, jnp.int32).reshape(1), *operands)
     return out.reshape(B, nH, d)

@@ -24,6 +24,14 @@ Contract shared by the kernel and the XLA fallback:
   causality, so rows may carry future/garbage page ids.
 - pos0 [C] int32: absolute position of the chunk's first token.
 - n_valid [C] int32 in [1, qb]: valid token count per chunk.
+- k_scales / v_scales [P, nKV] fp32 (optional): per-page, per-head
+  dequant scales for int8 pages (``serving_kv_quant``). Required iff
+  the pages are int8. Both arms dequantize identically — fp32 multiply
+  on the gathered/VMEM tile, then cast to the compute dtype
+  (ops/quant.py::dequantize_int8) — so the arms stay equality-pinned
+  on quantized pages too. In the kernel the scales ride the scalar-
+  prefetch path next to the block-table rows and are looked up per
+  (page, kv-head) program.
 
 Masking is PINNED across both arms: query row i attends keys
 kpos <= pos0 + min(i, n_valid - 1).  Padding rows i >= n_valid thus
@@ -67,17 +75,29 @@ def ragged_paged_supported(kt_pages_shape, n_q_heads: int, qb: int,
     return d in (128, 256) and bs % 128 == 0
 
 
-def _rpa_kernel(rows_ref, pos0_ref, nval_ref, q_ref, k_ref, v_ref, o_ref,
-                m_sc, l_sc, acc_sc, *, qb, bs, G, n_blocks, sm_scale):
+def _rpa_kernel(rows_ref, pos0_ref, nval_ref, *refs, qb, bs, G, n_blocks,
+                sm_scale, quant, mb, nkv):
     """One (chunk, kv-head, page) program: this chunk's qb*G query rows
     (row r = query token r//G, group head r%G) against one table-selected
     page, online-softmax accumulated in scratch over the page grid dim.
     Pages entirely past the chunk's last valid position are skipped —
     their keys would be fully masked, and exp(-1e30 - m) == 0 in fp32,
-    so skipping is exact, not an approximation."""
+    so skipping is exact, not an approximation.
+
+    ``quant``: int8 pages — two extra scalar-prefetch refs carry the
+    flattened [P * nKV] scale planes; the k/v tiles are dequantized in
+    VMEM (fp32 multiply, cast to the q dtype) before the dots, the same
+    op order as the XLA arm."""
     import jax.experimental.pallas as pl
 
+    if quant:
+        ksc_ref, vsc_ref, q_ref, k_ref, v_ref, o_ref, m_sc, l_sc, acc_sc \
+            = refs
+    else:
+        ksc_ref = vsc_ref = None
+        q_ref, k_ref, v_ref, o_ref, m_sc, l_sc, acc_sc = refs
     c = pl.program_id(0)
+    h = pl.program_id(1)
     j = pl.program_id(2)
     last = pos0_ref[c] + nval_ref[c] - 1            # last valid position
 
@@ -93,6 +113,10 @@ def _rpa_kernel(rows_ref, pos0_ref, nval_ref, q_ref, k_ref, v_ref, o_ref,
     def _compute():
         q = q_ref[...]                              # [qb*G, d]
         k = k_ref[...]                              # [d, bs] (d-major)
+        if quant:
+            pg = rows_ref[c * mb + j]
+            k = (k.astype(jnp.float32)
+                 * ksc_ref[pg * nkv + h]).astype(q.dtype)
         s = jax.lax.dot(q, k, preferred_element_type=jnp.float32) * sm_scale
         off = jax.lax.iota(jnp.int32, qb * G) // G
         qpos = pos0_ref[c] + jnp.minimum(off, nval_ref[c] - 1)
@@ -105,6 +129,10 @@ def _rpa_kernel(rows_ref, pos0_ref, nval_ref, q_ref, k_ref, v_ref, o_ref,
         l_sc[0, :] = l_sc[0, :] * alpha + jnp.sum(p, axis=1)
         m_sc[0, :] = m_new
         v = v_ref[...]                              # [bs, d]
+        if quant:
+            pg = rows_ref[c * mb + j]
+            v = (v.astype(jnp.float32)
+                 * vsc_ref[pg * nkv + h]).astype(q_ref.dtype)
         pv = jax.lax.dot(p.astype(v.dtype), v,
                          preferred_element_type=jnp.float32)
         acc_sc[...] = acc_sc[...] * alpha[:, None] + pv
@@ -118,9 +146,13 @@ def _rpa_kernel(rows_ref, pos0_ref, nval_ref, q_ref, k_ref, v_ref, o_ref,
 
 @functools.partial(jax.jit, static_argnames=("sm_scale",))
 def ragged_paged_attention_kernel(q, kt_pages, v_pages, rows, pos0,
-                                  n_valid, sm_scale: float):
+                                  n_valid, sm_scale: float,
+                                  k_scales=None, v_scales=None):
     """MXU unified-RPA kernel (d-major k pages).  See module docstring
-    for the contract; gate with ragged_paged_supported()."""
+    for the contract; gate with ragged_paged_supported().  int8 pages
+    take the per-page scale planes as two extra scalar-prefetch
+    operands (flattened [P * nKV]) riding next to the block-table
+    rows."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -129,56 +161,76 @@ def ragged_paged_attention_kernel(q, kt_pages, v_pages, rows, pos0,
     G = nH // nkv
     mb = rows.shape[1]
     bs = kt_pages.shape[3]
+    quant = k_scales is not None
     # row r of the [qb*G, d] q block = (query token r//G, group head r%G):
     # GQA never inflates the page reads, matching the decode kernels
     qg = q.reshape(C, qb, nkv, G, d).transpose(0, 2, 1, 3, 4)
     qg = qg.reshape(C, nkv, qb * G, d)
     rows_flat = rows.reshape(-1).astype(jnp.int32)
 
+    # index maps take every scalar-prefetch ref after the grid indices;
+    # only the block-table rows steer the block selection
+    def _qmap(c, h, j, rf, *_):
+        return (c, h, 0, 0)
+
+    def _pmap(c, h, j, rf, *_):
+        return (rf[c * mb + j], h, 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,                      # rows_flat, pos0, n_valid
+        # rows_flat, pos0, n_valid (+ k/v scale planes when quantized)
+        num_scalar_prefetch=5 if quant else 3,
         grid=(C, nkv, mb),
         in_specs=[
-            pl.BlockSpec((None, None, qb * G, d),
-                         lambda c, h, j, rf, p0, nv: (c, h, 0, 0)),
-            pl.BlockSpec((None, None, d, bs),
-                         lambda c, h, j, rf, p0, nv: (rf[c * mb + j], h, 0, 0)),
-            pl.BlockSpec((None, None, bs, d),
-                         lambda c, h, j, rf, p0, nv: (rf[c * mb + j], h, 0, 0)),
+            pl.BlockSpec((None, None, qb * G, d), _qmap),
+            pl.BlockSpec((None, None, d, bs), _pmap),
+            pl.BlockSpec((None, None, bs, d), _pmap),
         ],
-        out_specs=pl.BlockSpec((None, None, qb * G, d),
-                               lambda c, h, j, rf, p0, nv: (c, h, 0, 0)),
+        out_specs=pl.BlockSpec((None, None, qb * G, d), _qmap),
         scratch_shapes=[pltpu.VMEM((8, qb * G), jnp.float32),
                         pltpu.VMEM((8, qb * G), jnp.float32),
                         pltpu.VMEM((qb * G, d), jnp.float32)],
     )
-    out = pl.pallas_call(
+    call = pl.pallas_call(
         functools.partial(_rpa_kernel, qb=qb, bs=bs, G=G, n_blocks=mb,
-                          sm_scale=sm_scale),
+                          sm_scale=sm_scale, quant=quant, mb=mb, nkv=nkv),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((C, nkv, qb * G, d), q.dtype),
         interpret=_interpret_mode(),
-    )(rows_flat, pos0.astype(jnp.int32), n_valid.astype(jnp.int32),
-      qg, kt_pages, v_pages)
+    )
+    pre = (rows_flat, pos0.astype(jnp.int32), n_valid.astype(jnp.int32))
+    if quant:
+        pre = pre + (k_scales.reshape(-1).astype(jnp.float32),
+                     v_scales.reshape(-1).astype(jnp.float32))
+    out = call(*pre, qg, kt_pages, v_pages)
     return out.reshape(C, nkv, qb, G, d).transpose(0, 2, 1, 3, 4).reshape(
         C, qb, nH, d)
 
 
 def _ragged_paged_xla(q, k_pages, v_pages, rows, pos0, n_valid, sm_scale,
-                      k_layout):
+                      k_layout, k_scales=None, v_scales=None):
     """XLA gather fallback (and the kernel's numerics reference): gather
     each chunk's pages, one masked softmax over the flattened context.
     Applies the SAME clamped mask qpos(i) = pos0 + min(i, n_valid-1) so
-    padding rows match the kernel bit-for-bit."""
+    padding rows match the kernel bit-for-bit.  int8 pages gather their
+    per-page scales alongside and dequantize exactly as the kernel does
+    (fp32 multiply, cast to the q dtype, then the dots)."""
+    from ..quant import dequantize_int8
+
     C, qb, nH, d = q.shape
     nkv = k_pages.shape[1]
     G = nH // nkv
     mb = rows.shape[1]
     bs = k_pages.shape[3] if k_layout == "d_major" else k_pages.shape[2]
     kg = jnp.take(k_pages, rows, axis=0)            # [C, mb, nkv, ., .]
+    if k_scales is not None:
+        kg = dequantize_int8(
+            kg, jnp.take(k_scales, rows, axis=0)[..., None, None], q.dtype)
     if k_layout == "d_major":
         kg = jnp.swapaxes(kg, 3, 4)                 # -> [C, mb, nkv, bs, d]
     vg = jnp.take(v_pages, rows, axis=0)            # [C, mb, nkv, bs, d]
+    if v_scales is not None:
+        vg = dequantize_int8(
+            vg, jnp.take(v_scales, rows, axis=0)[..., None, None], q.dtype)
     kg = jnp.swapaxes(kg, 1, 2).reshape(C, nkv, mb * bs, d)
     vg = jnp.swapaxes(vg, 1, 2).reshape(C, nkv, mb * bs, d)
     qg = q.reshape(C, qb, nkv, G, d)
@@ -214,53 +266,64 @@ def _autotune_source() -> str:
 
 
 def _tuned_impl(C: int, qb: int, nH: int, d: int, nkv: int, mb: int,
-                bs: int, dtype) -> str:
+                bs: int, dtype, quant: bool = False) -> str:
     """Impl choice via the autotune registry.  As with ragged prefill,
     the unified kernel has no free block parameter (blocks ARE the page
     geometry), so the tunable axis is the implementation itself: the MXU
     kernel wins when chunks are deep (many pages re-read per chunk), the
     XLA gather path when the batch is shallow and per-program latency
     dominates.  candidates[0] = "kernel" keeps legacy behavior on
-    no-sweep backends."""
+    no-sweep backends.  Quantized pages tune their own bucket — dequant
+    shifts the arms' cost balance (the kernel dequantizes per VMEM tile,
+    the XLA arm on the full gathered context)."""
     from . import autotune
 
     def measure(impl):
+        pdt = jnp.int8 if quant else dtype
         qz = jnp.zeros((C, qb, nH, d), dtype)
-        ktz = jnp.zeros((1, nkv, d, bs), dtype)
-        vz = jnp.zeros((1, nkv, bs, d), dtype)
+        ktz = jnp.zeros((1, nkv, d, bs), pdt)
+        vz = jnp.zeros((1, nkv, bs, d), pdt)
         rz = jnp.zeros((C, mb), jnp.int32)
         pz = jnp.zeros((C,), jnp.int32)
         nz = jnp.ones((C,), jnp.int32)
+        sc = jnp.ones((1, nkv), jnp.float32) if quant else None
         if impl == "kernel":
             fn = lambda: ragged_paged_attention_kernel(  # noqa: E731
-                qz, ktz, vz, rz, pz, nz, 1.0)
+                qz, ktz, vz, rz, pz, nz, 1.0, sc, sc)
         else:
             fn = lambda: _ragged_paged_xla(qz, ktz, vz, rz, pz, nz,  # noqa: E731
-                                           1.0, "d_major")
+                                           1.0, "d_major", sc, sc)
         return autotune.time_candidate(fn)
 
     return str(autotune.tuned(
         "ragged_paged_attention",
-        f"c{C}_qb{qb}_h{nH}_d{d}_kv{nkv}_mb{mb}_bs{bs}",
+        f"c{C}_qb{qb}_h{nH}_d{d}_kv{nkv}_mb{mb}_bs{bs}"
+        + ("_q8" if quant else ""),
         str(jnp.dtype(dtype)), ["kernel", "xla"],
         measure=measure, source=_autotune_source()))
 
 
 def ragged_paged_attention(q, k_pages, v_pages, rows, pos0, n_valid,
-                           sm_scale: float, k_layout: str = "d_major"):
+                           sm_scale: float, k_layout: str = "d_major",
+                           k_scales=None, v_scales=None):
     """Unified ragged-paged attention: dispatches the MXU Pallas kernel
     when the page geometry supports it, else the XLA gather path.  See
-    module docstring for shapes."""
+    module docstring for shapes; int8 pages require both scale planes."""
+    quant = k_pages.dtype == jnp.int8
+    if quant and (k_scales is None or v_scales is None):
+        raise ValueError("int8 KV pages need k_scales and v_scales "
+                         "([P, nKV] fp32 per-page scale planes)")
     if (k_layout == "d_major"
             and ragged_paged_supported(k_pages.shape, q.shape[2],
                                        q.shape[1],
                                        k_pages.dtype.itemsize)):
         C, qb, nH, d = q.shape
         impl = _tuned_impl(C, qb, nH, d, k_pages.shape[1], rows.shape[1],
-                           k_pages.shape[3], q.dtype)
+                           k_pages.shape[3], q.dtype, quant)
         if impl == "kernel":
             return ragged_paged_attention_kernel(q, k_pages, v_pages,
                                                  rows, pos0, n_valid,
-                                                 sm_scale)
+                                                 sm_scale, k_scales,
+                                                 v_scales)
     return _ragged_paged_xla(q, k_pages, v_pages, rows, pos0, n_valid,
-                             sm_scale, k_layout)
+                             sm_scale, k_layout, k_scales, v_scales)

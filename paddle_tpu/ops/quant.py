@@ -1,26 +1,93 @@
-"""Shared weight-quantization primitives.
+"""Shared quantization primitives for the int8 memory plane.
 
-One implementation of per-column absmax int8 (reference: weight_quantize op,
-phi/kernels/gpu/weight_quantize_kernel.cu) used by both the incubate
-functional API and the LLaMA weight-only inference path.
+Two consumers share these:
+
+- **Weights** (reference: weight_quantize op,
+  phi/kernels/gpu/weight_quantize_kernel.cu): per-output-column absmax
+  int8, used by the incubate functional API and the LLaMA weight-only
+  decode path (dequant fused into the matmul epilogue,
+  ops/pallas/quant_matmul.py).
+- **KV pages** (serving engine, ``serving_kv_quant``): per-page,
+  per-kv-head symmetric int8 with an fp32 scale plane of shape
+  ``[n_pages, n_kv_heads]`` stored alongside each layer's page array.
+  Because a page fills incrementally (chunked prefill, decode,
+  speculative drafts), the page scale is a *running absmax*: writing
+  tokens scatter-maxes the plane (``kv_scale_update``), previously
+  written int8 content of the touched pages is rescaled onto the new
+  scale (``rescale_int8`` — exact identity when the scale did not
+  grow), and the new tokens quantize against the updated scale
+  (``quantize_to_scale``). Dequant is a single multiply that the
+  attention kernels fuse into their VMEM tile loads
+  (``dequantize_int8``).
+
+Scales are clamped to ``SCALE_EPS`` before any divide, so zero or
+constant-zero inputs round-trip to exact zeros instead of NaN.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["absmax_quantize_int8"]
+__all__ = ["absmax_quantize_int8", "dequantize_int8", "kv_scale_update",
+           "quantize_to_scale", "rescale_int8", "SCALE_EPS"]
+
+# Far below any real activation/weight scale but large enough that
+# value / SCALE_EPS cannot overflow fp32 for values that passed the
+# absmax reduction (|v| <= 127 * scale by construction).
+SCALE_EPS = 1e-30
 
 
 def absmax_quantize_int8(arr, axis: int = -2, scale_dtype=jnp.float32):
     """Quantize along all dims except the output-channel dim.
 
     Returns (int8 weights, scales) with ``scales`` keeping the reduced dims
-    (broadcastable for dequant-in-matmul).
+    (broadcastable for dequant-in-matmul). Zero rows get an epsilon scale:
+    they quantize to 0 and dequantize to exact 0 (never NaN).
     """
     scale = jnp.abs(arr).max(axis=axis, keepdims=True).astype(jnp.float32) \
         / 127.0
-    scale = jnp.where(scale == 0, 1.0, scale)
+    scale = jnp.maximum(scale, SCALE_EPS)
     q = jnp.clip(jnp.round(arr.astype(jnp.float32) / scale), -127, 127
                  ).astype(jnp.int8)
     return q, scale.astype(scale_dtype)
+
+
+def quantize_to_scale(x, scale):
+    """int8-quantize ``x`` against an externally managed ``scale``
+    (broadcastable). Used by the KV write path, where the scale is the
+    page's running absmax — guaranteed >= |x| / 127 for this write, so
+    the clip never saturates on in-scale values."""
+    s = jnp.maximum(scale.astype(jnp.float32), SCALE_EPS)
+    return jnp.clip(jnp.round(x.astype(jnp.float32) / s), -127, 127
+                    ).astype(jnp.int8)
+
+
+def dequantize_int8(q, scale, dtype=jnp.float32):
+    """``q * scale`` in fp32, cast to ``dtype``. BOTH ragged-paged-
+    attention arms call exactly this (fp32 multiply, then cast to the
+    compute dtype) so the kernel and the XLA gather fallback stay
+    equality-pinned on quantized pages."""
+    return (q.astype(jnp.float32) * scale.astype(jnp.float32)).astype(dtype)
+
+
+def rescale_int8(q, old_scale, new_scale):
+    """Re-express int8 content quantized at ``old_scale`` on
+    ``new_scale`` (both broadcastable against ``q``). When the scale is
+    unchanged the ratio is exactly 1.0 and ``round`` returns the stored
+    integer unchanged — rescaling untouched pages is a bit-exact no-op,
+    so the KV write path may conservatively rescale every page a chunk
+    *might* straddle."""
+    ratio = (old_scale.astype(jnp.float32)
+             / jnp.maximum(new_scale.astype(jnp.float32), SCALE_EPS))
+    return jnp.clip(jnp.round(q.astype(jnp.float32) * ratio), -127, 127
+                    ).astype(jnp.int8)
+
+
+def kv_scale_update(scales, page_ids, token_absmax):
+    """Scatter-max the per-page scale plane with this step's writes.
+
+    scales [P, nKV] fp32; page_ids [N] int32 (duplicates fine — max is
+    commutative, so the scatter is deterministic); token_absmax [N, nKV]
+    = |token|max / 127. Returns the updated plane; existing page content
+    must then be rescaled onto it (``rescale_int8``)."""
+    return scales.at[page_ids].max(token_absmax.astype(scales.dtype))

@@ -80,8 +80,11 @@ def test_serving_key_contract(bench):
          "occ_waste_prefill": 0.06, "occ_waste_overrun": 0.01,
          "occ_waste_spec_rejected": 0.01,
          "prefix_cache_hit_rate": 0.7, "spec_accept_rate": 0.0}
+    m = dict(m, kv_bytes_per_token=3072.0, kv_quant_enabled=False)
     spec_m = dict(m, spec_accept_rate=0.62, throughput_tok_s=450.0)
-    out = bench._serving_keys(m, spec_m)
+    kvq_m = dict(m, throughput_tok_s=430.0, kv_bytes_per_token=800.0,
+                 quality_delta=0.01)
+    out = bench._serving_keys(m, spec_m, kvq_m)
     for k in ("serving_ttft_p50", "serving_ttft_p99",
               "serving_tpot_p50", "serving_tpot_p99",
               "serving_goodput", "serving_occupancy",
@@ -91,7 +94,8 @@ def test_serving_key_contract(bench):
               "serving_occ_waste_admission_blocked",
               "serving_occ_waste_prefill", "serving_occ_waste_overrun",
               "serving_occ_waste_spec_rejected",
-              "serving_prefix_cache_hit_rate"):
+              "serving_prefix_cache_hit_rate",
+              "serving_kv_bytes_per_token", "serving_kv_quant_enabled"):
         assert k in out, k
     assert out["serving_goodput"] == 380.0
     assert out["serving_ttft_p99"] == 0.9
@@ -99,10 +103,23 @@ def test_serving_key_contract(bench):
     assert out["serving_occupancy"] == 0.85
     assert out["serving_spec_accept_rate"] == 0.62   # from the spec arm
     assert out["serving_spec_throughput_tok_s"] == 450.0
-    # without a speculative arm the rate comes from the main run (0.0)
+    # int8-KV plane keys: main-run bytes/token + enabled marker, and the
+    # quant arm's throughput / bytes / quality delta
+    assert out["serving_kv_bytes_per_token"] == 3072.0
+    assert out["serving_kv_quant_enabled"] == 0.0
+    assert out["serving_kv_quant_tok_s"] == 430.0
+    assert out["serving_kv_quant_bytes_per_token"] == 800.0
+    assert out["serving_kv_quant_quality_delta"] == 0.01
+    # without a speculative arm the rate comes from the main run (0.0);
+    # without a kv-quant arm its keys stay absent
     solo = bench._serving_keys(m)
     assert solo["serving_spec_accept_rate"] == 0.0
     assert "serving_spec_throughput_tok_s" not in solo
+    assert "serving_kv_quant_tok_s" not in solo
+    assert "serving_kv_quant_quality_delta" not in solo
+    # a kv_quant main run marks itself enabled
+    assert bench._serving_keys(dict(m, kv_quant_enabled=True))[
+        "serving_kv_quant_enabled"] == 1.0
 
 
 from conftest import requires_native_partial_manual

@@ -191,3 +191,36 @@ def test_paged_k_per_gate_kernel_consistency():
     want = np.einsum("hk,khd->hd", p, vv)
     np.testing.assert_allclose(np.asarray(got[0]), want, rtol=2e-3,
                                atol=2e-3)
+
+
+def test_decode_kernel_int8_cache_matches_predequantized():
+    """Dense decode on an int8 cache with per-position scales must match
+    the same kernel on the pre-dequantized fp32 cache (the in-kernel
+    dequant is the same fp32-multiply-then-cast, so outputs are equal to
+    normal kernel tolerance), and int8 caches without scales must be
+    rejected."""
+    from paddle_tpu.ops.quant import dequantize_int8
+
+    rng = np.random.RandomState(11)
+    B, nKV, G, S, d = 2, 2, 4, 256, 64
+    nH = nKV * G
+    q = jnp.asarray(rng.randn(B, nH, d).astype(np.float32))
+    kq = jnp.asarray(rng.randint(-127, 128, size=(B, nKV, S, d)),
+                     jnp.int8)
+    vq = jnp.asarray(rng.randint(-127, 128, size=(B, nKV, S, d)),
+                     jnp.int8)
+    ks = jnp.asarray(rng.uniform(0.005, 0.02, size=(B, nKV, S)),
+                     jnp.float32)
+    vs = jnp.asarray(rng.uniform(0.005, 0.02, size=(B, nKV, S)),
+                     jnp.float32)
+    kf = dequantize_int8(kq, ks[..., None])
+    vf = dequantize_int8(vq, vs[..., None])
+    sm = 1.0 / math.sqrt(d)
+    for pos in (0, 100, S - 1):
+        got = decode_attention(q, kq, vq, pos, sm, block_s=256,
+                               k_scale=ks, v_scale=vs)
+        want = decode_attention(q, kf, vf, pos, sm, block_s=256)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+    with pytest.raises(ValueError, match="scale"):
+        decode_attention(q, kq, vq, 5, sm, block_s=256)

@@ -1,0 +1,164 @@
+"""Shared int8 quantization primitives (ops/quant.py) and the
+epilogue-dequant Pallas matmul (ops/pallas/quant_matmul.py)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.quant import (SCALE_EPS, absmax_quantize_int8,
+                                  dequantize_int8, kv_scale_update,
+                                  quantize_to_scale, rescale_int8)
+
+
+@pytest.mark.smoke
+def test_absmax_roundtrip_error_bound():
+    rng = np.random.RandomState(0)
+    w = rng.randn(64, 32).astype(np.float32)
+    q, s = absmax_quantize_int8(jnp.asarray(w))
+    assert q.dtype == jnp.int8 and s.shape == (1, 32)
+    back = np.asarray(q, np.float32) * np.asarray(s)
+    # symmetric absmax: error bounded by half a quantization step
+    step = np.abs(w).max(axis=0, keepdims=True) / 127.0
+    assert np.all(np.abs(back - w) <= 0.5 * step + 1e-7)
+
+
+def test_absmax_axis_handling():
+    rng = np.random.RandomState(1)
+    w = rng.randn(4, 8, 16).astype(np.float32)
+    q0, s0 = absmax_quantize_int8(jnp.asarray(w), axis=0)
+    assert s0.shape == (1, 8, 16)
+    q2, s2 = absmax_quantize_int8(jnp.asarray(w), axis=-1)
+    assert s2.shape == (4, 8, 1)
+    # scales really are per-slice absmax / 127 along the reduced axis
+    np.testing.assert_allclose(np.asarray(s2)[..., 0],
+                               np.abs(w).max(axis=-1) / 127.0, rtol=1e-6)
+    assert int(np.abs(np.asarray(q2)).max()) == 127
+
+
+def test_zero_and_constant_rows_roundtrip_exact_zero():
+    """The satellite fix: all-zero (and near-zero) slices must quantize
+    to 0 and dequantize to exact 0 — never NaN/inf from a 0 divide."""
+    w = np.zeros((8, 4), np.float32)
+    w[:, 1] = 3.0          # one constant column; others stay zero
+    q, s = absmax_quantize_int8(jnp.asarray(w))
+    assert np.all(np.isfinite(np.asarray(s)))
+    back = np.asarray(q, np.float32) * np.asarray(s)
+    np.testing.assert_array_equal(back[:, 0], 0.0)
+    np.testing.assert_array_equal(back[:, 1], 3.0)
+    # quantize_to_scale against a zero (clamped) scale: same contract
+    qz = quantize_to_scale(jnp.zeros((4, 2)), jnp.zeros((4, 1)))
+    np.testing.assert_array_equal(np.asarray(qz), 0)
+    dz = dequantize_int8(qz, jnp.full((4, 1), SCALE_EPS))
+    assert np.all(np.isfinite(np.asarray(dz)))
+    np.testing.assert_array_equal(np.asarray(dz), 0.0)
+
+
+def test_rescale_identity_when_scale_unchanged():
+    """rescale_int8 with old == new must return the stored bytes
+    unchanged — the KV write path relies on this to blanket-rescale
+    pages a chunk merely *might* straddle."""
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randint(-127, 128, size=(16, 4), dtype=np.int8))
+    s = jnp.asarray(np.abs(rng.randn(16, 1)).astype(np.float32) + 0.1)
+    out = rescale_int8(q, s, s)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(q))
+    # growing the scale shrinks magnitudes, never overflows the clip
+    out2 = rescale_int8(q, s, 2.0 * s)
+    assert np.all(np.abs(np.asarray(out2, np.int32)) <= 64)
+
+
+def test_rescale_then_dequant_preserves_value():
+    rng = np.random.RandomState(3)
+    x = rng.randn(32, 4).astype(np.float32)
+    s_old = jnp.asarray(np.abs(x).max(axis=0, keepdims=True) / 127.0)
+    q = quantize_to_scale(jnp.asarray(x), s_old)
+    s_new = 1.7 * s_old
+    q2 = rescale_int8(q, s_old, s_new)
+    back = np.asarray(dequantize_int8(q2, s_new))
+    # one extra rounding step: error within 1.5 steps of the NEW scale
+    assert np.all(np.abs(back - x) <= 1.5 * np.asarray(s_new) + 1e-7)
+
+
+def test_kv_scale_update_scatter_max_with_duplicates():
+    scales = jnp.zeros((6, 2), jnp.float32)
+    pages = jnp.asarray([1, 3, 1, 1], jnp.int32)
+    absmax = jnp.asarray([[0.5, 1.0],
+                          [2.0, 0.1],
+                          [4.0, 0.2],
+                          [1.0, 3.0]], jnp.float32)
+    out = np.asarray(kv_scale_update(scales, pages, absmax))
+    np.testing.assert_allclose(out[1], [4.0, 3.0])   # max over duplicates
+    np.testing.assert_allclose(out[3], [2.0, 0.1])
+    assert np.all(out[[0, 2, 4, 5]] == 0.0)          # untouched pages
+    # running max: a smaller later write can never shrink a scale
+    out2 = np.asarray(kv_scale_update(jnp.asarray(out), pages, absmax * 0.1))
+    np.testing.assert_array_equal(out2, out)
+
+
+# ---------------------------------------------------------------------------
+# quant_matmul: epilogue-dequant weight-only int8 matmul
+
+
+def _qmm_case(seed, M, K, N, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(M, K).astype(np.float32), dtype)
+    w = rng.randn(K, N).astype(np.float32)
+    wq, s = absmax_quantize_int8(jnp.asarray(w))
+    return x, wq, s, w
+
+
+def test_quant_matmul_xla_matches_dequant_reference():
+    from paddle_tpu.ops.pallas.quant_matmul import _quant_matmul_xla
+
+    x, wq, s, _ = _qmm_case(0, 8, 128, 128)
+    got = np.asarray(_quant_matmul_xla(x, wq, s))
+    want = np.asarray(x) @ (np.asarray(wq, np.float32) * np.asarray(s))
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-5)
+
+
+@pytest.mark.smoke
+def test_quant_matmul_kernel_matches_xla():
+    # interpret mode on CPU
+    from paddle_tpu.ops.pallas import quant_matmul as mod
+
+    x, wq, s, _ = _qmm_case(1, 16, 256, 128)
+    want = np.asarray(mod._quant_matmul_xla(x, wq, s.reshape(1, -1)))
+    got = np.asarray(mod.quant_matmul_kernel(x, wq,
+                                             s.reshape(1, -1), 8, 128, 128))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-5)
+
+
+def test_quant_matmul_supported_gate():
+    from paddle_tpu.ops.pallas.quant_matmul import quant_matmul_supported
+
+    assert quant_matmul_supported(8, 128, 128)
+    assert not quant_matmul_supported(7, 128, 128)    # M sublanes
+    assert not quant_matmul_supported(8, 100, 128)    # K lanes
+    assert not quant_matmul_supported(8, 128, 100)    # N lanes
+
+
+def test_quant_matmul_dispatcher_respects_registry(monkeypatch):
+    """Whatever impl the autotune registry answers is what runs, and
+    leading dims are flattened/restored around the kernel."""
+    from paddle_tpu.ops.pallas import quant_matmul as mod
+
+    x, wq, s, _ = _qmm_case(2, 16, 128, 128)
+    x3 = x.reshape(2, 8, 128)
+    asked = []
+
+    def pin(impl):
+        def fake(M, K, N, dtype):
+            asked.append((M, K, N))
+            return impl
+        monkeypatch.setattr(mod, "_tuned_block", fake)
+
+    pin("xla")
+    want = np.asarray(mod._quant_matmul_xla(x3, wq, s.reshape(1, -1)))
+    got = np.asarray(mod.quant_matmul(x3, wq, s))
+    np.testing.assert_array_equal(got, want)
+    pin("kernel:8:128:128")
+    got_k = np.asarray(mod.quant_matmul(x3, wq, s))
+    assert got_k.shape == (2, 8, 128)
+    np.testing.assert_allclose(got_k, want, atol=2e-4, rtol=2e-5)
+    assert asked == [(16, 128, 128), (16, 128, 128)]

@@ -9,6 +9,8 @@ traffic shares its dispatches, whether its prefix came warm from the
 cache, and whether speculative verification is on (greedy-accept + keyed
 sampling make acceptance invisible to the stream)."""
 
+import warnings
+
 import numpy as np
 import pytest
 
@@ -205,3 +207,126 @@ def test_page_accounting_under_speculative_load_with_aborts():
         + st["waste_spec_rejected_slot_tokens"]), st
     assert not engine.queue
     assert all(s is None for s in engine.slots)
+
+
+# ---------------------------------------------------------------------------
+# int8 KV plane (serving_kv_quant)
+
+
+def test_decode_quantum_kwarg_deprecated_and_inert():
+    """Satellite: decode_quantum= must warn exactly once per ctor and
+    change nothing; omitting it must stay silent."""
+    with pytest.warns(DeprecationWarning, match="decode_quantum"):
+        ServingEngine(CFG, max_batch=1, page_size=16, max_seq=64,
+                      decode_quantum=4)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        ServingEngine(CFG, max_batch=1, page_size=16, max_seq=64)
+
+
+def test_kv_quant_default_off_is_structurally_identical():
+    """With the flag off (the default) the engine must build the exact
+    pre-quant structures: fp pages, no scale planes, and the original
+    (non-quant) jitted step — bit-identity for free, pinned here."""
+    engine = ServingEngine(CFG, max_batch=2, page_size=16, max_seq=128)
+    assert engine._kv_quant is False
+    assert engine.k_pages.dtype == CFG.dtype
+    assert engine.k_scales is None and engine.v_scales is None
+
+
+def test_kv_quant_streams_and_ledger_close():
+    """kv_quant=True end-to-end: int8 pages + scale planes, greedy
+    streams still track the isolated model closely, ledger closes."""
+    base, _, _ = _run(qb=16, sampled=True)
+    quant, _, engine = _run(qb=16, sampled=True, kv_quant=True)
+    assert engine._kv_quant and engine.k_pages.dtype == jnp.int8
+    assert engine.k_scales.shape == (CFG.n_layers, engine.n_pages,
+                                     CFG.n_kv_heads)
+    # quantified quality delta, fixed seed (PERF.md round 8): greedy
+    # token agreement between the int8 and fp engines
+    pairs = [(b, q) for b, q in zip(base, quant)]
+    agree = [sum(x == y for x, y in zip(b, q)) / max(len(b), 1)
+             for b, q in pairs]
+    assert all(len(b) == len(q) for b, q in pairs)
+    assert min(agree) >= 0.75, agree
+    assert sum(agree) / len(agree) >= 0.9, agree
+
+
+def test_kv_quant_geometry_invariance():
+    """The quantized plane must keep the unified step's core contract:
+    the stream cannot depend on grid geometry (qb/budget), even though
+    page-scale *history* differs across chunkings — rescale keeps every
+    geometry reading the same running-absmax encoding."""
+    a, _, _ = _run(qb=16, prefill_budget=64, kv_quant=True)
+    b, _, _ = _run(qb=4, prefill_budget=32, kv_quant=True)
+    assert a == b
+
+
+def test_kv_quant_page_accounting_under_speculative_load_with_aborts():
+    """Satellite 3: the randomized spec+abort load, on the int8 plane.
+    Every step must keep the census balanced; abort/rollback paths run
+    through the quantized scatter and allocation-time scale reset."""
+    engine = ServingEngine(CFG, max_batch=3, page_size=16, max_seq=128,
+                           n_pages=1 + 14, prefill_budget=32, qb=8,
+                           speculative_k=3, kv_quant=True)
+    rng = np.random.RandomState(23)
+    pat = rng.randint(1, 512, size=5).astype(np.int32)
+    for i in range(9):
+        if rng.rand() < 0.5:
+            prompt = np.tile(pat, rng.randint(2, 6))
+        else:
+            prompt = rng.randint(1, 512,
+                                 size=rng.randint(4, 40)).astype(np.int32)
+        engine.submit(Request(rid=i, prompt=prompt,
+                              max_new_tokens=int(rng.randint(3, 12)),
+                              temperature=float(rng.rand() < 0.3) * 0.8,
+                              seed=i))
+    aborts = {3: 2, 8: 5}
+    steps = 0
+    while engine.step(now=1e9):
+        steps += 1
+        if steps in aborts:
+            engine.abort(aborts[steps])
+        _assert_accounting(engine)
+        assert steps < 500
+    _assert_accounting(engine)
+    st = engine.stats
+    assert st["decode_slot_tokens"] == (
+        st["decode_active_tokens"] + st["waste_prefill_slot_tokens"]
+        + st["waste_queue_empty_slot_tokens"]
+        + st["waste_admission_blocked_slot_tokens"]
+        + st["waste_overrun_slot_tokens"]
+        + st["waste_spec_rejected_slot_tokens"]), st
+    assert not engine.queue and all(s is None for s in engine.slots)
+
+
+def test_kv_quant_prefix_cache_isolated_from_fp_pages():
+    """Quantized and fp page hashes must never alias (the ':kvq8' seed
+    tag): a warm int8 engine hits its own cache, and the off-path hash
+    preimage is unchanged."""
+    rng = np.random.RandomState(11)
+    warm_prompt = _mk_reqs(rng, sampled=True)[0].prompt
+    cold, _, _ = _run(qb=16, kv_quant=True)
+    warm, _, eng = _run(qb=16, kv_quant=True, warm=warm_prompt)
+    assert cold == warm
+    assert eng.pool.hits > 0
+    off = ServingEngine(CFG, max_batch=2, page_size=16, max_seq=256)
+    on = ServingEngine(CFG, max_batch=2, page_size=16, max_seq=256,
+                       kv_quant=True)
+    toks = np.arange(2 * off.bs, dtype=np.int32)
+    ha, hb = off._page_hashes(toks), on._page_hashes(toks)
+    assert len(ha) == len(hb) == 2
+    assert not set(ha) & set(hb)
+
+
+def test_kv_quant_capacity_doubles_at_fixed_bytes():
+    """The point of the plane: at a fixed HBM byte budget the int8 pool
+    holds >= 2x the pages (scales included in the int8 ledger)."""
+    off = ServingEngine(CFG, max_batch=2, page_size=16, max_seq=128)
+    on = ServingEngine(CFG, max_batch=2, page_size=16, max_seq=128,
+                       kv_quant=True)
+    assert on.kv_bytes_per_page() * 2 <= off.kv_bytes_per_page()
+    budget = 64 * off.kv_bytes_per_page()
+    assert budget // on.kv_bytes_per_page() >= 2 * (
+        budget // off.kv_bytes_per_page())
+    assert on.kv_bytes_per_token() * 2 <= off.kv_bytes_per_token()

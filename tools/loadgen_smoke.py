@@ -6,6 +6,10 @@ page, and close the occupancy ledger (active + waste buckets == 1).
 Catches regressions in arrivals/workload/driver/metrics AND in the
 unified scheduler under sustained saturation before a TPU bench round.
 
+Runs TWICE: once on the default fp plane and once with
+FLAGS_serving_kv_quant=1 (int8 pages + scale planes), so the quantized
+write/rescale/abort paths face the same sustained saturation.
+
 Usage:  JAX_PLATFORMS=cpu python -m tools.loadgen_smoke
 """
 
@@ -14,7 +18,7 @@ from __future__ import annotations
 import sys
 
 
-def main() -> int:
+def _run_pass(label: str) -> int:
     import jax.numpy as jnp
 
     from paddle_tpu.inference.loadgen import (OpenLoopDriver,
@@ -27,6 +31,11 @@ def main() -> int:
                       dtype=jnp.float32, param_dtype=jnp.float32)
     engine = ServingEngine(cfg, max_batch=3, page_size=16, max_seq=96,
                            n_pages=1 + 16, prefill_budget=32, qb=8)
+    if label == "kv_quant" and (not engine._kv_quant
+                                or engine.k_pages.dtype != jnp.int8):
+        print(f"loadgen_smoke[{label}]: FAIL — serving_kv_quant flag "
+              "did not reach the engine", file=sys.stderr)
+        return 1
     spec = WorkloadSpec(n_requests=200, seed=0, vocab_size=256,
                         process="poisson", rate=100.0,
                         prefix_len=16, n_prefixes=2, shared_frac=0.6,
@@ -38,36 +47,52 @@ def main() -> int:
     try:
         m = driver.run(reqs, aborts={5: 17})
     except RuntimeError as e:
-        print(f"loadgen_smoke: FAIL — {e}", file=sys.stderr)
+        print(f"loadgen_smoke[{label}]: FAIL — {e}", file=sys.stderr)
         return 1
     if m["n_aborted"] != 1 or not reqs[17].aborted:
-        print("loadgen_smoke: FAIL — mid-run abort did not fire",
-              file=sys.stderr)
+        print(f"loadgen_smoke[{label}]: FAIL — mid-run abort did not "
+              "fire", file=sys.stderr)
         return 1
     incomplete = [r.rid for r in reqs if not r.aborted
                   and (len(r.out_tokens) != r.max_new_tokens
                        or r.t_done is None)]
     if incomplete:
-        print(f"loadgen_smoke: FAIL — incomplete requests {incomplete}",
-              file=sys.stderr)
+        print(f"loadgen_smoke[{label}]: FAIL — incomplete requests "
+              f"{incomplete}", file=sys.stderr)
         return 1
     acc = engine.page_accounting()
     if (acc["total"] != engine.n_pages - 1 or acc["slot_owned"]
             or acc["deferred_free"]):
-        print(f"loadgen_smoke: FAIL — page leak: {acc}", file=sys.stderr)
+        print(f"loadgen_smoke[{label}]: FAIL — page leak: {acc}",
+              file=sys.stderr)
         return 1
     occ = (m["slot_occupancy"] + m["occ_waste_queue_empty"]
            + m["occ_waste_admission_blocked"] + m["occ_waste_prefill"]
            + m["occ_waste_overrun"] + m["occ_waste_spec_rejected"])
     if abs(occ - 1.0) > 0.01:
-        print(f"loadgen_smoke: FAIL — occupancy ledger does not close: "
-              f"{occ} != 1 ({m})", file=sys.stderr)
+        print(f"loadgen_smoke[{label}]: FAIL — occupancy ledger does "
+              f"not close: {occ} != 1 ({m})", file=sys.stderr)
         return 1
-    print(f"loadgen_smoke: OK — {m['n_completed']}/{m['n_requests']} "
-          f"requests (+1 abort) in {m['steps']} steps, occupancy "
-          f"{m['slot_occupancy']}, goodput {m['goodput_tok_s']} tok/s, "
-          f"no leak")
+    print(f"loadgen_smoke[{label}]: OK — {m['n_completed']}/"
+          f"{m['n_requests']} requests (+1 abort) in {m['steps']} steps, "
+          f"occupancy {m['slot_occupancy']}, goodput "
+          f"{m['goodput_tok_s']} tok/s, "
+          f"{engine.kv_bytes_per_token():.0f} KV B/tok, no leak")
     return 0
+
+
+def main() -> int:
+    from paddle_tpu.core.flags import GLOBAL_FLAGS
+
+    rc = _run_pass("fp")
+    if rc:
+        return rc
+    prev = GLOBAL_FLAGS.get("serving_kv_quant")
+    GLOBAL_FLAGS.set("serving_kv_quant", True)
+    try:
+        return _run_pass("kv_quant")
+    finally:
+        GLOBAL_FLAGS.set("serving_kv_quant", prev)
 
 
 if __name__ == "__main__":
