@@ -221,6 +221,13 @@ define_flag("decode_weight_quant", False,
             "(ops/pallas/quant_matmul.py; XLA fallback elsewhere). Off "
             "= full-precision weights, bit-identical.")
 
+define_flag("dist_allreduce_quant", False,
+            "EQuARX-style int8 gradient all-reduce for the dp gradient "
+            "sync: per-rank-chunk symmetric int8 with fp32 scales on the "
+            "wire for BOTH phases (reduce-scatter + all-gather), riding "
+            "the ops/quant.py primitives — ~4x less gradient-sync "
+            "bandwidth. Off = bit-identical full-precision psum sync.")
+
 define_flag("resilient_max_bad_steps", 3,
             "Consecutive NaN/Inf steps tolerated (skipped) before the "
             "resilient loop rolls state back to the last good checkpoint.")

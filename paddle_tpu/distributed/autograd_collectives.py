@@ -13,12 +13,15 @@ registered op covers the whole PyLayer family and XLA picks the collective
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.dispatch import op
 from ..core.tensor import Tensor
 
-__all__ = ["reshard_op", "scatter_axis", "gather_axis"]
+__all__ = ["reshard_op", "scatter_axis", "gather_axis",
+           "dist_allreduce_quant"]
 
 
 import functools
@@ -54,6 +57,58 @@ def scatter_axis(t: Tensor, mesh: Mesh, dim: int, axis: str) -> Tensor:
     entries = [None] * t.ndim
     entries[dim] = axis
     return reshard_op(t, mesh, P(*entries))
+
+
+def dist_allreduce_quant(x, axis_name: str, *, mean: bool = False,
+                         axis_size: int | None = None):
+    """int8-on-the-wire all-reduce over a shard_map axis (EQuARX recipe,
+    PAPERS.md): both phases of a reduce-scatter + all-gather all-reduce
+    move int8 payloads with one fp32 absmax scale per per-rank chunk
+    (ops/quant.py symmetric-int8 semantics), cutting gradient-sync
+    bandwidth ~4x vs fp32.
+
+    Phase 1 (reduce-scatter): each rank splits ``x`` into n chunks,
+    quantizes each against its own absmax, and ``all_to_all``s them; rank
+    j dequant-accumulates the n incoming versions of chunk j in fp32, so
+    accumulation never suffers int8 bit-growth.
+    Phase 2 (all-gather): each rank re-quantizes its reduced chunk and
+    ``all_gather``s it; dequant leaves every rank the byte-identical
+    result — each chunk is reduced exactly once, on exactly one rank, so
+    the result is deterministic and identical across replica groups by
+    construction.
+
+    Must be called inside a ``shard_map`` region where ``axis_name`` is
+    manual. Zero inputs round-trip to exact zeros (SCALE_EPS floor);
+    values that passed the absmax reduction cannot overflow on dequant
+    (|q * scale| <= absmax by construction)."""
+    from ..ops.quant import absmax_quantize_int8
+
+    if axis_size is not None:
+        n = int(axis_size)
+    elif hasattr(lax, "axis_size"):
+        n = int(lax.axis_size(axis_name))
+    else:
+        # 0.4.x compat: psum of a unit constant folds to the static size
+        n = int(lax.psum(1, axis_name))
+    if n == 1:
+        return x
+    flat = x.astype(jnp.float32).reshape(-1)
+    size = flat.size
+    pad = (-size) % n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    chunks = flat.reshape(n, -1)                       # row j -> rank j
+    q, s = absmax_quantize_int8(chunks, axis=-1)       # int8 [n,c], f32 [n,1]
+    q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    s = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    red = jnp.sum(q.astype(jnp.float32) * s, axis=0)   # my chunk, f32 [c]
+    if mean:
+        red = red / n
+    q2, s2 = absmax_quantize_int8(red[None, :], axis=-1)
+    qg = lax.all_gather(q2[0], axis_name)              # int8 [n, c]
+    sg = lax.all_gather(s2[0], axis_name)              # f32 [n, 1]
+    out = (qg.astype(jnp.float32) * sg).reshape(-1)[:size]
+    return out.reshape(x.shape).astype(x.dtype)
 
 
 def gather_axis(t: Tensor, mesh: Mesh, dim: int) -> Tensor:

@@ -298,15 +298,25 @@ def moe_block_apply(mp: dict, x, cfg: GPTConfig):
 
 
 def model_apply(params: dict, tokens, cfg: GPTConfig, sp_constraint=None,
-                blocks_fn=None, return_hidden: bool = False):
+                blocks_fn=None, return_hidden: bool = False,
+                emb_constraint=None):
     """Forward to logits (or the final hidden states with
     ``return_hidden`` — the chunked-loss path projects to vocab itself).
     ``blocks_fn(params_blocks, x)`` overrides the dense-stack execution
     (the pipeline path passes the shard_map'd stage runner); default is a
-    remat'd lax.scan over stacked layers."""
+    remat'd lax.scan over stacked layers.
+
+    ``emb_constraint`` pins the embedding gather's output the moment it
+    exists. Left unpinned, GSPMD back-propagates the ZeRO-sharded moment
+    layout (hidden dim over dp) onto the forward gather and then reshards
+    it to the activation layout with an involuntary full rematerialization
+    (MULTICHIP_r05: {devices=[1,1,2,4]} -> {devices=[2,2,1,2]} on
+    f32[B,T,H])."""
     B, T = tokens.shape
-    x = params["wte"][tokens].astype(cfg.dtype) + \
-        params["wpe"][:T].astype(cfg.dtype)
+    emb = params["wte"][tokens]
+    if emb_constraint is not None:
+        emb = emb_constraint(emb)
+    x = emb.astype(cfg.dtype) + params["wpe"][:T].astype(cfg.dtype)
     if sp_constraint is not None:
         x = sp_constraint(x)
 
@@ -397,7 +407,7 @@ def _chunked_ce(x, head, labels, chunk: int):
 
 
 def loss_fn(params, tokens, labels, cfg: GPTConfig, sp_constraint=None,
-            blocks_fn=None, loss_chunk: int = 512):
+            blocks_fn=None, loss_chunk: int = 512, emb_constraint=None):
     """Causal LM cross-entropy in fp32 (the reference's
     ParallelCrossEntropy semantics for mp-sharded logits come from GSPMD
     partitioning the log-sum-exp). ``loss_chunk`` > 0 streams the vocab
@@ -413,7 +423,8 @@ def loss_fn(params, tokens, labels, cfg: GPTConfig, sp_constraint=None,
     FLAGS_use_fused_ce."""
     if loss_chunk:
         hidden, aux = model_apply(params, tokens, cfg, sp_constraint,
-                                  blocks_fn, return_hidden=True)
+                                  blocks_fn, return_hidden=True,
+                                  emb_constraint=emb_constraint)
         head = (params["wte"].T if cfg.tie_embeddings else params["head_w"])
         from ..core.flags import GLOBAL_FLAGS
         from ..ops.pallas.fused_ce import fused_ce_supported, fused_softmax_ce
@@ -437,7 +448,8 @@ def loss_fn(params, tokens, labels, cfg: GPTConfig, sp_constraint=None,
             return nll_tok.mean() + 0.01 * aux
         nll = _chunked_ce(hidden, head.astype(cfg.dtype), labels, loss_chunk)
         return nll + 0.01 * aux
-    logits, aux = model_apply(params, tokens, cfg, sp_constraint, blocks_fn)
+    logits, aux = model_apply(params, tokens, cfg, sp_constraint, blocks_fn,
+                              emb_constraint=emb_constraint)
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
     nll = (lse - gold).mean()

@@ -22,7 +22,9 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..distributed.placement import sanitize_spec as _sanitize
 
 __all__ = ["pipeline_blocks_fn"]
 
@@ -78,6 +80,7 @@ def pipeline_blocks_fn(stage_fn: Callable, mesh: Mesh, n_microbatches: int,
         assert B % M == 0, f"batch {B} % microbatches {M} != 0"
         mb = B // M
         xs = x.reshape((M, mb) + x.shape[1:])
+        xs = _pin_boundary(xs, mesh)
 
         if local is None:
             in_specs = (jax.tree.map(lambda _: P(pp_axis), stacked_params),
@@ -121,9 +124,30 @@ def pipeline_blocks_fn(stage_fn: Callable, mesh: Mesh, n_microbatches: int,
             ys = ys.astype(x.dtype)
         else:
             ys = local(stacked_params, xs)[-1]
+        ys = _pin_boundary(ys, mesh)
         return ys.reshape((B,) + x.shape[1:])
 
     return blocks_fn
+
+
+def _pin_boundary(a, mesh):
+    """Anchor the [M, mb, T, H] activation entering/leaving the pp-manual
+    region: microbatch queue replicated, batch over dp, tokens over mp
+    (Megatron-SP), pp replicated. Without the anchor GSPMD is free to pick
+    an intermediate layout for the manual region's replicated operands and
+    reshard on the far side — the MULTICHIP_r05 involuntary-remat class of
+    transition."""
+    spec = _sanitize(P(None, "dp", "mp"), a.shape, mesh)
+    am = jax.sharding.get_abstract_mesh()
+    target = am if (am is not None and not am.empty) else mesh
+    try:
+        return lax.with_sharding_constraint(a, NamedSharding(target, spec))
+    except (TypeError, ValueError):
+        # The constraint is a compile-time layout anchor; on the eager /
+        # eager-grad paths (concrete arrays, no GSPMD pass) an abstract-
+        # mesh target rejects SingleDeviceSharding inputs — there is
+        # nothing to anchor there, so skip rather than reshard.
+        return a
 
 
 def _pipeline_local(stage_params, xs, *, stage_fn, n_stages, n_micro,

@@ -39,7 +39,7 @@ import random
 import time
 from typing import Callable, Optional
 
-__all__ = ["with_retries", "ResilientTrainLoop"]
+__all__ = ["with_retries", "agree_resume_step", "ResilientTrainLoop"]
 
 logger = logging.getLogger("paddle_tpu.parallel.resilient_loop")
 
@@ -92,6 +92,25 @@ def with_retries(fn: Callable, *args, retries: Optional[int] = None,
             if on_retry is not None:
                 on_retry(attempt, e)
             time.sleep(delay)
+
+
+def agree_resume_step(store, rank: int, world_size: int,
+                      local_step: Optional[int], *, tag: str = "resume",
+                      timeout: float = 120.0) -> Optional[int]:
+    """Fleet-wide resume agreement: every rank publishes the step of its
+    newest VALID checkpoint and all adopt the minimum — after a rank loss
+    the healed generation walks back to a step every survivor can
+    actually load (a rank that died before its newest save, or whose save
+    was torn, drags the whole fleet back with it). ``local_step=None``
+    publishes -1; an agreed -1 means no rank has a usable checkpoint and
+    the return is None (fresh start everywhere). ``tag`` must be unique
+    per generation — barrier keys are reused across relaunches."""
+    step = -1 if local_step is None else int(local_step)
+    store.set(f"{tag}/step/{rank}", str(step))
+    store.barrier(f"{tag}/published", world_size, timeout=timeout)
+    agreed = min(int(store.get(f"{tag}/step/{r}").decode())
+                 for r in range(world_size))
+    return None if agreed < 0 else agreed
 
 
 class ResilientTrainLoop:
@@ -165,6 +184,31 @@ class ResilientTrainLoop:
             logger.info("resumed from checkpoint step %d", resumed)
         return resumed
 
+    def resume_fleet(self, store, rank: int, world_size: int, *,
+                     tag: str = "resume",
+                     timeout: float = 120.0) -> Optional[int]:
+        """Multi-host resume: local newest-valid walk-back, then adopt
+        the fleet-wide minimum (:func:`agree_resume_step`). A rank whose
+        local history runs ahead of the agreement reloads at the agreed
+        step, so every rank of the healed generation restarts from the
+        SAME durable step. Returns the agreed step (None = fresh)."""
+        local = self.resume()
+        agreed = agree_resume_step(store, rank, world_size, local,
+                                   tag=tag, timeout=timeout)
+        if agreed is None:
+            self.step = 0
+            return None
+        if agreed != local:    # min over ranks: agreed < local here
+            from ..distributed.checkpoint import load_state_dict, step_dir
+
+            with_retries(load_state_dict, self.state,
+                         step_dir(self.ckpt_dir, agreed),
+                         retries=self.retries, on_retry=self._count_retry)
+            logger.warning("fleet agreement walked resume back from "
+                           "step %s to %d", local, agreed)
+        self.step = agreed
+        return agreed
+
     def _rollback(self):
         from ..distributed.checkpoint import load_latest_valid
 
@@ -226,6 +270,11 @@ class ResilientTrainLoop:
         fault = _chaos.fire("train.step")
         if fault is not None and fault.kind == "raise":
             raise _chaos.ChaosInjected("chaos: train step failure")
+        if fault is not None and fault.kind == "exit":
+            # simulated rank loss: the process vanishes mid-step with no
+            # cleanup, no checkpoint, no exception — peers discover it
+            # through the launcher's death watch / stale heartbeat lease
+            os._exit(int(fault.args.get("code", 1)))
         with self.watchdog.guard(f"step{self.step}"):
             if fault is not None and fault.kind == "hang":
                 time.sleep(float(fault.args.get("seconds", 1.0)))

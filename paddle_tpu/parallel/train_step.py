@@ -362,6 +362,16 @@ def make_sharded_train_step(cfg: GPTConfig, mesh: Mesh, lr: float = 1e-4,
         # explode m/(sqrt(v)+eps); refuse rather than silently diverge
         raise ValueError("v_dtype='int8' is unsafe (zeroed second moments "
                          "explode the update); use 'bfloat16'")
+    from ..core.flags import GLOBAL_FLAGS
+    use_quant_sync = (GLOBAL_FLAGS.has("dist_allreduce_quant")
+                      and bool(GLOBAL_FLAGS.get("dist_allreduce_quant"))
+                      and "dp" in mesh.axis_names and mesh.shape["dp"] > 1)
+    if use_quant_sync and "pp" in mesh.axis_names and mesh.shape["pp"] > 1:
+        # the pipeline is its own pp-manual shard_map; nesting it inside a
+        # dp-manual region is not a supported lowering — quantized grad
+        # sync targets dp(×mp) meshes
+        raise ValueError("dist_allreduce_quant does not support pp>1 "
+                         "meshes; use a dp(*mp) mesh or disable the flag")
     # Master-weight mode when params would be cast per-use anyway: keep the
     # fp32 master in the optimizer state and the live MATMUL weights in the
     # compute dtype (matmuls consumed them bf16 either way; the update
@@ -398,17 +408,78 @@ def make_sharded_train_step(cfg: GPTConfig, mesh: Mesh, lr: float = 1e-4,
 
     use_pp = "pp" in mesh.axis_names and mesh.shape["pp"] > 1
     use_sp = "mp" in mesh.axis_names and mesh.shape["mp"] > 1
+    multichip = any(mesh.shape[a] > 1 for a in mesh.axis_names)
 
-    def sp_constraint(x):
-        # Megatron-SP: between blocks, tokens shard over mp (+ batch over
-        # dp). Inside the manual-pp shard_map region the constraint must be
+    def _constrain(x, spec):
+        # Inside the manual-pp shard_map region the constraint must be
         # built over the context's abstract mesh (pp is Manual there).
-        spec = _sanitize(P("dp", "mp"), x.shape, mesh)
+        spec = _sanitize(spec, x.shape, mesh)
         am = jax.sharding.get_abstract_mesh()
         target = am if (am is not None and not am.empty) else mesh
         return lax.with_sharding_constraint(x, NamedSharding(target, spec))
 
+    def sp_constraint(x):
+        # Megatron-SP: between blocks, tokens shard over mp (+ batch over
+        # dp).
+        return _constrain(x, P("dp", "mp"))
+
+    def emb_constraint(x):
+        # The embedding gather's [B, T, H] output: batch over dp, T and H
+        # unsharded. Pinning AT the gather (indices dp-sharded, operand in
+        # its Megatron vocab layout, output fixed here) fully specifies the
+        # gather, so GSPMD partitions the op itself instead of inventing an
+        # intermediate layout and resharding it — the MULTICHIP_r05
+        # involuntary-full-rematerialization. The sp layout (T over mp) is
+        # re-established one elementwise op later, a cheap activation
+        # reshard rather than a gather reshard.
+        return _constrain(x, P("dp"))
+
     sp = sp_constraint if use_sp else None
+    emb = emb_constraint if multichip else None
+    grad_specs = gpt_param_specs(cfg)
+
+    # -- quantized gradient sync (EQuARX-style, flag-gated) ----------------
+    # With use_quant_sync (validated at the top), forward+backward run
+    # inside a dp-manual shard_map and gradient sync is an explicit
+    # int8-wire all-reduce (autograd_collectives.dist_allreduce_quant)
+    # instead of the psum GSPMD would insert. Off (default) the step below
+    # is the exact same program as before the flag existed — bit-identical.
+    def _quant_sync_grads(params, tokens, labels):
+        """(loss, grads) with int8-wire dp gradient sync. Params enter the
+        manual region replicated over dp (in_specs P()), so expert-parallel
+        MoE leaves are all-gathered in — correct, at the cost of replicated
+        expert compute; mp/pp-degenerate axes of size 1 are made manual too
+        so the region lowers as full-manual on runtimes without native
+        partial-manual shard_map support."""
+        from ..distributed.autograd_collectives import dist_allreduce_quant
+
+        sp_local = None
+        if use_sp:
+            def sp_local(x):
+                # dp is manual inside the region: constrain only the
+                # Megatron-SP token dim; batch sharding is implicit
+                return _constrain(x, P(None, "mp"))
+
+        def body(p, tok, lab):
+            def lf_local(pl):
+                return loss_fn(pl, tok, lab, cfg, sp_constraint=sp_local)
+
+            loss, grads = jax.value_and_grad(lf_local)(p)
+            grads = jax.tree.map(
+                lambda g: dist_allreduce_quant(
+                    g, "dp", mean=True, axis_size=mesh.shape["dp"]), grads)
+            return lax.pmean(loss, "dp"), grads
+
+        manual = {"dp"} | {a for a in mesh.axis_names if mesh.shape[a] == 1}
+        run = jax.shard_map(
+            body,
+            in_specs=(jax.tree.map(lambda _: P(), params), P("dp"),
+                      P("dp")),
+            out_specs=(P(), jax.tree.map(lambda _: P(), params)),
+            axis_names=manual,
+            check_vma=False,
+        )
+        return run(params, tokens, labels)
 
     blocks_fn = None
     if use_pp:
@@ -422,13 +493,36 @@ def make_sharded_train_step(cfg: GPTConfig, mesh: Mesh, lr: float = 1e-4,
         blocks_fn = pipeline_blocks_fn(stage_fn, mesh, n_microbatches)
 
     def step(params, opt_state, tokens, labels):
+        if multichip:
+            # anchor the batch layout inside the program: put_batch places
+            # tokens/labels over dp, but feeding numpy (or a future caller
+            # with different placement) must not change what the partitioner
+            # sees at the embedding gather's indices
+            tokens = _constrain(tokens, P("dp"))
+            labels = _constrain(labels, P("dp"))
+
         def lf(p):
             return loss_fn(p, tokens, labels, cfg, sp_constraint=sp,
+                           emb_constraint=emb,
                            blocks_fn=(functools.partial(_run_blocks,
                                                         blocks_fn)
                                       if blocks_fn else None))
 
-        loss, grads = jax.value_and_grad(lf)(params)
+        if use_quant_sync:
+            loss, grads = _quant_sync_grads(params, tokens, labels)
+        else:
+            loss, grads = jax.value_and_grad(lf)(params)
+        if multichip:
+            # grads leave the model graph in the PARAM layout; the ZeRO-1
+            # moment layout (shard_spec_over picks any divisible dim, e.g.
+            # wte's hidden dim over dp) is reached by an explicit reshard
+            # inside the update instead of back-propagating through the
+            # backward pass — unpinned, that propagation is what turned the
+            # embedding gather into an involuntary full rematerialization
+            # (MULTICHIP_r05) and invents conflicting attention layouts.
+            grads = jax.tree.map(lambda g, s: _constrain(g, s),
+                                 grads, grad_specs,
+                                 is_leaf=lambda x: isinstance(x, P))
         new_params, new_state = adamw_update(params, grads, opt_state, lr,
                                              m_dtype=m_dtype,
                                              v_dtype=v_dtype,

@@ -32,8 +32,15 @@ point                 kinds
 ``elastic.heartbeat`` ``drop`` (beat silently skipped; lease goes stale)
 ``train.step``        ``nan`` (loss poisoned to NaN), ``raise``
                       (ChaosInjected out of the step), ``hang`` (sleep
-                      ``seconds`` inside the watchdog guard)
+                      ``seconds`` inside the watchdog guard), ``exit``
+                      (``os._exit(code)`` mid-step — simulated rank
+                      loss: no cleanup, no checkpoint, no exception)
 ====================  ======================================================
+
+Multi-host targeting: a spec with ``rank=<r>`` in its args fires only in
+the process whose trainer rank (``PADDLE_TRAINER_ID`` / ``RANK`` env,
+default 0) matches — one armed plan, shipped to every worker through
+``PT_CHAOS_PLAN``, can kill exactly one rank of a fleet mid-step.
 
 Determinism: probabilistic faults draw from a ``random.Random`` seeded
 from ``(plan.seed, point, kind)``, and at-N faults count invocations per
@@ -68,6 +75,14 @@ class ChaosInjected(Exception):
     """An injected fault with no more specific exception type."""
 
 
+def _env_rank() -> int:
+    """This process's trainer rank (launch_procs rendezvous env), for
+    rank-targeted faults. Read per-check, not cached: tests re-point it
+    with monkeypatch and launchers may set it after import."""
+    return int(os.environ.get("PADDLE_TRAINER_ID",
+                              os.environ.get("RANK", "0")) or 0)
+
+
 @dataclass
 class FaultSpec:
     """One scheduled fault.
@@ -76,7 +91,8 @@ class FaultSpec:
     ``prob``: else fire per-invocation with this probability.
     ``once``: at most one firing total (default True; ``False`` with
     neither ``at`` nor ``prob`` means *every* invocation fires).
-    ``args``: site parameters (e.g. ``seconds`` for hangs).
+    ``args``: site parameters (e.g. ``seconds`` for hangs, ``code`` for
+    exits); ``rank`` restricts the spec to one trainer rank of a fleet.
     """
 
     point: str
@@ -160,6 +176,9 @@ class _ArmedPlan:
             self._counts[point] = n + 1
             for i, spec in specs:
                 if spec.once and i in self._fired:
+                    continue
+                want_rank = spec.args.get("rank")
+                if want_rank is not None and int(want_rank) != _env_rank():
                     continue
                 if spec.at is not None:
                     hit = n == spec.at

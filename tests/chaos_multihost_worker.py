@@ -1,0 +1,95 @@
+"""Worker for the multi-host chaos test: a 2-rank lockstep toy trainer
+supervised by run_elastic, faults armed through PT_CHAOS_PLAN.
+
+A rank-targeted ``train.step`` ``exit`` fault kills rank 1 mid-step in
+generation 0 (simulated node loss — no cleanup, no checkpoint); the
+launch controller's death watch tears down the surviving rank, and
+run_elastic relaunches the whole fleet. The healed generation runs with
+the plan disarmed and resumes through ``ResilientTrainLoop.resume_fleet``:
+every rank publishes its newest valid checkpoint step and all walk back
+to the fleet-wide minimum, so the survivor's extra committed step is
+discarded and the ranks restart in agreement. A per-step TCPStore
+barrier keeps the ranks in lockstep so the survivor can run at most one
+step past the victim — making the agreed resume step deterministic.
+
+Prints RESUMED/STEP/DONE markers the test asserts on.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.parallel.resilient_loop import ResilientTrainLoop
+from paddle_tpu.testing import chaos
+
+gen = int(os.environ.get("PADDLE_ELASTIC_RESTART", "0"))
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+host, _, port = os.environ["PADDLE_MASTER"].partition(":")
+total_steps = int(os.environ.get("CHAOS_TOTAL_STEPS", "8"))
+# per-rank checkpoint history (every process is its own "host" here);
+# the fleet agreement is exactly what reconciles them after the kill
+ckpt = os.path.join(os.environ["CHAOS_CKPT_DIR"], f"rank{rank}")
+
+# the armed plan (auto-armed from PT_CHAOS_PLAN at import) targets the
+# FIRST generation only: the relaunch must heal, not re-crash
+if gen != 0:
+    chaos.disarm()
+
+store = TCPStore(host, int(port or 6170), is_master=rank == 0,
+                 world_size=world)
+
+# identical deterministic toy problem on every rank (pure data
+# parallelism with identical batches: rank states stay bit-identical,
+# so any rank's checkpoint is a valid fleet state)
+rng = np.random.RandomState(0)
+X = rng.randn(8, 16).astype(np.float32)
+Y = (X @ rng.randn(16, 4) * 0.1).astype(np.float32)
+W0 = rng.randn(16, 4).astype(np.float32) * 0.01
+
+
+@jax.jit
+def _sgd(w, x, y):
+    def loss_fn(w):
+        return ((x @ w - y) ** 2).mean()
+
+    loss, g = jax.value_and_grad(loss_fn)(w)
+    return loss, w - 0.1 * g
+
+
+def step_fn(state, batch):
+    x, y = batch
+    loss, w = _sgd(state["w"]._data, x, y)
+    return loss, {"w": Tensor(w)}
+
+
+state = {"w": Tensor(jnp.asarray(W0))}
+loop = ResilientTrainLoop(step_fn, state, ckpt, save_every=1,
+                          keep_last_k=4, max_bad_steps=2, step_timeout=60.0,
+                          retries=2)
+agreed = loop.resume_fleet(store, rank, world, tag=f"gen{gen}/resume")
+print(f"RESUMED agreed={-1 if agreed is None else agreed} "
+      f"step={loop.step}", flush=True)
+
+while loop.step < total_steps:
+    # lockstep: nobody enters step N+1 until every rank committed step N
+    # (the collective of a real dp step); after the rank-1 kill the
+    # survivor blocks here until the launcher's death watch reaps it
+    store.barrier(f"gen{gen}/lockstep/{loop.step}", world, timeout=120.0)
+    loss = loop.run_step((X, Y))
+    if loss is not None:
+        print(f"STEP {loop.step} LOSS {loss:.6f}", flush=True)
+
+print(f"DONE step={loop.step} final_loss={loss:.6f} "
+      f"stats={loop.stats}", flush=True)
+sys.exit(0)

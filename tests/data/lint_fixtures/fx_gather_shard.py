@@ -1,0 +1,41 @@
+"""TPL008 fixture: sharded-gather constraint discipline (never imported)."""
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def constraint(x):
+    return jax.lax.with_sharding_constraint(x, P("dp"))
+
+
+def embed_bad(params, tokens):
+    emb = params["wte"][tokens]            # seeded violation: unpinned gather
+    return emb * 2.0
+
+
+def take_bad(params, idx):
+    return jnp.take(params["table"], idx, axis=0)  # seeded violation
+
+
+def embed_wrapped(params, tokens):
+    return constraint(params["wte"][tokens])       # ok: pinned at birth
+
+
+def embed_rebound(params, tokens, emb_constraint=None):
+    emb = params["wte"][tokens]            # ok: rebound through the hook
+    if emb_constraint is not None:
+        emb = emb_constraint(emb)
+    return emb
+
+
+def static_ok(params, tokens):
+    T = tokens.shape[0]
+    return params["wpe"][:T] + params["wte"][0]    # ok: slice / constant
+
+
+def host_lookup(cfg, key: str):
+    return cfg["tables"][key]              # ok: scalar-annotated key is static
+
+
+def justified(params, idx):
+    return params["pages"][idx]  # tpu-lint: disable=TPL008 -- fixture: suppressed instance

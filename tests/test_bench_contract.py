@@ -28,7 +28,7 @@ def bench(monkeypatch):
     # box); individual tests re-patch the ones they exercise
     for name in ("_bench_chip_probe", "_bench_decode", "_bench_serving",
                  "_bench_loss_curve", "_bench_13b", "_bench_long_ctx",
-                 "_bench_phases"):
+                 "_bench_multichip", "_bench_phases"):
         monkeypatch.setattr(b, name, lambda: {})
     return b
 
@@ -120,6 +120,35 @@ def test_serving_key_contract(bench):
     # a kv_quant main run marks itself enabled
     assert bench._serving_keys(dict(m, kv_quant_enabled=True))[
         "serving_kv_quant_enabled"] == 1.0
+
+
+def test_multichip_key_contract(bench):
+    """_multichip_keys is the pure raw-measurements -> bench-keys mapping
+    for the multichip family (ISSUE 9): step time, tok/s/chip, scaling
+    efficiency vs the 1-device serial run, comm fraction, and the
+    quantized-collective throughput + measured loss delta."""
+    m = {"mesh": "dp2xpp2xmp2", "n_devices": 8,
+         "step_ms": 100.0, "tok_s_per_chip": 1280.0,
+         "serial_step_ms": 640.0, "comm_ms": 25.0,
+         "quant_tok_s": 9000.0, "quant_off_tok_s": 8000.0,
+         "quant_off_loss": 7.5, "quant_on_loss": 7.50012}
+    out = bench._multichip_keys(m)
+    for k in ("multichip_mesh", "multichip_n_devices",
+              "multichip_step_ms", "multichip_tok_s_per_chip",
+              "multichip_scaling_eff", "multichip_comm_frac",
+              "dist_allreduce_quant_tok_s",
+              "dist_allreduce_quant_loss_delta"):
+        assert k in out, k
+    assert out["multichip_step_ms"] == 100.0
+    # 640 serial vs 8 chips * 100 ms -> 0.8 linear-scaling efficiency
+    assert out["multichip_scaling_eff"] == pytest.approx(0.8)
+    assert out["multichip_comm_frac"] == pytest.approx(0.25)
+    assert out["dist_allreduce_quant_tok_s"] == 9000.0
+    assert out["dist_allreduce_quant_loss_delta"] == pytest.approx(
+        0.00012, abs=1e-9)
+    # comm_frac is a ratio: a microbench slower than the step clamps to 1
+    assert bench._multichip_keys(dict(m, comm_ms=500.0))[
+        "multichip_comm_frac"] == 1.0
 
 
 from conftest import requires_native_partial_manual

@@ -529,6 +529,116 @@ def test_dropped_heartbeats_expire_lease():
 
 
 # ---------------------------------------------------------------------------
+# multi-host faults: rank targeting + rank loss mid-step
+# ---------------------------------------------------------------------------
+
+MH_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "chaos_multihost_worker.py")
+
+
+def test_rank_targeted_fault_filters_by_env_rank(monkeypatch):
+    """A spec with ``rank=<r>`` fires only in the process whose trainer
+    rank matches — one plan shipped fleet-wide kills exactly one rank."""
+    plan = chaos.FaultPlan(seed=0).add("train.step", "exit", at=0, rank=1,
+                                       code=7)
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    chaos.arm(plan)
+    assert chaos.fire("train.step") is None       # rank 0: filtered out
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    chaos.arm(plan)                               # re-arm resets counters
+    spec = chaos.fire("train.step")
+    assert spec is not None and spec.kind == "exit"
+    assert spec.args["code"] == 7 and spec.args["rank"] == 1
+    # env roundtrip keeps the rank targeting (fleet propagation path)
+    back = chaos.FaultPlan.from_json(plan.to_json())
+    assert back.faults[0].args == {"rank": 1, "code": 7}
+
+
+def test_agree_resume_step_takes_fleet_minimum():
+    from paddle_tpu.distributed.store import TCPStore
+    from paddle_tpu.parallel.resilient_loop import agree_resume_step
+
+    store = TCPStore("127.0.0.1", _free_port(), is_master=True,
+                     world_size=1)
+    out = {}
+
+    def publish(rank, step):
+        out[rank] = agree_resume_step(store, rank, 3, step, tag="t0",
+                                      timeout=20.0)
+
+    ts = [threading.Thread(target=publish, args=(r, s))
+          for r, s in ((0, 7), (1, 5), (2, 9))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert out == {0: 5, 1: 5, 2: 5}
+    # any rank without a usable checkpoint drags the fleet to fresh start
+    ts = [threading.Thread(target=publish, args=(r, s))
+          for r, s in ((0, 7), (1, None), (2, 9))]
+    out.clear()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert out == {0: None, 1: None, 2: None}
+
+
+@pytest.mark.slow
+def test_chaos_multihost_rank_loss_resume(tmp_path):
+    """Rank 1 of a 2-rank lockstep fleet vanishes mid-step (injected
+    ``exit`` — the simulated node loss); the launcher reaps the survivor,
+    run_elastic relaunches, and the healed generation agrees on the
+    victim's newest checkpoint step (walking back the survivor's extra
+    committed step) and trains to completion monotonically."""
+    from paddle_tpu.distributed.fleet.elastic import run_elastic
+
+    ckpt = str(tmp_path / "ckpt")
+    plan = chaos.FaultPlan(seed=0, name="mh")
+    # invocation 4 = the step-5 attempt: rank 1 dies holding checkpoints
+    # 1..4 while rank 0 may commit (and save) step 5 before the reap
+    plan.add("train.step", "exit", at=4, rank=1, code=7)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+
+    rc = run_elastic(
+        MH_WORKER, [], nprocs=2, max_restarts=2,
+        log_dir=str(tmp_path / "logs"),
+        env_extra={"PYTHONPATH": REPO, "CHAOS_CKPT_DIR": ckpt,
+                   "CHAOS_TOTAL_STEPS": "8", **plan.to_env()})
+    assert rc == 0, rc
+
+    logs = {}
+    for g in (0, 1):
+        for r in (0, 1):
+            p = tmp_path / "logs" / f"restart_{g}" / f"worker.{r}.log"
+            logs[(g, r)] = p.read_text() if p.exists() else ""
+
+    # gen0: fresh start on both ranks; rank 1 vanishes after step 4 with
+    # no DONE; the lockstep barrier bounds the survivor to one extra step
+    assert "RESUMED agreed=-1 step=0" in logs[(0, 0)]
+    assert "RESUMED agreed=-1 step=0" in logs[(0, 1)]
+    g01 = [int(s) for s in re.findall(r"STEP (\d+) ", logs[(0, 1)])]
+    assert g01 == [1, 2, 3, 4], logs[(0, 1)]
+    assert "DONE" not in logs[(0, 1)]
+    g00 = [int(s) for s in re.findall(r"STEP (\d+) ", logs[(0, 0)])]
+    assert g00[:4] == [1, 2, 3, 4] and len(g00) <= 5
+    assert "DONE" not in logs[(0, 0)]
+
+    # gen1: BOTH ranks agreed on step 4 (the fleet minimum) and resumed
+    # there — monotone continuation to completion on each rank
+    for r in (0, 1):
+        assert "RESUMED agreed=4 step=4" in logs[(1, r)], logs[(1, r)]
+        g1 = [int(s) for s in re.findall(r"STEP (\d+) ", logs[(1, r)])]
+        assert g1 == [5, 6, 7, 8], logs[(1, r)]
+        assert "DONE step=8" in logs[(1, r)]
+    # training progressed across the fault: final loss below the first
+    l0 = [float(x) for x in re.findall(r"LOSS ([\d.]+)", logs[(0, 0)])]
+    l1 = [float(x) for x in re.findall(r"LOSS ([\d.]+)", logs[(1, 0)])]
+    assert l1[-1] < l0[0]
+
+
+# ---------------------------------------------------------------------------
 # end-to-end: kill a worker mid-run, resume from last VALID checkpoint
 # ---------------------------------------------------------------------------
 

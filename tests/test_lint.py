@@ -174,6 +174,36 @@ def test_tpl007_autotune_bypass_fires_and_suppresses():
         assert silent not in msgs, silent
 
 
+def test_tpl008_gather_constraint_fires_and_suppresses():
+    src = open(fx("fx_gather_shard.py")).read()
+    f = lint(["fx_gather_shard.py"], "TPL008")
+    assert len(f) == 2, [(x.line, x.message) for x in f]
+    for x in f:
+        assert "seeded violation" in src.splitlines()[x.line - 1], \
+            (x.line, x.message)
+        assert x.severity == "warning"
+    msgs = " | ".join(x.message for x in f)
+    # both gather spellings fire ...
+    assert "params['wte'][...]" in msgs
+    assert "jnp.take" in msgs
+    # ... while the constraint-wrapped, hook-rebound, static-index, and
+    # suppressed gathers stay silent (their functions never appear)
+    for silent in ("embed_wrapped", "embed_rebound", "static_ok",
+                   "host_lookup", "justified"):
+        assert silent not in msgs, silent
+
+
+def test_tpl008_silent_without_sharding_marks(tmp_path):
+    # the same gather in a file that never touches sharding machinery is
+    # out of the rule's jurisdiction (GSPMD cannot repartition it)
+    mod = tmp_path / "plain.py"
+    mod.write_text("import jax.numpy as jnp\n\n"
+                   "def embed(params, tokens):\n"
+                   "    return params['wte'][tokens]\n")
+    f = run_lint([str(mod)], select={"TPL008"}, excludes=())
+    assert f == []
+
+
 # -- framework behaviors -----------------------------------------------------
 
 def test_suppression_syntax_variants():
@@ -222,7 +252,7 @@ def test_reporters_shape():
 
 def test_rule_table_unique_and_documented():
     rules = [c.rule for c in ALL_CHECKERS]
-    assert len(rules) == len(set(rules)) == 10  # 7 per-file + 3 interproc
+    assert len(rules) == len(set(rules)) == 11  # 8 per-file + 3 interproc
     assert all(c.description for c in ALL_CHECKERS)
     assert all(c.severity in ("error", "warning") for c in ALL_CHECKERS)
 

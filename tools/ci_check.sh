@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
 # One-command CI gate: static analysis -> op-contract baseline -> chaos
-# suite -> serving smoke -> kernel parity -> loadgen smoke -> tier-1.
+# suite -> serving smoke -> kernel parity -> loadgen smoke -> multichip
+# smoke -> tier-1.
 #
 #   bash tools/ci_check.sh
 #
@@ -12,12 +13,14 @@
 #   50  serving smoke failed (scheduler completion / page-leak check)
 #   60  kernel parity failed (fused kernel != unfused composition)
 #   70  loadgen smoke failed (open-loop saturation / occupancy ledger)
+#   80  multichip smoke failed (remat regression / serial-parity drift /
+#       quantized all-reduce divergence on the 8-device virtual mesh)
 #   30  tier-1 tests failed (ROADMAP.md command)
 #    0  all gates green
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== gate 1/7: tpu-lint (per-file + interprocedural rules) =="
+echo "== gate 1/8: tpu-lint (per-file + interprocedural rules) =="
 python -m tools.lint paddle_tpu tests --format=json > /tmp/tpu_lint.json
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -27,7 +30,7 @@ if [ "$rc" -ne 0 ]; then
 fi
 echo "tpu-lint: clean"
 
-echo "== gate 2/7: tpu-verify (abstract op-contract baseline) =="
+echo "== gate 2/8: tpu-verify (abstract op-contract baseline) =="
 JAX_PLATFORMS=cpu python -m tools.lint --contracts \
     --baseline artifacts/op_contracts.json
 rc=$?
@@ -37,7 +40,7 @@ if [ "$rc" -ne 0 ]; then
     exit 20
 fi
 
-echo "== gate 3/7: chaos suite (fault injection -> self-healing) =="
+echo "== gate 3/8: chaos suite (fault injection -> self-healing) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 rc=$?
@@ -47,7 +50,7 @@ if [ "$rc" -ne 0 ]; then
     exit 40
 fi
 
-echo "== gate 4/7: serving smoke (scheduler completion + zero page leak) =="
+echo "== gate 4/8: serving smoke (scheduler completion + zero page leak) =="
 JAX_PLATFORMS=cpu python -m tools.serving_smoke
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -56,7 +59,7 @@ if [ "$rc" -ne 0 ]; then
     exit 50
 fi
 
-echo "== gate 5/7: kernel parity (fused megakernels, CPU fallback arms) =="
+echo "== gate 5/8: kernel parity (fused megakernels, CPU fallback arms) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_fused_norm_epilogue.py \
     tests/test_fused_rope_attention.py tests/test_autotune.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
@@ -67,7 +70,7 @@ if [ "$rc" -ne 0 ]; then
     exit 60
 fi
 
-echo "== gate 6/7: loadgen smoke (open-loop saturation, >=200 arrivals) =="
+echo "== gate 6/8: loadgen smoke (open-loop saturation, >=200 arrivals) =="
 JAX_PLATFORMS=cpu python -m tools.loadgen_smoke
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -77,7 +80,18 @@ if [ "$rc" -ne 0 ]; then
     exit 70
 fi
 
-echo "== gate 7/7: tier-1 tests (ROADMAP.md) =="
+echo "== gate 7/8: multichip smoke (dp x mp mesh: remat-free compile," \
+     "serial parity, quantized all-reduce) =="
+python tools/multichip_smoke.py
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "ci_check: multichip smoke gate failed (rc=$rc) — the sharded" \
+         "train step rematerializes, drifted from the serial step, or the" \
+         "quantized all-reduce diverged" >&2
+    exit 80
+fi
+
+echo "== gate 8/8: tier-1 tests (ROADMAP.md) =="
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

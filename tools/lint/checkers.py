@@ -9,6 +9,7 @@ TPL004 recompile-hazard      time()/np.random/closure scalars under jit
 TPL005 collective-safety     lax.p* axis names unbound by any shard_map
 TPL006 flag-hygiene          define_flag() names that are never read
 TPL007 pallas-autotune-bypass pallas_call sites no tuned() entry reaches
+TPL008 gather-sharding-constraint  traced gathers never pinned by a constraint
 
 The analyses are deliberately first-order (per-function taint, per-file
 axis sets, project-wide name sets) — precise enough to catch the shipped
@@ -851,6 +852,137 @@ class PallasAutotuneBypass(Checker):
         self.ctx = None
 
 
+# -- TPL008: unconstrained gathers on sharded operands ------------------------
+
+class GatherShardingConstraint(Checker):
+    """An embedding-style gather (``table[ids]`` / ``jnp.take``) over
+    traced operands in a file that manipulates shardings, whose result is
+    never pinned by a sharding constraint. GSPMD picks the gather's output
+    layout by cost model, so a downstream layout (the ZeRO-sharded
+    optimizer moments in MULTICHIP_r05) back-propagates onto the gather
+    and the resulting reshard is an involuntary full rematerialization of
+    ``f32[B,T,H]``. The fix shipped in models/gpt.py: pin the gather
+    through a ``*constraint*`` call the moment the value exists — either
+    wrapping the gather directly (``constraint(params["wte"][tokens])``)
+    or rebinding its target before further use (``emb = params["wte"]
+    [tokens]`` then ``emb = emb_constraint(emb)``). Both shapes clear the
+    rule; gathers whose result escapes unpinned are reported.
+
+    First-order like the rest of the suite: the rule only looks at files
+    that reference sharding machinery at all, treats function parameters
+    (and anything assigned from them) as potentially mesh-sharded, and
+    skips static indexing (constants, slices, shape queries)."""
+
+    rule = "TPL008"
+    name = "gather-sharding-constraint"
+    severity = "warning"
+    description = ("traced gather (x[ids]/jnp.take) in a sharding-aware "
+                   "file whose result is never pinned by a sharding "
+                   "constraint")
+
+    SHARDING_MARKS = ("PartitionSpec", "NamedSharding", "shard_map",
+                      "with_sharding_constraint", "get_abstract_mesh")
+    TAKE_CALLS = {"jnp.take", "jax.numpy.take"}
+
+    def check(self, ctx):
+        if not any(m in ctx.source for m in self.SHARDING_MARKS):
+            return  # file never touches shardings: gathers are GSPMD-free
+        self.ctx = ctx
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan(node)
+        self.ctx = None
+
+    def _is_gather(self, node: ast.AST, tainted: set) -> bool:
+        if isinstance(node, ast.Subscript) and isinstance(node.ctx,
+                                                          ast.Load):
+            sl = node.slice
+            # static indexing — constants, slices, tuple/advanced mixes,
+            # shape-derived scalars — never produces a sharded gather
+            if isinstance(sl, (ast.Constant, ast.Slice, ast.Tuple)):
+                return False
+            if _is_shape_query(sl):
+                return False
+            # embedding-table shape: params["wte"][tokens] — a string-
+            # keyed entry of a traced pytree indexed by a traced array.
+            # Bare ``seq[i]`` subscripts are host-side container lookups
+            # far more often than array gathers; out of static reach on
+            # purpose (jnp.take covers the explicit-gather spelling).
+            base = node.value
+            if not (isinstance(base, ast.Subscript)
+                    and isinstance(base.slice, ast.Constant)
+                    and isinstance(base.slice.value, str)):
+                return False
+            return bool(names_in(sl) & tainted) \
+                and bool(names_in(base.value) & tainted)
+        if isinstance(node, ast.Call) and call_name(node) in \
+                self.TAKE_CALLS and node.args:
+            return bool(names_in(node.args[0]) & tainted)
+        return False
+
+    @staticmethod
+    def _is_constraint_call(node: ast.AST) -> bool:
+        return isinstance(node, ast.Call) and \
+            "constraint" in call_name(node).rsplit(".", 1)[-1]
+
+    def _scan(self, fn: ast.FunctionDef):
+        tainted = _propagate_taint(fn, _param_names(fn))
+        # every node that sits inside a *constraint* call's arguments is
+        # pinned at birth (constraint(params["wte"][tokens]))
+        pinned: set[int] = set()
+        for node in _iter_scope(fn):
+            if self._is_constraint_call(node):
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    for sub in ast.walk(arg):
+                        pinned.add(id(sub))
+        # names rebound through a constraint call (emb = emb_constraint(
+        # emb)), by line — clears gathers assigned to them earlier
+        rebinds: dict[str, list[int]] = {}
+        for node in _iter_scope(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and self._is_constraint_call(node.value) \
+                    and node.targets[0].id in names_in(node.value):
+                rebinds.setdefault(node.targets[0].id,
+                                   []).append(node.lineno)
+        for node in _iter_scope(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            target = (node.targets[0]
+                      if len(node.targets) == 1
+                      and isinstance(node.targets[0], ast.Name) else None)
+            if target is None:
+                continue
+            for sub in ast.walk(node.value):
+                if id(sub) in pinned or not self._is_gather(sub, tainted):
+                    continue
+                if any(ln > node.lineno
+                       for ln in rebinds.get(target.id, ())):
+                    pinned.add(id(sub))  # rebound through a constraint
+        for node in _iter_scope(fn):
+            for_report = None
+            if isinstance(node, (ast.Subscript, ast.Call)) \
+                    and id(node) not in pinned \
+                    and self._is_gather(node, tainted):
+                for_report = node
+            if for_report is not None:
+                if isinstance(for_report, ast.Call):
+                    what = "jnp.take"
+                else:  # _is_gather guarantees a str-keyed Subscript base
+                    b = for_report.value
+                    what = f"{dotted_name(b.value)}[{b.slice.value!r}][...]"
+                self.report(for_report,
+                            f"{what} gathers a traced index over a "
+                            "potentially mesh-sharded operand in "
+                            f"'{fn.name}' without a sharding constraint: "
+                            "GSPMD chooses the output layout by cost "
+                            "model and may reshard with an involuntary "
+                            "full rematerialization — pin it with "
+                            "with_sharding_constraint (or an injected "
+                            "*_constraint hook) the moment it exists")
+
+
 ALL_CHECKERS = [
     HostSyncInTrace,
     AsyncAliasing,
@@ -859,4 +991,5 @@ ALL_CHECKERS = [
     CollectiveSafety,
     FlagHygiene,
     PallasAutotuneBypass,
+    GatherShardingConstraint,
 ]
