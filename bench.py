@@ -162,6 +162,7 @@ def _run_secondary_benches() -> dict:
     for fn_name, err_key in (("_bench_chip_probe", "chip_probe_error"),
                              ("_bench_decode", "llama_decode_error"),
                              ("_bench_serving", "serving_error"),
+                             ("_bench_multitenant", "multitenant_error"),
                              ("_bench_loss_curve", "loss_curve_error"),
                              ("_bench_13b", "gpt3_1p3b_error"),
                              ("_bench_long_ctx", "long_ctx_error"),
@@ -388,6 +389,90 @@ def _bench_serving():
                  for a, b in zip(fa, qa))
     kvq_m["quality_delta"] = round(n_diff / max(n_tok, 1), 4)
     return _serving_keys(m, spec_m, kvq_m)
+
+
+def _multitenant_keys(lora_m, prio_m, con_m, n_adapters):
+    """Pure mapping: the three multi-tenant arms' loadgen metrics ->
+    bench keys (tests/test_bench_contract.py pins the key set)."""
+    return {
+        "serving_lora_tok_s": lora_m["throughput_tok_s"],
+        "serving_lora_n_adapters": float(n_adapters),
+        "serving_preemption_rate": prio_m["preemption_rate"],
+        "serving_occ_waste_preempted": prio_m["occ_waste_preempted"],
+        "serving_constrained_tok_s": con_m["throughput_tok_s"],
+    }
+
+
+def _bench_multitenant():
+    """Multi-tenant serving (inference/multitenant/, ISSUE 10): three
+    arms over the same engine config as _bench_serving.
+
+    - LoRA arm: the _bench_serving traffic shape with a pool of
+      adapters assigned per request — throughput with heterogeneous
+      adapters applied through the grouped BGMV path, adapter pages
+      riding the KV page pool.
+    - priority arm: a deliberately page-tight engine under two priority
+      classes — reports the preemption rate and the re-prefill
+      occupancy cost (occ_waste_preempted), the price of letting
+      high-priority traffic jump the pool.
+    - constrained arm: every request decodes under a small enum DFA
+      (synchronous harvest) — throughput with per-row vocab masks
+      riding the dispatch."""
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.inference.loadgen import (OpenLoopDriver,
+                                              WorkloadSpec, synthesize)
+    from paddle_tpu.inference.multitenant import (json_schema_dfa,
+                                                  make_lora)
+    from paddle_tpu.inference.serving import Request, ServingEngine
+
+    cfg = LlamaConfig(vocab_size=32000, hidden=2048, n_layers=16,
+                      n_heads=16, n_kv_heads=4, ffn_hidden=5504,
+                      max_seq_len=2048, dtype=jnp.bfloat16)
+
+    def mk_engine(**kw):
+        return ServingEngine(cfg, max_batch=8, page_size=128,
+                             max_seq=1536, prefill_budget=512, **kw)
+
+    def mk_warm():
+        return [Request(rid=-1, prompt=np.ones(640, np.int32),
+                        max_new_tokens=2, arrival=0.0)]
+
+    base = dict(n_requests=24, seed=7, vocab_size=cfg.vocab_size,
+                process="poisson", rate=30.0, prefix_len=512,
+                n_prefixes=1, shared_frac=0.9, tail_log_mean=5.3,
+                tail_log_sigma=0.6, tail_min=32, tail_max=512,
+                new_min=64, new_max=128, max_seq=1536)
+
+    # -- LoRA arm --------------------------------------------------------
+    n_adapters = 4
+    eng = mk_engine(lora=True, lora_rank=8, lora_slots=n_adapters)
+    for j in range(n_adapters):
+        eng.register_adapter("a%d" % j, make_lora(cfg, 8, seed=100 + j))
+    eng.run(mk_warm())
+    lora_wl = synthesize(WorkloadSpec(
+        **base, n_tenants=4, n_adapters=n_adapters, adapter_frac=0.75))
+    lora_m = OpenLoopDriver(eng, clock="wall").run(lora_wl)
+
+    # -- priority arm: pool sized to force preemption --------------------
+    eng2 = ServingEngine(cfg, max_batch=8, page_size=128, max_seq=1536,
+                         prefill_budget=512, n_pages=1 + 3 * 12,
+                         priorities=True)
+    eng2.run(mk_warm())
+    prio_wl = synthesize(WorkloadSpec(**base, priority_levels=3))
+    prio_m = OpenLoopDriver(eng2, clock="wall").run(prio_wl)
+
+    # -- constrained arm -------------------------------------------------
+    eng3 = mk_engine(constrained=True)
+    vocab = [""] * cfg.vocab_size
+    for i, w in enumerate(("yes", "no", "maybe", "y", "n", "m", "a",
+                           "b", "e", "o", "s")):
+        vocab[i + 1] = w
+    eng3.register_schema(
+        "s0", json_schema_dfa({"enum": ["yes", "no", "maybe"]}, vocab).fresh)
+    eng3.run(mk_warm())
+    con_wl = synthesize(WorkloadSpec(**base, constrained_frac=1.0))
+    con_m = OpenLoopDriver(eng3, clock="wall").run(con_wl)
+    return _multitenant_keys(lora_m, prio_m, con_m, n_adapters)
 
 
 def _bench_loss_curve():

@@ -221,6 +221,28 @@ define_flag("decode_weight_quant", False,
             "(ops/pallas/quant_matmul.py; XLA fallback elsewhere). Off "
             "= full-precision weights, bit-identical.")
 
+# -- multi-tenant serving (inference/multitenant/; all default off =
+#    bit-identical streams, pinned in tests/test_multitenant.py) ----------
+define_flag("serving_lora", False,
+            "Per-request LoRA serving: adapter weights live as "
+            "refcounted, content-hashed pages in the KV page pool "
+            "(inference/multitenant/lora.py) and heterogeneous adapters "
+            "apply across the packed batch in one grouped BGMV program "
+            "(ops/pallas/lora_matmul.py). Off = base model only, "
+            "bit-identical.")
+define_flag("serving_priorities", False,
+            "Priority classes with preemption: admission orders by "
+            "(priority desc, arrival) and under pool pressure a "
+            "low-priority resident request's KV pages are evicted and "
+            "it re-admits later through the prefix cache (re-prefill "
+            "charged to the occ_waste_preempted bucket). Off = FIFO "
+            "admission, bit-identical.")
+define_flag("serving_constrained", False,
+            "Constrained decoding: per-request JSON-schema/grammar token "
+            "masks (inference/multitenant/constrain.py) ride the static "
+            "unified program as per-row data and mask logits before "
+            "sampling. Off = unmasked sampling, bit-identical.")
+
 define_flag("dist_allreduce_quant", False,
             "EQuARX-style int8 gradient all-reduce for the dp gradient "
             "sync: per-rank-chunk symmetric int8 with fp32 scales on the "

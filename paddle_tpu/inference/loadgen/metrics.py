@@ -10,9 +10,9 @@ open-loop convention — queueing delay counts against the scheduler):
 - goodput: completed-request tokens per second (aborted/incomplete
   requests' tokens are excluded; raw throughput counts them).
 - occupancy: the engine's slot-token ledger, reused as-is — active
-  fraction plus the five waste buckets (queue-empty, admission-blocked,
-  prefill, overrun, spec-rejected) sum to 1 by construction, so a drop
-  in occupancy always carries its cause.
+  fraction plus the six waste buckets (queue-empty, admission-blocked,
+  prefill, overrun, spec-rejected, preempted) sum to 1 by construction,
+  so a drop in occupancy always carries its cause.
 """
 
 from __future__ import annotations
@@ -69,6 +69,11 @@ def summarize(requests, engine, wall_s: float) -> dict:
             st["waste_overrun_slot_tokens"] / slot_tok, 3),
         "occ_waste_spec_rejected": round(
             st["waste_spec_rejected_slot_tokens"] / slot_tok, 3),
+        "occ_waste_preempted": round(
+            st.get("waste_preempted_slot_tokens", 0) / slot_tok, 3),
+        "preemption_rate": round(
+            st.get("preemptions", 0) / max(1, len(requests)), 3),
+        "n_preemptions": st.get("preemptions", 0),
         "spec_accept_rate": round(
             st["spec_accepted_tokens"] / st["spec_proposed_tokens"], 3)
         if st["spec_proposed_tokens"] else 0.0,

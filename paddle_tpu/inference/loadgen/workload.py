@@ -52,6 +52,18 @@ class WorkloadSpec:
     temperature: float = 0.8
     top_p: float = 0.9
     max_seq: Optional[int] = None        # clamp prompt+new when set
+    # multi-tenant knobs (inference/multitenant/): all default off. The
+    # fields draw from a SEPARATE RandomState keyed off the seed, so a
+    # single-tenant stream (all knobs 0) is byte-identical to the
+    # pre-multi-tenant synthesize for the same seed — and even with the
+    # knobs on, prompts/arrivals/sampling are unchanged (pinned in
+    # tests/test_multitenant.py)
+    n_tenants: int = 0                   # round-robin tenant ids
+    n_adapters: int = 0                  # adapter pool size ("a<j>")
+    adapter_frac: float = 0.5            # P(request carries an adapter)
+    priority_levels: int = 0             # uniform priority in [0, levels)
+    constrained_frac: float = 0.0        # P(request names a schema)
+    n_schemas: int = 1                   # schema pool size ("s<j>")
 
 
 def synthesize(spec: WorkloadSpec) -> list[Request]:
@@ -98,4 +110,18 @@ def synthesize(spec: WorkloadSpec) -> list[Request]:
                       seed=int(rng.randint(1 << 30)))
         reqs.append(Request(rid=i, prompt=prompt, max_new_tokens=max_new,
                             arrival=float(arrivals[i]), **kw))
+    if (spec.n_tenants or spec.n_adapters or spec.priority_levels
+            or spec.constrained_frac):
+        # multi-tenant decoration AFTER the legacy draw sequence, from
+        # its own stream: the legacy fields above stay byte-identical
+        rng2 = np.random.RandomState((spec.seed + 0x517A) % (1 << 32))
+        for i, r in enumerate(reqs):
+            if spec.n_tenants:
+                r.tenant = i % spec.n_tenants
+            if spec.priority_levels:
+                r.priority = int(rng2.randint(spec.priority_levels))
+            if spec.n_adapters and rng2.rand() < spec.adapter_frac:
+                r.adapter_id = "a%d" % rng2.randint(spec.n_adapters)
+            if spec.constrained_frac and rng2.rand() < spec.constrained_frac:
+                r.schema_id = "s%d" % rng2.randint(max(1, spec.n_schemas))
     return reqs

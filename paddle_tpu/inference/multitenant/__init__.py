@@ -1,0 +1,27 @@
+"""Multi-tenant serving subsystem over the unified RPA engine.
+
+Three orthogonal request-diversity axes, all riding the ONE static
+compiled program per engine step (inference/serving.py) as data:
+
+- ``lora``: per-request LoRA adapters. Adapter weights live as
+  refcounted, content-hashed pages in the SAME page pool as the KV
+  cache (same ledger, same idle-LRU eviction machinery as the prefix
+  cache), and heterogeneous adapters apply across the packed batch in
+  one grouped BGMV program (ops/pallas/lora_matmul.py).
+- priority classes with preemption (inference/serving.py scheduler):
+  under pool pressure a low-priority resident request's KV pages are
+  evicted and it re-admits later through the prefix cache, so
+  preemption is nearly free.
+- ``constrain``: constrained/structured decoding. Per-request
+  JSON-schema/grammar token masks ride the static program as per-row
+  data and mask logits before the in-program sampler.
+
+All three are flag-gated (``serving_lora`` / ``serving_priorities`` /
+``serving_constrained``) and default off = bit-identical streams.
+"""
+
+from .constrain import ConstraintState, TokenDfa, json_schema_dfa
+from .lora import AdapterStore, make_lora
+
+__all__ = ["AdapterStore", "ConstraintState", "TokenDfa",
+           "json_schema_dfa", "make_lora"]

@@ -1,0 +1,206 @@
+"""Per-request LoRA adapter residency on the serving page pool.
+
+Adapter weights are first-class pool citizens: loading an adapter
+charges ``ceil(adapter_bytes / kv_page_bytes)`` page ids out of the SAME
+free list the KV cache allocates from, so adapter residency and KV
+capacity trade off in one ledger (page_accounting() counts them as the
+7th class, ``adapter``). The lifecycle mirrors the prefix cache exactly:
+
+- content-hashed: residency is keyed by the sha1 of the weight bytes,
+  so two tenants registering identical weights under different ids
+  share ONE resident copy (and every request using it shares the same
+  pages — the refcount assertion in tests/test_multitenant.py);
+- refcounted: admission of a request naming the adapter increfs it,
+  slot teardown (finish / abort / preemption) decrefs; refcount-0
+  adapters stay resident (warm) in an idle LRU;
+- evicted under pressure: when allocation would otherwise fail — or
+  every device slot is taken — idle adapters are evicted LRU-first,
+  returning their pages to the free list. Adapter pages never enter a
+  block table (they are capacity accounting, not KV bytes), so eviction
+  needs no deferred-free cycle.
+
+Device side, resident adapters live in four stacked buffers shaped for
+the engine's layer scan — ``[L, n_slots + 1, ...]`` — with slot 0 the
+identity (all-zero) adapter for rows without one. The engine passes the
+stacks plus a per-row slot-id vector into the unified step, where
+ops/pallas/lora_matmul.py applies them as one grouped BGMV program.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = ["AdapterStore", "make_lora"]
+
+# q and v projections carry the adapters (the classic LoRA target set)
+_PARTS = ("a_q", "b_q", "a_v", "b_v")
+
+
+def make_lora(cfg, rank: int, seed: int, scale: float = 0.05) -> dict:
+    """Random LoRA weights for tests/benches: A ~ N(0, scale), B ~ N(0,
+    scale) per layer for the q and v projections (any alpha/r scaling is
+    the registrant's business — fold it into B)."""
+    rng = np.random.RandomState(seed)
+    L, H, dH = cfg.n_layers, cfg.hidden, cfg.head_dim
+    nq, nv = cfg.n_heads * dH, cfg.n_kv_heads * dH
+    f = lambda *s: (rng.randn(*s) * scale).astype(np.float32)  # noqa: E731
+    return {"a_q": f(L, H, rank), "b_q": f(L, rank, nq),
+            "a_v": f(L, H, rank), "b_v": f(L, rank, nv)}
+
+
+class AdapterStore:
+    """Refcounted, content-hashed adapter residency: host weight library
+    + device slot stacks + pool page accounting. ``alloc_pages`` is the
+    engine's allocator (it already reclaims idle prefix-cache pages on
+    demand); ``release_pages`` returns evicted adapters' pages."""
+
+    def __init__(self, cfg, rank: int, n_slots: int, page_bytes: float,
+                 alloc_pages, release_pages):
+        self.cfg = cfg
+        self.rank = int(rank)
+        self.n_slots = int(n_slots)
+        self._alloc_pages = alloc_pages
+        self._release_pages = release_pages
+        L, H, dH = cfg.n_layers, cfg.hidden, cfg.head_dim
+        nq, nv = cfg.n_heads * dH, cfg.n_kv_heads * dH
+        dt = cfg.dtype
+        # scan layout: leading L so the per-layer slices ride the layer
+        # scan's xs; slot 0 = identity adapter (exact +0.0 delta)
+        self._aq = jnp.zeros((L, n_slots + 1, H, rank), dt)
+        self._bq = jnp.zeros((L, n_slots + 1, rank, nq), dt)
+        self._av = jnp.zeros((L, n_slots + 1, H, rank), dt)
+        self._bv = jnp.zeros((L, n_slots + 1, rank, nv), dt)
+        bytes_per = (self._aq[:, 0].nbytes + self._bq[:, 0].nbytes
+                     + self._av[:, 0].nbytes + self._bv[:, 0].nbytes)
+        self.pages_per_adapter = max(1, -(-bytes_per // int(page_bytes)))
+        self._weights: dict[bytes, dict] = {}      # hash -> host weights
+        self._hash_of_id: dict = {}                # adapter id -> hash
+        self._resident: dict[bytes, int] = {}      # hash -> device slot
+        self._ref: dict[bytes, int] = {}           # hash -> live requests
+        self._pages: dict[bytes, list[int]] = {}   # hash -> pool page ids
+        self._idle: dict[bytes, None] = {}         # refcount-0 LRU
+        self._free_slots = list(range(n_slots, 0, -1))
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # -- registration -------------------------------------------------------
+
+    def register(self, adapter_id, weights: dict) -> None:
+        """Add ``weights`` (make_lora() layout) to the host library under
+        ``adapter_id``. Residency is established lazily at first acquire.
+        Identical weight bytes under a different id dedupe to the same
+        content hash (shared residency, shared pages)."""
+        h = hashlib.sha1(b"pt-lora:%d" % self.rank)
+        for part in _PARTS:
+            w = np.ascontiguousarray(weights[part], dtype=np.float32)
+            h.update(w.tobytes())
+        digest = h.digest()
+        self._hash_of_id[adapter_id] = digest
+        if digest not in self._weights:
+            self._weights[digest] = {
+                part: np.asarray(weights[part], np.float32)
+                for part in _PARTS}
+
+    def known(self, adapter_id) -> bool:
+        return adapter_id in self._hash_of_id
+
+    def digest_of(self, adapter_id) -> bytes:
+        """Content digest of a registered adapter — the engine salts its
+        prefix-cache page hashes with it (the v-delta changes KV page
+        bytes, so cross-adapter prefixes must never alias)."""
+        return self._hash_of_id[adapter_id]
+
+    # -- residency ----------------------------------------------------------
+
+    def acquire(self, adapter_id) -> Optional[int]:
+        """Incref ``adapter_id``'s adapter, loading it (device slot +
+        pool pages) on miss; returns its device slot, or None when the
+        pool/slots cannot fit it even after evicting every idle adapter
+        (the caller treats that exactly like pool-blocked admission)."""
+        digest = self._hash_of_id[adapter_id]
+        slot = self._resident.get(digest)
+        if slot is not None:
+            if self._ref[digest] == 0:
+                self._idle.pop(digest, None)
+            self._ref[digest] += 1
+            self.hits += 1
+            return slot
+        self.misses += 1
+        while not self._free_slots:
+            if not self._evict_idle():
+                return None
+        pages = self._alloc_pages(self.pages_per_adapter)
+        while pages is None:
+            if not self._evict_idle():
+                return None
+            pages = self._alloc_pages(self.pages_per_adapter)
+        slot = self._free_slots.pop()
+        w = self._weights[digest]
+        dt = self.cfg.dtype
+        self._aq = self._aq.at[:, slot].set(jnp.asarray(w["a_q"], dt))
+        self._bq = self._bq.at[:, slot].set(jnp.asarray(w["b_q"], dt))
+        self._av = self._av.at[:, slot].set(jnp.asarray(w["a_v"], dt))
+        self._bv = self._bv.at[:, slot].set(jnp.asarray(w["b_v"], dt))
+        self._resident[digest] = slot
+        self._ref[digest] = 1
+        self._pages[digest] = pages
+        return slot
+
+    def decref(self, adapter_id) -> None:
+        digest = self._hash_of_id[adapter_id]
+        self._ref[digest] -= 1
+        if self._ref[digest] == 0:
+            self._idle[digest] = None      # warm: evict only on pressure
+
+    def _evict_idle(self) -> bool:
+        """Drop the LRU idle adapter, returning its pages to the pool;
+        False when nothing is idle (every resident adapter is in use)."""
+        if not self._idle:
+            return False
+        digest = next(iter(self._idle))
+        del self._idle[digest]
+        slot = self._resident.pop(digest)
+        del self._ref[digest]
+        self._release_pages(self._pages.pop(digest))
+        self._free_slots.append(slot)
+        self.evictions += 1
+        return True
+
+    # -- engine-facing views ------------------------------------------------
+
+    def slot_of(self, adapter_id) -> int:
+        """Resident device slot of an ACQUIRED adapter (0 never maps to
+        a real adapter — it is the identity slot)."""
+        return self._resident[self._hash_of_id[adapter_id]]
+
+    def ref_of(self, adapter_id) -> int:
+        return self._ref.get(self._hash_of_id[adapter_id], 0)
+
+    def pages_of(self, adapter_id) -> list[int]:
+        return list(self._pages.get(self._hash_of_id[adapter_id], []))
+
+    def stacks(self) -> dict:
+        """The four device stacks, scan layout [L, n_slots + 1, ...] —
+        one pytree operand of the unified step."""
+        return {"aq": self._aq, "bq": self._bq,
+                "av": self._av, "bv": self._bv}
+
+    def n_pages_held(self) -> int:
+        """Pool pages currently charged to resident adapters (the
+        ``adapter`` class of the 7-part page-accounting ledger)."""
+        return sum(len(p) for p in self._pages.values())
+
+    def n_resident(self) -> int:
+        return len(self._resident)
+
+    def stats(self) -> dict:
+        return {"adapter_hits": self.hits, "adapter_misses": self.misses,
+                "adapter_evictions": self.evictions,
+                "adapters_resident": len(self._resident),
+                "adapter_pages": self.n_pages_held()}

@@ -74,12 +74,25 @@ class Request:
     temperature: float = 0.0
     top_p: float = 1.0
     seed: int = 0
+    # multi-tenant surface (inference/multitenant/): all default-None/0
+    # = the single-tenant request the engine always served. tenant is
+    # pure telemetry; priority steers admission order and preemption
+    # when serving_priorities is on; adapter_id names a registered LoRA
+    # adapter (serving_lora); schema_id/constraint constrain decoding
+    # (serving_constrained; schema_id binds a registered schema factory
+    # at admission, constraint is a live ConstraintState)
+    tenant: int = 0
+    priority: int = 0
+    adapter_id: Optional[object] = None
+    schema_id: Optional[object] = None
+    constraint: Optional[object] = None
     # filled by the engine:
     out_tokens: list = dataclasses.field(default_factory=list)
     t_first: Optional[float] = None    # first-token wall time
     t_done: Optional[float] = None
     aborted: bool = False
     age: int = 0                       # pool-blocked admission skips
+    n_preempted: int = 0               # KV evictions this request survived
 
 
 def _pick_tokens(logits, temps, topps, seeds, positions):
@@ -230,7 +243,12 @@ class ServingEngine:
                  qb: Optional[int] = None,
                  speculative_k: Optional[int] = None,
                  spec_ngram: Optional[int] = None,
-                 kv_quant: Optional[bool] = None):
+                 kv_quant: Optional[bool] = None,
+                 lora: Optional[bool] = None,
+                 lora_rank: int = 8,
+                 lora_slots: int = 4,
+                 priorities: Optional[bool] = None,
+                 constrained: Optional[bool] = None):
         if decode_quantum is not None:
             # the unified step (PR 7) has no decode-quantum boundary;
             # the kwarg was previously swallowed silently
@@ -291,6 +309,22 @@ class ServingEngine:
             self._proposer = None
         self._cache_on = bool(prefix_cache)
         self.admit_aging = admit_aging
+        # -- multi-tenant axes (inference/multitenant/): all default off
+        #    = the exact single-tenant engine (bit-identical, pinned) ---
+        if lora is None:
+            lora = GLOBAL_FLAGS.get("serving_lora")
+        if priorities is None:
+            priorities = GLOBAL_FLAGS.get("serving_priorities")
+        if constrained is None:
+            constrained = GLOBAL_FLAGS.get("serving_constrained")
+        self._lora_on = bool(lora)
+        self._prio_on = bool(priorities)
+        self._constr_on = bool(constrained)
+        if self._constr_on and self.spec_k:
+            raise ValueError(
+                "serving_constrained is incompatible with "
+                "serving_speculative_k: a constraint mask covers one "
+                "sampling position per row, not a k-token draft ladder")
         L, nKV, d = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
         # serving_kv_quant: pages are symmetric int8 with a per-page,
         # per-head fp32 scale plane per layer — KV bytes per token drop
@@ -330,6 +364,25 @@ class ServingEngine:
         self._prefilling: dict[int, int] = {}
         self.pool = _PagePool(self.n_pages, cache_limit=prefix_cache_pages)
         self.queue: list[Request] = []
+        # per-slot multi-tenant state: the admitted request's adapter id
+        # (refcount handle), its device slot in the adapter stacks (0 =
+        # identity), and its EFFECTIVE prompt — original prompt plus any
+        # tokens already emitted before a preemption, so a resumed
+        # request re-prefills its whole history (mostly through the
+        # prefix cache) and its first new pick lands on the same
+        # (seed, position) sampling key as the uninterrupted stream
+        self._slot_adapter_id: list = [None] * self.B
+        self._slot_aslot: list[int] = [0] * self.B
+        self._slot_prompt: list = [None] * self.B
+        if self._lora_on:
+            from .multitenant.lora import AdapterStore
+
+            self.adapters = AdapterStore(
+                cfg, lora_rank, lora_slots, self.kv_bytes_per_page(),
+                self._alloc_pages, self.pool.release)
+        else:
+            self.adapters = None
+        self._schemas: dict = {}           # schema id -> ConstraintState factory
         if self._kv_quant:
             self._unified = jax.jit(self._unified_step_impl_q,
                                     donate_argnums=(1, 2, 3, 4))
@@ -350,20 +403,23 @@ class ServingEngine:
             "prefill_cached_tokens": 0,
             "decode_slot_tokens": 0, "decode_active_tokens": 0,
             # slot_occupancy decomposition (all in slot-token units, so
-            # active + the five waste buckets == decode_slot_tokens):
+            # active + the six waste buckets == decode_slot_tokens):
             "waste_prefill_slot_tokens": 0,        # slot mid-prefill
             "waste_queue_empty_slot_tokens": 0,    # idle, nothing arrived
             "waste_admission_blocked_slot_tokens": 0,  # idle, pool-blocked
             "waste_overrun_slot_tokens": 0,        # aborted/over-produced
             "waste_spec_rejected_slot_tokens": 0,  # rejected draft tokens
+            "waste_preempted_slot_tokens": 0,      # re-prefill after preempt
             "spec_proposed_tokens": 0, "spec_accepted_tokens": 0,
+            "preemptions": 0,
         }
 
     # -- compiled program ---------------------------------------------------
 
     def _unified_step_impl(self, params, k_pages, v_pages, tokens,
                            prev_out, chain_mask, chain_row, ptable,
-                           row_slot, pos0, n_valid, temps, topps, seeds):
+                           row_slot, pos0, n_valid, temps, topps, seeds,
+                           *mt_ops):
         """THE engine step: one ``[n_rows, qb]`` unified ragged-paged-
         attention program serving an arbitrary prefill/decode mix. Row c
         holds n_valid[c] tokens of request row_slot[c] starting at
@@ -392,6 +448,16 @@ class ServingEngine:
         from ..ops.pallas.ragged_paged_attention import \
             ragged_paged_attention
 
+        # multi-tenant operands ride as trailing varargs so the default
+        # (flags off) trace is literally the legacy trace: row adapter
+        # slot ids + the four adapter stacks (serving_lora), then the
+        # per-row [C, V] vocab legality mask (serving_constrained)
+        mt = list(mt_ops)
+        if self._lora_on:
+            aid, ast = mt.pop(0), mt.pop(0)
+        vmask = mt.pop(0) if self._constr_on else None
+        from ..ops.pallas.lora_matmul import lora_matmul
+
         tok0 = jnp.where(chain_mask, prev_out[chain_row, 0], tokens[:, 0])
         tokens = jnp.concatenate([tok0[:, None], tokens[:, 1:]], axis=1)
         rows = ptable[row_slot]                      # [C, max_blocks]
@@ -408,11 +474,21 @@ class ServingEngine:
 
         def body(carry, inp):
             x = carry
-            bp, kp, vp = inp
+            if self._lora_on:
+                bp, kp, vp, aq_l, bq_l, av_l, bv_l = inp
+            else:
+                bp, kp, vp = inp
             h = rms_norm(x, bp["attn_norm"], cfg.rms_eps)
-            q = _mm(h, bp["wq"], cfg).reshape(C, qb, nH, dH)
+            q = _mm(h, bp["wq"], cfg)
             k = _mm(h, bp["wk"], cfg).reshape(C, qb, nKV, dH)
-            v = _mm(h, bp["wv"], cfg).reshape(C, qb, nKV, dH)
+            v = _mm(h, bp["wv"], cfg)
+            if self._lora_on:
+                # grouped BGMV: each packed row through ITS adapter's
+                # q/v low-rank delta (slot 0 = exact +0.0 identity)
+                q = q + lora_matmul(h, aq_l, bq_l, aid).astype(q.dtype)
+                v = v + lora_matmul(h, av_l, bv_l, aid).astype(v.dtype)
+            q = q.reshape(C, qb, nH, dH)
+            v = v.reshape(C, qb, nKV, dH)
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
             kp = kp.at[pages, :, :, offs].set(
@@ -428,8 +504,10 @@ class ServingEngine:
                     cfg.dtype) * _mm(h, bp["w_up"], cfg), bp["w_down"], cfg)
             return x, (kp, vp)
 
-        x, (ks, vs) = lax.scan(body, x, (params["blocks"], k_pages,
-                                         v_pages))
+        xs = (params["blocks"], k_pages, v_pages)
+        if self._lora_on:
+            xs = xs + (ast["aq"], ast["bq"], ast["av"], ast["bv"])
+        x, (ks, vs) = lax.scan(body, x, xs)
         x = rms_norm(x, params["final_norm"], cfg.rms_eps)
         if self.spec_k:
             # speculative verify needs the model's pick at EVERY draft
@@ -445,6 +523,11 @@ class ServingEngine:
             last = x[jnp.arange(C), n_valid - 1]     # [C, H]
             logits = _mm(last[:, None], params["head"], cfg).astype(
                 jnp.float32)[:, 0]
+            if self._constr_on:
+                # constrained rows only see schema-legal logits;
+                # unconstrained rows carry an all-True mask, and
+                # where(True, x, _) == x exactly (bit-identity pinned)
+                logits = jnp.where(vmask, logits, -1e30)
             # keyed on the LAST VALID input position (pos0 + n_valid - 1
             # = T - 1 for a final prefill chunk, the input token's
             # position for a decode row) — sampled streams are
@@ -456,7 +539,7 @@ class ServingEngine:
     def _unified_step_impl_q(self, params, k_pages, v_pages, k_scales,
                              v_scales, tokens, prev_out, chain_mask,
                              chain_row, ptable, row_slot, pos0, n_valid,
-                             temps, topps, seeds):
+                             temps, topps, seeds, *mt_ops):
         """``serving_kv_quant`` variant of the unified step: pages are
         int8, each layer's scatter writes quantized pages and maintains
         the per-page, per-head scale plane, and the attention call
@@ -487,6 +570,12 @@ class ServingEngine:
         from ..ops.quant import (kv_scale_update, quantize_to_scale,
                                  rescale_int8)
 
+        mt = list(mt_ops)                  # same layout as the bf16 impl
+        if self._lora_on:
+            aid, ast = mt.pop(0), mt.pop(0)
+        vmask = mt.pop(0) if self._constr_on else None
+        from ..ops.pallas.lora_matmul import lora_matmul
+
         tok0 = jnp.where(chain_mask, prev_out[chain_row, 0], tokens[:, 0])
         tokens = jnp.concatenate([tok0[:, None], tokens[:, 1:]], axis=1)
         rows = ptable[row_slot]                      # [C, max_blocks]
@@ -513,11 +602,19 @@ class ServingEngine:
 
         def body(carry, inp):
             x = carry
-            bp, kp, vp, ksc, vsc = inp
+            if self._lora_on:
+                bp, kp, vp, ksc, vsc, aq_l, bq_l, av_l, bv_l = inp
+            else:
+                bp, kp, vp, ksc, vsc = inp
             h = rms_norm(x, bp["attn_norm"], cfg.rms_eps)
-            q = _mm(h, bp["wq"], cfg).reshape(C, qb, nH, dH)
+            q = _mm(h, bp["wq"], cfg)
             k = _mm(h, bp["wk"], cfg).reshape(C, qb, nKV, dH)
-            v = _mm(h, bp["wv"], cfg).reshape(C, qb, nKV, dH)
+            v = _mm(h, bp["wv"], cfg)
+            if self._lora_on:
+                q = q + lora_matmul(h, aq_l, bq_l, aid).astype(q.dtype)
+                v = v + lora_matmul(h, av_l, bv_l, aid).astype(v.dtype)
+            q = q.reshape(C, qb, nH, dH)
+            v = v.reshape(C, qb, nKV, dH)
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
             kf = k.reshape(C * qb, nKV, dH).astype(jnp.float32)
@@ -548,9 +645,10 @@ class ServingEngine:
                     cfg.dtype) * _mm(h, bp["w_up"], cfg), bp["w_down"], cfg)
             return x, (kp, vp, ksc_new, vsc_new)
 
-        x, (ks, vs, kss, vss) = lax.scan(
-            body, x, (params["blocks"], k_pages, v_pages, k_scales,
-                      v_scales))
+        xs = (params["blocks"], k_pages, v_pages, k_scales, v_scales)
+        if self._lora_on:
+            xs = xs + (ast["aq"], ast["bq"], ast["av"], ast["bv"])
+        x, (ks, vs, kss, vss) = lax.scan(body, x, xs)
         x = rms_norm(x, params["final_norm"], cfg.rms_eps)
         if self.spec_k:
             logits = _mm(x, params["head"], cfg).astype(jnp.float32)
@@ -563,11 +661,30 @@ class ServingEngine:
             last = x[jnp.arange(C), n_valid - 1]     # [C, H]
             logits = _mm(last[:, None], params["head"], cfg).astype(
                 jnp.float32)[:, 0]
+            if self._constr_on:
+                logits = jnp.where(vmask, logits, -1e30)
             out = _pick_tokens(logits, temps, topps, seeds,
                                pos0 + n_valid - 1)[:, None]
         return out, ks, vs, kss, vss
 
     # -- scheduler ----------------------------------------------------------
+
+    def register_adapter(self, adapter_id, weights: dict) -> None:
+        """Add a LoRA adapter (multitenant.lora.make_lora layout) to the
+        host library; requests name it by ``adapter_id``. Residency is
+        lazy — first admission loads it onto pool pages."""
+        if not self._lora_on:
+            raise RuntimeError("register_adapter requires serving_lora")
+        self.adapters.register(adapter_id, weights)
+
+    def register_schema(self, schema_id, factory) -> None:
+        """Bind ``schema_id`` to a zero-arg ConstraintState factory
+        (e.g. ``json_schema_dfa(...).fresh``); a request naming it gets
+        a fresh constraint at admission."""
+        if not self._constr_on:
+            raise RuntimeError(
+                "register_schema requires serving_constrained")
+        self._schemas[schema_id] = factory
 
     def submit(self, req: Request) -> None:
         if len(req.prompt) + req.max_new_tokens > self.max_seq:
@@ -580,6 +697,32 @@ class ServingEngine:
             raise ValueError(
                 f"request {req.rid}: needs {n_blk} pages but the pool "
                 f"holds {self.n_pages - 1} — it could never be admitted")
+        if req.adapter_id is not None:
+            if not self._lora_on:
+                raise ValueError(
+                    f"request {req.rid}: adapter_id set but serving_lora "
+                    "is off")
+            if not self.adapters.known(req.adapter_id):
+                raise ValueError(
+                    f"request {req.rid}: unknown adapter "
+                    f"{req.adapter_id!r} — register_adapter it first")
+        if req.schema_id is not None or req.constraint is not None:
+            if not self._constr_on:
+                raise ValueError(
+                    f"request {req.rid}: constrained-decoding fields set "
+                    "but serving_constrained is off")
+            if (req.schema_id is not None
+                    and req.schema_id not in self._schemas):
+                raise ValueError(
+                    f"request {req.rid}: unknown schema "
+                    f"{req.schema_id!r} — register_schema it first")
+            if (req.constraint is not None
+                    and req.constraint.dfa.vocab_size
+                    != self.cfg.vocab_size):
+                raise ValueError(
+                    f"request {req.rid}: constraint vocab "
+                    f"{req.constraint.dfa.vocab_size} != model vocab "
+                    f"{self.cfg.vocab_size}")
         self.queue.append(req)
 
     def abort(self, rid: int) -> bool:
@@ -610,7 +753,8 @@ class ServingEngine:
                 return True
         return False
 
-    def _page_hashes(self, prompt: np.ndarray) -> list[bytes]:
+    def _page_hashes(self, prompt: np.ndarray,
+                     salt: bytes = b"") -> list[bytes]:
         """Cumulative content hash per FULL prompt page: hash j covers
         pages 0..j, so equal hash j implies the whole prefix matches —
         one dict hit per page, no per-page prefix comparison."""
@@ -622,10 +766,14 @@ class ServingEngine:
         # the quantized page + its scale-plane entries — a deterministic
         # function of the prefix tokens given the quant mode — so
         # tagging the seed keeps int8 and bf16 page content from ever
-        # aliasing in the cache.
+        # aliasing in the cache. ``salt`` extends the same argument to
+        # per-request LoRA: the v-projection delta changes the page
+        # bytes, so the adapter's content digest joins the preimage
+        # (same-adapter requests still share; cross-adapter never alias).
         seed = b"pt-prefix:%d" % self.bs
         if self._kv_quant:
             seed += b":kvq8"
+        seed += salt
         h = hashlib.sha1(seed)
         for j in range(n_full):
             h.update(np.ascontiguousarray(
@@ -636,9 +784,14 @@ class ServingEngine:
 
     def _alloc_pages(self, n: int) -> Optional[list[int]]:
         """Free-list alloc, reclaiming idle (refcount-0) prefix-cache
-        pages on demand when the list runs short."""
+        pages on demand when the list runs short — then idle (warm but
+        unreferenced) LoRA adapters, in that order: cached KV is cheaper
+        to rebuild than an adapter reload is frequent."""
         if len(self.pool.free) < n:
             self.pool.evict(n - len(self.pool.free))
+        while (len(self.pool.free) < n and self.adapters is not None
+               and self.adapters._evict_idle()):
+            pass
         pages = self.pool.alloc(n)
         if self._kv_quant and pages:
             # a reused page's stale running-absmax would quantize the
@@ -660,27 +813,77 @@ class ServingEngine:
         and it cannot starve. Admission maps cached prefix pages into
         the block table (incref) and allocates only the rest."""
         free_slots = [s for s in range(self.B) if self.slots[s] is None]
-        i = 0
-        while i < len(self.queue) and free_slots:
-            req = self.queue[i]
-            if req.arrival > now:
-                i += 1
+        cand = list(self.queue)
+        if self._prio_on:
+            # priority classes: admission order is highest-priority-first,
+            # FIFO (arrival) within a class; the skip/aging machinery is
+            # unchanged. sort is stable, so priorities all-0 reproduces
+            # the legacy order exactly.
+            cand.sort(key=lambda r: (-r.priority, r.arrival))
+        preempted = False
+        for req in cand:
+            if not free_slots:
+                break
+            if req.out_tokens and len(req.out_tokens) >= req.max_new_tokens:
+                # a preempted request can complete via the token its
+                # in-flight row produced: nothing left to decode, so it
+                # leaves the queue instead of re-admitting (t_done was
+                # already recorded at harvest)
+                for j, r in enumerate(self.queue):
+                    if r is req:
+                        self.queue.pop(j)
+                        break
                 continue
-            T = len(req.prompt)
-            n_blk = -(-(T + req.max_new_tokens) // self.bs)
-            # never look up the page holding the last prompt token: its
-            # chunk must run to produce the first-token logits
-            hashes = self._page_hashes(req.prompt) if self._cache_on else []
-            shared = self.pool.lookup(hashes[:(T - 1) // self.bs])
-            pages = self._alloc_pages(n_blk - len(shared))
+            if req.arrival > now:
+                continue
+            # effective prompt: the original plus tokens already emitted
+            # before a preemption — a resumed request re-prefills its
+            # whole history (mostly through the prefix cache) and its
+            # next pick lands on the same (seed, position) key as the
+            # uninterrupted stream (preempt-resume bit-identity)
+            P = (np.concatenate([np.asarray(req.prompt, np.int32),
+                                 np.asarray(req.out_tokens, np.int32)])
+                 if req.out_tokens else req.prompt)
+            T = len(P)
+            n_blk = -(-(len(req.prompt) + req.max_new_tokens) // self.bs)
+            # the adapter increfs before the KV alloc so a shared hit
+            # cannot be evicted from under us while we evict for pages
+            aslot = 0
+            if self._lora_on and req.adapter_id is not None:
+                aslot = self.adapters.acquire(req.adapter_id)
+            if aslot is None:              # adapter-blocked == pool-blocked
+                shared, pages = [], None
+            else:
+                # never look up the page holding the last prompt token:
+                # its chunk must run to produce the first-token logits.
+                # The adapter digest salts the hash: v-deltas change the
+                # page BYTES, so KV written under adapter X must never
+                # serve a request under adapter Y (or none)
+                salt = (b"lora:" + self.adapters.digest_of(req.adapter_id)
+                        if self._lora_on and req.adapter_id is not None
+                        else b"")
+                hashes = (self._page_hashes(P, salt)
+                          if self._cache_on else [])
+                shared = self.pool.lookup(hashes[:(T - 1) // self.bs])
+                pages = self._alloc_pages(n_blk - len(shared))
             if pages is None:
                 self.pool.decref(shared)
+                if aslot:
+                    self.adapters.decref(req.adapter_id)
+                if (self._prio_on and not preempted
+                        and self._preempt_for(req)):
+                    # a lower-priority resident gave up its KV; its pages
+                    # settle through deferred-free, so the retry happens
+                    # next step (at most one preemption per admit pass)
+                    preempted = True
                 req.age += 1
                 if req.age > self.admit_aging:
                     break                  # aged request becomes a barrier
-                i += 1
                 continue
-            self.queue.pop(i)
+            for j, r in enumerate(self.queue):
+                if r is req:
+                    self.queue.pop(j)
+                    break
             slot = free_slots.pop(0)
             n_shared = len(shared)
             self.slots[slot] = req
@@ -689,6 +892,15 @@ class ServingEngine:
             self._slot_hashes[slot] = hashes
             self._slot_nshared[slot] = n_shared
             self._slot_offered[slot] = n_shared
+            self._slot_prompt[slot] = P
+            if self._lora_on and req.adapter_id is not None:
+                self._slot_adapter_id[slot] = req.adapter_id
+                self._slot_aslot[slot] = aslot
+            if (self._constr_on and req.constraint is None
+                    and req.schema_id is not None):
+                # fresh DFA on first admission only — a resumed request
+                # keeps its advanced state (its emitted tokens stand)
+                req.constraint = self._schemas[req.schema_id]()
             row = np.zeros((self.max_blocks,), np.int32)
             row[:n_shared] = shared
             row[n_shared:n_blk] = pages
@@ -701,6 +913,45 @@ class ServingEngine:
             # only tokens actually run)
             self._prefilling[slot] = n_shared * self.bs
             self.stats["prefill_cached_tokens"] += n_shared * self.bs
+
+    def _preempt_for(self, req: Request) -> bool:
+        """Evict the weakest strictly-lower-priority resident so ``req``
+        can admit once the pages settle: lowest priority first, youngest
+        (latest arrival) within a class — the request that loses the
+        least progress. Returns False when nobody outranks."""
+        best = None
+        for s in range(self.B):
+            r = self.slots[s]
+            if r is None or r.priority >= req.priority:
+                continue
+            key = (r.priority, -r.arrival)
+            if best is None or key < best[0]:
+                best = (key, s)
+        if best is None:
+            return False
+        self._preempt(best[1])
+        return True
+
+    def _preempt(self, slot: int) -> None:
+        """Evict a resident request's KV pages and requeue it; emitted
+        tokens stand, and re-admission re-prefills prompt + emitted
+        history (through the prefix cache, so resumption is nearly
+        free). A token an in-flight program holds for it is legitimate
+        — it lands via the snapshot at harvest, BEFORE the request can
+        re-admit (admission runs at step start, harvest after dispatch),
+        so the resumed effective prompt always includes it."""
+        req = self.slots[slot]
+        req.n_preempted += 1
+        req.age = 0                        # re-admission ages afresh
+        self.stats["preemptions"] += 1
+        self._release_slot_pages(slot, defer=True)
+        self._prefilling.pop(slot, None)
+        self.table[slot] = 0
+        self.seq_lens[slot] = 0
+        self.cur_tok[slot] = 0
+        self.samp_temp[slot] = 0.0
+        self.slots[slot] = None
+        self.queue.append(req)
 
     def _release_slot_pages(self, slot: int, defer: bool) -> None:
         """Tear down a slot's page state: owned pages to the free list
@@ -718,6 +969,15 @@ class ServingEngine:
             self.pool.release(owned)
             self.pool.commit_evictable()
         self._full_rows[slot] = 0
+        # adapter refcount rides slot residency: every teardown path
+        # (finish / abort / preempt / predictive release) lands here.
+        # Idempotent — the id is cleared on first release.
+        aid = self._slot_adapter_id[slot]
+        if aid is not None:
+            self.adapters.decref(aid)
+            self._slot_adapter_id[slot] = None
+        self._slot_aslot[slot] = 0
+        self._slot_prompt[slot] = None
 
     def _finish_if_done(self, slot: int, defer_free: bool = False) -> None:
         req = self.slots[slot]
@@ -762,7 +1022,11 @@ class ServingEngine:
         self._admit(now)
         prev = self._inflight
         self._dispatch_unified(now)
-        if self.spec_k:
+        if self.spec_k or self._constr_on:
+            # synchronous modes: drafts (spec) and vocab masks
+            # (constrained) are host state derived from the previous
+            # step's tokens, so each step harvests before the next
+            # dispatch (chaining is moot — nothing stays in flight)
             if self._inflight is not None:
                 self._harvest(self._inflight)
         elif prev is not None:
@@ -829,8 +1093,7 @@ class ServingEngine:
         for slot in list(self._prefilling):
             if len(sched) >= C:
                 break
-            req = self.slots[slot]
-            T = len(req.prompt)
+            T = len(self._slot_prompt[slot])   # prompt (+ resumed history)
             pos = self._prefilling[slot]
             while pos < T and len(sched) < C:
                 n = min(qb, T - pos)
@@ -850,6 +1113,10 @@ class ServingEngine:
         tsd = np.zeros((C,), np.int32)
         cmask = np.zeros((C,), bool)
         crow = np.zeros((C,), np.int32)
+        if self._lora_on:
+            aidv = np.zeros((C,), np.int32)    # idle rows -> identity slot
+        if self._constr_on:
+            vm = np.ones((C, self.cfg.vocab_size), bool)
         snap = []
         n_pf_rows = 0
         for idx, (s, kind, pos, m, drafts) in enumerate(sched):
@@ -857,6 +1124,8 @@ class ServingEngine:
             rs[idx] = s
             p0[idx] = pos
             nv[idx] = m
+            if self._lora_on:
+                aidv[idx] = self._slot_aslot[s]
             if kind == "dec":
                 if s in prev_rows:
                     cmask[idx] = True
@@ -867,13 +1136,15 @@ class ServingEngine:
                     tokens[idx, 1:m] = drafts
             else:
                 n_pf_rows += 1
-                tokens[idx, :m] = req.prompt[pos:pos + m]
+                tokens[idx, :m] = self._slot_prompt[s][pos:pos + m]
                 if kind == "fin":
                     fin_slots.add(s)
             if kind != "mid":
                 tt[idx] = req.temperature
                 tp[idx] = req.top_p
                 tsd[idx] = req.seed
+                if self._constr_on and req.constraint is not None:
+                    vm[idx] = req.constraint.mask()
             snap.append((idx, s, req, kind, m, drafts))
         ptab = np.concatenate(
             [self._full_rows, np.zeros((1, self.max_blocks), np.int32)])
@@ -885,6 +1156,11 @@ class ServingEngine:
         # state — every operand is a fresh local array here, but
         # jnp.array (copying) keeps the handoff alias-free by
         # construction.
+        extra = []                          # multi-tenant varargs
+        if self._lora_on:
+            extra += [jnp.array(aidv), self.adapters.stacks()]
+        if self._constr_on:
+            extra.append(jnp.array(vm))
         if self._kv_quant:
             (out, self.k_pages, self.v_pages, self.k_scales,
              self.v_scales) = self._unified(
@@ -892,13 +1168,13 @@ class ServingEngine:
                 self.v_scales, jnp.array(tokens), prev_out,
                 jnp.array(cmask), jnp.array(crow), jnp.array(ptab),
                 jnp.array(rs), jnp.array(p0), jnp.array(nv),
-                jnp.array(tt), jnp.array(tp), jnp.array(tsd))
+                jnp.array(tt), jnp.array(tp), jnp.array(tsd), *extra)
         else:
             out, self.k_pages, self.v_pages = self._unified(
                 self.params, self.k_pages, self.v_pages, jnp.array(tokens),
                 prev_out, jnp.array(cmask), jnp.array(crow), jnp.array(ptab),
                 jnp.array(rs), jnp.array(p0), jnp.array(nv), jnp.array(tt),
-                jnp.array(tp), jnp.array(tsd))
+                jnp.array(tp), jnp.array(tsd), *extra)
         self._inflight = (out, snap)
         self._prev_out_dev = out
         # post-dispatch bookkeeping: prefix-cache offers for pages this
@@ -920,7 +1196,7 @@ class ServingEngine:
             if kind == "fin":
                 del self._prefilling[s]
                 self.table[s] = self._full_rows[s]
-                self.seq_lens[s] = len(req.prompt)
+                self.seq_lens[s] = len(self._slot_prompt[s])
                 self.samp_temp[s] = req.temperature
                 self.samp_topp[s] = req.top_p
                 self.samp_seed[s] = req.seed
@@ -937,8 +1213,16 @@ class ServingEngine:
             blocked = any(r.arrival <= now for r in self.queue)
             self.stats["waste_admission_blocked_slot_tokens" if blocked
                        else "waste_queue_empty_slot_tokens"] += n_idle
-        n_mid_slots = len(pref_entry) - len(fin_slots)
-        self.stats["waste_prefill_slot_tokens"] += n_mid_slots
+        # a resumed (previously preempted) request's mid-prefill slot-
+        # tokens are the price of preemption, not of admission latency —
+        # charge them to their own bucket (0 with serving_priorities off)
+        mid_slots = [s for s in pref_entry if s not in fin_slots]
+        n_mid_pre = sum(
+            1 for s in mid_slots
+            if self.slots[s] is not None and self.slots[s].n_preempted)
+        self.stats["waste_preempted_slot_tokens"] += n_mid_pre
+        self.stats["waste_prefill_slot_tokens"] += len(mid_slots) - n_mid_pre
+        n_mid_slots = len(mid_slots)
         self.stats["decode_slot_tokens"] += (
             sum(m for _s, kind, _p, m, _d in sched if kind == "dec")
             + len(fin_slots) + n_mid_slots + n_idle)
@@ -975,6 +1259,8 @@ class ServingEngine:
                 tok = int(toks[idx, m - 1] if self.spec_k else toks[idx, 0])
                 if len(req.out_tokens) < req.max_new_tokens:
                     req.out_tokens.append(tok)
+                    if req.constraint is not None:
+                        req.constraint.advance(tok)
                     self.stats["decode_active_tokens"] += 1
                 else:
                     self.stats["waste_overrun_slot_tokens"] += 1
@@ -1013,6 +1299,8 @@ class ServingEngine:
                 tok = int(toks[idx, 0])
                 if len(req.out_tokens) < req.max_new_tokens:
                     req.out_tokens.append(tok)
+                    if req.constraint is not None:
+                        req.constraint.advance(tok)
                     self.stats["decode_active_tokens"] += 1
                 else:
                     self.stats["waste_overrun_slot_tokens"] += 1
@@ -1049,7 +1337,8 @@ class ServingEngine:
         """Page census for the leak invariant: every non-sink page is in
         exactly one of free / slot-owned / slot-shared (refcounted cache
         mappings, deduplicated) / idle-cached (refcount 0, pending or
-        evictable) / deferred-free; the counts sum to n_pages - 1."""
+        evictable) / deferred-free / adapter (resident LoRA weights);
+        the counts sum to n_pages - 1."""
         owned = [p for lst in self._slot_owned for p in lst]
         shared = {p for lst in self._slot_shared for p in lst}
         cache_idle = [p for p, r in self.pool.ref.items() if r == 0]
@@ -1059,6 +1348,8 @@ class ServingEngine:
             "slot_shared": len(shared),
             "cache_idle": len(cache_idle),
             "deferred_free": len(self._deferred_free),
+            "adapter": (self.adapters.n_pages_held()
+                        if self.adapters is not None else 0),
         }
         counts["total"] = sum(counts.values())
         return counts
@@ -1117,7 +1408,7 @@ class ServingEngine:
             "slot_occupancy": round(
                 st["decode_active_tokens"] / slot_tok, 3),
             # occupancy decomposition: fractions of slot-tokens lost per
-            # cause (active + these five == 1)
+            # cause (active + these six == 1)
             "occ_waste_queue_empty": round(
                 st["waste_queue_empty_slot_tokens"] / slot_tok, 3),
             "occ_waste_admission_blocked": round(
@@ -1128,6 +1419,10 @@ class ServingEngine:
                 st["waste_overrun_slot_tokens"] / slot_tok, 3),
             "occ_waste_spec_rejected": round(
                 st["waste_spec_rejected_slot_tokens"] / slot_tok, 3),
+            "occ_waste_preempted": round(
+                st["waste_preempted_slot_tokens"] / slot_tok, 3),
+            "preemption_rate": round(
+                st["preemptions"] / max(1, len(requests)), 3),
             "spec_accept_rate": round(
                 st["spec_accepted_tokens"]
                 / st["spec_proposed_tokens"], 3)
@@ -1139,5 +1434,6 @@ class ServingEngine:
                 hits / (hits + misses), 3) if hits + misses else 0.0,
             "prefix_cache_hits": hits,
             "prefix_cache_misses": misses,
+            **(self.adapters.stats() if self.adapters is not None else {}),
             **st,
         }

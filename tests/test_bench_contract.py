@@ -27,8 +27,8 @@ def bench(monkeypatch):
     # serving engine, 100-step loss curve — hours on the 1-core CPU CI
     # box); individual tests re-patch the ones they exercise
     for name in ("_bench_chip_probe", "_bench_decode", "_bench_serving",
-                 "_bench_loss_curve", "_bench_13b", "_bench_long_ctx",
-                 "_bench_multichip", "_bench_phases"):
+                 "_bench_multitenant", "_bench_loss_curve", "_bench_13b",
+                 "_bench_long_ctx", "_bench_multichip", "_bench_phases"):
         monkeypatch.setattr(b, name, lambda: {})
     return b
 
@@ -120,6 +120,31 @@ def test_serving_key_contract(bench):
     # a kv_quant main run marks itself enabled
     assert bench._serving_keys(dict(m, kv_quant_enabled=True))[
         "serving_kv_quant_enabled"] == 1.0
+
+
+def test_multitenant_key_contract(bench):
+    """_multitenant_keys is the pure loadgen-metrics -> bench-keys
+    mapping for the multi-tenant family (ISSUE 10): LoRA-arm throughput
+    and adapter count, priority-arm preemption rate and re-prefill
+    occupancy cost, constrained-arm throughput."""
+    lora_m = {"throughput_tok_s": 350.0}
+    prio_m = {"preemption_rate": 0.25, "occ_waste_preempted": 0.04}
+    con_m = {"throughput_tok_s": 390.0}
+    out = bench._multitenant_keys(lora_m, prio_m, con_m, 4)
+    for k in ("serving_lora_tok_s", "serving_lora_n_adapters",
+              "serving_preemption_rate", "serving_occ_waste_preempted",
+              "serving_constrained_tok_s"):
+        assert k in out, k
+    assert out["serving_lora_tok_s"] == 350.0
+    assert out["serving_lora_n_adapters"] == 4.0
+    assert out["serving_preemption_rate"] == 0.25
+    assert out["serving_occ_waste_preempted"] == 0.04
+    assert out["serving_constrained_tok_s"] == 390.0
+    # error marker name is wired in the secondary list
+    import inspect
+
+    src = inspect.getsource(bench._run_secondary_benches)
+    assert "_bench_multitenant" in src and "multitenant_error" in src
 
 
 def test_multichip_key_contract(bench):

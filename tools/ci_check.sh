@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # One-command CI gate: static analysis -> op-contract baseline -> chaos
 # suite -> serving smoke -> kernel parity -> loadgen smoke -> multichip
-# smoke -> tier-1.
+# smoke -> multitenant smoke -> tier-1.
 #
 #   bash tools/ci_check.sh
 #
@@ -15,12 +15,14 @@
 #   70  loadgen smoke failed (open-loop saturation / occupancy ledger)
 #   80  multichip smoke failed (remat regression / serial-parity drift /
 #       quantized all-reduce divergence on the 8-device virtual mesh)
+#   90  multitenant smoke failed (adapter isolation / preemption /
+#       constrained-stream legality / 7-class page-ledger leak)
 #   30  tier-1 tests failed (ROADMAP.md command)
 #    0  all gates green
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== gate 1/8: tpu-lint (per-file + interprocedural rules) =="
+echo "== gate 1/9: tpu-lint (per-file + interprocedural rules) =="
 python -m tools.lint paddle_tpu tests --format=json > /tmp/tpu_lint.json
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -30,7 +32,7 @@ if [ "$rc" -ne 0 ]; then
 fi
 echo "tpu-lint: clean"
 
-echo "== gate 2/8: tpu-verify (abstract op-contract baseline) =="
+echo "== gate 2/9: tpu-verify (abstract op-contract baseline) =="
 JAX_PLATFORMS=cpu python -m tools.lint --contracts \
     --baseline artifacts/op_contracts.json
 rc=$?
@@ -40,7 +42,7 @@ if [ "$rc" -ne 0 ]; then
     exit 20
 fi
 
-echo "== gate 3/8: chaos suite (fault injection -> self-healing) =="
+echo "== gate 3/9: chaos suite (fault injection -> self-healing) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 rc=$?
@@ -50,7 +52,7 @@ if [ "$rc" -ne 0 ]; then
     exit 40
 fi
 
-echo "== gate 4/8: serving smoke (scheduler completion + zero page leak) =="
+echo "== gate 4/9: serving smoke (scheduler completion + zero page leak) =="
 JAX_PLATFORMS=cpu python -m tools.serving_smoke
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -59,7 +61,7 @@ if [ "$rc" -ne 0 ]; then
     exit 50
 fi
 
-echo "== gate 5/8: kernel parity (fused megakernels, CPU fallback arms) =="
+echo "== gate 5/9: kernel parity (fused megakernels, CPU fallback arms) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_fused_norm_epilogue.py \
     tests/test_fused_rope_attention.py tests/test_autotune.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
@@ -70,7 +72,7 @@ if [ "$rc" -ne 0 ]; then
     exit 60
 fi
 
-echo "== gate 6/8: loadgen smoke (open-loop saturation, >=200 arrivals) =="
+echo "== gate 6/9: loadgen smoke (open-loop saturation, >=200 arrivals) =="
 JAX_PLATFORMS=cpu python -m tools.loadgen_smoke
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -80,7 +82,7 @@ if [ "$rc" -ne 0 ]; then
     exit 70
 fi
 
-echo "== gate 7/8: multichip smoke (dp x mp mesh: remat-free compile," \
+echo "== gate 7/9: multichip smoke (dp x mp mesh: remat-free compile," \
      "serial parity, quantized all-reduce) =="
 python tools/multichip_smoke.py
 rc=$?
@@ -91,10 +93,26 @@ if [ "$rc" -ne 0 ]; then
     exit 80
 fi
 
-echo "== gate 8/8: tier-1 tests (ROADMAP.md) =="
+echo "== gate 8/9: multitenant smoke (LoRA isolation, preemption," \
+     "constrained legality, 7-class ledger) =="
+JAX_PLATFORMS=cpu python -m tools.multitenant_smoke
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "ci_check: multitenant smoke gate failed (rc=$rc) — an adapter" \
+         "stream leaked across tenants, preemption broke a stream, a" \
+         "constrained request emitted an illegal token, or the 7-class" \
+         "page ledger no longer closes" >&2
+    exit 90
+fi
+
+echo "== gate 9/9: tier-1 tests (ROADMAP.md) =="
 set -o pipefail
 rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+# budget raised 870 -> 1200: the suite is ~1010s single-process as of
+# PR 10 (711 tests; growth is spread across rounds, top offenders are
+# the lint/contract sweeps) — keep headroom so a green suite can't
+# time out
+timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
