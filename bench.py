@@ -163,6 +163,7 @@ def _run_secondary_benches() -> dict:
                              ("_bench_decode", "llama_decode_error"),
                              ("_bench_serving", "serving_error"),
                              ("_bench_multitenant", "multitenant_error"),
+                             ("_bench_fleet", "fleet_error"),
                              ("_bench_loss_curve", "loss_curve_error"),
                              ("_bench_13b", "gpt3_1p3b_error"),
                              ("_bench_long_ctx", "long_ctx_error"),
@@ -473,6 +474,59 @@ def _bench_multitenant():
     con_wl = synthesize(WorkloadSpec(**base, constrained_frac=1.0))
     con_m = OpenLoopDriver(eng3, clock="wall").run(con_wl)
     return _multitenant_keys(lora_m, prio_m, con_m, n_adapters)
+
+
+def _fleet_keys(m):
+    """Pure mapping: FleetDriver metrics dict -> bench fleet_* keys
+    (tests/test_bench_contract.py pins the key set)."""
+    return {
+        "fleet_n_engines": float(m["fleet_n_engines"]),
+        "fleet_goodput": m["goodput_tok_s"],
+        "fleet_ttft_p99": m["ttft_p99_s"],
+        "fleet_migrated_pages": float(m["migrated_pages"]),
+        "fleet_recovery_ms": m["recovery_ms_max"],
+        "fleet_deadline_miss_rate": m["deadline_miss_rate"],
+    }
+
+
+def _bench_fleet():
+    """Fleet serving (inference/fleet/, ISSUE 11): a 2-replica
+    FleetRouter under the _bench_serving traffic shape with a skewed
+    tenant mix, per-request TTFT deadlines, and a mid-run replica kill.
+    Measures fleet goodput and TTFT tail WITH the loss, the pages
+    migrated off the dead replica, the worst victim-stream recovery
+    latency (kill -> first post-kill token on the survivor), and the
+    deadline miss rate under the shrunken capacity."""
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.inference.fleet import FleetRouter
+    from paddle_tpu.inference.loadgen import (FleetDriver, WorkloadSpec,
+                                              synthesize)
+    from paddle_tpu.inference.serving import Request
+
+    cfg = LlamaConfig(vocab_size=32000, hidden=2048, n_layers=16,
+                      n_heads=16, n_kv_heads=4, ffn_hidden=5504,
+                      max_seq_len=2048, dtype=jnp.bfloat16)
+    router = FleetRouter(cfg, n_engines=2, seed=0,
+                         engine_kwargs=dict(max_batch=8, page_size=128,
+                                            max_seq=1536,
+                                            prefill_budget=512))
+    # compile pass on each replica outside the timed run
+    for i, rep in enumerate(router.replicas):
+        rep.engine.run([Request(rid=-1 - i,
+                                prompt=np.ones(640, np.int32),
+                                max_new_tokens=2, arrival=0.0)])
+    wl = synthesize(WorkloadSpec(
+        n_requests=48, seed=7, vocab_size=cfg.vocab_size,
+        process="poisson", rate=30.0, prefix_len=512, n_prefixes=1,
+        shared_frac=0.9, tail_log_mean=5.3, tail_log_sigma=0.6,
+        tail_min=32, tail_max=512, new_min=64, new_max=128,
+        max_seq=1536, n_tenants=8, tenant_skew=1.2, n_sessions=6,
+        deadline_ttft=30.0, deadline_e2e=120.0))
+    # kill replica 1 a third of the way into the arrival window — the
+    # survivor absorbs migrated pages plus the remaining arrivals
+    kill_at = float(np.percentile([r.arrival for r in wl], 33))
+    m = FleetDriver(router, clock="wall").run(wl, kills={kill_at: 1})
+    return _fleet_keys(m)
 
 
 def _bench_loss_curve():

@@ -243,6 +243,51 @@ define_flag("serving_constrained", False,
             "unified program as per-row data and mask logits before "
             "sampling. Off = unmasked sampling, bit-identical.")
 
+# -- fleet serving (inference/fleet/; consulted only by FleetRouter —
+#    serving_fleet_engines=0 means no fleet layer exists and a lone
+#    ServingEngine is bit-identical to PR 10, pinned in
+#    tests/test_fleet.py) -------------------------------------------------
+define_flag("serving_fleet_engines", 0,
+            "Replica count the FleetRouter builds when not given "
+            "engines explicitly. 0 (default) = fleet layer off; a "
+            "single ServingEngine never consults any serving_fleet_* "
+            "flag, so off is bit-identical by construction.")
+define_flag("serving_fleet_migration", True,
+            "On engine loss, ship the victims' full KV pages (+ int8 "
+            "scale planes) from the dead engine's still-readable pool "
+            "to the re-admission target's prefix cache. Off = victims "
+            "recover by re-prefill only (same streams, more FLOPs).")
+define_flag("serving_fleet_affinity", True,
+            "Session affinity in router placement: requests carrying "
+            "the same Request.session key prefer the replica that "
+            "served the session last (their KV prefix is resident "
+            "there). Deadline-tight requests override affinity.")
+define_flag("serving_fleet_retry_max", 3,
+            "Re-admission attempts per victim request after an engine "
+            "loss before the router gives up and aborts it.")
+define_flag("serving_fleet_retry_base_delay", 0.05,
+            "Base backoff seconds between re-admission attempts "
+            "(exponential: base * 2**attempt, deterministic).")
+define_flag("serving_fleet_step_budget", 0.0,
+            "Wall seconds one ServingEngine.step may take before the "
+            "router declares the replica hung and recovers its "
+            "requests. 0 (default) = hang detection off.")
+define_flag("serving_fleet_fail_threshold", 1,
+            "Consecutive step exceptions before a replica is declared "
+            "dead (1 = first raise kills it).")
+define_flag("serving_fleet_shed_backlog", 0.0,
+            "Graceful-degradation knob: when the never-yet-accepted "
+            "backlog exceeds this multiple of surviving pool capacity "
+            "(in pages) after a replica loss, the router sheds the "
+            "lowest-priority queued requests down to the limit. "
+            "Accepted streams are never shed. 0 (default) = no "
+            "pressure shedding (only never-placeable requests drop).")
+define_flag("serving_fleet_tight_deadline", 0.25,
+            "Remaining-TTFT-budget threshold (seconds) below which "
+            "router placement ignores affinity/cache bonuses and "
+            "routes to the least-loaded replica (deadline-aware "
+            "routing).")
+
 define_flag("dist_allreduce_quant", False,
             "EQuARX-style int8 gradient all-reduce for the dp gradient "
             "sync: per-rank-chunk symmetric int8 with fp32 scales on the "

@@ -1,0 +1,19 @@
+"""Fleet-scale serving (ROADMAP item: disaggregated + fleet-scale).
+
+A :class:`FleetRouter` drives N single-host ``ServingEngine`` replicas
+of one model: prefix-cache/adapter/session-aware placement with
+deadline-aware routing, health probes (step exceptions + a wall-clock
+step budget for hangs), retry/backoff re-admission after a replica
+loss, KV page migration from the dead replica's still-readable pool
+into a survivor's prefix cache, and graceful degradation (shed
+lowest-priority never-accepted load when capacity shrinks).
+
+The whole layer is host-side policy over unchanged engines: a lone
+``ServingEngine`` never touches this package, so ``serving_fleet_*``
+flags off is bit-identical single-engine behavior by construction.
+"""
+
+from .migration import ship_pages
+from .router import FleetRouter
+
+__all__ = ["FleetRouter", "ship_pages"]

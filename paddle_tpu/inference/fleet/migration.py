@@ -1,0 +1,54 @@
+"""KV page migration transport: donor export -> wire -> survivor adopt.
+
+The heavy lifting lives on the engine (``export_request_pages`` /
+``adopt_pages`` — see the wire-format comment in serving.py): a KV page
+is a pure function of (params, token prefix, page size, quant mode,
+adapter digest), so replicas of one model can exchange page bytes and
+the adopter's prefix cache stays sound. This module is the *wire*: it
+moves a shipment between two in-process engines, carries the
+``migration.ship`` chaos point (``drop`` — shipment lost; ``corrupt``
+— one payload byte flipped so the adopter's per-page crc rejects it),
+and reports what happened so the router can count pages/bytes and fall
+back to re-prefill recovery. Migration is an optimization, never a
+correctness dependency: every fallback path re-prefills the victim's
+prompt + emitted history and lands on the same keyed (seed, position)
+sampling stream.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...testing import chaos as _chaos
+
+__all__ = ["ship_pages"]
+
+
+def ship_pages(donor, target, rid: int) -> dict:
+    """Ship request ``rid``'s full KV pages from ``donor`` to
+    ``target``. Returns ``{"status", "pages", "bytes"}`` where status is
+    one of ``ok`` / ``nothing`` (no exportable full page) / ``dropped``
+    (chaos: lost on the wire) / ``rejected`` (crc or adopter refusal —
+    includes chaos ``corrupt``/``migration.adopt``) / ``failed``
+    (donor-side export error: treat the donor HBM as unreadable)."""
+    try:
+        shipment = donor.export_request_pages(rid)
+    except Exception:
+        return {"status": "failed", "pages": 0, "bytes": 0}
+    if shipment is None:
+        return {"status": "nothing", "pages": 0, "bytes": 0}
+    nbytes = donor.shipment_bytes(shipment)
+    if _chaos.active():
+        spec = _chaos.fire("migration.ship",
+                           ctx={"engine": donor.engine_id})
+        if spec is not None:
+            if spec.kind == "drop":
+                return {"status": "dropped", "pages": 0, "bytes": 0}
+            if spec.kind == "corrupt":
+                k = np.ascontiguousarray(shipment["k"])
+                k.view(np.uint8).reshape(-1)[0] ^= 0xFF
+                shipment["k"] = k
+    n = target.adopt_pages(shipment)
+    if n == 0:
+        return {"status": "rejected", "pages": 0, "bytes": 0}
+    return {"status": "ok", "pages": n, "bytes": nbytes}

@@ -1,0 +1,482 @@
+"""FleetRouter: N ServingEngine replicas behind one admission surface.
+
+Placement (finishing PR 10's deferred admission scoring) is cache-
+gravity with a load term, all in token units:
+
+    score = cached_prefix_tokens              (pages resident, peeked)
+          + adapter_bonus + session_bonus     (residency, affinity)
+          - load_penalty                      (queued + resident work)
+
+A deadline-tight request (remaining TTFT budget below
+``serving_fleet_tight_deadline``) ignores the gravity terms and routes
+pure least-loaded — cache hits don't help a request that dies in a
+queue. Ties break to the lowest engine id, so placement is
+deterministic for a given fleet state.
+
+Health: a replica dies after ``serving_fleet_fail_threshold``
+consecutive step exceptions, or when one step exceeds the wall-clock
+``serving_fleet_step_budget`` (hang detection — single-threaded, so a
+hang is observed as elapsed time once the step returns). Death is
+permanent (replicas don't resurrect; a new engine is a new replica).
+
+Recovery on death: the replica's resident + queued requests become
+victims. Victims that can be shed are shed first (graceful
+degradation: never-accepted work only, lowest priority first, and only
+under real pressure — see _shed_for_pressure). Each surviving resident
+victim's full KV pages are migrated donor -> chosen target
+(``serving_fleet_migration``; the donor pool is host-readable after a
+*serving*-level death — when it isn't, chaos ``migration.ship`` models
+the loss and recovery falls back to plain re-prefill). Victims then
+re-enter through the normal submit path: the engine re-prefills prompt
++ emitted history (mostly through the just-migrated cache pages) and
+keyed (seed, position) sampling makes the resumed stream bit-identical
+to an uninterrupted run. Placement failures go to a retry queue with
+deterministic exponential backoff up to ``serving_fleet_retry_max``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from ...core.flags import GLOBAL_FLAGS
+from ..serving import Request, ServingEngine
+from .migration import ship_pages
+
+__all__ = ["FleetRouter"]
+
+
+class _Replica:
+    """One engine + its health state."""
+
+    def __init__(self, engine: ServingEngine):
+        self.engine = engine
+        self.alive = True
+        self.failures = 0          # consecutive step exceptions
+        self.last_step_s = 0.0
+        self.last_error: Optional[str] = None
+
+    def load_tokens(self) -> int:
+        """Outstanding work in token units: queued prompt+decode plus
+        remaining decode of resident requests."""
+        e = self.engine
+        n = sum(len(r.prompt) + r.max_new_tokens for r in e.queue)
+        for r in e.slots:
+            if r is not None:
+                n += max(0, r.max_new_tokens - len(r.out_tokens))
+        return n
+
+
+class FleetRouter:
+    """Route requests across N replicas of one model; survive replica
+    loss with bit-identical streams. See the module docstring."""
+
+    def __init__(self, cfg=None, n_engines: Optional[int] = None,
+                 engines: Optional[list] = None, seed: int = 0,
+                 engine_kwargs: Optional[dict] = None,
+                 migration: Optional[bool] = None,
+                 affinity: Optional[bool] = None,
+                 retry_max: Optional[int] = None,
+                 retry_base_delay: Optional[float] = None,
+                 step_budget: Optional[float] = None,
+                 fail_threshold: Optional[int] = None,
+                 shed_backlog: Optional[float] = None,
+                 tight_deadline: Optional[float] = None):
+        if engines is None:
+            if n_engines is None:
+                n_engines = int(GLOBAL_FLAGS.get("serving_fleet_engines"))
+            if n_engines < 1:
+                raise ValueError(
+                    "FleetRouter needs engines or n_engines >= 1 "
+                    "(serving_fleet_engines is 0 = fleet off)")
+            if cfg is None:
+                raise ValueError("FleetRouter needs cfg to build engines")
+            kw = dict(engine_kwargs or {})
+            engines = [ServingEngine(cfg, seed=seed, engine_id=0, **kw)]
+            # replicas share ONE params dict — the premise that makes
+            # cross-engine page bytes (and thus migration) exchangeable
+            for i in range(1, n_engines):
+                engines.append(ServingEngine(
+                    cfg, params=engines[0].params, seed=seed,
+                    engine_id=i, **kw))
+        self.replicas = [_Replica(e) for e in engines]
+        if len({r.engine.engine_id for r in self.replicas}) \
+                != len(self.replicas):
+            raise ValueError("replica engine_ids must be unique")
+        g = GLOBAL_FLAGS.get
+        self.migration = bool(g("serving_fleet_migration")
+                              if migration is None else migration)
+        self.affinity = bool(g("serving_fleet_affinity")
+                             if affinity is None else affinity)
+        self.retry_max = int(g("serving_fleet_retry_max")
+                             if retry_max is None else retry_max)
+        self.retry_base_delay = float(
+            g("serving_fleet_retry_base_delay")
+            if retry_base_delay is None else retry_base_delay)
+        self.step_budget = float(g("serving_fleet_step_budget")
+                                 if step_budget is None else step_budget)
+        self.fail_threshold = max(1, int(
+            g("serving_fleet_fail_threshold")
+            if fail_threshold is None else fail_threshold))
+        self.shed_backlog = float(g("serving_fleet_shed_backlog")
+                                  if shed_backlog is None else shed_backlog)
+        self.tight_deadline = float(
+            g("serving_fleet_tight_deadline")
+            if tight_deadline is None else tight_deadline)
+        self._owner: dict[int, _Replica] = {}      # rid -> placement
+        self._requests: dict[int, Request] = {}
+        # retry entries: [ready_monotonic, attempt, request]
+        self._retry: list[list] = []
+        self._sessions: dict = {}                   # session -> engine_id
+        # accepted victims awaiting their first post-kill token:
+        # [request, len(out_tokens) at kill, monotonic at kill]
+        self._recovering: list[list] = []
+        self._recovery_ms: list[float] = []
+        self.stats = {
+            "n_submitted": 0, "n_killed": 0, "n_recovered": 0,
+            "migrated_pages": 0, "migration_bytes": 0,
+            "migration_dropped": 0, "migration_rejected": 0,
+            "migration_failed": 0, "n_shed": 0, "n_retry_exhausted": 0,
+            "n_deadline_dropped": 0,
+        }
+
+    # -- registration broadcast ------------------------------------------
+
+    def register_adapter(self, adapter_id, weights: dict) -> None:
+        """Register a LoRA adapter on every replica (placement may send
+        an adapter request anywhere; digests — and so cache salts —
+        match because the weights do)."""
+        for r in self.replicas:
+            r.engine.register_adapter(adapter_id, weights)
+
+    def register_schema(self, schema_id, factory) -> None:
+        for r in self.replicas:
+            r.engine.register_schema(schema_id, factory)
+
+    # -- placement --------------------------------------------------------
+
+    def _alive(self) -> list[_Replica]:
+        return [r for r in self.replicas if r.alive]
+
+    def _cached_tokens(self, rep: _Replica, req: Request) -> int:
+        """Tokens of ``req``'s effective prompt resident in ``rep``'s
+        prefix cache — a pure peek (no incref, no side effects)."""
+        e = rep.engine
+        if not e._cache_on:
+            return 0
+        P = (np.concatenate([np.asarray(req.prompt, np.int32),
+                             np.asarray(req.out_tokens, np.int32)])
+             if req.out_tokens else np.asarray(req.prompt, np.int32))
+        n = 0
+        for h in e._page_hashes(P, e._cache_salt(req)):
+            if h not in e.pool.cache:
+                break
+            n += 1
+        return n * e.bs
+
+    def _choose(self, req: Request, now: float) -> Optional[_Replica]:
+        alive = self._alive()
+        if not alive:
+            return None
+        rem_ttft = None
+        if req.deadline_ttft > 0 and req.t_first is None:
+            rem_ttft = (req.arrival + req.deadline_ttft) - now
+        tight = rem_ttft is not None and rem_ttft <= self.tight_deadline
+        best = None
+        for rep in alive:
+            e = rep.engine
+            if tight:
+                # deadline-aware routing: cache gravity is worthless to
+                # a request about to miss TTFT — pure least-loaded
+                score = -float(rep.load_tokens())
+            else:
+                score = float(self._cached_tokens(rep, req))
+                if (req.adapter_id is not None and e.adapters is not None
+                        and req.adapter_id in e.adapters._resident):
+                    score += 2.0 * e.bs
+                if (self.affinity and req.session is not None
+                        and self._sessions.get(req.session)
+                        == e.engine_id):
+                    score += 4.0 * e.bs
+                score -= float(rep.load_tokens())
+            key = (score, -e.engine_id)
+            if best is None or key > best[0]:
+                best = (key, rep)
+        return best[1]
+
+    def _expired(self, req: Request, now: float) -> bool:
+        return (req.deadline_e2e > 0
+                and now > req.arrival + req.deadline_e2e)
+
+    def _place(self, req: Request, now: float) -> bool:
+        """Choose a replica and hand the request to its engine. False =
+        no alive replica (caller retries/sheds); a structurally
+        impossible request (engine.submit ValueError) propagates on
+        first submission and sheds on recovery paths."""
+        if self._expired(req, now):
+            self._drop(req, "n_deadline_dropped")
+            return True                     # handled, don't retry
+        rep = self._choose(req, now)
+        if rep is None:
+            return False
+        rep.engine.submit(req)
+        self._owner[req.rid] = rep
+        if self.affinity and req.session is not None:
+            self._sessions[req.session] = rep.engine.engine_id
+        return True
+
+    def _drop(self, req: Request, counter: str) -> None:
+        req.aborted = True
+        req.t_done = time.monotonic()
+        self._owner.pop(req.rid, None)
+        self.stats[counter] += 1
+
+    def _queue_retry(self, req: Request, attempt: int) -> None:
+        """Deterministic exponential backoff on the real clock (driver
+        clocks — wall offsets or the rush constant — don't advance
+        between router steps, so backoff can't key off them)."""
+        if attempt > self.retry_max:
+            self._drop(req, "n_retry_exhausted")
+            return
+        delay = (0.0 if attempt == 0
+                 else self.retry_base_delay * (2.0 ** (attempt - 1)))
+        self._retry.append([time.monotonic() + delay, attempt, req])
+
+    def submit(self, req: Request, now: float = 0.0) -> None:
+        self._requests[req.rid] = req
+        self.stats["n_submitted"] += 1
+        if not self._place(req, now):
+            self._queue_retry(req, 0)
+
+    def abort(self, rid: int) -> bool:
+        """Cancel a request wherever it is: placed on a replica, in the
+        router retry queue, or recovering."""
+        self._recovering = [e for e in self._recovering
+                            if e[0].rid != rid]
+        rep = self._owner.pop(rid, None)
+        if rep is not None and rep.engine.abort(rid):
+            return True
+        for i, (_rdy, _att, req) in enumerate(self._retry):
+            if req.rid == rid:
+                self._retry.pop(i)
+                req.aborted = True
+                req.t_done = time.monotonic()
+                return True
+        return False
+
+    # -- stepping + health ------------------------------------------------
+
+    def step(self, now: float = 0.0) -> bool:
+        """One fleet tick: drain ready retries, step every live engine
+        (exceptions/hangs -> death + recovery), track stream
+        recoveries. Returns True while any work remains anywhere."""
+        if self._retry:
+            t = time.monotonic()
+            ready = [e for e in self._retry if e[0] <= t]
+            self._retry = [e for e in self._retry if e[0] > t]
+            for _rdy, attempt, req in ready:
+                if req.aborted:
+                    continue
+                try:
+                    placed = self._place(req, now)
+                except ValueError:
+                    self._drop(req, "n_shed")   # can never fit anywhere
+                    continue
+                if not placed:
+                    self._queue_retry(req, attempt + 1)
+        busy = False
+        for rep in self.replicas:
+            if not rep.alive:
+                continue
+            t0 = time.monotonic()
+            try:
+                more = rep.engine.step(now=now)
+            except Exception as exc:          # noqa: BLE001 — a replica
+                rep.failures += 1             # loss is any step escape
+                rep.last_error = f"{type(exc).__name__}: {exc}"
+                if rep.failures >= self.fail_threshold:
+                    self._declare_dead(rep, now)
+                busy = True
+                continue
+            rep.failures = 0
+            rep.last_step_s = time.monotonic() - t0
+            if self.step_budget > 0 and rep.last_step_s > self.step_budget:
+                # hang detection, single-threaded: the stall is observed
+                # as elapsed wall time once the step finally returns
+                rep.last_error = (f"step took {rep.last_step_s:.3f}s > "
+                                  f"budget {self.step_budget:.3f}s")
+                self._declare_dead(rep, now)
+                busy = True
+                continue
+            busy = busy or more
+        if self._recovering:
+            t = time.monotonic()
+            still = []
+            for entry in self._recovering:
+                req, n0, t0 = entry
+                if req.aborted:
+                    continue
+                if len(req.out_tokens) > n0:
+                    self._recovery_ms.append((t - t0) * 1000.0)
+                    self.stats["n_recovered"] += 1
+                else:
+                    still.append(entry)
+            self._recovering = still
+        return busy or bool(self._retry) or bool(self._recovering)
+
+    def kill_engine(self, engine_id: int, now: float = 0.0) -> None:
+        """Deterministic replica kill (bench/smoke hook): same death +
+        recovery path as a chaos-injected step failure."""
+        for rep in self.replicas:
+            if rep.engine.engine_id == engine_id and rep.alive:
+                rep.last_error = "killed"
+                self._declare_dead(rep, now)
+                return
+        raise ValueError(f"no live replica with engine_id {engine_id}")
+
+    # -- death + recovery -------------------------------------------------
+
+    def _declare_dead(self, rep: _Replica, now: float) -> None:
+        rep.alive = False
+        self.stats["n_killed"] += 1
+        e = rep.engine
+        resident = [(s, r) for s, r in enumerate(e.slots)
+                    if r is not None and not r.aborted
+                    and len(r.out_tokens) < r.max_new_tokens]
+        queued = [r for r in e.queue
+                  if not r.aborted
+                  and len(r.out_tokens) < r.max_new_tokens]
+        for _s, r in resident:
+            if r.out_tokens:       # an accepted stream: time its resume
+                self._recovering.append([r, len(r.out_tokens),
+                                         time.monotonic()])
+        for rid in [r.rid for _s, r in resident] + [r.rid for r in queued]:
+            if self._owner.get(rid) is rep:
+                del self._owner[rid]
+        victims = ([r for _s, r in resident]
+                   + sorted(queued, key=lambda r: (-r.priority, r.arrival)))
+        victims = self._shed_for_pressure(victims, now)
+        for req in victims:
+            req.age = 0            # re-admission ages afresh
+            if self._expired(req, now):
+                self._drop(req, "n_deadline_dropped")
+                continue
+            target = self._choose(req, now)
+            if target is None:
+                self._queue_retry(req, 0)
+                continue
+            if self.migration and req.out_tokens:
+                # ship the victim's full pages donor -> target BEFORE
+                # re-admission so its re-prefill runs through the cache.
+                # Any wire/adopter failure just means re-prefill does
+                # the work — streams are identical either way.
+                res = ship_pages(e, target.engine, req.rid)
+                self.stats["migrated_pages"] += res["pages"]
+                self.stats["migration_bytes"] += res["bytes"]
+                if res["status"] in ("dropped", "rejected", "failed"):
+                    self.stats["migration_" + (
+                        "dropped" if res["status"] == "dropped"
+                        else "rejected" if res["status"] == "rejected"
+                        else "failed")] += 1
+            try:
+                target.engine.submit(req)
+            except ValueError:
+                self._drop(req, "n_shed")   # can never fit on survivors
+                continue
+            self._owner[req.rid] = target
+            if self.affinity and req.session is not None:
+                self._sessions[req.session] = target.engine.engine_id
+
+    def _shed_for_pressure(self, victims: list, now: float) -> list:
+        """Graceful degradation under ``serving_fleet_shed_backlog``:
+        when the fleet's never-accepted backlog (victims + every live
+        queue + the retry queue, in pages) exceeds the factor times
+        surviving pool capacity, shed lowest-priority latest-arrival
+        never-accepted requests until it fits. Accepted streams
+        (anything with an emitted token or a recorded TTFT) are never
+        shed. Returns the surviving victims."""
+        if self.shed_backlog <= 0 or not self._alive():
+            return victims
+        cap = sum(r.engine.n_pages - 1 for r in self._alive())
+
+        def pages_needed(r, e) -> int:
+            return -(-(len(r.prompt) + r.max_new_tokens) // e.bs)
+
+        bs_engine = self._alive()[0].engine
+        backlog = []
+        for r in victims:
+            if r.t_first is None and not r.out_tokens:
+                backlog.append((r, None))
+        for rep in self._alive():
+            for r in rep.engine.queue:
+                if r.t_first is None and not r.out_tokens:
+                    backlog.append((r, rep))
+        for _rdy, _att, r in self._retry:
+            if (r.t_first is None and not r.out_tokens
+                    and not r.aborted):
+                backlog.append((r, None))
+        demand = sum(pages_needed(r, bs_engine) for r, _ in backlog)
+        limit = int(self.shed_backlog * cap)
+        if demand <= limit:
+            return victims
+        shed_rids = set()
+        # lowest priority first, youngest (latest arrival) within a
+        # class — mirrors the engine's own preemption victim order
+        for r, rep in sorted(backlog,
+                             key=lambda t: (t[0].priority, -t[0].arrival)):
+            if demand <= limit:
+                break
+            demand -= pages_needed(r, bs_engine)
+            shed_rids.add(r.rid)
+            if rep is not None:
+                rep.engine.abort(r.rid)
+                self._owner.pop(r.rid, None)
+                self.stats["n_shed"] += 1
+            else:
+                self._retry = [e2 for e2 in self._retry
+                               if e2[2].rid != r.rid]
+                self._drop(r, "n_shed")
+        return [r for r in victims if r.rid not in shed_rids]
+
+    # -- observability ----------------------------------------------------
+
+    def health(self) -> list[dict]:
+        out = []
+        for rep in self.replicas:
+            e = rep.engine
+            out.append({
+                "engine": e.engine_id, "alive": rep.alive,
+                "failures": rep.failures,
+                "last_step_ms": round(rep.last_step_s * 1000.0, 3),
+                "last_error": rep.last_error,
+                "free_pages": len(e.pool.free),
+                "resident": sum(1 for s in e.slots if s is not None),
+                "queued": len(e.queue),
+            })
+        return out
+
+    def page_accounting(self) -> dict:
+        """Per-engine censuses plus the fleet-wide sum; each engine's
+        ``total`` must equal its ``n_pages - 1`` (dead engines' frozen
+        pools included — death loses a replica, not the invariant)."""
+        per = {r.engine.engine_id: r.engine.page_accounting()
+               for r in self.replicas}
+        fleet: dict[str, int] = {}
+        for acc in per.values():
+            for k, v2 in acc.items():
+                fleet[k] = fleet.get(k, 0) + v2
+        expected = sum(r.engine.n_pages - 1 for r in self.replicas)
+        return {"engines": per, "fleet": fleet, "expected": expected}
+
+    def fleet_stats(self) -> dict:
+        rms = self._recovery_ms
+        return {
+            "fleet_n_engines": len(self.replicas),
+            "fleet_n_alive": len(self._alive()),
+            "recovery_ms_max": round(max(rms), 3) if rms else 0.0,
+            "recovery_ms_mean": round(sum(rms) / len(rms), 3)
+            if rms else 0.0,
+            **self.stats,
+        }

@@ -12,11 +12,12 @@ engine's slot-token waste buckets (metrics.py).
 """
 
 from .arrivals import burst_arrivals, gamma_arrivals, poisson_arrivals
-from .driver import OpenLoopDriver
-from .metrics import percentile, summarize
+from .driver import FleetDriver, OpenLoopDriver
+from .metrics import percentile, summarize, summarize_fleet
 from .workload import WorkloadSpec, synthesize
 
 __all__ = [
-    "OpenLoopDriver", "WorkloadSpec", "synthesize", "summarize",
-    "percentile", "poisson_arrivals", "gamma_arrivals", "burst_arrivals",
+    "OpenLoopDriver", "FleetDriver", "WorkloadSpec", "synthesize",
+    "summarize", "summarize_fleet", "percentile", "poisson_arrivals",
+    "gamma_arrivals", "burst_arrivals",
 ]

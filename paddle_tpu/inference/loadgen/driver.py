@@ -1,4 +1,6 @@
-"""Open-loop driver: arrival-faithful traffic against a ServingEngine.
+"""Open-loop drivers: arrival-faithful traffic against one ServingEngine
+(:class:`OpenLoopDriver`) or a FleetRouter of N replicas
+(:class:`FleetDriver`).
 
 Open loop means arrivals NEVER wait for the service side — every request
 is queued up front with its arrival stamp and the engine's admission
@@ -18,7 +20,15 @@ Two clocks:
 
 Abort injection: ``aborts`` maps a wall/step threshold to a rid; the
 driver fires each abort the first step after its threshold passes,
-exercising mid-flight teardown under load.
+exercising mid-flight teardown under load. ``FleetDriver`` adds
+``kills`` with the same threshold semantics mapping to an engine id —
+mid-run replica loss.
+
+Deadlines: a request carrying ``deadline_ttft``/``deadline_e2e`` (> 0,
+seconds from arrival) is aborted the first step after its budget lapses
+without the corresponding event, and counted in ``n_deadline_expired``.
+Wall clock only — under ``rush`` the virtual now is +inf, which would
+expire everything instantly and mean nothing.
 """
 
 from __future__ import annotations
@@ -26,9 +36,39 @@ from __future__ import annotations
 import time
 from typing import Optional
 
-from .metrics import summarize
+from .metrics import summarize, summarize_fleet
 
-__all__ = ["OpenLoopDriver"]
+__all__ = ["OpenLoopDriver", "FleetDriver"]
+
+
+def _rebase_times(requests, t0: float) -> None:
+    """Convert the engine's absolute-monotonic t_first/t_done stamps to
+    driver-relative seconds, the timebase ``arrival`` already uses — so
+    the TTFT/e2e percentiles in metrics.py measure what they claim."""
+    for r in requests:
+        if r.t_first is not None and r.t_first >= t0:
+            r.t_first -= t0
+        if r.t_done is not None and r.t_done >= t0:
+            r.t_done -= t0
+
+
+def _sweep_deadlines(requests, abort_fn, now: float) -> int:
+    """Abort every live request past its TTFT/e2e budget; returns how
+    many expired this sweep."""
+    n = 0
+    for r in requests:
+        if (r.aborted or r.t_done is not None
+                or len(r.out_tokens) >= r.max_new_tokens):
+            continue
+        miss_ttft = (r.deadline_ttft > 0 and r.t_first is None
+                     and now > r.arrival + r.deadline_ttft)
+        miss_e2e = (r.deadline_e2e > 0
+                    and now > r.arrival + r.deadline_e2e)
+        if miss_ttft or miss_e2e:
+            abort_fn(r.rid)
+            r.aborted = True               # even if already untracked
+            n += 1
+    return n
 
 
 class OpenLoopDriver:
@@ -51,6 +91,10 @@ class OpenLoopDriver:
             eng.submit(r)
         eng.stats = {k: 0 for k in eng.stats}
         pending = sorted((aborts or {}).items())
+        deadlined = (self.clock == "wall"
+                     and [r for r in requests
+                          if r.deadline_ttft > 0 or r.deadline_e2e > 0])
+        n_deadline = 0
         if not max_steps:
             total = sum(r.max_new_tokens + len(r.prompt)
                         for r in requests)
@@ -63,6 +107,8 @@ class OpenLoopDriver:
             gate = steps if self.clock == "rush" else now
             while pending and pending[0][0] <= gate:
                 eng.abort(pending.pop(0)[1])
+            if deadlined:
+                n_deadline += _sweep_deadlines(deadlined, eng.abort, now)
             if not eng.step(now=now):
                 break
             steps += 1
@@ -81,6 +127,89 @@ class OpenLoopDriver:
             eng.pool.release(eng._deferred_free)
             eng._deferred_free = []
             eng.pool.commit_evictable()
+        _rebase_times(requests, t0)
         out = summarize(requests, eng, wall)
         out["steps"] = steps
+        out["n_deadline_expired"] = n_deadline
+        out["deadline_miss_rate"] = round(
+            n_deadline / max(1, len(requests)), 3)
+        return out
+
+
+class FleetDriver:
+    """Open-loop traffic against a :class:`~..fleet.FleetRouter`: same
+    clock/abort semantics as OpenLoopDriver, plus deterministic mid-run
+    replica ``kills`` and fleet metrics (goodput/TTFT across replicas,
+    migrated pages, recovery latency, shed/deadline drops)."""
+
+    def __init__(self, router, clock: str = "wall"):
+        if clock not in ("wall", "rush"):
+            raise ValueError(f"unknown clock '{clock}'")
+        self.router = router
+        self.clock = clock
+
+    def run(self, requests, aborts: Optional[dict] = None,
+            kills: Optional[dict] = None, max_steps: int = 0) -> dict:
+        """``kills``: {threshold: engine_id} with abort threshold
+        semantics — the replica is killed (router recovery path) the
+        first step after the threshold passes."""
+        router = self.router
+        for rep in router.replicas:
+            rep.engine.stats = {k: 0 for k in rep.engine.stats}
+        pending = sorted((aborts or {}).items())
+        pending_kills = sorted((kills or {}).items())
+        deadlined = (self.clock == "wall"
+                     and [r for r in requests
+                          if r.deadline_ttft > 0 or r.deadline_e2e > 0])
+        n_deadline = 0
+        if not max_steps:
+            total = sum(r.max_new_tokens + len(r.prompt)
+                        for r in requests)
+            max_steps = 200 + 4 * total
+        t0 = time.monotonic()
+        for r in sorted(requests, key=lambda r: r.arrival):
+            router.submit(r, now=0.0 if self.clock == "wall" else 1e18)
+        steps = 0
+        while True:
+            now = (1e18 if self.clock == "rush"
+                   else time.monotonic() - t0)
+            gate = steps if self.clock == "rush" else now
+            while pending and pending[0][0] <= gate:
+                router.abort(pending.pop(0)[1])
+            while pending_kills and pending_kills[0][0] <= gate:
+                router.kill_engine(pending_kills.pop(0)[1], now=now)
+            if deadlined:
+                n_deadline += _sweep_deadlines(deadlined, router.abort,
+                                               now)
+            if not router.step(now=now):
+                break
+            steps += 1
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"fleet driver: fleet did not drain in "
+                    f"{max_steps} steps")
+            if self.clock == "wall":
+                live = [rep.engine for rep in router.replicas
+                        if rep.alive]
+                if live and all(
+                        not any(s is not None for s in e.slots)
+                        and e._inflight is None for e in live) \
+                        and any(e.queue for e in live):
+                    nxt = min(r.arrival for e in live for r in e.queue)
+                    wait = max(0.0, nxt - (time.monotonic() - t0))
+                    time.sleep(min(max(wait, 0.001), 0.05))
+        wall = time.monotonic() - t0
+        for rep in router.replicas:
+            e = rep.engine
+            if rep.alive and (e._deferred_free or e.pool.pending_evict):
+                e.pool.release(e._deferred_free)
+                e._deferred_free = []
+                e.pool.commit_evictable()
+        _rebase_times(requests, t0)
+        out = summarize_fleet(requests, router, wall)
+        out["steps"] = steps
+        out["n_deadline_expired"] = n_deadline
+        out["deadline_miss_rate"] = round(
+            (n_deadline + router.stats["n_deadline_dropped"])
+            / max(1, len(requests)), 3)
         return out

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["percentile", "summarize"]
+__all__ = ["percentile", "summarize", "summarize_fleet"]
 
 
 def percentile(xs, p: float) -> float:
@@ -28,9 +28,11 @@ def percentile(xs, p: float) -> float:
     return float(np.percentile(np.asarray(xs, np.float64), p))
 
 
-def summarize(requests, engine, wall_s: float) -> dict:
-    """Aggregate per-request records + the engine's step ledger into the
-    bench-facing metric dict."""
+def _aggregate(requests, st: dict, hits: int, misses: int,
+               wall_s: float) -> dict:
+    """The shared request-record + step-ledger aggregation; ``st`` is
+    one engine's stats dict or the element-wise sum across a fleet's
+    replicas (the ledger identities survive summation)."""
     done = [r for r in requests
             if not r.aborted and r.t_done is not None
             and len(r.out_tokens) >= r.max_new_tokens]
@@ -42,7 +44,6 @@ def summarize(requests, engine, wall_s: float) -> dict:
             if r.t_first is not None and len(r.out_tokens) > 1]
     total_tok = sum(len(r.out_tokens) for r in requests)
     good_tok = sum(len(r.out_tokens) for r in done)
-    st = engine.stats
     slot_tok = max(1, st["decode_slot_tokens"])
     out = {
         "n_requests": len(requests),
@@ -78,8 +79,32 @@ def summarize(requests, engine, wall_s: float) -> dict:
             st["spec_accepted_tokens"] / st["spec_proposed_tokens"], 3)
         if st["spec_proposed_tokens"] else 0.0,
         "prefix_cache_hit_rate": round(
-            engine.pool.hits / (engine.pool.hits + engine.pool.misses),
-            3) if engine.pool.hits + engine.pool.misses else 0.0,
+            hits / (hits + misses), 3) if hits + misses else 0.0,
         "unified_steps": st["unified_steps"],
     }
+    return out
+
+
+def summarize(requests, engine, wall_s: float) -> dict:
+    """Aggregate per-request records + the engine's step ledger into the
+    bench-facing metric dict."""
+    return _aggregate(requests, engine.stats, engine.pool.hits,
+                      engine.pool.misses, wall_s)
+
+
+def summarize_fleet(requests, router, wall_s: float) -> dict:
+    """Fleet aggregation: the same request-level percentiles over the
+    whole request set, the step/occupancy ledger summed across every
+    replica (dead ones included — their pre-kill work happened), plus
+    the router's own counters (kills, migrated pages/bytes, recovery
+    latency, shed/retry/deadline drops)."""
+    st: dict = {}
+    hits = misses = 0
+    for rep in router.replicas:
+        for k, v in rep.engine.stats.items():
+            st[k] = st.get(k, 0) + v
+        hits += rep.engine.pool.hits
+        misses += rep.engine.pool.misses
+    out = _aggregate(requests, st, hits, misses, wall_s)
+    out.update(router.fleet_stats())
     return out

@@ -64,6 +64,18 @@ class WorkloadSpec:
     priority_levels: int = 0             # uniform priority in [0, levels)
     constrained_frac: float = 0.0        # P(request names a schema)
     n_schemas: int = 1                   # schema pool size ("s<j>")
+    # fleet knobs (inference/fleet/): all default off, decorated from a
+    # THIRD RandomState after the multi-tenant pass — legacy and
+    # multi-tenant streams stay byte-identical (same convention as
+    # above). Deadlines are constant per-request budgets in seconds
+    # from arrival (0 = none); tenant_skew > 0 replaces the round-robin
+    # tenant assignment with a Zipf-ish draw (weight of tenant t is
+    # 1/(t+1)^skew) — the skewed mix a real fleet sees; n_sessions > 0
+    # tags requests with session keys for router affinity.
+    deadline_ttft: float = 0.0
+    deadline_e2e: float = 0.0
+    tenant_skew: float = 0.0
+    n_sessions: int = 0
 
 
 def synthesize(spec: WorkloadSpec) -> list[Request]:
@@ -124,4 +136,20 @@ def synthesize(spec: WorkloadSpec) -> list[Request]:
                 r.adapter_id = "a%d" % rng2.randint(spec.n_adapters)
             if spec.constrained_frac and rng2.rand() < spec.constrained_frac:
                 r.schema_id = "s%d" % rng2.randint(max(1, spec.n_schemas))
+    if (spec.deadline_ttft or spec.deadline_e2e or spec.n_sessions
+            or (spec.tenant_skew and spec.n_tenants)):
+        # fleet decoration, third stream: earlier draws untouched
+        rng3 = np.random.RandomState((spec.seed + 0xF1EE7) % (1 << 32))
+        if spec.tenant_skew and spec.n_tenants:
+            w = 1.0 / np.arange(1, spec.n_tenants + 1) ** spec.tenant_skew
+            w /= w.sum()
+        for r in reqs:
+            if spec.deadline_ttft:
+                r.deadline_ttft = spec.deadline_ttft
+            if spec.deadline_e2e:
+                r.deadline_e2e = spec.deadline_e2e
+            if spec.tenant_skew and spec.n_tenants:
+                r.tenant = int(rng3.choice(spec.n_tenants, p=w))
+            if spec.n_sessions:
+                r.session = "sess%d" % rng3.randint(spec.n_sessions)
     return reqs

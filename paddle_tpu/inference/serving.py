@@ -46,6 +46,7 @@ import hashlib
 import math
 import time
 import warnings
+import zlib
 from typing import Optional
 
 import numpy as np
@@ -58,6 +59,7 @@ from ..core.flags import GLOBAL_FLAGS
 from ..models.llama import (LlamaConfig, apply_rope, init_llama_params,
                             quantize_weights_int8, rms_norm, rope_angles,
                             _mm)
+from ..testing import chaos as _chaos
 
 __all__ = ["Request", "ServingEngine"]
 
@@ -86,6 +88,15 @@ class Request:
     adapter_id: Optional[object] = None
     schema_id: Optional[object] = None
     constraint: Optional[object] = None
+    # fleet serving (inference/fleet/): deadline_* are seconds-from-
+    # arrival budgets (0 = none) — the loadgen driver aborts expired
+    # requests and the router routes deadline-tight ones to the least-
+    # loaded replica; session is an opaque affinity key that keeps a
+    # conversation on the replica already holding its KV prefix. The
+    # engine itself never reads any of these.
+    deadline_ttft: float = 0.0
+    deadline_e2e: float = 0.0
+    session: Optional[object] = None
     # filled by the engine:
     out_tokens: list = dataclasses.field(default_factory=list)
     t_first: Optional[float] = None    # first-token wall time
@@ -248,7 +259,8 @@ class ServingEngine:
                  lora_rank: int = 8,
                  lora_slots: int = 4,
                  priorities: Optional[bool] = None,
-                 constrained: Optional[bool] = None):
+                 constrained: Optional[bool] = None,
+                 engine_id: int = 0):
         if decode_quantum is not None:
             # the unified step (PR 7) has no decode-quantum boundary;
             # the kwarg was previously swallowed silently
@@ -258,6 +270,10 @@ class ServingEngine:
                 "no decode-quantum boundary", DeprecationWarning,
                 stacklevel=2)
         self.decode_quantum = max(1, decode_quantum or 8)  # legacy attr
+        # fleet identity: names this replica in router health/stats and
+        # targets chaos specs (fire(..., ctx={"engine": id})); a lone
+        # engine keeps the default 0 and never consults it otherwise
+        self.engine_id = int(engine_id)
         self.cfg = cfg
         self.params = params if params is not None else init_llama_params(
             cfg, jax.random.PRNGKey(seed))
@@ -397,6 +413,10 @@ class ServingEngine:
         self._inflight = None              # (out_dev [C, 1|qb], snapshot)
         self._prev_out_dev = None
         self._deferred_free: list[int] = []
+        # migration staging (inference/fleet/): pages allocated by
+        # begin_adopt but not yet committed into the prefix cache — the
+        # ledger's ``in_flight`` class (page_accounting)
+        self._adopting: list[dict] = []
         self.stats = {
             "unified_steps": 0, "decode_steps": 0, "prefills": 0,
             "prefill_tokens": 0, "prefill_grid_tokens": 0,
@@ -782,11 +802,27 @@ class ServingEngine:
             out.append(h.digest())
         return out
 
+    def _cache_salt(self, req: Request) -> bytes:
+        """The per-request prefix-cache hash salt: the LoRA adapter's
+        content digest when one is bound (the v-projection delta changes
+        the page BYTES, so KV written under adapter X must never serve a
+        request under adapter Y or none), else empty. Shared by
+        admission lookup and migration export so a shipped page lands
+        under exactly the hash the victim's re-admission will probe."""
+        if self._lora_on and req.adapter_id is not None:
+            return b"lora:" + self.adapters.digest_of(req.adapter_id)
+        return b""
+
     def _alloc_pages(self, n: int) -> Optional[list[int]]:
         """Free-list alloc, reclaiming idle (refcount-0) prefix-cache
         pages on demand when the list runs short — then idle (warm but
         unreferenced) LoRA adapters, in that order: cached KV is cheaper
         to rebuild than an adapter reload is frequent."""
+        if _chaos.active():               # disarmed: one global load
+            spec = _chaos.fire("pool.alloc", ctx={"engine": self.engine_id})
+            if spec is not None and spec.kind == "fail":
+                return None               # pool reports empty; admission
+                                          # backpressure handles the rest
         if len(self.pool.free) < n:
             self.pool.evict(n - len(self.pool.free))
         while (len(self.pool.free) < n and self.adapters is not None
@@ -855,14 +891,8 @@ class ServingEngine:
                 shared, pages = [], None
             else:
                 # never look up the page holding the last prompt token:
-                # its chunk must run to produce the first-token logits.
-                # The adapter digest salts the hash: v-deltas change the
-                # page BYTES, so KV written under adapter X must never
-                # serve a request under adapter Y (or none)
-                salt = (b"lora:" + self.adapters.digest_of(req.adapter_id)
-                        if self._lora_on and req.adapter_id is not None
-                        else b"")
-                hashes = (self._page_hashes(P, salt)
+                # its chunk must run to produce the first-token logits
+                hashes = (self._page_hashes(P, self._cache_salt(req))
                           if self._cache_on else [])
                 shared = self.pool.lookup(hashes[:(T - 1) // self.bs])
                 pages = self._alloc_pages(n_blk - len(shared))
@@ -990,6 +1020,21 @@ class ServingEngine:
             self.samp_temp[slot] = 0.0     # idle rows pick greedily
             self.slots[slot] = None
 
+    def _chaos_step(self) -> None:
+        """Armed-only fault probe for ``engine.step`` (kinds: ``raise``
+        — the router sees a dead replica; ``hang`` — sleep ``seconds``
+        so the router's step-budget watchdog catches the stall). Kept
+        out of line so the disarmed ``step()`` cost is exactly the
+        ``chaos.active()`` global load."""
+        spec = _chaos.fire("engine.step", ctx={"engine": self.engine_id})
+        if spec is None:
+            return
+        if spec.kind == "hang":
+            time.sleep(float(spec.args.get("seconds", 0.05)))
+        else:
+            raise _chaos.ChaosInjected(
+                f"chaos: engine {self.engine_id} step failure")
+
     def step(self, now: Optional[float] = None) -> bool:
         """Admissions + ONE unified dispatch (decode rows + prefill
         chunks in the same grid) + harvest. Returns True while work
@@ -1018,6 +1063,8 @@ class ServingEngine:
         seq_lens at harvest (a rejected draft's k/v is masked by its
         position and overwritten before it could ever be attended).
         """
+        if _chaos.active():               # disarmed: one global load,
+            self._chaos_step()            # nothing else on the hot path
         now = time.monotonic() if now is None else now
         self._admit(now)
         prev = self._inflight
@@ -1315,6 +1362,185 @@ class ServingEngine:
                 # remains to record
                 req.t_done = now
 
+    # -- KV page migration (inference/fleet/) -----------------------------
+    #
+    # A KV page is a pure function of (params, token prefix, page size,
+    # quant mode, adapter digest) — the exact argument that makes the
+    # prefix cache sound — so a page's bytes shipped from a donor engine
+    # equal what the adopter would compute itself, and a victim request
+    # resumed through adopted pages emits the same stream as an
+    # uninterrupted run. The wire format ("shipment") is a dict:
+    #
+    #   version=1, rid, page_size, kv_quant, dtype, geom=(L, nKV, dH)
+    #   hashes  [n]  cumulative prefix-chain hashes (adapter-salted)
+    #   k       [n, L, nKV, dH, bs]   page-major contiguous payload
+    #   v       [n, L, nKV, bs, dH]
+    #   k_scales/v_scales [n, L, nKV] fp32 (kv_quant only, else None)
+    #   crc     [n]  crc32 over each page's k+v(+scale) bytes
+    #
+    # Adoption is two-phase so the page ledger stays exact while bytes
+    # are in transit: begin_adopt allocates + stages (ledger class
+    # ``in_flight``), commit_adopt writes the device arrays and inserts
+    # into the prefix cache at refcount 0 (idle-cached — the victim's
+    # normal re-admission lookup increfs and splices them into its
+    # block table), abort_adopt returns staged pages to the free list.
+
+    def export_request_pages(self, rid: int) -> Optional[dict]:
+        """Serialize the full KV pages (+ scale planes) a resident
+        request has written, for adoption by another engine. Exportable
+        prefix = tokens both (a) known to the host (prompt + harvested
+        out_tokens — a chained in-flight token's KV exists but its value
+        doesn't) and (b) dispatched into the pool (``seq_lens`` /
+        ``_prefilling`` advance at dispatch; reading the donated page
+        arrays below syncs with any in-flight program). Returns None for
+        unknown/queued rids or when no full page is covered."""
+        for slot in range(self.B):
+            req = self.slots[slot]
+            if req is not None and req.rid == rid:
+                break
+        else:
+            return None
+        full = (np.concatenate([np.asarray(req.prompt, np.int32),
+                                np.asarray(req.out_tokens, np.int32)])
+                if req.out_tokens else np.asarray(req.prompt, np.int32))
+        written = (self._prefilling[slot] if slot in self._prefilling
+                   else int(self.seq_lens[slot]))
+        known = min(written, len(full))
+        n_exp = known // self.bs
+        if n_exp <= 0:
+            return None
+        hashes = self._page_hashes(full[:n_exp * self.bs],
+                                   self._cache_salt(req))
+        pg = np.asarray(self._full_rows[slot][:n_exp], np.int32)
+        # page-major contiguous payload; np.asarray syncs with in-flight
+        # programs, so every dispatched position is actually on host
+        k = np.ascontiguousarray(np.moveaxis(
+            np.asarray(self.k_pages[:, pg]), 1, 0))
+        v = np.ascontiguousarray(np.moveaxis(
+            np.asarray(self.v_pages[:, pg]), 1, 0))
+        ks = vs = None
+        if self._kv_quant:
+            ks = np.ascontiguousarray(np.moveaxis(
+                np.asarray(self.k_scales[:, pg]), 1, 0))
+            vs = np.ascontiguousarray(np.moveaxis(
+                np.asarray(self.v_scales[:, pg]), 1, 0))
+        crc = [zlib.crc32(k[j].tobytes() + v[j].tobytes()
+                          + (ks[j].tobytes() + vs[j].tobytes()
+                             if self._kv_quant else b""))
+               for j in range(n_exp)]
+        cfg = self.cfg
+        return {"version": 1, "rid": rid, "page_size": self.bs,
+                "kv_quant": self._kv_quant,
+                "dtype": str(self.k_pages.dtype),
+                "geom": (cfg.n_layers, cfg.n_kv_heads, cfg.head_dim),
+                "hashes": hashes, "k": k, "v": v,
+                "k_scales": ks, "v_scales": vs, "crc": crc}
+
+    @staticmethod
+    def shipment_bytes(shipment: dict) -> int:
+        """Wire bytes of a shipment's page payload (int8 pages ship 4x
+        cheaper than bf16x2 — the EQuARX argument applied to KV)."""
+        n = shipment["k"].nbytes + shipment["v"].nbytes
+        if shipment["kv_quant"]:
+            n += shipment["k_scales"].nbytes + shipment["v_scales"].nbytes
+        return int(n)
+
+    def begin_adopt(self, shipment: dict) -> Optional[dict]:
+        """Phase 1 of adoption: validate the shipment against this
+        pool's geometry (ValueError on mismatch — shipments only move
+        between replicas of one model), drop pages whose crc fails or
+        whose hash is already resident, allocate pool pages for the
+        rest, and stage them (ledger class ``in_flight``). Returns the
+        staging handle, or None when nothing is adoptable (all cached,
+        crc-dead at page 0, allocation failure, or an armed
+        ``migration.adopt`` fault)."""
+        cfg = self.cfg
+        if (shipment.get("version") != 1
+                or shipment["page_size"] != self.bs
+                or shipment["kv_quant"] != self._kv_quant
+                or shipment["dtype"] != str(self.k_pages.dtype)
+                or tuple(shipment["geom"]) != (cfg.n_layers,
+                                               cfg.n_kv_heads,
+                                               cfg.head_dim)):
+            raise ValueError(
+                f"shipment geometry {shipment.get('page_size')}/"
+                f"{shipment.get('dtype')}/{shipment.get('geom')} does "
+                f"not match this pool ({self.bs}/{self.k_pages.dtype}/"
+                f"{(cfg.n_layers, cfg.n_kv_heads, cfg.head_dim)})")
+        if _chaos.active():
+            spec = _chaos.fire("migration.adopt",
+                               ctx={"engine": self.engine_id})
+            if spec is not None and spec.kind == "fail":
+                return None
+        k, v = shipment["k"], shipment["v"]
+        ks, vs = shipment["k_scales"], shipment["v_scales"]
+        staged: list[tuple[int, int]] = []     # (shipment idx, pool page)
+        for j, h in enumerate(shipment["hashes"]):
+            if zlib.crc32(k[j].tobytes() + v[j].tobytes()
+                          + (ks[j].tobytes() + vs[j].tobytes()
+                             if self._kv_quant else b"")) \
+                    != shipment["crc"][j]:
+                break     # corrupt: pages past j can't extend the chain
+            if h in self.pool.cache:
+                continue  # already resident here; chain stays contiguous
+            pages = self._alloc_pages(1)
+            if pages is None:
+                break     # adopter full: keep the prefix we could stage
+            staged.append((j, pages[0]))
+        if not staged:
+            return None
+        handle = {"shipment": shipment, "staged": staged}
+        self._adopting.append(handle)
+        return handle
+
+    def commit_adopt(self, handle: dict) -> int:
+        """Phase 2: write the staged pages' bytes into the device pool
+        (one batched scatter per array, chained after any in-flight
+        program's donated output) and publish them in the prefix cache
+        at refcount 0 — idle-cached, exactly where a page a finished
+        request offered would sit, so the victim's re-admission lookup
+        (and anyone sharing the prefix) increfs them from there.
+        Returns the number of pages adopted."""
+        self._adopting.remove(handle)
+        shipment, staged = handle["shipment"], handle["staged"]
+        idx = [j for j, _ in staged]
+        pages = [p for _, p in staged]
+        pg = jnp.asarray(pages, jnp.int32)
+        dt = self.k_pages.dtype
+        self.k_pages = self.k_pages.at[:, pg].set(
+            jnp.asarray(np.moveaxis(shipment["k"][idx], 0, 1), dt))
+        self.v_pages = self.v_pages.at[:, pg].set(
+            jnp.asarray(np.moveaxis(shipment["v"][idx], 0, 1), dt))
+        if self._kv_quant:
+            self.k_scales = self.k_scales.at[:, pg].set(
+                jnp.asarray(np.moveaxis(shipment["k_scales"][idx], 0, 1),
+                            jnp.float32))
+            self.v_scales = self.v_scales.at[:, pg].set(
+                jnp.asarray(np.moveaxis(shipment["v_scales"][idx], 0, 1),
+                            jnp.float32))
+        for (j, p) in staged:
+            self.pool.insert(shipment["hashes"][j], p)
+        # drop the insert refcount: the pages idle in the cache until a
+        # lookup claims them. They settle to evictable at the next
+        # harvest/idle commit like any other pending page.
+        self.pool.decref(pages)
+        return len(pages)
+
+    def abort_adopt(self, handle: dict) -> None:
+        """Roll back a staged adoption: pages return to the free list
+        untouched (nothing was published, nothing dispatched could have
+        referenced them)."""
+        self._adopting.remove(handle)
+        self.pool.release([p for _, p in handle["staged"]])
+
+    def adopt_pages(self, shipment: dict) -> int:
+        """begin_adopt + commit_adopt in one call (the router's path);
+        returns pages adopted (0 when nothing was adoptable)."""
+        handle = self.begin_adopt(shipment)
+        if handle is None:
+            return 0
+        return self.commit_adopt(handle)
+
     def kv_bytes_per_page(self) -> float:
         """HBM bytes one KV page costs across all layers, including the
         page's share of the scale planes. The structural capacity
@@ -1337,8 +1563,10 @@ class ServingEngine:
         """Page census for the leak invariant: every non-sink page is in
         exactly one of free / slot-owned / slot-shared (refcounted cache
         mappings, deduplicated) / idle-cached (refcount 0, pending or
-        evictable) / deferred-free / adapter (resident LoRA weights);
-        the counts sum to n_pages - 1."""
+        evictable) / deferred-free / adapter (resident LoRA weights) /
+        in-flight (migration pages staged by begin_adopt, not yet
+        committed or rolled back); the counts sum to n_pages - 1 —
+        per engine, and therefore fleet-wide by summation."""
         owned = [p for lst in self._slot_owned for p in lst]
         shared = {p for lst in self._slot_shared for p in lst}
         cache_idle = [p for p, r in self.pool.ref.items() if r == 0]
@@ -1350,6 +1578,7 @@ class ServingEngine:
             "deferred_free": len(self._deferred_free),
             "adapter": (self.adapters.n_pages_held()
                         if self.adapters is not None else 0),
+            "in_flight": sum(len(h["staged"]) for h in self._adopting),
         }
         counts["total"] = sum(counts.values())
         return counts

@@ -35,12 +35,34 @@ point                 kinds
                       ``seconds`` inside the watchdog guard), ``exit``
                       (``os._exit(code)`` mid-step — simulated rank
                       loss: no cleanup, no checkpoint, no exception)
+``engine.step``       ``raise`` (ChaosInjected out of ServingEngine.step
+                      — the router sees a dead replica), ``hang``
+                      (sleep ``seconds`` inside step; the router's
+                      step-budget watchdog catches the stall)
+``pool.alloc``        ``fail`` (page allocation reports an empty pool
+                      even when pages are free — admission backpressure)
+``migration.ship``    ``drop`` (exported page shipment lost on the
+                      wire), ``corrupt`` (one byte of page payload
+                      flipped in transit; the adopter's crc rejects it)
+``migration.adopt``   ``fail`` (survivor refuses the shipment before
+                      staging — e.g. no free pages at the adopter)
 ====================  ======================================================
 
 Multi-host targeting: a spec with ``rank=<r>`` in its args fires only in
 the process whose trainer rank (``PADDLE_TRAINER_ID`` / ``RANK`` env,
 default 0) matches — one armed plan, shipped to every worker through
 ``PT_CHAOS_PLAN``, can kill exactly one rank of a fleet mid-step.
+
+In-process targeting: probes at sites that exist many times per process
+(N serving engines in one fleet) pass a ``ctx`` dict, e.g.
+``fire("engine.step", ctx={"engine": 0})``. Every key present in BOTH
+``spec.args`` and ``ctx`` must match (string-compared, surviving JSON
+round trips) or the spec is skipped — so ``plan.add("engine.step",
+"raise", at=7, engine=0)`` kills exactly engine 0 and nothing else.
+Site parameters like ``seconds``/``code`` are untouched: they only
+constrain when the site also reports them. Invocation counters for
+``at=N`` are kept per ``(point, ctx)`` pair, so "the 7th step of
+engine 0" means engine 0's own 7th step regardless of interleaving.
 
 Determinism: probabilistic faults draw from a ``random.Random`` seeded
 from ``(plan.seed, point, kind)``, and at-N faults count invocations per
@@ -167,18 +189,24 @@ class _ArmedPlan:
             rng = self._rngs[i] = random.Random(self.plan.seed ^ salt)
         return rng
 
-    def check(self, point: str) -> Optional[FaultSpec]:
+    def check(self, point: str,
+              ctx: Optional[dict] = None) -> Optional[FaultSpec]:
         specs = self._by_point.get(point)
         if specs is None:
             return None
+        key = point if not ctx else (
+            point + "|" + repr(sorted((k, str(v)) for k, v in ctx.items())))
         with self._lock:
-            n = self._counts.get(point, 0)
-            self._counts[point] = n + 1
+            n = self._counts.get(key, 0)
+            self._counts[key] = n + 1
             for i, spec in specs:
                 if spec.once and i in self._fired:
                     continue
                 want_rank = spec.args.get("rank")
                 if want_rank is not None and int(want_rank) != _env_rank():
+                    continue
+                if ctx and any(str(spec.args[k]) != str(v)
+                               for k, v in ctx.items() if k in spec.args):
                     continue
                 if spec.at is not None:
                     hit = n == spec.at
@@ -213,12 +241,16 @@ def active() -> bool:
     return _armed is not None
 
 
-def fire(point: str) -> Optional[FaultSpec]:
+def fire(point: str, ctx: Optional[dict] = None) -> Optional[FaultSpec]:
     """The probe production code calls: returns the fault that fires at
-    this invocation of ``point``, or None. Zero-cost when disarmed."""
+    this invocation of ``point``, or None. Zero-cost when disarmed.
+    ``ctx`` narrows matching to specs whose args agree on every shared
+    key (see "In-process targeting" above); serving hot paths guard the
+    call itself behind ``chaos.active()`` so the disarmed cost stays a
+    single global load."""
     if _armed is None:
         return None
-    return _armed.check(point)
+    return _armed.check(point, ctx)
 
 
 _EXC_FOR_KIND = {

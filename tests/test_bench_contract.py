@@ -27,8 +27,9 @@ def bench(monkeypatch):
     # serving engine, 100-step loss curve — hours on the 1-core CPU CI
     # box); individual tests re-patch the ones they exercise
     for name in ("_bench_chip_probe", "_bench_decode", "_bench_serving",
-                 "_bench_multitenant", "_bench_loss_curve", "_bench_13b",
-                 "_bench_long_ctx", "_bench_multichip", "_bench_phases"):
+                 "_bench_multitenant", "_bench_fleet", "_bench_loss_curve",
+                 "_bench_13b", "_bench_long_ctx", "_bench_multichip",
+                 "_bench_phases"):
         monkeypatch.setattr(b, name, lambda: {})
     return b
 
@@ -145,6 +146,33 @@ def test_multitenant_key_contract(bench):
 
     src = inspect.getsource(bench._run_secondary_benches)
     assert "_bench_multitenant" in src and "multitenant_error" in src
+
+
+def test_fleet_key_contract(bench):
+    """_fleet_keys is the pure FleetDriver-metrics -> bench-keys mapping
+    for the fleet family (ISSUE 11): replica count, fleet goodput and
+    TTFT tail measured WITH a mid-run replica loss, pages migrated off
+    the dead replica, worst stream-recovery latency, and the deadline
+    miss rate under shrunken capacity."""
+    m = {"fleet_n_engines": 2, "goodput_tok_s": 310.0,
+         "ttft_p99_s": 1.4, "migrated_pages": 9,
+         "recovery_ms_max": 220.5, "deadline_miss_rate": 0.021}
+    out = bench._fleet_keys(m)
+    for k in ("fleet_n_engines", "fleet_goodput", "fleet_ttft_p99",
+              "fleet_migrated_pages", "fleet_recovery_ms",
+              "fleet_deadline_miss_rate"):
+        assert k in out, k
+    assert out["fleet_n_engines"] == 2.0
+    assert out["fleet_goodput"] == 310.0
+    assert out["fleet_ttft_p99"] == 1.4
+    assert out["fleet_migrated_pages"] == 9.0
+    assert out["fleet_recovery_ms"] == 220.5
+    assert out["fleet_deadline_miss_rate"] == 0.021
+    # error marker name is wired in the secondary list
+    import inspect
+
+    src = inspect.getsource(bench._run_secondary_benches)
+    assert "_bench_fleet" in src and "fleet_error" in src
 
 
 def test_multichip_key_contract(bench):

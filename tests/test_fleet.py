@@ -1,0 +1,583 @@
+"""Fleet-resilient serving (PR 11): FleetRouter placement, KV page
+migration, engine-loss chaos, deadline/retry routing, and the extended
+page-ledger invariant.
+
+The headline property: kill a replica mid-decode and every victim
+stream — re-admitted elsewhere through migrated KV pages (or plain
+re-prefill when migration is chaos-dropped) and keyed (seed, position)
+sampling — is bit-identical to an uninterrupted run, greedy AND
+sampled. The 7-class page ledger (free / slot_owned / slot_shared /
+cache_idle / deferred_free / adapter / in_flight) must sum exactly per
+engine and fleet-wide on every step, replica deaths included."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.flags import GLOBAL_FLAGS
+from paddle_tpu.inference.fleet import FleetRouter, ship_pages
+from paddle_tpu.inference.serving import Request, ServingEngine
+from paddle_tpu.models.llama import LlamaConfig
+from paddle_tpu.testing import chaos
+
+CFG = LlamaConfig(vocab_size=256, hidden=64, n_layers=2, n_heads=4,
+                  n_kv_heads=2, ffn_hidden=128, max_seq_len=256,
+                  dtype=jnp.float32, param_dtype=jnp.float32)
+EKW = dict(max_batch=2, page_size=16, max_seq=128, n_pages=1 + 24,
+           prefill_budget=32)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    chaos.disarm()
+
+
+def _mk_router(**kw):
+    ekw = dict(EKW, **kw.pop("engine_kwargs", {}))
+    return FleetRouter(CFG, n_engines=2, seed=0, engine_kwargs=ekw, **kw)
+
+
+def _mk_reqs(rng, n=4, max_new=10, sampled=()):
+    reqs = []
+    for i in range(n):
+        prompt = rng.randint(1, CFG.vocab_size,
+                             size=rng.randint(24, 48)).astype(np.int32)
+        kw = (dict(temperature=0.8, top_p=0.9, seed=100 + i)
+              if i in sampled else {})
+        reqs.append(Request(rid=i, prompt=prompt, max_new_tokens=max_new,
+                            arrival=0.0, **kw))
+    return reqs
+
+
+def _solo_run(params, req):
+    """Uninterrupted single-engine reference for one request."""
+    eng = ServingEngine(CFG, params=params, seed=0, **EKW)
+    ref = Request(rid=1000 + req.rid, prompt=req.prompt.copy(),
+                  max_new_tokens=req.max_new_tokens,
+                  temperature=req.temperature, top_p=req.top_p,
+                  seed=req.seed)
+    eng.run([ref])
+    return ref.out_tokens
+
+
+def _assert_fleet_ledger(router):
+    acc = router.page_accounting()
+    for eid, a in acc["engines"].items():
+        eng = next(r.engine for r in router.replicas
+                   if r.engine.engine_id == eid)
+        assert a["total"] == eng.n_pages - 1, (eid, a)
+    assert acc["fleet"]["total"] == acc["expected"], acc
+
+
+def _settle(router):
+    for rep in router.replicas:
+        e = rep.engine
+        if rep.alive and (e._deferred_free or e.pool.pending_evict):
+            e.pool.release(e._deferred_free)
+            e._deferred_free = []
+            e.pool.commit_evictable()
+
+
+def _drain(router, limit=2000):
+    steps = 0
+    while router.step(now=1e18):
+        steps += 1
+        assert steps < limit, "fleet did not drain"
+    return steps
+
+
+# -- headline: engine loss -> bit-identical resume --------------------------
+
+
+def test_engine_loss_chaos_bit_identical_resume_greedy_and_sampled():
+    """Chaos kills engine 0 on its own 6th step, mid-decode. Every
+    stream (greedy and sampled) must complete bit-identically to an
+    uninterrupted solo run, pages must migrate, and the ledger must sum
+    on every step — on the frozen corpse too."""
+    chaos.arm(chaos.FaultPlan(seed=0)
+              .add("engine.step", "raise", at=6, engine=0))
+    router = _mk_router()
+    params = router.replicas[0].engine.params
+    reqs = _mk_reqs(np.random.RandomState(11), n=4, sampled=(1, 3))
+    for r in reqs:
+        router.submit(r, now=1e18)
+    steps = 0
+    while router.step(now=1e18):
+        steps += 1
+        _assert_fleet_ledger(router)
+        assert steps < 2000
+    assert [rep.alive for rep in router.replicas] == [False, True]
+    assert router.stats["n_killed"] == 1
+    bad = [r.rid for r in reqs if r.aborted or r.t_done is None
+           or len(r.out_tokens) != r.max_new_tokens]
+    assert not bad, bad
+    for r in reqs:
+        assert r.out_tokens == _solo_run(params, r), r.rid
+    # engine 0 had accepted streams at death: they migrated + recovered
+    assert router.stats["migrated_pages"] > 0
+    assert router.stats["n_recovered"] > 0
+    assert router.fleet_stats()["recovery_ms_max"] > 0
+    _settle(router)
+    _assert_fleet_ledger(router)
+
+
+def test_engine_loss_with_migration_dropped_still_bit_identical():
+    """Chaos drops every shipment on the wire: recovery falls back to
+    plain re-prefill and the streams are STILL bit-identical — migration
+    is a cache warm-up, never a correctness dependency."""
+    chaos.arm(chaos.FaultPlan(seed=0)
+              .add("engine.step", "raise", at=6, engine=0)
+              .add("migration.ship", "drop", once=False))
+    router = _mk_router()
+    params = router.replicas[0].engine.params
+    reqs = _mk_reqs(np.random.RandomState(11), n=4, sampled=(1, 3))
+    for r in reqs:
+        router.submit(r, now=1e18)
+    _drain(router)
+    assert router.stats["n_killed"] == 1
+    assert router.stats["migrated_pages"] == 0
+    assert router.stats["migration_dropped"] > 0
+    for r in reqs:
+        assert not r.aborted and len(r.out_tokens) == r.max_new_tokens
+        assert r.out_tokens == _solo_run(params, r), r.rid
+    _settle(router)
+    _assert_fleet_ledger(router)
+
+
+def test_engine_loss_with_corrupt_shipment_rejected_by_crc():
+    """A bit flipped in transit: the adopter's per-page crc rejects the
+    shipment (nothing poisoned into the cache), recovery re-prefills,
+    streams stay bit-identical."""
+    chaos.arm(chaos.FaultPlan(seed=0)
+              .add("engine.step", "raise", at=6, engine=0)
+              .add("migration.ship", "corrupt", once=False))
+    router = _mk_router()
+    params = router.replicas[0].engine.params
+    reqs = _mk_reqs(np.random.RandomState(11), n=4, sampled=(1, 3))
+    for r in reqs:
+        router.submit(r, now=1e18)
+    _drain(router)
+    assert router.stats["migration_rejected"] > 0
+    assert router.stats["migrated_pages"] == 0
+    for r in reqs:
+        assert not r.aborted and len(r.out_tokens) == r.max_new_tokens
+        assert r.out_tokens == _solo_run(params, r), r.rid
+    _settle(router)
+    _assert_fleet_ledger(router)
+
+
+def test_hang_detection_kills_stalled_replica():
+    """A replica whose step exceeds serving_fleet_step_budget is dead
+    (single-threaded hang detection: the stall is observed as elapsed
+    time); its victims resume bit-identically on the survivor."""
+    router = _mk_router(step_budget=0.5)
+    params = router.replicas[0].engine.params
+    # compile OUTSIDE the watched window: the first step pays jit and
+    # would blow any budget tight enough to catch a real stall
+    for i, rep in enumerate(router.replicas):
+        rep.engine.run([Request(rid=-1 - i,
+                                prompt=np.ones(40, np.int32),
+                                max_new_tokens=2, arrival=0.0)])
+    chaos.arm(chaos.FaultPlan(seed=0)
+              .add("engine.step", "hang", at=6, engine=0, seconds=1.0))
+    reqs = _mk_reqs(np.random.RandomState(4), n=2)
+    for r in reqs:
+        router.submit(r, now=1e18)
+    _drain(router)
+    dead = [rep for rep in router.replicas if not rep.alive]
+    assert len(dead) == 1 and "budget" in dead[0].last_error
+    for r in reqs:
+        assert not r.aborted and len(r.out_tokens) == r.max_new_tokens
+        assert r.out_tokens == _solo_run(params, r), r.rid
+
+
+# -- migration mechanics ----------------------------------------------------
+
+
+def test_migration_two_phase_adopt_in_flight_ledger_and_cache_hits():
+    """export -> begin_adopt stages pages in the in_flight ledger class
+    (total stays exact) -> commit_adopt lands them cache_idle through
+    the prefix-cache insert path -> the victim's re-prefill hits them.
+    abort_adopt returns staged pages to the free list."""
+    router = _mk_router()
+    donor, recv = (rep.engine for rep in router.replicas)
+    req = Request(rid=0, prompt=np.arange(1, 41, dtype=np.int32),
+                  max_new_tokens=8, arrival=0.0)
+    donor.submit(req)
+    steps = 0
+    while len(req.out_tokens) < 4:
+        donor.step(now=1e18)
+        steps += 1
+        assert steps < 200
+    ship = donor.export_request_pages(0)
+    assert ship is not None and len(ship["hashes"]) >= 2
+    assert ServingEngine.shipment_bytes(ship) > 0
+    # abort path first: staged pages must come straight back
+    h = recv.begin_adopt(ship)
+    assert h is not None and recv.page_accounting()["in_flight"] > 0
+    _assert_fleet_ledger(router)
+    recv.abort_adopt(h)
+    assert recv.page_accounting()["in_flight"] == 0
+    free0 = len(recv.pool.free)
+    # real adoption
+    h = recv.begin_adopt(ship)
+    acc = recv.page_accounting()
+    assert acc["in_flight"] == len(ship["hashes"])
+    assert acc["total"] == recv.n_pages - 1
+    n = recv.commit_adopt(h)
+    assert n == len(ship["hashes"])
+    acc = recv.page_accounting()
+    assert acc["in_flight"] == 0 and acc["cache_idle"] >= n
+    assert len(recv.pool.free) == free0 - n
+    # the migrated prefix now serves the victim's re-prefill from cache
+    hits0 = recv.pool.hits
+    full = np.concatenate([req.prompt,
+                           np.asarray(req.out_tokens, np.int32)])
+    re_req = Request(rid=1, prompt=full, max_new_tokens=4, arrival=0.0)
+    recv.run([re_req])
+    assert recv.pool.hits - hits0 >= n
+    # duplicate shipment: already-cached hashes are skipped, not staged
+    ship2 = donor.export_request_pages(0)
+    assert recv.adopt_pages(ship2) == 0
+
+
+def test_ship_pages_statuses():
+    """ship_pages reports what happened: ok with page/byte counts for a
+    real transfer, nothing for a request with no full pages."""
+    router = _mk_router()
+    donor, recv = (rep.engine for rep in router.replicas)
+    req = Request(rid=0, prompt=np.arange(1, 41, dtype=np.int32),
+                  max_new_tokens=8, arrival=0.0)
+    donor.submit(req)
+    steps = 0
+    while len(req.out_tokens) < 4:
+        donor.step(now=1e18)
+        steps += 1
+        assert steps < 200
+    res = ship_pages(donor, recv, 0)
+    assert res["status"] == "ok" and res["pages"] >= 2
+    assert res["bytes"] > 0
+    short = Request(rid=7, prompt=np.arange(1, 6, dtype=np.int32),
+                    max_new_tokens=4, arrival=0.0)
+    donor.submit(short)
+    while short.t_first is None:
+        donor.step(now=1e18)
+    assert ship_pages(donor, recv, 7)["status"] == "nothing"
+
+
+# -- ledger invariant under randomized kill/migrate/abort -------------------
+
+
+def test_ledger_invariant_randomized_kill_migrate_abort():
+    """Satellite 3: randomized load with mid-run aborts and a randomized
+    replica kill; the 7-class census must balance per engine AND
+    fleet-wide after EVERY router step, and survivors must settle with
+    nothing stuck in slot/deferred/in_flight classes."""
+    rng = np.random.RandomState(29)
+    reqs = _mk_reqs(rng, n=8, max_new=8, sampled=(2, 5))
+    router = _mk_router()
+    for r in reqs:
+        router.submit(r, now=1e18)
+    kill_at = int(rng.randint(4, 9))
+    abort_at = {int(rng.randint(2, 12)): int(rng.randint(8))
+                for _ in range(2)}
+    steps = 0
+    while router.step(now=1e18):
+        steps += 1
+        if steps == kill_at:
+            alive = [rep.engine.engine_id for rep in router.replicas
+                     if rep.alive]
+            router.kill_engine(int(rng.choice(alive)), now=1e18)
+        rid = abort_at.pop(steps, None)
+        if rid is not None:
+            router.abort(rid)
+        _assert_fleet_ledger(router)
+        assert steps < 2000
+    assert router.stats["n_killed"] == 1
+    for r in reqs:
+        assert r.aborted or len(r.out_tokens) == r.max_new_tokens
+    _settle(router)
+    _assert_fleet_ledger(router)
+    for rep in router.replicas:
+        if rep.alive:
+            a = rep.engine.page_accounting()
+            assert not (a["slot_owned"] or a["slot_shared"]
+                        or a["deferred_free"] or a["in_flight"]), a
+
+
+# -- placement --------------------------------------------------------------
+
+
+def test_placement_prefix_cache_gravity_and_load_spread():
+    """An empty fleet ties break to engine 0 and load spreads the next
+    request to engine 1; a warm prefix on engine 1 outweighs the tie and
+    attracts the matching request there."""
+    router = _mk_router()
+    e1 = router.replicas[1].engine
+    prefix = np.arange(1, 33, dtype=np.int32)           # 2 full pages
+    warm = Request(rid=50, prompt=np.concatenate(
+        [prefix, np.asarray([7, 8, 9], np.int32)]),
+        max_new_tokens=2, arrival=0.0)
+    e1.run([warm])
+    assert len(e1.pool.cache) >= 2
+    rng = np.random.RandomState(0)
+    ra = Request(rid=0, prompt=rng.randint(
+        1, 256, 40).astype(np.int32), max_new_tokens=4, arrival=0.0)
+    router.submit(ra, now=1e18)
+    assert router._owner[0].engine.engine_id == 0      # tie -> lowest id
+    rb = Request(rid=1, prompt=rng.randint(
+        1, 256, 40).astype(np.int32), max_new_tokens=4, arrival=0.0)
+    router.submit(rb, now=1e18)
+    assert router._owner[1].engine.engine_id == 1      # least loaded
+    rc = Request(rid=2, prompt=np.concatenate(
+        [prefix, np.asarray([4, 5], np.int32)]),
+        max_new_tokens=4, arrival=0.0)
+    router.submit(rc, now=1e18)
+    assert router._owner[2].engine.engine_id == 1      # cache gravity
+    for rid in (0, 1, 2):
+        router.abort(rid)
+
+
+def test_session_affinity_and_tight_deadline_override():
+    """A session sticks to the replica that served it even when load
+    says otherwise; a deadline-tight request ignores every gravity term
+    and routes pure least-loaded."""
+    router = _mk_router()
+    rng = np.random.RandomState(1)
+    ra = Request(rid=0, prompt=rng.randint(1, 256, 30).astype(np.int32),
+                 max_new_tokens=4, arrival=0.0, session="s1")
+    router.submit(ra, now=1e18)
+    assert router._owner[0].engine.engine_id == 0
+    # engine 0 is now the loaded one, but the session bonus (4*bs
+    # tokens) outweighs ra's remaining work
+    rb = Request(rid=1, prompt=rng.randint(1, 256, 30).astype(np.int32),
+                 max_new_tokens=4, arrival=0.0, session="s1")
+    router.submit(rb, now=1e18)
+    assert router._owner[1].engine.engine_id == 0
+    # same shape but TTFT-tight: load wins, affinity ignored
+    rc = Request(rid=2, prompt=rng.randint(1, 256, 30).astype(np.int32),
+                 max_new_tokens=4, arrival=0.0, session="s1",
+                 deadline_ttft=0.2)
+    router.submit(rc, now=0.0)
+    assert router._owner[2].engine.engine_id == 1
+    for rid in (0, 1, 2):
+        router.abort(rid)
+
+
+def test_shed_only_never_accepted_lowest_priority_first():
+    """Graceful degradation: when a death shrinks capacity below the
+    serving_fleet_shed_backlog threshold, only never-accepted requests
+    shed, lowest priority first — accepted streams always survive."""
+    router = _mk_router(shed_backlog=0.1)    # limit = 0.1 * 48 = 4 pages
+    active = Request(rid=0, prompt=np.arange(1, 41, dtype=np.int32),
+                     max_new_tokens=6, arrival=0.0)
+    router.submit(active, now=1e18)
+    steps = 0
+    while not active.out_tokens:
+        router.step(now=1e18)
+        steps += 1
+        assert steps < 200
+    rng = np.random.RandomState(2)
+    queued = []
+    for i, prio in enumerate((0, 0, 1, 1, 2, 2)):
+        r = Request(rid=10 + i, prompt=rng.randint(
+            1, 256, 30).astype(np.int32), max_new_tokens=8,
+            arrival=1e17, priority=prio)
+        queued.append(r)
+        router.submit(r, now=0.0)    # future arrival: never accepted
+    victim = router._owner[0].engine.engine_id
+    router.kill_engine(victim, now=0.0)
+    assert router.stats["n_shed"] > 0
+    shed = [r for r in queued if r.aborted]
+    kept = [r for r in queued if not r.aborted]
+    assert shed, "pressure shed nothing"
+    # priority ordering: nothing kept outranks nothing shed downward —
+    # every shed priority <= every kept priority
+    assert max(r.priority for r in shed) <= min(
+        [r.priority for r in kept] or [2])
+    assert not active.aborted        # accepted stream never shed
+    _drain(router)
+    assert len(active.out_tokens) == active.max_new_tokens
+    for r in kept:
+        router.abort(r.rid)
+
+
+def test_retry_backoff_exhaustion_when_fleet_is_gone():
+    """No alive replica: a submission enters the retry queue, backs off
+    (deterministic exponential schedule), exhausts serving_fleet_retry_max
+    attempts, and drops with n_retry_exhausted — the router terminates
+    instead of spinning."""
+    router = _mk_router(retry_max=2, retry_base_delay=0.001)
+    router.kill_engine(0, now=0.0)
+    router.kill_engine(1, now=0.0)
+    req = Request(rid=0, prompt=np.arange(1, 20, dtype=np.int32),
+                  max_new_tokens=4, arrival=0.0)
+    router.submit(req, now=1e18)
+    import time as _time
+    steps = 0
+    while router.step(now=1e18):
+        _time.sleep(0.002)           # let the backoff clocks pass
+        steps += 1
+        assert steps < 500
+    assert req.aborted and req.t_done is not None
+    assert router.stats["n_retry_exhausted"] == 1
+    assert router.fleet_stats()["fleet_n_alive"] == 0
+
+
+# -- loadgen: deadlines + fleet driver --------------------------------------
+
+
+def test_openloop_driver_deadline_expiry_metric():
+    """Satellite 1: a request whose TTFT budget lapses is aborted and
+    counted in n_deadline_expired; the rest of the run is unaffected."""
+    from paddle_tpu.inference.loadgen import OpenLoopDriver
+
+    eng = ServingEngine(CFG, seed=0, **EKW)
+    doomed = Request(rid=0, prompt=np.arange(1, 30, dtype=np.int32),
+                     max_new_tokens=6, arrival=0.0, deadline_ttft=1e-9)
+    fine = Request(rid=1, prompt=np.arange(1, 30, dtype=np.int32),
+                   max_new_tokens=6, arrival=0.0, deadline_ttft=60.0)
+    m = OpenLoopDriver(eng, clock="wall").run([doomed, fine])
+    assert doomed.aborted and m["n_deadline_expired"] == 1
+    assert m["deadline_miss_rate"] == 0.5
+    assert not fine.aborted
+    assert len(fine.out_tokens) == fine.max_new_tokens
+
+
+def test_fleet_driver_rush_kill_completes_and_reports():
+    """FleetDriver under the rush clock with a step-indexed kill: every
+    request completes, the metric surface carries the fleet keys, and
+    the fleet ledger closes."""
+    from paddle_tpu.inference.loadgen import FleetDriver
+
+    router = _mk_router()
+    reqs = _mk_reqs(np.random.RandomState(13), n=6, max_new=6,
+                    sampled=(4,))
+    m = FleetDriver(router, clock="rush").run(reqs, kills={4: 1})
+    assert all(len(r.out_tokens) == r.max_new_tokens for r in reqs)
+    for k in ("fleet_n_engines", "fleet_n_alive", "migrated_pages",
+              "recovery_ms_max", "n_deadline_expired",
+              "deadline_miss_rate", "goodput_tok_s"):
+        assert k in m, k
+    assert m["fleet_n_engines"] == 2 and m["fleet_n_alive"] == 1
+    _assert_fleet_ledger(router)
+
+
+def test_workload_fleet_decoration_seeded_and_legacy_identical():
+    """Fleet knobs draw from a third RandomState: knobs-off synthesize
+    is byte-identical to the PR 10 stream, knobs-on changes ONLY the
+    new fields (prompts/arrivals/sampling/tenant-less fields
+    untouched), and the skewed tenant draw actually skews."""
+    from paddle_tpu.inference.loadgen import WorkloadSpec, synthesize
+
+    base_kw = dict(n_requests=24, seed=9, vocab_size=256, prefix_len=16,
+                   n_prefixes=2, sampled_frac=0.5, max_seq=96,
+                   tail_max=64, new_min=4, new_max=8)
+    a = synthesize(WorkloadSpec(**base_kw))
+    b = synthesize(WorkloadSpec(**base_kw))
+    fl = synthesize(WorkloadSpec(**base_kw, n_tenants=4, tenant_skew=1.5,
+                                 n_sessions=3, deadline_ttft=2.0,
+                                 deadline_e2e=9.0))
+    for ra, rb in zip(a, b):
+        assert np.array_equal(ra.prompt, rb.prompt)
+    for ra, rf in zip(a, fl):
+        assert np.array_equal(ra.prompt, rf.prompt)
+        assert ra.arrival == rf.arrival
+        assert (ra.temperature, ra.top_p, ra.seed) == (
+            rf.temperature, rf.top_p, rf.seed)
+        assert ra.deadline_ttft == 0.0 and ra.session is None
+        assert rf.deadline_ttft == 2.0 and rf.deadline_e2e == 9.0
+        assert rf.session is not None
+    counts = np.bincount([r.tenant for r in fl], minlength=4)
+    assert counts[0] > counts[3]     # Zipf-ish skew toward tenant 0
+
+
+# -- flags off = single-engine bit-identity ---------------------------------
+
+
+def test_fleet_flags_default_off_and_single_engine_untouched():
+    """All serving_fleet_* flags default to fleet-off values, and a lone
+    ServingEngine never consults ANY of them — so with the flags off (or
+    even on), single-engine streams and compiled programs are identical
+    to PR 10 by construction. Pinned both structurally (no fleet-flag
+    read anywhere in serving.py / the engine step path) and
+    behaviorally (streams unchanged under toggled flags)."""
+    assert GLOBAL_FLAGS.get("serving_fleet_engines") == 0
+    assert GLOBAL_FLAGS.get("serving_fleet_migration") is True
+    assert GLOBAL_FLAGS.get("serving_fleet_affinity") is True
+    assert GLOBAL_FLAGS.get("serving_fleet_retry_max") == 3
+    assert GLOBAL_FLAGS.get("serving_fleet_retry_base_delay") == 0.05
+    assert GLOBAL_FLAGS.get("serving_fleet_step_budget") == 0.0
+    assert GLOBAL_FLAGS.get("serving_fleet_fail_threshold") == 1
+    assert GLOBAL_FLAGS.get("serving_fleet_shed_backlog") == 0.0
+    assert GLOBAL_FLAGS.get("serving_fleet_tight_deadline") == 0.25
+    import inspect
+
+    import paddle_tpu.inference.serving as sv
+    assert "serving_fleet" not in inspect.getsource(sv)
+
+    rng = np.random.RandomState(6)
+    prompts = [rng.randint(1, 256, 30).astype(np.int32)
+               for _ in range(2)]
+
+    def run():
+        eng = ServingEngine(CFG, seed=0, **EKW)
+        reqs = [Request(rid=i, prompt=p.copy(), max_new_tokens=5,
+                        **(dict(temperature=0.9, top_p=0.8, seed=3)
+                           if i == 1 else {}))
+                for i, p in enumerate(prompts)]
+        eng.run(reqs)
+        return [r.out_tokens for r in reqs]
+
+    base = run()
+    try:
+        GLOBAL_FLAGS.set("serving_fleet_engines", 2)
+        GLOBAL_FLAGS.set("serving_fleet_migration", False)
+        GLOBAL_FLAGS.set("serving_fleet_step_budget", 0.5)
+        assert run() == base
+    finally:
+        GLOBAL_FLAGS.set("serving_fleet_engines", 0)
+        GLOBAL_FLAGS.set("serving_fleet_migration", True)
+        GLOBAL_FLAGS.set("serving_fleet_step_budget", 0.0)
+
+
+# -- chaos plumbing ---------------------------------------------------------
+
+
+def test_disarmed_probes_never_reach_chaos_fire():
+    """Satellite 2 pin: the serving hot paths guard every probe behind
+    chaos.active(), so the disarmed cost is one global load — fire() is
+    never even called."""
+    assert not chaos.active()
+    orig = chaos.fire
+
+    def boom(*a, **k):
+        raise AssertionError("disarmed probe called chaos.fire")
+
+    chaos.fire = boom
+    try:
+        eng = ServingEngine(CFG, seed=0, **EKW)
+        req = Request(rid=0, prompt=np.arange(1, 30, dtype=np.int32),
+                      max_new_tokens=4, arrival=0.0)
+        eng.run([req])
+        assert len(req.out_tokens) == 4
+    finally:
+        chaos.fire = orig
+
+
+def test_chaos_ctx_selector_and_per_ctx_counters():
+    """ctx targeting: a spec with engine=0 fires only for ctx engine=0,
+    and at=N counts that ctx's OWN invocations — interleaved probes from
+    other engines don't consume the schedule."""
+    chaos.arm(chaos.FaultPlan(seed=0)
+              .add("engine.step", "raise", at=1, engine=0))
+    # engine 1 hammers the point: never fires, never advances engine 0's
+    # counter
+    for _ in range(5):
+        assert chaos.fire("engine.step", ctx={"engine": 1}) is None
+    assert chaos.fire("engine.step", ctx={"engine": 0}) is None   # its #0
+    spec = chaos.fire("engine.step", ctx={"engine": 0})           # its #1
+    assert spec is not None and spec.kind == "raise"
+    assert chaos.fire("engine.step", ctx={"engine": 0}) is None   # once
