@@ -164,6 +164,7 @@ def _run_secondary_benches() -> dict:
                              ("_bench_serving", "serving_error"),
                              ("_bench_multitenant", "multitenant_error"),
                              ("_bench_fleet", "fleet_error"),
+                             ("_bench_disagg", "disagg_error"),
                              ("_bench_loss_curve", "loss_curve_error"),
                              ("_bench_13b", "gpt3_1p3b_error"),
                              ("_bench_long_ctx", "long_ctx_error"),
@@ -527,6 +528,80 @@ def _bench_fleet():
     kill_at = float(np.percentile([r.arrival for r in wl], 33))
     m = FleetDriver(router, clock="wall").run(wl, kills={kill_at: 1})
     return _fleet_keys(m)
+
+
+def _disagg_keys(m, coloc, fail):
+    """Pure mapping: (disagg-arm, colocated-arm, pool-kill-failover-arm)
+    FleetDriver metric dicts -> bench disagg_* keys
+    (tests/test_bench_contract.py pins the key set). Deltas are
+    colocated minus disagg: positive = the pool split won."""
+    return {
+        "disagg_ttft_p50": m["ttft_p50_s"],
+        "disagg_ttft_p99": m["ttft_p99_s"],
+        "disagg_goodput": m["goodput_tok_s"],
+        "disagg_shipped_pages": float(m["disagg_shipped_pages"]),
+        "colocated_ttft_p50": coloc["ttft_p50_s"],
+        "colocated_ttft_p99": coloc["ttft_p99_s"],
+        "disagg_ttft_delta_p50": round(
+            coloc["ttft_p50_s"] - m["ttft_p50_s"], 4),
+        "disagg_ttft_delta_p99": round(
+            coloc["ttft_p99_s"] - m["ttft_p99_s"], 4),
+        "disagg_degraded_steps": float(fail["degraded_steps"]),
+        "disagg_degraded_frac": fail["degraded_frac"],
+        "disagg_recovery_ms": fail["disagg_recovery_ms"],
+        "disagg_failover_ttft_p99": fail["ttft_p99_s"],
+    }
+
+
+def _bench_disagg():
+    """Disaggregated serving (inference/fleet/ pool split, ISSUE 12),
+    three arms on the same prefill-heavy workload: (1) 1 prefill + 1
+    decode engine — the TTFT benefit of interference-free prefill; (2)
+    the same 2 engines colocated — the baseline; (3) the disagg split
+    with the whole prefill pool killed mid-run — degraded colocated
+    failover cost, then a fresh prefill engine joins post-drain so the
+    kill -> re-split recovery time is measured."""
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.inference.fleet import FleetRouter
+    from paddle_tpu.inference.loadgen import (FleetDriver, WorkloadSpec,
+                                              synthesize)
+    from paddle_tpu.inference.serving import Request
+
+    cfg = LlamaConfig(vocab_size=32000, hidden=2048, n_layers=16,
+                      n_heads=16, n_kv_heads=4, ffn_hidden=5504,
+                      max_seq_len=2048, dtype=jnp.bfloat16)
+    ekw = dict(max_batch=8, page_size=128, max_seq=1536,
+               prefill_budget=512)
+    spec = dict(
+        n_requests=48, seed=7, vocab_size=cfg.vocab_size,
+        process="poisson", rate=30.0, prefix_len=512, n_prefixes=1,
+        shared_frac=0.9, tail_log_mean=5.3, tail_log_sigma=0.6,
+        tail_min=32, tail_max=512, new_min=96, new_max=192,
+        max_seq=1536, prefill_heavy_frac=0.5, prefill_heavy_len=256)
+
+    def arm(disagg_prefill, kills=None, join_after=False):
+        router = FleetRouter(cfg, n_engines=2, seed=0,
+                             engine_kwargs=dict(ekw),
+                             disagg_prefill=disagg_prefill)
+        for i, rep in enumerate(router.replicas):
+            rep.engine.run([Request(rid=-1 - i,
+                                    prompt=np.ones(640, np.int32),
+                                    max_new_tokens=2, arrival=0.0)])
+        wl = synthesize(WorkloadSpec(**spec))
+        m = FleetDriver(router, clock="wall").run(wl, kills=kills)
+        if join_after:
+            # recovery: a fresh prefill engine joins, the next census
+            # re-splits and closes the degraded episode timer
+            router.add_engine(role="prefill", engine_kwargs=dict(ekw))
+            router.step(now=1e18)
+            m.update(router.fleet_stats())
+        return m, wl
+
+    m_disagg, wl = arm(1)
+    m_coloc, _ = arm(0)
+    kill_at = float(np.percentile([r.arrival for r in wl], 33))
+    m_fail, _ = arm(1, kills={kill_at: "pool:prefill"}, join_after=True)
+    return _disagg_keys(m_disagg, m_coloc, m_fail)
 
 
 def _bench_loss_curve():

@@ -288,6 +288,27 @@ define_flag("serving_fleet_tight_deadline", 0.25,
             "routes to the least-loaded replica (deadline-aware "
             "routing).")
 
+# -- disaggregated prefill/decode pools (inference/fleet/; consulted
+#    only by FleetRouter — serving_disagg_prefill=0 means no pool split
+#    and the fleet is bit-identical to the PR 11 colocated layout,
+#    pinned in tests/test_disagg.py) --------------------------------------
+define_flag("serving_disagg_prefill", 0,
+            "Replica count the FleetRouter assigns to the prefill pool "
+            "(the first N replicas; the rest form the decode pool). "
+            "0 (default) = no disaggregation: every replica serves "
+            "both phases exactly as in PR 11. Prefill-pool engines run "
+            "chunked prefill + first-token emission only, export full "
+            "KV pages over the migration wire, and never hold a decode "
+            "row; shipment retries ride serving_fleet_retry_max / "
+            "serving_fleet_retry_base_delay.")
+define_flag("serving_disagg_ship_deadline", 0.0,
+            "Per-shipment wall-clock deadline (seconds) for the "
+            "prefill->decode page handoff, measured from export. A "
+            "shipment past its deadline stops retrying and the request "
+            "falls back to colocated serving (re-prefill through the "
+            "prefix cache — same stream, more FLOPs). 0 (default) = "
+            "no deadline; only retry exhaustion triggers fallback.")
+
 define_flag("dist_allreduce_quant", False,
             "EQuARX-style int8 gradient all-reduce for the dp gradient "
             "sync: per-rank-chunk symmetric int8 with fp32 scales on the "

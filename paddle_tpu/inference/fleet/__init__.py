@@ -8,12 +8,23 @@ loss, KV page migration from the dead replica's still-readable pool
 into a survivor's prefix cache, and graceful degradation (shed
 lowest-priority never-accepted load when capacity shrinks).
 
+Disaggregated pools (``serving_disagg_prefill`` > 0): the router
+splits the replicas into a prefill pool (engines in ``prefill_only``
+mode: chunked prefill + first-token emission, full pages exported over
+the migration wire, no decode residency) and a decode pool that adopts
+the shipped pages through the prefix cache and decodes from the first
+generated token. Pool death (every engine of a role dead, or shipments
+exhausting retries) degrades the fleet to colocated serving — every
+survivor serves both phases, streams complete bit-identically — and a
+recovered role re-splits automatically.
+
 The whole layer is host-side policy over unchanged engines: a lone
-``ServingEngine`` never touches this package, so ``serving_fleet_*``
-flags off is bit-identical single-engine behavior by construction.
+``ServingEngine`` never touches this package, so ``serving_fleet_*`` /
+``serving_disagg_*`` flags off is bit-identical single-engine behavior
+by construction.
 """
 
-from .migration import ship_pages
+from .migration import ship_pages, ship_shipment
 from .router import FleetRouter
 
-__all__ = ["FleetRouter", "ship_pages"]
+__all__ = ["FleetRouter", "ship_pages", "ship_shipment"]

@@ -17,11 +17,13 @@ sampling stream.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from ...testing import chaos as _chaos
 
-__all__ = ["ship_pages"]
+__all__ = ["ship_pages", "ship_shipment"]
 
 
 def ship_pages(donor, target, rid: int) -> dict:
@@ -48,6 +50,48 @@ def ship_pages(donor, target, rid: int) -> dict:
                 k = np.ascontiguousarray(shipment["k"])
                 k.view(np.uint8).reshape(-1)[0] ^= 0xFF
                 shipment["k"] = k
+    n = target.adopt_pages(shipment)
+    if n == 0:
+        return {"status": "rejected", "pages": 0, "bytes": 0}
+    return {"status": "ok", "pages": n, "bytes": nbytes}
+
+
+def ship_shipment(shipment: dict, donor_id: int, target,
+                  donor_pool: str = None) -> dict:
+    """Ship an *already exported* shipment to ``target`` — the
+    disaggregated prefill->decode handoff, where the donor exported at
+    prefill completion and released the slot, so it may hold nothing
+    for this rid by delivery time (or be dead). Same wire semantics and
+    ``migration.ship`` chaos point as :func:`ship_pages`, plus the
+    ``stall`` kind (sleep ``seconds`` on the wire before delivering —
+    the router's per-shipment deadline decides whether the late pages
+    still count) and a ``pool`` ctx tag when the donor had a pool role.
+
+    Redelivery-safe: a shipment whose every page hash is already
+    resident in the target's prefix cache is a zero-byte success
+    (status ``ok``, 0 pages) — a retried delivery after a late-but-
+    landed first attempt must not read as an adopter refusal."""
+    if shipment is None:
+        # zero-full-page export: the donor had nothing shippable (short
+        # prompt under one page) — a well-formed no-op, not an error
+        return {"status": "nothing", "pages": 0, "bytes": 0}
+    nbytes = target.shipment_bytes(shipment)
+    if _chaos.active():
+        ctx = {"engine": donor_id}
+        if donor_pool is not None:
+            ctx["pool"] = donor_pool
+        spec = _chaos.fire("migration.ship", ctx=ctx)
+        if spec is not None:
+            if spec.kind == "drop":
+                return {"status": "dropped", "pages": 0, "bytes": 0}
+            if spec.kind == "stall":
+                time.sleep(float(spec.args.get("seconds", 0.05)))
+            if spec.kind == "corrupt":
+                k = np.ascontiguousarray(shipment["k"])
+                k.view(np.uint8).reshape(-1)[0] ^= 0xFF
+                shipment["k"] = k
+    if all(h in target.pool.cache for h in shipment["hashes"]):
+        return {"status": "ok", "pages": 0, "bytes": 0}
     n = target.adopt_pages(shipment)
     if n == 0:
         return {"status": "rejected", "pages": 0, "bytes": 0}

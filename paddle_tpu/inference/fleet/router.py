@@ -32,6 +32,27 @@ re-enter through the normal submit path: the engine re-prefills prompt
 keyed (seed, position) sampling makes the resumed stream bit-identical
 to an uninterrupted run. Placement failures go to a retry queue with
 deterministic exponential backoff up to ``serving_fleet_retry_max``.
+
+Disaggregated pools (``serving_disagg_prefill`` > 0, DistServe/
+Mooncake): the first N replicas form the *prefill pool* (engines in
+``prefill_only`` mode — chunked prefill + first-token emission, then
+the prompt's full pages land in the engine ``outbox`` and the slot is
+released), the rest the *decode pool*. The router drains outboxes into
+*ship jobs* that ride the same deterministic-exponential retry queue
+as placement retries (plus a per-shipment wall-clock deadline,
+``serving_disagg_ship_deadline``), delivers pages over the crc'd
+migration wire into a decode engine's prefix cache, and re-submits the
+request there — the decode engine re-prefills exactly the unshipped
+tail and the stream continues bit-identically (same resume mechanism
+as preemption/engine loss). Failure is never fatal: a shipment that
+exhausts its retries or deadline falls back to colocated serving
+(submit anywhere alive, re-prefill does the work), and *pool death*
+(every engine of a role dead, or a shipment exhausting retries) flips
+the fleet to **degraded colocated mode** — every survivor serves both
+phases like a plain PR 11 fleet, ``degraded_steps`` counts the ticks —
+until both roles have a live engine again and the router re-splits
+automatically (``n_resplit``; mid-decode residents of re-promoted
+prefill engines are swept back out through their outboxes).
 """
 
 from __future__ import annotations
@@ -43,7 +64,7 @@ import numpy as np
 
 from ...core.flags import GLOBAL_FLAGS
 from ..serving import Request, ServingEngine
-from .migration import ship_pages
+from .migration import ship_pages, ship_shipment
 
 __all__ = ["FleetRouter"]
 
@@ -57,6 +78,7 @@ class _Replica:
         self.failures = 0          # consecutive step exceptions
         self.last_step_s = 0.0
         self.last_error: Optional[str] = None
+        self.role: Optional[str] = None   # "prefill"/"decode" when disagg
 
     def load_tokens(self) -> int:
         """Outstanding work in token units: queued prompt+decode plus
@@ -83,7 +105,9 @@ class FleetRouter:
                  step_budget: Optional[float] = None,
                  fail_threshold: Optional[int] = None,
                  shed_backlog: Optional[float] = None,
-                 tight_deadline: Optional[float] = None):
+                 tight_deadline: Optional[float] = None,
+                 disagg_prefill: Optional[int] = None,
+                 ship_deadline: Optional[float] = None):
         if engines is None:
             if n_engines is None:
                 n_engines = int(GLOBAL_FLAGS.get("serving_fleet_engines"))
@@ -125,9 +149,35 @@ class FleetRouter:
         self.tight_deadline = float(
             g("serving_fleet_tight_deadline")
             if tight_deadline is None else tight_deadline)
+        # disaggregated pools: the first disagg_prefill replicas become
+        # the prefill pool (prefill_only engines), the rest the decode
+        # pool. 0 = no split, bit-identical PR 11 colocated fleet.
+        dp = int(g("serving_disagg_prefill")
+                 if disagg_prefill is None else disagg_prefill)
+        self.ship_deadline = float(
+            g("serving_disagg_ship_deadline")
+            if ship_deadline is None else ship_deadline)
+        if dp >= len(self.replicas):
+            raise ValueError(
+                f"serving_disagg_prefill={dp} leaves no decode engine "
+                f"(fleet has {len(self.replicas)} replicas)")
+        self.disagg = dp > 0
+        self.degraded = False
+        self._degraded_t0 = 0.0
+        self._degraded_ms: list[float] = []
+        if self.disagg:
+            for i, rep in enumerate(self.replicas):
+                rep.role = "prefill" if i < dp else "decode"
+                rep.engine.pool_role = rep.role
+                rep.engine.prefill_only = rep.role == "prefill"
+        # rids whose prefill phase is done (shipped or fallen back):
+        # placement routes them to the decode pool from here on
+        self._decode_phase: set[int] = set()
         self._owner: dict[int, _Replica] = {}      # rid -> placement
         self._requests: dict[int, Request] = {}
-        # retry entries: [ready_monotonic, attempt, request]
+        # retry entries: [ready_monotonic, attempt, request, ship_job]
+        # (ship_job None = placement retry; else a dict — see
+        # _drain_outboxes — riding the same deterministic backoff)
         self._retry: list[list] = []
         self._sessions: dict = {}                   # session -> engine_id
         # accepted victims awaiting their first post-kill token:
@@ -140,6 +190,10 @@ class FleetRouter:
             "migration_dropped": 0, "migration_rejected": 0,
             "migration_failed": 0, "n_shed": 0, "n_retry_exhausted": 0,
             "n_deadline_dropped": 0,
+            # disaggregated-pool counters (all zero when disagg off)
+            "disagg_shipped_pages": 0, "disagg_ship_bytes": 0,
+            "degraded_steps": 0, "n_resplit": 0,
+            "n_ship_retries": 0, "n_ship_deadline": 0,
         }
 
     # -- registration broadcast ------------------------------------------
@@ -176,10 +230,27 @@ class FleetRouter:
             n += 1
         return n * e.bs
 
-    def _choose(self, req: Request, now: float) -> Optional[_Replica]:
+    def _role_for(self, req: Request) -> Optional[str]:
+        """Which pool this request belongs to right now. None = any
+        engine (disagg off, or degraded colocated mode)."""
+        if not self.disagg or self.degraded:
+            return None
+        if req.rid in self._decode_phase or req.out_tokens:
+            return "decode"
+        return "prefill"
+
+    def _choose(self, req: Request, now: float,
+                role: Optional[str] = None) -> Optional[_Replica]:
         alive = self._alive()
         if not alive:
             return None
+        if role is not None:
+            # pool-scoped placement; an empty pool falls back to any
+            # live engine (that IS colocated degradation — the census
+            # flips the degraded flag on the next fleet tick)
+            pool = [r for r in alive if r.role == role]
+            if pool:
+                alive = pool
         rem_ttft = None
         if req.deadline_ttft > 0 and req.t_first is None:
             rem_ttft = (req.arrival + req.deadline_ttft) - now
@@ -218,7 +289,7 @@ class FleetRouter:
         if self._expired(req, now):
             self._drop(req, "n_deadline_dropped")
             return True                     # handled, don't retry
-        rep = self._choose(req, now)
+        rep = self._choose(req, now, self._role_for(req))
         if rep is None:
             return False
         rep.engine.submit(req)
@@ -231,6 +302,7 @@ class FleetRouter:
         req.aborted = True
         req.t_done = time.monotonic()
         self._owner.pop(req.rid, None)
+        self._decode_phase.discard(req.rid)
         self.stats[counter] += 1
 
     def _queue_retry(self, req: Request, attempt: int) -> None:
@@ -242,7 +314,7 @@ class FleetRouter:
             return
         delay = (0.0 if attempt == 0
                  else self.retry_base_delay * (2.0 ** (attempt - 1)))
-        self._retry.append([time.monotonic() + delay, attempt, req])
+        self._retry.append([time.monotonic() + delay, attempt, req, None])
 
     def submit(self, req: Request, now: float = 0.0) -> None:
         self._requests[req.rid] = req
@@ -257,27 +329,44 @@ class FleetRouter:
                             if e[0].rid != rid]
         rep = self._owner.pop(rid, None)
         if rep is not None and rep.engine.abort(rid):
+            self._decode_phase.discard(rid)
             return True
-        for i, (_rdy, _att, req) in enumerate(self._retry):
+        for i, (_rdy, _att, req, _job) in enumerate(self._retry):
             if req.rid == rid:
                 self._retry.pop(i)
                 req.aborted = True
                 req.t_done = time.monotonic()
+                self._decode_phase.discard(rid)
                 return True
+        for rep2 in self.replicas:      # swept into an engine outbox,
+            for i, (req, _sh) in enumerate(rep2.engine.outbox):  # not yet
+                if req.rid == rid:                       # picked up
+                    rep2.engine.outbox.pop(i)
+                    req.aborted = True
+                    req.t_done = time.monotonic()
+                    self._decode_phase.discard(rid)
+                    return True
         return False
 
     # -- stepping + health ------------------------------------------------
 
     def step(self, now: float = 0.0) -> bool:
-        """One fleet tick: drain ready retries, step every live engine
-        (exceptions/hangs -> death + recovery), track stream
-        recoveries. Returns True while any work remains anywhere."""
+        """One fleet tick: pool-role census (enter/leave degraded
+        colocated mode), drain ready retries (placement + ship jobs),
+        step every live engine (exceptions/hangs -> death + recovery),
+        drain prefill outboxes into ship jobs, track stream recoveries.
+        Returns True while any work remains anywhere."""
+        if self.disagg:
+            self._roles_census(now)
         if self._retry:
             t = time.monotonic()
             ready = [e for e in self._retry if e[0] <= t]
             self._retry = [e for e in self._retry if e[0] > t]
-            for _rdy, attempt, req in ready:
+            for _rdy, attempt, req, job in ready:
                 if req.aborted:
+                    continue
+                if job is not None:
+                    self._attempt_ship(job, attempt, now)
                     continue
                 try:
                     placed = self._place(req, now)
@@ -311,6 +400,12 @@ class FleetRouter:
                 busy = True
                 continue
             busy = busy or more
+        if self.disagg:
+            busy = self._drain_outboxes(now) or busy
+            if self.degraded:
+                # counted at tick end so a same-tick enter (shipment
+                # exhaustion during the drain above) registers
+                self.stats["degraded_steps"] += 1
         if self._recovering:
             t = time.monotonic()
             still = []
@@ -336,6 +431,190 @@ class FleetRouter:
                 return
         raise ValueError(f"no live replica with engine_id {engine_id}")
 
+    def kill_pool(self, role: str, now: float = 0.0) -> None:
+        """Kill every live engine of a pool role (bench/smoke hook for
+        pool death; chaos pool-scoped ``engine.step`` specs exercise
+        the same outcome through the fault path)."""
+        for rep in [r for r in self._alive() if r.role == role]:
+            rep.last_error = f"killed ({role} pool)"
+            self._declare_dead(rep, now)
+
+    def add_engine(self, engine: Optional[ServingEngine] = None,
+                   role: Optional[str] = None,
+                   engine_kwargs: Optional[dict] = None,
+                   seed: int = 0) -> int:
+        """Join a fresh replica (recovery path — death is permanent, a
+        new engine is a new replica). Built engines share replica 0's
+        params dict, keeping migration/shipment page bytes
+        exchangeable. In disagg mode the new replica takes ``role`` (or
+        the thinner live pool); if the fleet is degraded it serves
+        colocated until the next census re-splits. Returns the new
+        engine_id."""
+        eid = 1 + max(r.engine.engine_id for r in self.replicas)
+        if engine is None:
+            ref = self.replicas[0].engine
+            engine = ServingEngine(ref.cfg, params=ref.params, seed=seed,
+                                   engine_id=eid,
+                                   **dict(engine_kwargs or {}))
+        rep = _Replica(engine)
+        if self.disagg:
+            alive = self._alive()
+            n_pre = sum(1 for r in alive if r.role == "prefill")
+            n_dec = sum(1 for r in alive if r.role == "decode")
+            rep.role = role or ("prefill" if n_pre <= n_dec else "decode")
+            engine.pool_role = rep.role
+            engine.prefill_only = (rep.role == "prefill"
+                                   and not self.degraded)
+        self.replicas.append(rep)
+        if len({r.engine.engine_id for r in self.replicas}) \
+                != len(self.replicas):
+            raise ValueError("replica engine_ids must be unique")
+        return engine.engine_id
+
+    # -- disaggregated pools: census, shipping, degraded mode -------------
+
+    def _roles_census(self, now: float) -> None:
+        """Enter degraded colocated mode when a pool role has no live
+        engine; re-split as soon as both roles are live again AND no
+        ship job is still in flight (a pending shipment finishing under
+        the colocated regime keeps its simple fallback semantics)."""
+        roles = {r.role for r in self._alive()}
+        whole = "prefill" in roles and "decode" in roles
+        if not self.degraded and not whole:
+            self._set_degraded()
+        elif self.degraded and whole and not any(
+                e[3] is not None for e in self._retry):
+            self._resplit()
+
+    def _set_degraded(self) -> None:
+        """Pool death -> colocated: every survivor serves both phases
+        (prefill_only off), placement stops filtering by role."""
+        self.degraded = True
+        self._degraded_t0 = time.monotonic()
+        for rep in self._alive():
+            rep.engine.prefill_only = False
+
+    def _resplit(self) -> None:
+        """Both roles live again: restore the pool split. Mid-decode
+        residents of engines returning to the prefill role are swept
+        out through their outboxes on their next step and ship to the
+        decode pool — the same bit-identical resume as a first
+        handoff."""
+        self.degraded = False
+        self._degraded_ms.append(
+            (time.monotonic() - self._degraded_t0) * 1000.0)
+        self.stats["n_resplit"] += 1
+        for rep in self._alive():
+            if rep.role == "prefill":
+                rep.engine.prefill_only = True
+
+    def _drain_outboxes(self, now: float) -> bool:
+        """Pick up (request, shipment) pairs the prefill engines swept
+        out and attempt delivery to the decode pool. Returns True if
+        anything was processed (the driver must keep ticking)."""
+        any_work = False
+        for rep in self.replicas:
+            if not rep.alive or not rep.engine.outbox:
+                continue
+            jobs, rep.engine.outbox = rep.engine.outbox, []
+            for req, shipment in jobs:
+                if (req.aborted
+                        or len(req.out_tokens) >= req.max_new_tokens):
+                    continue        # cancelled / completed at prefill
+                any_work = True
+                if self._owner.get(req.rid) is rep:
+                    del self._owner[req.rid]
+                job = {"req": req, "shipment": shipment,
+                       "donor": rep.engine.engine_id, "pool": rep.role,
+                       "t0": time.monotonic()}
+                self._attempt_ship(job, 0, now)
+        return any_work
+
+    def _attempt_ship(self, job: dict, attempt: int, now: float) -> None:
+        """One delivery attempt of a prefill->decode handoff. Wire or
+        adopter failure (and a delivery landing past the per-shipment
+        deadline) re-queues on the deterministic backoff; exhaustion
+        falls back to colocated serving — the request is never
+        dropped."""
+        req = job["req"]
+        if req.aborted:
+            return
+        if self._expired(req, now):
+            self._drop(req, "n_deadline_dropped")
+            return
+        target = self._choose(req, now, role="decode")
+        if target is None:          # nothing alive anywhere right now
+            self._queue_ship_retry(job, attempt + 1, now)
+            return
+        res = {"status": "ok", "pages": 0, "bytes": 0}
+        if job["shipment"] is not None and self.migration:
+            res = ship_shipment(job["shipment"], job["donor"],
+                                target.engine, donor_pool=job["pool"])
+        late = (self.ship_deadline > 0
+                and time.monotonic() - job["t0"] > self.ship_deadline)
+        if res["status"] in ("dropped", "rejected", "failed") or late:
+            if res["status"] in ("dropped", "rejected", "failed"):
+                self.stats["migration_" + res["status"]] += 1
+            self.stats["n_ship_retries"] += 1
+            self._queue_ship_retry(job, attempt + 1, now)
+            return
+        self.stats["disagg_shipped_pages"] += res["pages"]
+        self.stats["disagg_ship_bytes"] += res["bytes"]
+        self._deliver(req, target)
+
+    def _queue_ship_retry(self, job: dict, attempt: int,
+                          now: float) -> None:
+        """Backoff for ship jobs — same deterministic exponential ladder
+        as placement retries. Exhaustion (attempts past
+        ``serving_fleet_retry_max``, or the shipment past its
+        ``serving_disagg_ship_deadline``) is the second pool-death
+        signal: degrade to colocated and deliver by re-prefill."""
+        req = job["req"]
+        expired = (self.ship_deadline > 0
+                   and time.monotonic() - job["t0"] > self.ship_deadline)
+        if attempt > self.retry_max or expired:
+            if expired:
+                self.stats["n_ship_deadline"] += 1
+            self.stats["n_retry_exhausted"] += 1
+            self._decode_phase.add(req.rid)
+            if self.disagg and not self.degraded:
+                self._set_degraded()
+            self._deliver_fallback(req, now)
+            return
+        delay = (0.0 if attempt == 0
+                 else self.retry_base_delay * (2.0 ** (attempt - 1)))
+        self._retry.append([time.monotonic() + delay, attempt, req, job])
+
+    def _deliver(self, req: Request, target: _Replica) -> None:
+        """Re-submit the request on the decode target: it re-prefills
+        prompt + emitted history through the just-adopted pages and the
+        stream continues bit-identically from the first generated
+        token."""
+        try:
+            target.engine.submit(req)
+        except ValueError:
+            self._drop(req, "n_shed")   # can never fit on this fleet
+            return
+        self._owner[req.rid] = target
+        self._decode_phase.add(req.rid)
+        if self.affinity and req.session is not None:
+            self._sessions[req.session] = target.engine.engine_id
+
+    def _deliver_fallback(self, req: Request, now: float) -> None:
+        """Colocated fallback after shipment exhaustion: submit to any
+        live engine (no pages shipped — re-prefill through whatever the
+        prefix cache holds does the work; the stream is identical, the
+        cost is FLOPs). No live engine at all -> placement retry
+        queue."""
+        if self._expired(req, now):
+            self._drop(req, "n_deadline_dropped")
+            return
+        target = self._choose(req, now)
+        if target is None:
+            self._queue_retry(req, 0)
+            return
+        self._deliver(req, target)
+
     # -- death + recovery -------------------------------------------------
 
     def _declare_dead(self, rep: _Replica, now: float) -> None:
@@ -348,14 +627,29 @@ class FleetRouter:
         queued = [r for r in e.queue
                   if not r.aborted
                   and len(r.out_tokens) < r.max_new_tokens]
+        # shipments exported but not yet picked up die with the donor
+        # (the payload is the donor's host memory): those requests are
+        # accepted streams — recover them by plain re-admission, the
+        # decode-pool re-prefill rebuilds what the lost pages held
+        shipped = [r for r, _sh in e.outbox
+                   if not r.aborted
+                   and len(r.out_tokens) < r.max_new_tokens]
+        e.outbox = []
+        for r in shipped:
+            self._decode_phase.add(r.rid)
         for _s, r in resident:
             if r.out_tokens:       # an accepted stream: time its resume
                 self._recovering.append([r, len(r.out_tokens),
                                          time.monotonic()])
-        for rid in [r.rid for _s, r in resident] + [r.rid for r in queued]:
+        for r in shipped:
+            self._recovering.append([r, len(r.out_tokens),
+                                     time.monotonic()])
+        for rid in ([r.rid for _s, r in resident]
+                    + [r.rid for r in queued]
+                    + [r.rid for r in shipped]):
             if self._owner.get(rid) is rep:
                 del self._owner[rid]
-        victims = ([r for _s, r in resident]
+        victims = ([r for _s, r in resident] + shipped
                    + sorted(queued, key=lambda r: (-r.priority, r.arrival)))
         victims = self._shed_for_pressure(victims, now)
         for req in victims:
@@ -363,7 +657,10 @@ class FleetRouter:
             if self._expired(req, now):
                 self._drop(req, "n_deadline_dropped")
                 continue
-            target = self._choose(req, now)
+            if self.disagg and req.out_tokens:
+                # an accepted stream is decode-phase wherever it died
+                self._decode_phase.add(req.rid)
+            target = self._choose(req, now, self._role_for(req))
             if target is None:
                 self._queue_retry(req, 0)
                 continue
@@ -413,7 +710,7 @@ class FleetRouter:
             for r in rep.engine.queue:
                 if r.t_first is None and not r.out_tokens:
                     backlog.append((r, rep))
-        for _rdy, _att, r in self._retry:
+        for _rdy, _att, r, _job in self._retry:
             if (r.t_first is None and not r.out_tokens
                     and not r.aborted):
                 backlog.append((r, None))
@@ -448,6 +745,7 @@ class FleetRouter:
             e = rep.engine
             out.append({
                 "engine": e.engine_id, "alive": rep.alive,
+                "role": rep.role,
                 "failures": rep.failures,
                 "last_step_ms": round(rep.last_step_s * 1000.0, 3),
                 "last_error": rep.last_error,
@@ -472,9 +770,17 @@ class FleetRouter:
 
     def fleet_stats(self) -> dict:
         rms = self._recovery_ms
+        dms = self._degraded_ms
         return {
             "fleet_n_engines": len(self.replicas),
             "fleet_n_alive": len(self._alive()),
+            "fleet_n_prefill": sum(1 for r in self._alive()
+                                   if r.role == "prefill"),
+            "fleet_n_decode": sum(1 for r in self._alive()
+                                  if r.role == "decode"),
+            "disagg_degraded": 1 if self.degraded else 0,
+            # longest completed degraded episode, kill -> re-split
+            "disagg_recovery_ms": round(max(dms), 3) if dms else 0.0,
             "recovery_ms_max": round(max(rms), 3) if rms else 0.0,
             "recovery_ms_mean": round(sum(rms) / len(rms), 3)
             if rms else 0.0,

@@ -22,7 +22,9 @@ Abort injection: ``aborts`` maps a wall/step threshold to a rid; the
 driver fires each abort the first step after its threshold passes,
 exercising mid-flight teardown under load. ``FleetDriver`` adds
 ``kills`` with the same threshold semantics mapping to an engine id —
-mid-run replica loss.
+mid-run replica loss — or to the string ``"pool:<role>"``, which kills
+every live engine of a disaggregated pool role at once (pool death;
+the router degrades to colocated serving).
 
 Deadlines: a request carrying ``deadline_ttft``/``deadline_e2e`` (> 0,
 seconds from arrival) is aborted the first step after its budget lapses
@@ -150,9 +152,10 @@ class FleetDriver:
 
     def run(self, requests, aborts: Optional[dict] = None,
             kills: Optional[dict] = None, max_steps: int = 0) -> dict:
-        """``kills``: {threshold: engine_id} with abort threshold
-        semantics — the replica is killed (router recovery path) the
-        first step after the threshold passes."""
+        """``kills``: {threshold: engine_id | "pool:<role>"} with abort
+        threshold semantics — the replica (or every live replica of the
+        named disaggregated pool role) is killed (router recovery path)
+        the first step after the threshold passes."""
         router = self.router
         for rep in router.replicas:
             rep.engine.stats = {k: 0 for k in rep.engine.stats}
@@ -177,7 +180,11 @@ class FleetDriver:
             while pending and pending[0][0] <= gate:
                 router.abort(pending.pop(0)[1])
             while pending_kills and pending_kills[0][0] <= gate:
-                router.kill_engine(pending_kills.pop(0)[1], now=now)
+                tgt = pending_kills.pop(0)[1]
+                if isinstance(tgt, str) and tgt.startswith("pool:"):
+                    router.kill_pool(tgt[len("pool:"):], now=now)
+                else:
+                    router.kill_engine(tgt, now=now)
             if deadlined:
                 n_deadline += _sweep_deadlines(deadlined, router.abort,
                                                now)
@@ -208,6 +215,10 @@ class FleetDriver:
         _rebase_times(requests, t0)
         out = summarize_fleet(requests, router, wall)
         out["steps"] = steps
+        # fraction of fleet ticks spent in degraded colocated mode
+        # (0.0 when disagg off or no pool ever died)
+        out["degraded_frac"] = round(
+            router.stats["degraded_steps"] / max(1, steps), 3)
         out["n_deadline_expired"] = n_deadline
         out["deadline_miss_rate"] = round(
             (n_deadline + router.stats["n_deadline_dropped"])

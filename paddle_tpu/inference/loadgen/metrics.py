@@ -97,7 +97,11 @@ def summarize_fleet(requests, router, wall_s: float) -> dict:
     whole request set, the step/occupancy ledger summed across every
     replica (dead ones included — their pre-kill work happened), plus
     the router's own counters (kills, migrated pages/bytes, recovery
-    latency, shed/retry/deadline drops)."""
+    latency, shed/retry/deadline drops; under disaggregated pools also
+    shipped pages/bytes, pool census, degraded-mode ticks and the
+    longest degraded episode — ``disagg_recovery_ms``). TTFT under
+    disaggregation is measured at the *prefill* engine's first-token
+    emission, which is exactly the pool split's claimed benefit."""
     st: dict = {}
     hits = misses = 0
     for rep in router.replicas:

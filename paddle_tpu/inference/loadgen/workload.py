@@ -76,6 +76,15 @@ class WorkloadSpec:
     deadline_e2e: float = 0.0
     tenant_skew: float = 0.0
     n_sessions: int = 0
+    # disaggregation knob (inference/fleet/ pool split): a FOURTH
+    # stream, same convention — earlier streams stay byte-identical.
+    # prefill_heavy_frac > 0 re-shapes that fraction of requests into
+    # the long-prompt/short-output mix where prefill/decode
+    # interference is worst (the DistServe argument): the prompt is
+    # extended by prefill_heavy_len fresh tokens and the output clamped
+    # to new_min.
+    prefill_heavy_frac: float = 0.0
+    prefill_heavy_len: int = 256
 
 
 def synthesize(spec: WorkloadSpec) -> list[Request]:
@@ -152,4 +161,22 @@ def synthesize(spec: WorkloadSpec) -> list[Request]:
                 r.tenant = int(rng3.choice(spec.n_tenants, p=w))
             if spec.n_sessions:
                 r.session = "sess%d" % rng3.randint(spec.n_sessions)
+    if spec.prefill_heavy_frac:
+        # disaggregation decoration, fourth stream: earlier draws
+        # untouched; clamping respects max_seq like the legacy path
+        rng4 = np.random.RandomState((spec.seed + 0xD15A6) % (1 << 32))
+        for r in reqs:
+            if rng4.rand() >= spec.prefill_heavy_frac:
+                continue
+            extra = rng4.randint(1, spec.vocab_size,
+                                 size=spec.prefill_heavy_len)
+            r.prompt = np.concatenate(
+                [np.asarray(r.prompt, np.int32),
+                 extra.astype(np.int32)])
+            r.max_new_tokens = max(1, min(r.max_new_tokens,
+                                          spec.new_min))
+            if spec.max_seq is not None:
+                over = len(r.prompt) + r.max_new_tokens - spec.max_seq
+                if over > 0:
+                    r.prompt = r.prompt[:len(r.prompt) - over]
     return reqs

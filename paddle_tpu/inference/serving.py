@@ -260,7 +260,8 @@ class ServingEngine:
                  lora_slots: int = 4,
                  priorities: Optional[bool] = None,
                  constrained: Optional[bool] = None,
-                 engine_id: int = 0):
+                 engine_id: int = 0,
+                 prefill_only: bool = False):
         if decode_quantum is not None:
             # the unified step (PR 7) has no decode-quantum boundary;
             # the kwarg was previously swallowed silently
@@ -274,6 +275,18 @@ class ServingEngine:
         # targets chaos specs (fire(..., ctx={"engine": id})); a lone
         # engine keeps the default 0 and never consults it otherwise
         self.engine_id = int(engine_id)
+        # disaggregated pool role (inference/fleet/): a prefill-only
+        # engine runs chunked prefill through first-token emission,
+        # exports the prompt's full KV pages into ``outbox`` for the
+        # router to ship, and releases the slot immediately — it never
+        # dispatches a decode row. Router-assigned (ctor kwarg or
+        # attribute flip for degraded/re-split transitions), never a
+        # flag read here: a lone engine keeps the defaults and is
+        # bit-identical by construction. ``pool_role`` additionally
+        # tags chaos probes so faults can target one pool.
+        self.prefill_only = bool(prefill_only)
+        self.pool_role: Optional[str] = None
+        self.outbox: list = []  # (request, shipment | None), router-drained
         self.cfg = cfg
         self.params = params if params is not None else init_llama_params(
             cfg, jax.random.PRNGKey(seed))
@@ -1026,7 +1039,10 @@ class ServingEngine:
         so the router's step-budget watchdog catches the stall). Kept
         out of line so the disarmed ``step()`` cost is exactly the
         ``chaos.active()`` global load."""
-        spec = _chaos.fire("engine.step", ctx={"engine": self.engine_id})
+        ctx = {"engine": self.engine_id}
+        if self.pool_role is not None:
+            ctx["pool"] = self.pool_role
+        spec = _chaos.fire("engine.step", ctx=ctx)
         if spec is None:
             return
         if spec.kind == "hang":
@@ -1078,6 +1094,8 @@ class ServingEngine:
                 self._harvest(self._inflight)
         elif prev is not None:
             self._harvest(prev)
+        if self.prefill_only:
+            self._export_completed()
         if self._inflight is None and (self._deferred_free
                                        or self.pool.pending_evict):
             # nothing in flight: deferred/pending pages can only be
@@ -1105,6 +1123,50 @@ class ServingEngine:
         return (self._inflight is not None or bool(self.queue)
                 or any(s is not None for s in self.slots))
 
+    def _export_completed(self) -> None:
+        """Prefill-only sweep (runs post-harvest): a resident slot that
+        is past its prefill flip with its first token landed is done
+        HERE — export the prompt's full pages (the shipment the router
+        hands to a decode engine; None when the prompt spans less than
+        one full page and re-prefill is the whole handoff), queue the
+        request on ``outbox``, and release the slot immediately. No
+        decode residency: pages settle through the deferred-free path
+        exactly like a predictive release, so an in-flight program that
+        still references them keeps them pinned for one harvest cycle.
+        The decode engine re-admits with effective prompt = prompt +
+        out_tokens, its cache lookup covers exactly the shipped pages,
+        and the tail re-prefills — the same resume path preemption and
+        engine loss already use, hence bit-identical streams. Also the
+        re-split path: a mid-decode resident on an engine returning to
+        the prefill role is swept out the same way and resumes on a
+        decode engine. A slot the CURRENT in-flight program references
+        is never swept: a resumed request (history in out_tokens) would
+        otherwise export before its prefill-final emission is
+        harvested, and the snapshot append plus the re-admission's
+        re-emission would duplicate that token in the stream."""
+        inflight = ({s for _i, s, _r, _k, _m, _d in self._inflight[1]}
+                    if self._inflight is not None else set())
+        for s in range(self.B):
+            req = self.slots[s]
+            if (req is None or s in self._prefilling or s in inflight
+                    or not req.out_tokens):
+                continue
+            shipment = self.export_request_pages(req.rid)
+            self.outbox.append((req, shipment))
+            # immediate (non-deferred) release: the in-flight guard
+            # above means no dispatched program references this slot's
+            # pages (its prefill-final is harvested, and a prefill-only
+            # engine never dispatches its decode rows), so the pool can
+            # recycle them for the NEXT admission wave without waiting
+            # for a full pipeline drain — the prefill pool's slot
+            # turnover is the whole point of the split
+            self._release_slot_pages(s, defer=False)
+            self.table[s] = 0
+            self.seq_lens[s] = 0
+            self.cur_tok[s] = 0
+            self.samp_temp[s] = 0.0
+            self.slots[s] = None
+
     def _dispatch_unified(self, now: float = 0.0) -> None:
         """Build and dispatch one unified step for the CURRENT slot
         state; does not block. Row assignment: every decoding slot gets
@@ -1117,6 +1179,11 @@ class ServingEngine:
         pref_entry = set(self._prefilling)
         decoding = [s for s in range(self.B) if self.slots[s] is not None
                     and s not in pref_entry]
+        if self.prefill_only:
+            # pool role: this engine never dispatches a decode row — a
+            # slot past its prefill flip idles until the export sweep
+            # ships its pages and releases it (same step, post-harvest)
+            decoding = []
         # previous dispatch's token-bearing rows, for in-program chaining
         prev_rows: dict[int, int] = {}
         if self._inflight is not None:

@@ -38,12 +38,20 @@ point                 kinds
 ``engine.step``       ``raise`` (ChaosInjected out of ServingEngine.step
                       — the router sees a dead replica), ``hang``
                       (sleep ``seconds`` inside step; the router's
-                      step-budget watchdog catches the stall)
+                      step-budget watchdog catches the stall).
+                      Pool-scoped: ``pool="prefill"`` + ``once=False``
+                      kills every engine of a disaggregated pool role
+                      as each one next steps (pool death, not a single
+                      replica loss)
 ``pool.alloc``        ``fail`` (page allocation reports an empty pool
                       even when pages are free — admission backpressure)
 ``migration.ship``    ``drop`` (exported page shipment lost on the
                       wire), ``corrupt`` (one byte of page payload
-                      flipped in transit; the adopter's crc rejects it)
+                      flipped in transit; the adopter's crc rejects it),
+                      ``stall`` (sleep ``seconds`` on the wire before
+                      delivery — a slow shipment; the router's
+                      per-shipment deadline decides whether the late
+                      pages still count)
 ``migration.adopt``   ``fail`` (survivor refuses the shipment before
                       staging — e.g. no free pages at the adopter)
 ====================  ======================================================
@@ -63,6 +71,12 @@ Site parameters like ``seconds``/``code`` are untouched: they only
 constrain when the site also reports them. Invocation counters for
 ``at=N`` are kept per ``(point, ctx)`` pair, so "the 7th step of
 engine 0" means engine 0's own 7th step regardless of interleaving.
+``pool`` is the one targeting key handled more strictly: a spec
+carrying ``pool=<role>`` *never* matches a probe whose ctx reports no
+pool (disaggregated engines tag their probes with their pool role;
+plain engines report none), so a pool-scoped kill cannot leak onto a
+colocated fleet — and with ``once=False`` it fires for *every* engine
+of the role, which is how a test kills an entire prefill pool.
 
 Determinism: probabilistic faults draw from a ``random.Random`` seeded
 from ``(plan.seed, point, kind)``, and at-N faults count invocations per
@@ -204,6 +218,11 @@ class _ArmedPlan:
                     continue
                 want_rank = spec.args.get("rank")
                 if want_rank is not None and int(want_rank) != _env_rank():
+                    continue
+                # pool-scoped specs only match probes that report a pool
+                # role: a pool=<role> kill can never hit a colocated
+                # (pool-less) engine by accident
+                if "pool" in spec.args and (not ctx or "pool" not in ctx):
                     continue
                 if ctx and any(str(spec.args[k]) != str(v)
                                for k, v in ctx.items() if k in spec.args):

@@ -27,9 +27,9 @@ def bench(monkeypatch):
     # serving engine, 100-step loss curve — hours on the 1-core CPU CI
     # box); individual tests re-patch the ones they exercise
     for name in ("_bench_chip_probe", "_bench_decode", "_bench_serving",
-                 "_bench_multitenant", "_bench_fleet", "_bench_loss_curve",
-                 "_bench_13b", "_bench_long_ctx", "_bench_multichip",
-                 "_bench_phases"):
+                 "_bench_multitenant", "_bench_fleet", "_bench_disagg",
+                 "_bench_loss_curve", "_bench_13b", "_bench_long_ctx",
+                 "_bench_multichip", "_bench_phases"):
         monkeypatch.setattr(b, name, lambda: {})
     return b
 
@@ -173,6 +173,41 @@ def test_fleet_key_contract(bench):
 
     src = inspect.getsource(bench._run_secondary_benches)
     assert "_bench_fleet" in src and "fleet_error" in src
+
+
+def test_disagg_key_contract(bench):
+    """_disagg_keys is the pure FleetDriver-metrics -> bench-keys
+    mapping for the disaggregated-pool family (ISSUE 12): disagg-arm
+    TTFT and shipped pages, colocated-arm TTFT with deltas (positive =
+    the pool split won), and the failover arm's degraded-mode cost +
+    kill -> re-split recovery time."""
+    m = {"ttft_p50_s": 0.20, "ttft_p99_s": 0.80,
+         "goodput_tok_s": 300.0, "disagg_shipped_pages": 40}
+    coloc = {"ttft_p50_s": 0.35, "ttft_p99_s": 1.30}
+    fail = {"degraded_steps": 120, "degraded_frac": 0.4,
+            "disagg_recovery_ms": 850.5, "ttft_p99_s": 1.9}
+    out = bench._disagg_keys(m, coloc, fail)
+    for k in ("disagg_ttft_p50", "disagg_ttft_p99", "disagg_goodput",
+              "disagg_shipped_pages", "colocated_ttft_p50",
+              "colocated_ttft_p99", "disagg_ttft_delta_p50",
+              "disagg_ttft_delta_p99", "disagg_degraded_steps",
+              "disagg_degraded_frac", "disagg_recovery_ms",
+              "disagg_failover_ttft_p99"):
+        assert k in out, k
+    assert out["disagg_ttft_p50"] == 0.20
+    assert out["disagg_ttft_p99"] == 0.80
+    assert out["disagg_shipped_pages"] == 40.0
+    assert out["colocated_ttft_p99"] == 1.30
+    assert out["disagg_ttft_delta_p50"] == pytest.approx(0.15)
+    assert out["disagg_ttft_delta_p99"] == pytest.approx(0.50)
+    assert out["disagg_degraded_steps"] == 120.0
+    assert out["disagg_recovery_ms"] == 850.5
+    assert out["disagg_failover_ttft_p99"] == 1.9
+    # error marker name is wired in the secondary list
+    import inspect
+
+    src = inspect.getsource(bench._run_secondary_benches)
+    assert "_bench_disagg" in src and "disagg_error" in src
 
 
 def test_multichip_key_contract(bench):

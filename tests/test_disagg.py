@@ -1,0 +1,438 @@
+"""Disaggregated prefill/decode pools (PR 12): FleetRouter pool roles,
+page shipment over the migration wire, pool-loss failover into degraded
+colocated mode, and automatic re-split on recovery.
+
+The headline property: with the replica set split into a prefill pool
+(chunked prefill + first token only, pages exported and the slot
+released) and a decode pool (adopts shipped pages, decodes from token
+two), chaos-killing the ENTIRE prefill pool mid-shipment degrades the
+fleet to colocated mode and every in-flight stream — greedy AND
+sampled — still completes bit-identically to an uninterrupted solo
+run. A joined replacement engine triggers an automatic re-split and the
+next request takes the split path again."""
+
+import inspect
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.flags import GLOBAL_FLAGS
+from paddle_tpu.inference.fleet import FleetRouter, ship_shipment
+from paddle_tpu.inference.serving import Request, ServingEngine
+from paddle_tpu.models.llama import LlamaConfig
+from paddle_tpu.testing import chaos
+
+CFG = LlamaConfig(vocab_size=256, hidden=64, n_layers=2, n_heads=4,
+                  n_kv_heads=2, ffn_hidden=128, max_seq_len=256,
+                  dtype=jnp.float32, param_dtype=jnp.float32)
+EKW = dict(max_batch=2, page_size=16, max_seq=128, n_pages=1 + 24,
+           prefill_budget=32)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    chaos.disarm()
+
+
+def _mk_router(n_engines=2, **kw):
+    ekw = dict(EKW, **kw.pop("engine_kwargs", {}))
+    return FleetRouter(CFG, n_engines=n_engines, seed=0,
+                       engine_kwargs=ekw, **kw)
+
+
+def _mk_reqs(rng, n=4, max_new=10, sampled=()):
+    reqs = []
+    for i in range(n):
+        prompt = rng.randint(1, CFG.vocab_size,
+                             size=rng.randint(24, 48)).astype(np.int32)
+        kw = (dict(temperature=0.8, top_p=0.9, seed=100 + i)
+              if i in sampled else {})
+        reqs.append(Request(rid=i, prompt=prompt, max_new_tokens=max_new,
+                            arrival=0.0, **kw))
+    return reqs
+
+
+def _solo_run(params, req):
+    """Uninterrupted single-engine reference for one request."""
+    eng = ServingEngine(CFG, params=params, seed=0, **EKW)
+    ref = Request(rid=1000 + req.rid, prompt=req.prompt.copy(),
+                  max_new_tokens=req.max_new_tokens,
+                  temperature=req.temperature, top_p=req.top_p,
+                  seed=req.seed)
+    eng.run([ref])
+    return ref.out_tokens
+
+
+def _assert_fleet_ledger(router):
+    acc = router.page_accounting()
+    for eid, a in acc["engines"].items():
+        eng = next(r.engine for r in router.replicas
+                   if r.engine.engine_id == eid)
+        assert a["total"] == eng.n_pages - 1, (eid, a)
+    assert acc["fleet"]["total"] == acc["expected"], acc
+
+
+def _drain(router, limit=3000):
+    steps = 0
+    while router.step(now=1e18):
+        steps += 1
+        assert steps < limit, "fleet did not drain"
+    return steps
+
+
+def _assert_complete_and_identical(reqs, params):
+    bad = [r.rid for r in reqs if r.aborted or r.t_done is None
+           or len(r.out_tokens) != r.max_new_tokens]
+    assert not bad, bad
+    for r in reqs:
+        assert r.out_tokens == _solo_run(params, r), r.rid
+
+
+# -- basic split: prefill pool ships, decode pool finishes ------------------
+
+
+def test_basic_split_ships_pages_and_streams_bit_identical():
+    """1 prefill + 1 decode: the prefill engine emits each request's
+    FIRST token only (TTFT is paid there, interference-free), exports
+    the prompt's full pages over the wire, and releases the slot; the
+    decode engine adopts the pages and produces tokens two..N. Streams
+    are bit-identical to solo runs and both ledgers settle clean."""
+    router = _mk_router(disagg_prefill=1)
+    params = router.replicas[0].engine.params
+    assert router.disagg and not router.degraded
+    assert [rep.role for rep in router.replicas] == ["prefill", "decode"]
+    pre, dec = (rep.engine for rep in router.replicas)
+    assert pre.prefill_only and not dec.prefill_only
+    reqs = _mk_reqs(np.random.RandomState(3), n=4, sampled=(1, 3))
+    for r in reqs:
+        router.submit(r, now=1e18)
+    _drain(router)
+    st = router.fleet_stats()
+    assert st["fleet_n_prefill"] == 1 and st["fleet_n_decode"] == 1
+    assert st["disagg_shipped_pages"] >= 4 and st["disagg_ship_bytes"] > 0
+    assert st["degraded_steps"] == 0 and st["disagg_degraded"] == 0
+    # the prefill engine never ran a pure-decode step; the decode
+    # engine did all the token-two..N work
+    assert pre.stats["decode_steps"] == 0
+    assert dec.stats["decode_steps"] > 0
+    _assert_complete_and_identical(reqs, params)
+    _assert_fleet_ledger(router)
+    # slots fully released on both sides, outboxes drained
+    for e in (pre, dec):
+        assert all(s is None for s in e.slots) and not e.outbox
+
+
+# -- headline: whole-pool loss -> degraded colocated -> re-split ------------
+
+
+def test_prefill_pool_loss_degrades_colocated_then_resplits():
+    """2 prefill + 2 decode. Once at least one page has shipped, chaos
+    kills the ENTIRE prefill pool (pool-scoped spec, once=False). The
+    router census detects the role extinction, flips to degraded
+    colocated mode (live engines prefill+decode again), and every
+    stream — greedy and sampled — completes bit-identically. Joining a
+    fresh prefill engine re-splits automatically; the next request
+    ships pages again and degraded-episode length is reported."""
+    router = _mk_router(n_engines=4, disagg_prefill=2)
+    params = router.replicas[0].engine.params
+    rng = np.random.RandomState(7)
+    reqs = _mk_reqs(rng, n=6, max_new=8, sampled=(1, 3, 5))
+    for r in reqs:
+        router.submit(r, now=1e18)
+    steps = 0
+    while router.step(now=1e18):
+        steps += 1
+        _assert_fleet_ledger(router)
+        if (router.stats["disagg_shipped_pages"] >= 1
+                and not chaos.active()):
+            chaos.arm(chaos.FaultPlan(seed=0)
+                      .add("engine.step", "raise", once=False,
+                           pool="prefill"))
+        assert steps < 3000
+    chaos.disarm()
+    st = router.fleet_stats()
+    assert st["fleet_n_prefill"] == 0 and st["n_killed"] == 2
+    assert router.degraded and st["disagg_degraded"] == 1
+    assert st["degraded_steps"] >= 1
+    _assert_complete_and_identical(reqs, params)
+    # survivors (the old decode pool) now run colocated
+    for rep in router.replicas:
+        if rep.alive:
+            assert not rep.engine.prefill_only
+    # recovery: one replacement prefill engine -> automatic re-split
+    router.add_engine(role="prefill", engine_kwargs=EKW)
+    router.step(now=1e18)
+    assert not router.degraded
+    assert router.stats["n_resplit"] == 1
+    st = router.fleet_stats()
+    assert st["fleet_n_prefill"] == 1 and st["disagg_recovery_ms"] > 0
+    # a post-re-split request takes the split path again
+    r2 = Request(rid=100, max_new_tokens=6, arrival=0.0,
+                 prompt=rng.randint(1, 256, 40).astype(np.int32))
+    shipped0 = router.stats["disagg_shipped_pages"]
+    router.submit(r2, now=1e18)
+    _drain(router)
+    assert router.stats["disagg_shipped_pages"] > shipped0
+    _assert_complete_and_identical([r2], params)
+    _assert_fleet_ledger(router)
+
+
+# -- satellite 3: ship-retry exhaustion -> colocated fallback ---------------
+
+
+def test_ship_retry_exhaustion_completes_via_colocated_fallback():
+    """Every shipment chaos-dropped on the wire: the ship job rides the
+    deterministic-exponential retry queue, exhausts retry_max, lands in
+    n_retry_exhausted — and the request still completes bit-identically
+    through the degraded colocated fallback (never dropped)."""
+    chaos.arm(chaos.FaultPlan(seed=0)
+              .add("migration.ship", "drop", once=False))
+    router = _mk_router(disagg_prefill=1, retry_max=2,
+                        retry_base_delay=0.0)
+    params = router.replicas[0].engine.params
+    reqs = _mk_reqs(np.random.RandomState(9), n=3, max_new=6,
+                    sampled=(2,))
+    for r in reqs:
+        router.submit(r, now=1e18)
+    _drain(router)
+    st = router.fleet_stats()
+    assert st["n_retry_exhausted"] >= 1
+    assert st["n_ship_retries"] >= 1
+    assert st["migration_dropped"] >= 1
+    # exhaustion entered degraded mode; both roles stayed alive, so the
+    # census re-split automatically once the ship queue emptied
+    assert st["degraded_steps"] >= 1 and st["n_resplit"] >= 1
+    _assert_complete_and_identical(reqs, params)
+    _assert_fleet_ledger(router)
+
+
+def test_ship_deadline_expiry_counts_and_still_completes():
+    """A stalled wire blows the per-shipment deadline: the job is
+    retired through n_ship_deadline (not retried forever) and the
+    stream completes via the colocated fallback."""
+    chaos.arm(chaos.FaultPlan(seed=0)
+              .add("migration.ship", "stall", once=False, seconds=0.05))
+    router = _mk_router(disagg_prefill=1, retry_max=2,
+                        retry_base_delay=0.0, ship_deadline=0.01)
+    params = router.replicas[0].engine.params
+    reqs = _mk_reqs(np.random.RandomState(13), n=2, max_new=6)
+    for r in reqs:
+        router.submit(r, now=1e18)
+    _drain(router)
+    st = router.fleet_stats()
+    assert st["n_ship_deadline"] >= 1
+    assert st["n_retry_exhausted"] >= 1
+    _assert_complete_and_identical(reqs, params)
+    _assert_fleet_ledger(router)
+
+
+# -- satellite 2: migration-wire edge cases ---------------------------------
+
+
+def test_wire_zero_full_page_export_is_well_formed_nothing():
+    """A resident request that has not yet covered one full page
+    exports None, and the router-facing wire reports a well-formed
+    ``nothing`` instead of shipping an empty payload."""
+    router = _mk_router()
+    donor, recv = (rep.engine for rep in router.replicas)
+    short = Request(rid=7, prompt=np.arange(1, 6, dtype=np.int32),
+                    max_new_tokens=4, arrival=0.0)
+    donor.submit(short)
+    while short.t_first is None:
+        donor.step(now=1e18)
+    assert donor.export_request_pages(7) is None
+    res = ship_shipment(None, donor.engine_id, recv)
+    assert res == {"status": "nothing", "pages": 0, "bytes": 0}
+    _assert_fleet_ledger(router)
+
+
+def test_wire_redelivery_skips_cached_hashes():
+    """Double delivery of one shipment is safe: the second begin_adopt
+    finds every hash already resident and stages nothing, and the
+    ship_shipment wrapper short-circuits to ok/0 pages without touching
+    the pool — the at-least-once retry queue can redeliver freely."""
+    router = _mk_router()
+    donor, recv = (rep.engine for rep in router.replicas)
+    req = Request(rid=0, prompt=np.arange(1, 41, dtype=np.int32),
+                  max_new_tokens=8, arrival=0.0)
+    donor.submit(req)
+    steps = 0
+    while len(req.out_tokens) < 4:
+        donor.step(now=1e18)
+        steps += 1
+        assert steps < 200
+    ship = donor.export_request_pages(0)
+    assert ship is not None
+    first = ship_shipment(ship, donor.engine_id, recv)
+    assert first["status"] == "ok" and first["pages"] >= 2
+    assert first["bytes"] > 0
+    free0 = len(recv.pool.free)
+    # redelivery: all hashes cached -> no staging, no allocation
+    again = ship_shipment(ship, donor.engine_id, recv)
+    assert again == {"status": "ok", "pages": 0, "bytes": 0}
+    assert recv.begin_adopt(ship) is None
+    assert recv.page_accounting()["in_flight"] == 0
+    assert len(recv.pool.free) == free0
+    _assert_fleet_ledger(router)
+
+
+def test_wire_abort_adopt_leaves_in_flight_empty_and_pool_leak_free():
+    """begin_adopt stages into the in_flight ledger class;
+    abort_adopt returns every staged page to the free list — in_flight
+    drains to zero and the free count is exactly restored."""
+    router = _mk_router()
+    donor, recv = (rep.engine for rep in router.replicas)
+    req = Request(rid=0, prompt=np.arange(1, 41, dtype=np.int32),
+                  max_new_tokens=8, arrival=0.0)
+    donor.submit(req)
+    steps = 0
+    while len(req.out_tokens) < 4:
+        donor.step(now=1e18)
+        steps += 1
+        assert steps < 200
+    ship = donor.export_request_pages(0)
+    free0 = len(recv.pool.free)
+    h = recv.begin_adopt(ship)
+    assert h is not None
+    acc = recv.page_accounting()
+    assert acc["in_flight"] == len(ship["hashes"])
+    assert acc["total"] == recv.n_pages - 1
+    recv.abort_adopt(h)
+    acc = recv.page_accounting()
+    assert acc["in_flight"] == 0
+    assert len(recv.pool.free) == free0
+    assert acc["total"] == recv.n_pages - 1
+    _assert_fleet_ledger(router)
+
+
+# -- donor death with queued shipments --------------------------------------
+
+
+def test_donor_death_with_pending_outbox_recovers_requests():
+    """A prefill engine dies with shipments still in its outbox: the
+    payload dies with the donor's host memory, but the REQUESTS are
+    recovered — re-admitted through the victim path and completed
+    bit-identically (as plain re-prefills on the survivor)."""
+    router = _mk_router(disagg_prefill=1)
+    params = router.replicas[0].engine.params
+    pre = router.replicas[0]
+    reqs = _mk_reqs(np.random.RandomState(21), n=2, max_new=6,
+                    sampled=(1,))
+    for r in reqs:
+        router.submit(r, now=1e18)
+    # step the prefill ENGINE directly until its outbox holds a
+    # shipment the router has not yet drained, then kill it
+    steps = 0
+    while not pre.engine.outbox:
+        if not pre.engine.step(now=1e18):
+            router.step(now=1e18)
+        steps += 1
+        assert steps < 500
+    router.kill_engine(pre.engine.engine_id, now=1e18)
+    _drain(router)
+    assert router.degraded        # prefill pool is gone
+    _assert_complete_and_identical(reqs, params)
+    _assert_fleet_ledger(router)
+
+
+# -- flags off = PR 11 fleet + single engine untouched ----------------------
+
+
+def test_disagg_flags_default_off_and_everything_untouched():
+    """serving_disagg_* defaults are pool-split-off, the engine source
+    never reads a disagg (or fleet) flag — single-engine programs are
+    untouched by construction — and a flags-off FleetRouter is the
+    PR 11 router: no roles, no shipments, streams bit-identical, with
+    the flag values toggled around the run."""
+    assert GLOBAL_FLAGS.get("serving_disagg_prefill") == 0
+    assert GLOBAL_FLAGS.get("serving_disagg_ship_deadline") == 0.0
+    import paddle_tpu.inference.serving as sv
+
+    src = inspect.getsource(sv)
+    assert "serving_disagg" not in src
+    assert "serving_fleet" not in src
+
+    rng = np.random.RandomState(6)
+    prompts = [rng.randint(1, 256, 30).astype(np.int32)
+               for _ in range(2)]
+
+    def run_solo():
+        eng = ServingEngine(CFG, seed=0, **EKW)
+        reqs = [Request(rid=i, prompt=p.copy(), max_new_tokens=5,
+                        **(dict(temperature=0.9, top_p=0.8, seed=3)
+                           if i == 1 else {}))
+                for i, p in enumerate(prompts)]
+        eng.run(reqs)
+        return [r.out_tokens for r in reqs]
+
+    base = run_solo()
+    try:
+        GLOBAL_FLAGS.set("serving_disagg_prefill", 1)
+        GLOBAL_FLAGS.set("serving_disagg_ship_deadline", 2.0)
+        assert run_solo() == base
+    finally:
+        GLOBAL_FLAGS.set("serving_disagg_prefill", 0)
+        GLOBAL_FLAGS.set("serving_disagg_ship_deadline", 0.0)
+    # flags-off fleet: the PR 11 router, byte-for-byte behavior
+    router = _mk_router()
+    params = router.replicas[0].engine.params
+    assert not router.disagg and not router.degraded
+    assert all(rep.role is None for rep in router.replicas)
+    assert all(not rep.engine.prefill_only for rep in router.replicas)
+    reqs = _mk_reqs(np.random.RandomState(17), n=3, max_new=6,
+                    sampled=(1,))
+    for r in reqs:
+        router.submit(r, now=1e18)
+    _drain(router)
+    st = router.fleet_stats()
+    assert st["disagg_shipped_pages"] == 0 and st["degraded_steps"] == 0
+    assert st["fleet_n_prefill"] == 0
+    _assert_complete_and_identical(reqs, params)
+
+
+def test_disagg_prefill_must_leave_a_decode_pool():
+    """A split that leaves no decode engine is a config error, not a
+    silent colocated fallback."""
+    with pytest.raises(ValueError):
+        _mk_router(disagg_prefill=2)
+    with pytest.raises(ValueError):
+        _mk_router(disagg_prefill=3)
+
+
+# -- workload: prefill-heavy fourth stream ----------------------------------
+
+
+def test_workload_prefill_heavy_decoration_seeded_and_legacy_identical():
+    """prefill_heavy_frac draws from its own RandomState stream: the
+    legacy/multi-tenant/fleet fields stay byte-identical for the same
+    seed, the decorated fraction gets longer prompts and clamped
+    outputs, and the decoration is reproducible."""
+    from paddle_tpu.inference.loadgen.workload import (WorkloadSpec,
+                                                       synthesize)
+
+    base = synthesize(WorkloadSpec(n_requests=40, seed=5,
+                                   vocab_size=256, max_seq=512))
+    hot = synthesize(WorkloadSpec(n_requests=40, seed=5, vocab_size=256,
+                                  max_seq=512, prefill_heavy_frac=0.5,
+                                  prefill_heavy_len=64))
+    hot2 = synthesize(WorkloadSpec(n_requests=40, seed=5,
+                                   vocab_size=256, max_seq=512,
+                                   prefill_heavy_frac=0.5,
+                                   prefill_heavy_len=64))
+    n_heavy = 0
+    for b, h, h2 in zip(base, hot, hot2):
+        assert h.arrival == b.arrival
+        assert np.array_equal(h.prompt, h2.prompt)
+        assert h.max_new_tokens == h2.max_new_tokens
+        if len(h.prompt) > len(b.prompt):
+            n_heavy += 1
+            assert np.array_equal(h.prompt[:len(b.prompt)], b.prompt)
+            assert h.max_new_tokens <= b.max_new_tokens
+            assert len(h.prompt) + h.max_new_tokens <= 512
+        else:
+            assert np.array_equal(h.prompt, b.prompt)
+            assert h.max_new_tokens == b.max_new_tokens
+    assert 0 < n_heavy < 40

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # One-command CI gate: static analysis -> op-contract baseline -> chaos
 # suite -> serving smoke -> kernel parity -> loadgen smoke -> multichip
-# smoke -> multitenant smoke -> fleet smoke -> tier-1.
+# smoke -> multitenant smoke -> fleet smoke -> disagg smoke -> tier-1.
 #
 #   bash tools/ci_check.sh
 #
@@ -19,12 +19,15 @@
 #       constrained-stream legality / 7-class page-ledger leak)
 #  100  fleet smoke failed (engine-loss recovery: a victim stream was
 #       dropped or diverged, no pages migrated, or the survivor leaked)
+#  110  disagg smoke failed (prefill-pool loss: no pages adopted over
+#       the prefill->decode wire, degraded-mode completion dropped or
+#       diverged a stream, or a surviving ledger leaked)
 #   30  tier-1 tests failed (ROADMAP.md command)
 #    0  all gates green
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== gate 1/10: tpu-lint (per-file + interprocedural rules) =="
+echo "== gate 1/11: tpu-lint (per-file + interprocedural rules) =="
 python -m tools.lint paddle_tpu tests --format=json > /tmp/tpu_lint.json
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -34,7 +37,7 @@ if [ "$rc" -ne 0 ]; then
 fi
 echo "tpu-lint: clean"
 
-echo "== gate 2/10: tpu-verify (abstract op-contract baseline) =="
+echo "== gate 2/11: tpu-verify (abstract op-contract baseline) =="
 JAX_PLATFORMS=cpu python -m tools.lint --contracts \
     --baseline artifacts/op_contracts.json
 rc=$?
@@ -44,7 +47,7 @@ if [ "$rc" -ne 0 ]; then
     exit 20
 fi
 
-echo "== gate 3/10: chaos suite (fault injection -> self-healing) =="
+echo "== gate 3/11: chaos suite (fault injection -> self-healing) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 rc=$?
@@ -54,7 +57,7 @@ if [ "$rc" -ne 0 ]; then
     exit 40
 fi
 
-echo "== gate 4/10: serving smoke (scheduler completion + zero page leak) =="
+echo "== gate 4/11: serving smoke (scheduler completion + zero page leak) =="
 JAX_PLATFORMS=cpu python -m tools.serving_smoke
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -63,7 +66,7 @@ if [ "$rc" -ne 0 ]; then
     exit 50
 fi
 
-echo "== gate 5/10: kernel parity (fused megakernels, CPU fallback arms) =="
+echo "== gate 5/11: kernel parity (fused megakernels, CPU fallback arms) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_fused_norm_epilogue.py \
     tests/test_fused_rope_attention.py tests/test_autotune.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
@@ -74,7 +77,7 @@ if [ "$rc" -ne 0 ]; then
     exit 60
 fi
 
-echo "== gate 6/10: loadgen smoke (open-loop saturation, >=200 arrivals) =="
+echo "== gate 6/11: loadgen smoke (open-loop saturation, >=200 arrivals) =="
 JAX_PLATFORMS=cpu python -m tools.loadgen_smoke
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -84,7 +87,7 @@ if [ "$rc" -ne 0 ]; then
     exit 70
 fi
 
-echo "== gate 7/10: multichip smoke (dp x mp mesh: remat-free compile," \
+echo "== gate 7/11: multichip smoke (dp x mp mesh: remat-free compile," \
      "serial parity, quantized all-reduce) =="
 python tools/multichip_smoke.py
 rc=$?
@@ -95,7 +98,7 @@ if [ "$rc" -ne 0 ]; then
     exit 80
 fi
 
-echo "== gate 8/10: multitenant smoke (LoRA isolation, preemption," \
+echo "== gate 8/11: multitenant smoke (LoRA isolation, preemption," \
      "constrained legality, 7-class ledger) =="
 JAX_PLATFORMS=cpu python -m tools.multitenant_smoke
 rc=$?
@@ -107,7 +110,7 @@ if [ "$rc" -ne 0 ]; then
     exit 90
 fi
 
-echo "== gate 9/10: fleet smoke (engine loss -> bit-identical resume," \
+echo "== gate 9/11: fleet smoke (engine loss -> bit-identical resume," \
      "page migration, survivor ledger) =="
 JAX_PLATFORMS=cpu python -m tools.fleet_smoke
 rc=$?
@@ -118,7 +121,19 @@ if [ "$rc" -ne 0 ]; then
     exit 100
 fi
 
-echo "== gate 10/10: tier-1 tests (ROADMAP.md) =="
+echo "== gate 10/11: disagg smoke (prefill-pool loss -> degraded" \
+     "colocated completion, shipped pages, surviving ledgers) =="
+JAX_PLATFORMS=cpu python -m tools.disagg_smoke
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "ci_check: disagg smoke gate failed (rc=$rc) — killing the" \
+         "prefill pool mid-shipment dropped or diverged a stream, no" \
+         "pages were adopted pre-kill, or a surviving engine leaked" >&2
+    exit 110
+fi
+
+echo "== gate 11/11: tier-1 tests (ROADMAP.md) =="
+
 set -o pipefail
 rm -f /tmp/_t1.log
 # budget raised 870 -> 1200: the suite is ~1010s single-process as of
