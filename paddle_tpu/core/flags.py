@@ -209,6 +209,14 @@ define_flag("serving_spec_ngram", 3,
             "Longest n-gram the speculative prompt-lookup proposer "
             "matches against the request's history (falls back to "
             "shorter grams down to 1).")
+define_flag("serving_wire_overlap", False,
+            "Overlapped migration wire: a donor engine stages completed "
+            "slots' KV pages through an async device->host copy chained "
+            "after the in-flight program (no blocking chain sync at "
+            "export), and an adopter folds commit_adopt's page scatter "
+            "between programs (applied at its next dispatch) instead of "
+            "serializing behind the in-flight chain. Off (default) = "
+            "the PR 12 synchronous wire, bit-identical.")
 define_flag("serving_kv_quant", False,
             "Store KV pages as symmetric int8 with a per-page, per-head "
             "fp32 scale plane ([L, n_pages, n_kv_heads]); dequant is "
@@ -308,6 +316,23 @@ define_flag("serving_disagg_ship_deadline", 0.0,
             "falls back to colocated serving (re-prefill through the "
             "prefix cache — same stream, more FLOPs). 0 (default) = "
             "no deadline; only retry exhaustion triggers fallback.")
+define_flag("serving_disagg_dynamic", False,
+            "Measured-load pool splitting: the router tracks per-role "
+            "demand EWMAs (queued prefill tokens vs remaining decode "
+            "tokens) and re-splits the prefill/decode pools when the "
+            "measured share leaves a hysteresis band around the current "
+            "split, moving one replica per tick. serving_disagg_prefill"
+            "=N acts as a pin/override (the split never moves). Off "
+            "(default) = static split only, bit-identical.")
+define_flag("serving_disagg_ewma", 0.3,
+            "EWMA smoothing factor (0 < alpha <= 1) for the dynamic-"
+            "split per-role demand estimates; higher = faster reaction "
+            "to phase shifts, lower = steadier split.")
+define_flag("serving_disagg_hysteresis", 0.2,
+            "Dead band for dynamic re-splitting: the measured prefill "
+            "share must differ from the current pool share by more than "
+            "this fraction before a replica changes role (prevents "
+            "role flapping at phase boundaries).")
 
 define_flag("dist_allreduce_quant", False,
             "EQuARX-style int8 gradient all-reduce for the dp gradient "

@@ -4,15 +4,19 @@ The heavy lifting lives on the engine (``export_request_pages`` /
 ``adopt_pages`` — see the wire-format comment in serving.py): a KV page
 is a pure function of (params, token prefix, page size, quant mode,
 adapter digest), so replicas of one model can exchange page bytes and
-the adopter's prefix cache stays sound. This module is the *wire*: it
-moves a shipment between two in-process engines, carries the
+the adopter's prefix cache stays sound. Wire format v2 additionally
+carries the payload's ``quant_mode`` plus the token prefix, so an int8
+shipment can land in an fp pool (and vice versa) through an edge
+conversion instead of a rejection. This module is the *wire*: it moves
+a shipment between two in-process engines, carries the
 ``migration.ship`` chaos point (``drop`` — shipment lost; ``corrupt``
 — one payload byte flipped so the adopter's per-page crc rejects it),
-and reports what happened so the router can count pages/bytes and fall
-back to re-prefill recovery. Migration is an optimization, never a
-correctness dependency: every fallback path re-prefills the victim's
-prompt + emitted history and lands on the same keyed (seed, position)
-sampling stream.
+and reports what happened — pages, bytes, and the adopter-side wall
+milliseconds (``adopt_ms``) — so the router can keep its wire counters
+and fall back to re-prefill recovery. Migration is an optimization,
+never a correctness dependency: every fallback path re-prefills the
+victim's prompt + emitted history and lands on the same keyed
+(seed, position) sampling stream.
 """
 
 from __future__ import annotations
@@ -26,34 +30,56 @@ from ...testing import chaos as _chaos
 __all__ = ["ship_pages", "ship_shipment"]
 
 
+def _adopt(target, shipment: dict, nbytes: int) -> dict:
+    """Deliver ``shipment`` into ``target``'s pool and time the
+    adopter-side cost (begin/commit — the scatter the overlapped wire
+    defers between programs shows up here as a near-zero commit)."""
+    t0 = time.perf_counter()
+    try:
+        n = target.adopt_pages(shipment)
+    except ValueError:
+        # unconvertible mode/geometry mismatch: a wire-level rejection,
+        # not a transport error — the router falls back to re-prefill
+        n = 0
+    ms = (time.perf_counter() - t0) * 1e3
+    if n == 0:
+        return {"status": "rejected", "pages": 0, "bytes": 0,
+                "adopt_ms": ms}
+    return {"status": "ok", "pages": n, "bytes": nbytes, "adopt_ms": ms}
+
+
 def ship_pages(donor, target, rid: int) -> dict:
     """Ship request ``rid``'s full KV pages from ``donor`` to
-    ``target``. Returns ``{"status", "pages", "bytes"}`` where status is
-    one of ``ok`` / ``nothing`` (no exportable full page) / ``dropped``
-    (chaos: lost on the wire) / ``rejected`` (crc or adopter refusal —
-    includes chaos ``corrupt``/``migration.adopt``) / ``failed``
-    (donor-side export error: treat the donor HBM as unreadable)."""
+    ``target``. Returns ``{"status", "pages", "bytes", "adopt_ms"}``
+    where status is one of ``ok`` / ``nothing`` (no exportable full
+    page) / ``dropped`` (chaos: lost on the wire) / ``rejected`` (crc
+    or adopter refusal — includes chaos ``corrupt``/``migration.adopt``)
+    / ``failed`` (donor-side export error: treat the donor HBM as
+    unreadable)."""
     try:
         shipment = donor.export_request_pages(rid)
     except Exception:
-        return {"status": "failed", "pages": 0, "bytes": 0}
+        return {"status": "failed", "pages": 0, "bytes": 0,
+                "adopt_ms": 0.0}
     if shipment is None:
-        return {"status": "nothing", "pages": 0, "bytes": 0}
+        return {"status": "nothing", "pages": 0, "bytes": 0,
+                "adopt_ms": 0.0}
     nbytes = donor.shipment_bytes(shipment)
     if _chaos.active():
         spec = _chaos.fire("migration.ship",
                            ctx={"engine": donor.engine_id})
         if spec is not None:
             if spec.kind == "drop":
-                return {"status": "dropped", "pages": 0, "bytes": 0}
+                return {"status": "dropped", "pages": 0, "bytes": 0,
+                        "adopt_ms": 0.0}
             if spec.kind == "corrupt":
-                k = np.ascontiguousarray(shipment["k"])
+                # copy=True: a staged-then-finalized payload is a
+                # read-only device-array view — the flip must stick
+                # (and persist in the job so retries reject too)
+                k = np.array(shipment["k"], copy=True)
                 k.view(np.uint8).reshape(-1)[0] ^= 0xFF
                 shipment["k"] = k
-    n = target.adopt_pages(shipment)
-    if n == 0:
-        return {"status": "rejected", "pages": 0, "bytes": 0}
-    return {"status": "ok", "pages": n, "bytes": nbytes}
+    return _adopt(target, shipment, nbytes)
 
 
 def ship_shipment(shipment: dict, donor_id: int, target,
@@ -70,11 +96,14 @@ def ship_shipment(shipment: dict, donor_id: int, target,
     Redelivery-safe: a shipment whose every page hash is already
     resident in the target's prefix cache is a zero-byte success
     (status ``ok``, 0 pages) — a retried delivery after a late-but-
-    landed first attempt must not read as an adopter refusal."""
+    landed first attempt must not read as an adopter refusal. The check
+    uses the TARGET's cache keyspace (``shipment_cache_hashes``), so a
+    cross-quant-mode redelivery is skip-safe too."""
     if shipment is None:
         # zero-full-page export: the donor had nothing shippable (short
         # prompt under one page) — a well-formed no-op, not an error
-        return {"status": "nothing", "pages": 0, "bytes": 0}
+        return {"status": "nothing", "pages": 0, "bytes": 0,
+                "adopt_ms": 0.0}
     nbytes = target.shipment_bytes(shipment)
     if _chaos.active():
         ctx = {"engine": donor_id}
@@ -83,16 +112,20 @@ def ship_shipment(shipment: dict, donor_id: int, target,
         spec = _chaos.fire("migration.ship", ctx=ctx)
         if spec is not None:
             if spec.kind == "drop":
-                return {"status": "dropped", "pages": 0, "bytes": 0}
+                return {"status": "dropped", "pages": 0, "bytes": 0,
+                        "adopt_ms": 0.0}
             if spec.kind == "stall":
                 time.sleep(float(spec.args.get("seconds", 0.05)))
             if spec.kind == "corrupt":
-                k = np.ascontiguousarray(shipment["k"])
+                # copy=True: a staged-then-finalized payload is a
+                # read-only device-array view — the flip must stick
+                # (and persist in the job so retries reject too)
+                k = np.array(shipment["k"], copy=True)
                 k.view(np.uint8).reshape(-1)[0] ^= 0xFF
                 shipment["k"] = k
-    if all(h in target.pool.cache for h in shipment["hashes"]):
-        return {"status": "ok", "pages": 0, "bytes": 0}
-    n = target.adopt_pages(shipment)
-    if n == 0:
-        return {"status": "rejected", "pages": 0, "bytes": 0}
-    return {"status": "ok", "pages": n, "bytes": nbytes}
+    hashes = (target.shipment_cache_hashes(shipment)
+              if hasattr(target, "shipment_cache_hashes")
+              else shipment["hashes"])
+    if hashes is not None and all(h in target.pool.cache for h in hashes):
+        return {"status": "ok", "pages": 0, "bytes": 0, "adopt_ms": 0.0}
+    return _adopt(target, shipment, nbytes)

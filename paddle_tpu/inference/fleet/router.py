@@ -107,7 +107,10 @@ class FleetRouter:
                  shed_backlog: Optional[float] = None,
                  tight_deadline: Optional[float] = None,
                  disagg_prefill: Optional[int] = None,
-                 ship_deadline: Optional[float] = None):
+                 ship_deadline: Optional[float] = None,
+                 disagg_dynamic: Optional[bool] = None,
+                 dynamic_ewma: Optional[float] = None,
+                 dynamic_hysteresis: Optional[float] = None):
         if engines is None:
             if n_engines is None:
                 n_engines = int(GLOBAL_FLAGS.get("serving_fleet_engines"))
@@ -161,6 +164,24 @@ class FleetRouter:
             raise ValueError(
                 f"serving_disagg_prefill={dp} leaves no decode engine "
                 f"(fleet has {len(self.replicas)} replicas)")
+        # measured-load pool splitting (serving_disagg_dynamic): the
+        # router EWMAs per-role demand and moves one replica per tick
+        # when the measured prefill share leaves the hysteresis band.
+        # An explicit serving_disagg_prefill=N is a PIN — the static
+        # split holds and the dynamic controller never moves it.
+        self.dynamic = bool(g("serving_disagg_dynamic")
+                            if disagg_dynamic is None else disagg_dynamic)
+        self.split_alpha = float(g("serving_disagg_ewma")
+                                 if dynamic_ewma is None else dynamic_ewma)
+        self.split_band = float(
+            g("serving_disagg_hysteresis")
+            if dynamic_hysteresis is None else dynamic_hysteresis)
+        self._split_pinned = dp > 0
+        if self.dynamic and dp == 0 and len(self.replicas) >= 2:
+            dp = max(1, len(self.replicas) // 2)
+        self._pf_ewma: Optional[float] = None
+        self._dec_ewma: Optional[float] = None
+        self._split_traj: list[float] = []
         self.disagg = dp > 0
         self.degraded = False
         self._degraded_t0 = 0.0
@@ -170,6 +191,7 @@ class FleetRouter:
                 rep.role = "prefill" if i < dp else "decode"
                 rep.engine.pool_role = rep.role
                 rep.engine.prefill_only = rep.role == "prefill"
+            self._split_traj.append(round(dp / len(self.replicas), 3))
         # rids whose prefill phase is done (shipped or fallen back):
         # placement routes them to the decode pool from here on
         self._decode_phase: set[int] = set()
@@ -194,6 +216,12 @@ class FleetRouter:
             "disagg_shipped_pages": 0, "disagg_ship_bytes": 0,
             "degraded_steps": 0, "n_resplit": 0,
             "n_ship_retries": 0, "n_ship_deadline": 0,
+            # wire observability: total payload bytes over the migration
+            # wire (disagg handoffs + death migrations), adopter-side
+            # wall ms, successful page-bearing handoffs, and the peak
+            # outbox + ship-retry depth seen on any tick
+            "shipped_bytes": 0, "wire_adopt_ms": 0.0,
+            "n_handoffs": 0, "ship_queue_depth": 0,
         }
 
     # -- registration broadcast ------------------------------------------
@@ -358,6 +386,9 @@ class FleetRouter:
         Returns True while any work remains anywhere."""
         if self.disagg:
             self._roles_census(now)
+            if (self.dynamic and not self._split_pinned
+                    and not self.degraded):
+                self._dynamic_resplit(now)
         if self._retry:
             t = time.monotonic()
             ready = [e for e in self._retry if e[0] <= t]
@@ -507,12 +538,97 @@ class FleetRouter:
         for rep in self._alive():
             if rep.role == "prefill":
                 rep.engine.prefill_only = True
+        self._record_split()
+
+    def _record_split(self) -> None:
+        alive = self._alive()
+        if alive:
+            n_pre = sum(1 for r in alive if r.role == "prefill")
+            self._split_traj.append(round(n_pre / len(alive), 3))
+
+    def _dynamic_resplit(self, now: float) -> None:
+        """Measured-load split controller (``serving_disagg_dynamic``,
+        unpinned fleets only): census per-role demand in token units —
+        queued + mid-prefill prompt tokens vs remaining decode tokens —
+        EWMA both, and when the smoothed prefill share leaves the
+        hysteresis band around the current pool share, move ONE replica
+        per tick toward the measured split (each pool always keeps at
+        least one live engine). A promoted decode engine's mid-decode
+        residents are swept back out through its outbox on its next
+        step — the same bit-identical resume as any handoff."""
+        alive = self._alive()
+        n = len(alive)
+        if n < 2:
+            return
+        pf = dec = 0.0
+        for rep in alive:
+            e = rep.engine
+            for r in e.queue:
+                if r.aborted:
+                    continue
+                if r.out_tokens or r.rid in self._decode_phase:
+                    dec += max(0, r.max_new_tokens - len(r.out_tokens))
+                else:
+                    pf += len(r.prompt)
+            for s, r in enumerate(e.slots):
+                if r is None or r.aborted:
+                    continue
+                if s in e._prefilling:
+                    pf += max(0, len(e._slot_prompt[s])
+                              - e._prefilling[s])
+                else:
+                    dec += max(0, r.max_new_tokens - len(r.out_tokens))
+        for _rdy, _att, r, job in self._retry:
+            if r.aborted:
+                continue
+            if (job is not None or r.out_tokens
+                    or r.rid in self._decode_phase):
+                dec += max(0, r.max_new_tokens - len(r.out_tokens))
+            else:
+                pf += len(r.prompt)
+        a = self.split_alpha
+        self._pf_ewma = (pf if self._pf_ewma is None
+                         else a * pf + (1.0 - a) * self._pf_ewma)
+        self._dec_ewma = (dec if self._dec_ewma is None
+                          else a * dec + (1.0 - a) * self._dec_ewma)
+        tot = self._pf_ewma + self._dec_ewma
+        if tot <= 0.0:
+            return
+        share = self._pf_ewma / tot
+        n_pre = sum(1 for r in alive if r.role == "prefill")
+        desired = min(n - 1, max(1, int(round(share * n))))
+        if desired == n_pre or abs(share - n_pre / n) <= self.split_band:
+            return
+        moved = (self._flip_role(alive, "decode", "prefill")
+                 if desired > n_pre
+                 else self._flip_role(alive, "prefill", "decode"))
+        if moved:
+            self.stats["n_resplit"] += 1
+            self._record_split()
+
+    def _flip_role(self, alive: list, src: str, dst: str) -> bool:
+        """Move the least-loaded live ``src``-pool replica to ``dst``
+        (ties break to the lowest engine id — deterministic). Refuses
+        to empty a pool."""
+        cands = [r for r in alive if r.role == src]
+        if len(cands) <= 1:
+            return False
+        rep = min(cands, key=lambda r: (r.load_tokens(),
+                                        r.engine.engine_id))
+        rep.role = dst
+        rep.engine.pool_role = dst
+        rep.engine.prefill_only = dst == "prefill"
+        return True
 
     def _drain_outboxes(self, now: float) -> bool:
         """Pick up (request, shipment) pairs the prefill engines swept
-        out and attempt delivery to the decode pool. Returns True if
-        anything was processed (the driver must keep ticking)."""
+        out and attempt delivery to the decode pool. A wire_overlap
+        donor's staged shipment is finalized HERE — the async staging
+        copy is read back and crc'd at drain time, not inside the
+        donor's step. Returns True if anything was processed (the
+        driver must keep ticking)."""
         any_work = False
+        n_tick = 0
         for rep in self.replicas:
             if not rep.alive or not rep.engine.outbox:
                 continue
@@ -522,12 +638,21 @@ class FleetRouter:
                         or len(req.out_tokens) >= req.max_new_tokens):
                     continue        # cancelled / completed at prefill
                 any_work = True
+                n_tick += 1
                 if self._owner.get(req.rid) is rep:
                     del self._owner[req.rid]
+                if shipment is not None and shipment.get("staged"):
+                    # chaos migration.stage ``drop`` surfaces as a None
+                    # shipment: the request still hands off, the decode
+                    # pool re-prefills (bit-identical, more FLOPs)
+                    shipment = rep.engine.finalize_shipment(shipment)
                 job = {"req": req, "shipment": shipment,
                        "donor": rep.engine.engine_id, "pool": rep.role,
                        "t0": time.monotonic()}
                 self._attempt_ship(job, 0, now)
+        depth = n_tick + sum(1 for e in self._retry if e[3] is not None)
+        if depth > self.stats["ship_queue_depth"]:
+            self.stats["ship_queue_depth"] = depth
         return any_work
 
     def _attempt_ship(self, job: dict, attempt: int, now: float) -> None:
@@ -546,10 +671,11 @@ class FleetRouter:
         if target is None:          # nothing alive anywhere right now
             self._queue_ship_retry(job, attempt + 1, now)
             return
-        res = {"status": "ok", "pages": 0, "bytes": 0}
+        res = {"status": "ok", "pages": 0, "bytes": 0, "adopt_ms": 0.0}
         if job["shipment"] is not None and self.migration:
             res = ship_shipment(job["shipment"], job["donor"],
                                 target.engine, donor_pool=job["pool"])
+        self.stats["wire_adopt_ms"] += res.get("adopt_ms", 0.0)
         late = (self.ship_deadline > 0
                 and time.monotonic() - job["t0"] > self.ship_deadline)
         if res["status"] in ("dropped", "rejected", "failed") or late:
@@ -560,6 +686,9 @@ class FleetRouter:
             return
         self.stats["disagg_shipped_pages"] += res["pages"]
         self.stats["disagg_ship_bytes"] += res["bytes"]
+        self.stats["shipped_bytes"] += res["bytes"]
+        if res["pages"]:
+            self.stats["n_handoffs"] += 1
         self._deliver(req, target)
 
     def _queue_ship_retry(self, job: dict, attempt: int,
@@ -672,6 +801,8 @@ class FleetRouter:
                 res = ship_pages(e, target.engine, req.rid)
                 self.stats["migrated_pages"] += res["pages"]
                 self.stats["migration_bytes"] += res["bytes"]
+                self.stats["shipped_bytes"] += res["bytes"]
+                self.stats["wire_adopt_ms"] += res.get("adopt_ms", 0.0)
                 if res["status"] in ("dropped", "rejected", "failed"):
                     self.stats["migration_" + (
                         "dropped" if res["status"] == "dropped"
@@ -771,12 +902,13 @@ class FleetRouter:
     def fleet_stats(self) -> dict:
         rms = self._recovery_ms
         dms = self._degraded_ms
-        return {
+        alive = self._alive()
+        n_pre = sum(1 for r in alive if r.role == "prefill")
+        out = {
             "fleet_n_engines": len(self.replicas),
-            "fleet_n_alive": len(self._alive()),
-            "fleet_n_prefill": sum(1 for r in self._alive()
-                                   if r.role == "prefill"),
-            "fleet_n_decode": sum(1 for r in self._alive()
+            "fleet_n_alive": len(alive),
+            "fleet_n_prefill": n_pre,
+            "fleet_n_decode": sum(1 for r in alive
                                   if r.role == "decode"),
             "disagg_degraded": 1 if self.degraded else 0,
             # longest completed degraded episode, kill -> re-split
@@ -786,3 +918,13 @@ class FleetRouter:
             if rms else 0.0,
             **self.stats,
         }
+        out["wire_adopt_ms"] = round(out["wire_adopt_ms"], 3)
+        # donor-side export cost lives on the engines; sum it here so
+        # summarize_fleet sees one fleet-wide number next to adopt_ms
+        out["wire_export_ms"] = round(
+            sum(r.engine.stats.get("wire_export_ms", 0.0)
+                for r in self.replicas), 3)
+        out["split_ratio"] = (round(n_pre / len(alive), 3)
+                              if self.disagg and alive else 0.0)
+        out["split_trajectory"] = list(self._split_traj)
+        return out

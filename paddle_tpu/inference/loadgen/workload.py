@@ -85,6 +85,18 @@ class WorkloadSpec:
     # to new_min.
     prefill_heavy_frac: float = 0.0
     prefill_heavy_len: int = 256
+    # phase-imbalance knob (dynamic pool splitting): a FIFTH stream,
+    # same convention — earlier streams stay byte-identical. When
+    # phase_imbalance > 0, requests alternate by arrival epoch
+    # (floor(arrival / phase_epoch_s)): even epochs are prefill-heavy
+    # (prompt extended by phase_imbalance_len fresh tokens, output
+    # clamped to new_min), odd epochs decode-heavy (output raised
+    # toward new_max * phase_imbalance). The drifting mix is what the
+    # measured-load split controller (serving_disagg_dynamic) exists
+    # to chase.
+    phase_imbalance: float = 0.0
+    phase_epoch_s: float = 2.0
+    phase_imbalance_len: int = 192
 
 
 def synthesize(spec: WorkloadSpec) -> list[Request]:
@@ -179,4 +191,34 @@ def synthesize(spec: WorkloadSpec) -> list[Request]:
                 over = len(r.prompt) + r.max_new_tokens - spec.max_seq
                 if over > 0:
                     r.prompt = r.prompt[:len(r.prompt) - over]
+    if spec.phase_imbalance:
+        # phase-imbalance decoration, fifth stream: earlier draws
+        # untouched. Epoch parity comes from the (already final)
+        # arrival stamp, so the alternation is a property of wall
+        # time, not of request index.
+        rng5 = np.random.RandomState((spec.seed + 0x9A5E) % (1 << 32))
+        ep = max(spec.phase_epoch_s, 1e-9)
+        for r in reqs:
+            if rng5.rand() >= spec.phase_imbalance:
+                continue
+            if int(r.arrival // ep) % 2 == 0:
+                extra = rng5.randint(1, spec.vocab_size,
+                                     size=spec.phase_imbalance_len)
+                r.prompt = np.concatenate(
+                    [np.asarray(r.prompt, np.int32),
+                     extra.astype(np.int32)])
+                r.max_new_tokens = max(1, min(r.max_new_tokens,
+                                              spec.new_min))
+            else:
+                r.max_new_tokens = max(
+                    r.max_new_tokens,
+                    int(round(spec.new_max * spec.phase_imbalance)))
+            if spec.max_seq is not None:
+                over = len(r.prompt) + r.max_new_tokens - spec.max_seq
+                if over > 0:
+                    keep = max(1, len(r.prompt) - over)
+                    r.prompt = r.prompt[:keep]
+                    r.max_new_tokens = min(
+                        r.max_new_tokens,
+                        max(1, spec.max_seq - len(r.prompt)))
     return reqs

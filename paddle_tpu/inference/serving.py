@@ -261,7 +261,8 @@ class ServingEngine:
                  priorities: Optional[bool] = None,
                  constrained: Optional[bool] = None,
                  engine_id: int = 0,
-                 prefill_only: bool = False):
+                 prefill_only: bool = False,
+                 wire_overlap: Optional[bool] = None):
         if decode_quantum is not None:
             # the unified step (PR 7) has no decode-quantum boundary;
             # the kwarg was previously swallowed silently
@@ -321,6 +322,15 @@ class ServingEngine:
         if kv_quant is None:
             kv_quant = GLOBAL_FLAGS.get("serving_kv_quant")
         self._kv_quant = bool(kv_quant)
+        # overlapped migration wire (serving_wire_overlap): export stages
+        # an async device->host copy chained after the in-flight program
+        # instead of a blocking chain sync, and adoption commits fold
+        # into the next dispatch as one batched scatter. Off = the
+        # synchronous wire, bit-identical; a lone engine never exports
+        # or adopts, so the toggle is inert outside a fleet either way.
+        if wire_overlap is None:
+            wire_overlap = GLOBAL_FLAGS.get("serving_wire_overlap")
+        self._wire_overlap = bool(wire_overlap)
         # unified grid: n_rows chunks of qb tokens each. Every decoding
         # slot gets one row per step, remaining rows carry prefill
         # slices, so n_rows >= max_batch.
@@ -430,6 +440,12 @@ class ServingEngine:
         # begin_adopt but not yet committed into the prefix cache — the
         # ledger's ``in_flight`` class (page_accounting)
         self._adopting: list[dict] = []
+        # deferred adoption commits (wire_overlap): committed pages are
+        # already published in the prefix cache (ledger class cache_idle)
+        # but their device bytes land as one batched scatter at the next
+        # dispatch — _flush_commits runs before any program or export
+        # could read them
+        self._commit_pending: list[dict] = []
         self.stats = {
             "unified_steps": 0, "decode_steps": 0, "prefills": 0,
             "prefill_tokens": 0, "prefill_grid_tokens": 0,
@@ -445,6 +461,10 @@ class ServingEngine:
             "waste_preempted_slot_tokens": 0,      # re-prefill after preempt
             "spec_proposed_tokens": 0, "spec_accepted_tokens": 0,
             "preemptions": 0,
+            # migration-wire observability: host milliseconds this
+            # engine spent materializing export payloads (the donor-side
+            # wire cost the overlapped path shrinks to a buffer swap)
+            "wire_export_ms": 0.0,
         }
 
     # -- compiled program ---------------------------------------------------
@@ -1151,7 +1171,11 @@ class ServingEngine:
             if (req is None or s in self._prefilling or s in inflight
                     or not req.out_tokens):
                 continue
-            shipment = self.export_request_pages(req.rid)
+            t0 = time.perf_counter()
+            shipment = (self.stage_request_pages(req.rid)
+                        if self._wire_overlap
+                        else self.export_request_pages(req.rid))
+            self.stats["wire_export_ms"] += (time.perf_counter() - t0) * 1e3
             self.outbox.append((req, shipment))
             # immediate (non-deferred) release: the in-flight guard
             # above means no dispatched program references this slot's
@@ -1175,6 +1199,12 @@ class ServingEngine:
         rest idle against the sink. Charges the occupancy ledger one
         slot-token per engaged slot (m for a speculative row) — the
         decode/spec split is classified at harvest."""
+        if self._commit_pending:
+            # deferred adoption commits land HERE, between programs: the
+            # scatter chains after the in-flight step's donated output
+            # and before this dispatch, so the program about to read the
+            # adopted pages sees committed bytes
+            self._flush_commits()
         C, qb = self.n_rows, self.qb
         pref_entry = set(self._prefilling)
         decoding = [s for s in range(self.B) if self.slots[s] is not None
@@ -1438,29 +1468,42 @@ class ServingEngine:
     # resumed through adopted pages emits the same stream as an
     # uninterrupted run. The wire format ("shipment") is a dict:
     #
-    #   version=1, rid, page_size, kv_quant, dtype, geom=(L, nKV, dH)
+    #   version=2, rid, page_size, kv_quant, dtype, geom=(L, nKV, dH)
     #   hashes  [n]  cumulative prefix-chain hashes (adapter-salted)
     #   k       [n, L, nKV, dH, bs]   page-major contiguous payload
     #   v       [n, L, nKV, bs, dH]
-    #   k_scales/v_scales [n, L, nKV] fp32 (kv_quant only, else None)
+    #   k_scales/v_scales [n, L, nKV] fp32 (int8 payload only, else None)
     #   crc     [n]  crc32 over each page's k+v(+scale) bytes
+    #   -- v2 additive fields (v1 shipments lack them and still adopt):
+    #   quant_mode  "int8" | "fp"  — the PAYLOAD's representation; a
+    #               mismatched adopter converts at the edge instead of
+    #               rejecting (fp->int8 one-shot absmax quantization,
+    #               int8->fp the kernels' own fp32 dequant multiply)
+    #   tokens  [n*bs] int32 prefix tokens — lets a cross-mode adopter
+    #               re-key the pages under ITS hash preimage (the cache
+    #               tags int8 content, so hashes don't transfer)
+    #   salt    adapter-digest hash salt (b"" when no LoRA adapter)
+    #   staged  True while the payload is still an in-flight async
+    #               device->host copy (wire_overlap donors; crc=None
+    #               until finalize_shipment materializes host bytes)
     #
     # Adoption is two-phase so the page ledger stays exact while bytes
     # are in transit: begin_adopt allocates + stages (ledger class
-    # ``in_flight``), commit_adopt writes the device arrays and inserts
-    # into the prefix cache at refcount 0 (idle-cached — the victim's
-    # normal re-admission lookup increfs and splices them into its
-    # block table), abort_adopt returns staged pages to the free list.
+    # ``in_flight``), commit_adopt publishes into the prefix cache at
+    # refcount 0 (idle-cached — the victim's normal re-admission lookup
+    # increfs and splices them into its block table) and either scatters
+    # the device arrays immediately (sync wire) or defers the scatter to
+    # the next dispatch as one batched between-programs write
+    # (wire_overlap), abort_adopt returns staged pages to the free list.
 
-    def export_request_pages(self, rid: int) -> Optional[dict]:
-        """Serialize the full KV pages (+ scale planes) a resident
-        request has written, for adoption by another engine. Exportable
-        prefix = tokens both (a) known to the host (prompt + harvested
-        out_tokens — a chained in-flight token's KV exists but its value
-        doesn't) and (b) dispatched into the pool (``seq_lens`` /
-        ``_prefilling`` advance at dispatch; reading the donated page
-        arrays below syncs with any in-flight program). Returns None for
-        unknown/queued rids or when no full page is covered."""
+    def _export_meta(self, rid: int):
+        """Shared export-prefix computation: the slot serving ``rid``,
+        its hashes, and the page ids covering the exportable prefix —
+        tokens both (a) known to the host (prompt + harvested out_tokens
+        — a chained in-flight token's KV exists but its value doesn't)
+        and (b) dispatched into the pool (``seq_lens`` / ``_prefilling``
+        advance at dispatch). None for unknown/queued rids or when no
+        full page is covered."""
         for slot in range(self.B):
             req = self.slots[slot]
             if req is not None and req.rid == rid:
@@ -1476,9 +1519,34 @@ class ServingEngine:
         n_exp = known // self.bs
         if n_exp <= 0:
             return None
-        hashes = self._page_hashes(full[:n_exp * self.bs],
-                                   self._cache_salt(req))
+        tokens = np.ascontiguousarray(full[:n_exp * self.bs], np.int32)
+        salt = self._cache_salt(req)
+        hashes = self._page_hashes(tokens, salt)
         pg = np.asarray(self._full_rows[slot][:n_exp], np.int32)
+        return slot, tokens, salt, hashes, pg
+
+    def _shipment_header(self, rid: int, tokens, salt, hashes) -> dict:
+        cfg = self.cfg
+        return {"version": 2, "rid": rid, "page_size": self.bs,
+                "kv_quant": self._kv_quant,
+                "quant_mode": "int8" if self._kv_quant else "fp",
+                "dtype": str(self.k_pages.dtype),
+                "geom": (cfg.n_layers, cfg.n_kv_heads, cfg.head_dim),
+                "hashes": hashes, "tokens": tokens, "salt": salt}
+
+    def export_request_pages(self, rid: int) -> Optional[dict]:
+        """Serialize the full KV pages (+ scale planes) a resident
+        request has written, for adoption by another engine — the
+        synchronous wire: reading the donated page arrays below blocks
+        on any in-flight program. Returns None for unknown/queued rids
+        or when no full page is covered."""
+        if self._commit_pending:
+            self._flush_commits()
+        meta = self._export_meta(rid)
+        if meta is None:
+            return None
+        _slot, tokens, salt, hashes, pg = meta
+        n_exp = len(pg)
         # page-major contiguous payload; np.asarray syncs with in-flight
         # programs, so every dispatched position is actually on host
         k = np.ascontiguousarray(np.moveaxis(
@@ -1495,37 +1563,207 @@ class ServingEngine:
                           + (ks[j].tobytes() + vs[j].tobytes()
                              if self._kv_quant else b""))
                for j in range(n_exp)]
-        cfg = self.cfg
-        return {"version": 1, "rid": rid, "page_size": self.bs,
-                "kv_quant": self._kv_quant,
-                "dtype": str(self.k_pages.dtype),
-                "geom": (cfg.n_layers, cfg.n_kv_heads, cfg.head_dim),
-                "hashes": hashes, "k": k, "v": v,
-                "k_scales": ks, "v_scales": vs, "crc": crc}
+        out = self._shipment_header(rid, tokens, salt, hashes)
+        out.update({"k": k, "v": v, "k_scales": ks, "v_scales": vs,
+                    "crc": crc})
+        return out
+
+    def stage_request_pages(self, rid: int) -> Optional[dict]:
+        """Overlapped-wire export (``wire_overlap``): snapshot the
+        request's pages into a staging buffer CHAINED after the
+        in-flight program — an on-device gather plus one async
+        device->host copy per shipment — and return immediately with
+        ``staged=True`` / ``crc=None``. The donor's compute chain never
+        blocks; ``finalize_shipment`` (router drain time) materializes
+        host bytes and crcs. Safe against the donor's own page reuse:
+        the gather is dispatched before the slot's pages return to the
+        free list, and any later program writing them serializes after
+        it through the donated page arrays."""
+        if self._commit_pending:
+            self._flush_commits()
+        meta = self._export_meta(rid)
+        if meta is None:
+            return None
+        _slot, tokens, salt, hashes, pg = meta
+        pgd = jnp.asarray(pg, jnp.int32)
+        k = jnp.moveaxis(self.k_pages[:, pgd], 1, 0)
+        v = jnp.moveaxis(self.v_pages[:, pgd], 1, 0)
+        ks = vs = None
+        if self._kv_quant:
+            ks = jnp.moveaxis(self.k_scales[:, pgd], 1, 0)
+            vs = jnp.moveaxis(self.v_scales[:, pgd], 1, 0)
+        for a in (k, v, ks, vs):
+            # start the device->host transfer now, without blocking:
+            # by finalize time the bytes are (usually) already resident
+            if a is not None and hasattr(a, "copy_to_host_async"):
+                a.copy_to_host_async()
+        out = self._shipment_header(rid, tokens, salt, hashes)
+        out.update({"k": k, "v": v, "k_scales": ks, "v_scales": vs,
+                    "crc": None, "staged": True})
+        return out
+
+    def finalize_shipment(self, shipment: Optional[dict]) -> Optional[dict]:
+        """Materialize a staged shipment's host bytes + per-page crcs
+        (the router calls this when draining the outbox — the only
+        place the staging buffer is read). Chaos point
+        ``migration.stage``: ``drop`` loses the staging buffer (the
+        shipment is gone; the request falls back to re-prefill),
+        ``corrupt`` flips a payload byte AFTER the crcs are computed,
+        so the adopter's crc check rejects the page. Pass-through for
+        non-staged (sync-wire) shipments."""
+        if not shipment or not shipment.get("staged"):
+            return shipment
+        t0 = time.perf_counter()
+        quant = shipment["k_scales"] is not None
+        k = np.ascontiguousarray(np.asarray(shipment["k"]))
+        v = np.ascontiguousarray(np.asarray(shipment["v"]))
+        ks = vs = None
+        if quant:
+            ks = np.ascontiguousarray(np.asarray(shipment["k_scales"]))
+            vs = np.ascontiguousarray(np.asarray(shipment["v_scales"]))
+        crc = [zlib.crc32(k[j].tobytes() + v[j].tobytes()
+                          + (ks[j].tobytes() + vs[j].tobytes()
+                             if quant else b""))
+               for j in range(len(shipment["hashes"]))]
+        shipment.update({"k": k, "v": v, "k_scales": ks, "v_scales": vs,
+                         "crc": crc, "staged": False})
+        self.stats["wire_export_ms"] += (time.perf_counter() - t0) * 1e3
+        if _chaos.active():
+            ctx = {"engine": self.engine_id}
+            if self.pool_role is not None:
+                ctx["pool"] = self.pool_role
+            spec = _chaos.fire("migration.stage", ctx=ctx)
+            if spec is not None:
+                if spec.kind == "drop":
+                    return None
+                if spec.kind == "corrupt":
+                    # np.asarray of a device array is read-only: copy
+                    # before flipping so the mutation sticks (and
+                    # persists across redelivery retries)
+                    k = np.array(k, copy=True)
+                    k.reshape(-1).view(np.uint8)[0] ^= 0xFF
+                    shipment["k"] = k
+        return shipment
 
     @staticmethod
     def shipment_bytes(shipment: dict) -> int:
         """Wire bytes of a shipment's page payload (int8 pages ship 4x
         cheaper than bf16x2 — the EQuARX argument applied to KV)."""
         n = shipment["k"].nbytes + shipment["v"].nbytes
-        if shipment["kv_quant"]:
+        if shipment["k_scales"] is not None:
             n += shipment["k_scales"].nbytes + shipment["v_scales"].nbytes
         return int(n)
+
+    def _shipment_quant_mode(self, shipment: dict) -> str:
+        """The PAYLOAD representation of a shipment: v2 carries it
+        explicitly; v1 predates mixed-mode wires, so its ``kv_quant``
+        bool is authoritative."""
+        qm = shipment.get("quant_mode")
+        if qm is not None:
+            return qm
+        return "int8" if shipment.get("kv_quant") else "fp"
+
+    def shipment_cache_hashes(self, shipment: dict) -> Optional[list]:
+        """The hashes a shipment's pages occupy in THIS pool's cache
+        keyspace. Same-mode shipments transfer their hashes verbatim;
+        a cross-mode shipment is re-keyed from its token prefix (the
+        preimage tags the quant mode, so int8 and fp content never
+        alias). None when re-keying is impossible (v1 cross-mode) —
+        callers must then treat nothing as cached."""
+        want = "int8" if self._kv_quant else "fp"
+        if self._shipment_quant_mode(shipment) == want:
+            return list(shipment["hashes"])
+        toks = shipment.get("tokens")
+        if toks is None:
+            return None
+        return self._page_hashes(
+            np.asarray(toks, np.int32),
+            shipment.get("salt", b""))[:len(shipment["hashes"])]
+
+    def _convert_shipment(self, shipment: dict) -> Optional[dict]:
+        """fp<->int8 edge conversion for a mixed-mode wire: re-express
+        a v2 shipment's payload in THIS pool's representation and
+        re-key its hashes from the shipped token prefix. fp->int8 is a
+        one-shot per-page/per-kv-head absmax quantization — with
+        page-aligned prefill chunks that is byte-identical to what the
+        int8 engine's own running-absmax write path would have stored;
+        int8->fp applies the kernels' exact dequant (fp32 multiply,
+        cast). Crcs are checked against the ORIGINAL payload first and
+        the conversion truncates at the first bad page — a corrupt
+        shipment must not be laundered into a freshly-crc'd one.
+        Returns None when the shipment cannot be re-keyed (v1: no
+        token prefix on the wire)."""
+        toks = shipment.get("tokens")
+        if toks is None:
+            return None
+        from ..ops.quant import SCALE_EPS
+
+        src_q = self._shipment_quant_mode(shipment) == "int8"
+        k, v = shipment["k"], shipment["v"]
+        ks, vs = shipment["k_scales"], shipment["v_scales"]
+        n_ok = 0
+        for j in range(len(shipment["hashes"])):
+            if zlib.crc32(k[j].tobytes() + v[j].tobytes()
+                          + (ks[j].tobytes() + vs[j].tobytes()
+                             if src_q else b"")) != shipment["crc"][j]:
+                break     # corrupt: pages past j can't extend the chain
+            n_ok += 1
+        tokens = np.asarray(toks, np.int32)[:n_ok * self.bs]
+        hashes = self._page_hashes(tokens, shipment.get("salt", b""))
+        dt = self.k_pages.dtype
+        if src_q:
+            # int8 payload -> fp pool: q * scale in fp32 (exactly what
+            # both attention arms compute), cast to the pool dtype
+            kc = (k[:n_ok].astype(np.float32)
+                  * ks[:n_ok, :, :, None, None]).astype(dt)
+            vc = (v[:n_ok].astype(np.float32)
+                  * vs[:n_ok, :, :, None, None]).astype(dt)
+            ksc = vsc = None
+        else:
+            # fp payload -> int8 pool: one-shot absmax over each page's
+            # [dH, bs] tail dims per (page, layer, kv-head). The STORED
+            # scale is the raw absmax/127 (the engine's running plane is
+            # never clamped — only the quantizing divide is, exactly as
+            # quantize_to_scale does), so a page written in one aligned
+            # chunk converts byte-identically to what the int8 engine's
+            # own write path stores.
+            kf = np.asarray(k[:n_ok], np.float32)
+            vf = np.asarray(v[:n_ok], np.float32)
+            ksc = (np.abs(kf).max(axis=(3, 4))
+                   / np.float32(127.0)).astype(np.float32)
+            vsc = (np.abs(vf).max(axis=(3, 4))
+                   / np.float32(127.0)).astype(np.float32)
+            kc = np.clip(np.round(
+                kf / np.maximum(ksc, SCALE_EPS)[:, :, :, None, None]),
+                -127, 127).astype(np.int8)
+            vc = np.clip(np.round(
+                vf / np.maximum(vsc, SCALE_EPS)[:, :, :, None, None]),
+                -127, 127).astype(np.int8)
+        crc = [zlib.crc32(kc[j].tobytes() + vc[j].tobytes()
+                          + (ksc[j].tobytes() + vsc[j].tobytes()
+                             if ksc is not None else b""))
+               for j in range(n_ok)]
+        out = dict(shipment)
+        out.update({"kv_quant": self._kv_quant,
+                    "quant_mode": "int8" if self._kv_quant else "fp",
+                    "dtype": str(dt), "hashes": hashes, "tokens": tokens,
+                    "k": kc, "v": vc, "k_scales": ksc, "v_scales": vsc,
+                    "crc": crc})
+        return out
 
     def begin_adopt(self, shipment: dict) -> Optional[dict]:
         """Phase 1 of adoption: validate the shipment against this
         pool's geometry (ValueError on mismatch — shipments only move
-        between replicas of one model), drop pages whose crc fails or
-        whose hash is already resident, allocate pool pages for the
-        rest, and stage them (ledger class ``in_flight``). Returns the
-        staging handle, or None when nothing is adoptable (all cached,
-        crc-dead at page 0, allocation failure, or an armed
-        ``migration.adopt`` fault)."""
+        between replicas of one model; a mismatched QUANT MODE on a v2
+        shipment converts at the edge instead), drop pages whose crc
+        fails or whose hash is already resident, allocate pool pages
+        for the rest, and stage them (ledger class ``in_flight``).
+        Returns the staging handle, or None when nothing is adoptable
+        (all cached, crc-dead at page 0, allocation failure, or an
+        armed ``migration.adopt`` fault)."""
         cfg = self.cfg
-        if (shipment.get("version") != 1
+        if (shipment.get("version") not in (1, 2)
                 or shipment["page_size"] != self.bs
-                or shipment["kv_quant"] != self._kv_quant
-                or shipment["dtype"] != str(self.k_pages.dtype)
                 or tuple(shipment["geom"]) != (cfg.n_layers,
                                                cfg.n_kv_heads,
                                                cfg.head_dim)):
@@ -1534,6 +1772,20 @@ class ServingEngine:
                 f"{shipment.get('dtype')}/{shipment.get('geom')} does "
                 f"not match this pool ({self.bs}/{self.k_pages.dtype}/"
                 f"{(cfg.n_layers, cfg.n_kv_heads, cfg.head_dim)})")
+        want = "int8" if self._kv_quant else "fp"
+        if self._shipment_quant_mode(shipment) != want:
+            conv = self._convert_shipment(shipment)
+            if conv is None:
+                raise ValueError(
+                    f"shipment quant mode "
+                    f"{self._shipment_quant_mode(shipment)} does not "
+                    f"match this pool ({want}) and carries no token "
+                    f"prefix to re-key from (wire v1)")
+            shipment = conv
+        elif shipment["dtype"] != str(self.k_pages.dtype):
+            raise ValueError(
+                f"shipment dtype {shipment['dtype']} does not match "
+                f"this pool ({self.k_pages.dtype})")
         if _chaos.active():
             spec = _chaos.fire("migration.adopt",
                                ctx={"engine": self.engine_id})
@@ -1561,30 +1813,56 @@ class ServingEngine:
         return handle
 
     def commit_adopt(self, handle: dict) -> int:
-        """Phase 2: write the staged pages' bytes into the device pool
-        (one batched scatter per array, chained after any in-flight
-        program's donated output) and publish them in the prefix cache
-        at refcount 0 — idle-cached, exactly where a page a finished
+        """Phase 2: publish the staged pages in the prefix cache at
+        refcount 0 — idle-cached, exactly where a page a finished
         request offered would sit, so the victim's re-admission lookup
-        (and anyone sharing the prefix) increfs them from there.
+        (and anyone sharing the prefix) increfs them from there — and
+        write their bytes into the device pool: immediately on the
+        synchronous wire (one batched scatter per array, chained after
+        any in-flight program's donated output), or deferred to the
+        next dispatch under ``wire_overlap`` (_flush_commits folds all
+        pending commits into ONE between-programs scatter, so adoption
+        never serializes behind the in-flight chain). Chaos point
+        ``migration.commit`` (kind ``raise``) fires before any state
+        moves — abort_adopt still rolls the staging back leak-free.
         Returns the number of pages adopted."""
+        if _chaos.active():
+            ctx = {"engine": self.engine_id}
+            if self.pool_role is not None:
+                ctx["pool"] = self.pool_role
+            spec = _chaos.fire("migration.commit", ctx=ctx)
+            if spec is not None and spec.kind == "raise":
+                raise _chaos.ChaosInjected(
+                    f"chaos: engine {self.engine_id} commit failure")
         self._adopting.remove(handle)
         shipment, staged = handle["shipment"], handle["staged"]
         idx = [j for j, _ in staged]
         pages = [p for _, p in staged]
-        pg = jnp.asarray(pages, jnp.int32)
-        dt = self.k_pages.dtype
-        self.k_pages = self.k_pages.at[:, pg].set(
-            jnp.asarray(np.moveaxis(shipment["k"][idx], 0, 1), dt))
-        self.v_pages = self.v_pages.at[:, pg].set(
-            jnp.asarray(np.moveaxis(shipment["v"][idx], 0, 1), dt))
-        if self._kv_quant:
-            self.k_scales = self.k_scales.at[:, pg].set(
-                jnp.asarray(np.moveaxis(shipment["k_scales"][idx], 0, 1),
-                            jnp.float32))
-            self.v_scales = self.v_scales.at[:, pg].set(
-                jnp.asarray(np.moveaxis(shipment["v_scales"][idx], 0, 1),
-                            jnp.float32))
+        if self._wire_overlap:
+            self._commit_pending.append({
+                "pages": pages,
+                "hashes": [shipment["hashes"][j] for j in idx],
+                "k": np.moveaxis(shipment["k"][idx], 0, 1),
+                "v": np.moveaxis(shipment["v"][idx], 0, 1),
+                "ks": (np.moveaxis(shipment["k_scales"][idx], 0, 1)
+                       if self._kv_quant else None),
+                "vs": (np.moveaxis(shipment["v_scales"][idx], 0, 1)
+                       if self._kv_quant else None),
+            })
+        else:
+            pg = jnp.asarray(pages, jnp.int32)
+            dt = self.k_pages.dtype
+            self.k_pages = self.k_pages.at[:, pg].set(
+                jnp.asarray(np.moveaxis(shipment["k"][idx], 0, 1), dt))
+            self.v_pages = self.v_pages.at[:, pg].set(
+                jnp.asarray(np.moveaxis(shipment["v"][idx], 0, 1), dt))
+            if self._kv_quant:
+                self.k_scales = self.k_scales.at[:, pg].set(
+                    jnp.asarray(np.moveaxis(shipment["k_scales"][idx],
+                                            0, 1), jnp.float32))
+                self.v_scales = self.v_scales.at[:, pg].set(
+                    jnp.asarray(np.moveaxis(shipment["v_scales"][idx],
+                                            0, 1), jnp.float32))
         for (j, p) in staged:
             self.pool.insert(shipment["hashes"][j], p)
         # drop the insert refcount: the pages idle in the cache until a
@@ -1592,6 +1870,44 @@ class ServingEngine:
         # harvest/idle commit like any other pending page.
         self.pool.decref(pages)
         return len(pages)
+
+    def _flush_commits(self) -> None:
+        """Apply all deferred adoption commits (``wire_overlap``) as one
+        batched scatter per page array. Runs between programs — at
+        dispatch entry, before any program could attend the pages, and
+        at export entry, before their bytes could re-ship. A pending
+        page whose cache entry no longer matches its commit hash was
+        evicted (and possibly re-allocated) since the commit: writing
+        it now would clobber the new tenant's bytes — and, under
+        kv_quant, its freshly-zeroed scale plane — so it is skipped."""
+        pend, self._commit_pending = self._commit_pending, []
+        pages: list[int] = []
+        karrs, varrs, ksarrs, vsarrs = [], [], [], []
+        for ent in pend:
+            keep = [i for i, (p, h) in enumerate(zip(ent["pages"],
+                                                     ent["hashes"]))
+                    if self.pool.hash_of.get(p) == h]
+            if not keep:
+                continue
+            pages += [ent["pages"][i] for i in keep]
+            karrs.append(ent["k"][:, keep])
+            varrs.append(ent["v"][:, keep])
+            if ent["ks"] is not None:
+                ksarrs.append(ent["ks"][:, keep])
+                vsarrs.append(ent["vs"][:, keep])
+        if not pages:
+            return
+        pg = jnp.asarray(pages, jnp.int32)
+        dt = self.k_pages.dtype
+        self.k_pages = self.k_pages.at[:, pg].set(
+            jnp.asarray(np.concatenate(karrs, axis=1), dt))
+        self.v_pages = self.v_pages.at[:, pg].set(
+            jnp.asarray(np.concatenate(varrs, axis=1), dt))
+        if self._kv_quant:
+            self.k_scales = self.k_scales.at[:, pg].set(
+                jnp.asarray(np.concatenate(ksarrs, axis=1), jnp.float32))
+            self.v_scales = self.v_scales.at[:, pg].set(
+                jnp.asarray(np.concatenate(vsarrs, axis=1), jnp.float32))
 
     def abort_adopt(self, handle: dict) -> None:
         """Roll back a staged adoption: pages return to the free list
@@ -1602,11 +1918,18 @@ class ServingEngine:
 
     def adopt_pages(self, shipment: dict) -> int:
         """begin_adopt + commit_adopt in one call (the router's path);
-        returns pages adopted (0 when nothing was adoptable)."""
+        returns pages adopted (0 when nothing was adoptable). A commit
+        that raises (chaos ``migration.commit``) aborts the staging
+        leak-free and reports 0 — the wire treats it as a rejection
+        and the request falls back to retry/re-prefill."""
         handle = self.begin_adopt(shipment)
         if handle is None:
             return 0
-        return self.commit_adopt(handle)
+        try:
+            return self.commit_adopt(handle)
+        except Exception:
+            self.abort_adopt(handle)
+            return 0
 
     def kv_bytes_per_page(self) -> float:
         """HBM bytes one KV page costs across all layers, including the

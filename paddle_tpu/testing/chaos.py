@@ -54,6 +54,19 @@ point                 kinds
                       pages still count)
 ``migration.adopt``   ``fail`` (survivor refuses the shipment before
                       staging — e.g. no free pages at the adopter)
+``migration.stage``   ``drop`` (a wire_overlap donor's staging buffer is
+                      lost at finalize — the shipment never reaches the
+                      wire and the request falls back to re-prefill),
+                      ``corrupt`` (one staging-buffer payload byte
+                      flipped AFTER the crcs are computed, so the
+                      adopter's per-page crc rejects the page).
+                      Pool-scoped like ``engine.step``: the donor tags
+                      its probe with its pool role
+``migration.commit``  ``raise`` (ChaosInjected out of commit_adopt
+                      before any state moves — the staged pages roll
+                      back leak-free through abort_adopt and the wire
+                      reports a rejection). Pool-scoped: the adopter
+                      tags its probe with its pool role
 ====================  ======================================================
 
 Multi-host targeting: a spec with ``rank=<r>`` in its args fires only in

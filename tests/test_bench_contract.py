@@ -203,11 +203,52 @@ def test_disagg_key_contract(bench):
     assert out["disagg_degraded_steps"] == 120.0
     assert out["disagg_recovery_ms"] == 850.5
     assert out["disagg_failover_ttft_p99"] == 1.9
+    # the wire extension keys only appear when the overlap/int8 arms
+    # are passed (the 3-arg call above stays exactly the base set)
+    assert "overlap_wire_ms_per_handoff" not in out
     # error marker name is wired in the secondary list
     import inspect
 
     src = inspect.getsource(bench._run_secondary_benches)
     assert "_bench_disagg" in src and "disagg_error" in src
+
+
+def test_disagg_wire_key_contract(bench):
+    """The ISSUE 14 wire extension of _disagg_keys: per-handoff wire
+    cost for the synchronous vs overlapped arms (speedup > 1 = the
+    staged export + deferred commit won) and bytes per handoff for the
+    fp vs native-int8 arms (compression ~4x on an fp32 cache)."""
+    m = {"ttft_p50_s": 0.20, "ttft_p99_s": 0.80,
+         "goodput_tok_s": 300.0, "disagg_shipped_pages": 40,
+         "shipped_bytes": 400000, "n_handoffs": 10,
+         "ship_queue_depth": 3, "wire_export_ms": 50.0,
+         "wire_adopt_ms": 30.0}
+    coloc = {"ttft_p50_s": 0.35, "ttft_p99_s": 1.30}
+    fail = {"degraded_steps": 120, "degraded_frac": 0.4,
+            "disagg_recovery_ms": 850.5, "ttft_p99_s": 1.9}
+    overlap = {"ttft_p99_s": 0.75, "goodput_tok_s": 310.0,
+               "shipped_bytes": 400000, "n_handoffs": 10,
+               "wire_export_ms": 10.0, "wire_adopt_ms": 10.0}
+    int8 = {"shipped_bytes": 101000, "n_handoffs": 10}
+    out = bench._disagg_keys(m, coloc, fail, overlap=overlap, int8=int8)
+    for k in ("disagg_shipped_bytes", "disagg_n_handoffs",
+              "disagg_ship_queue_depth", "disagg_wire_export_ms",
+              "disagg_wire_adopt_ms", "disagg_wire_ms_per_handoff",
+              "overlap_wire_ms_per_handoff", "overlap_wire_speedup",
+              "overlap_ttft_p99", "overlap_goodput",
+              "fp_bytes_per_handoff", "int8_bytes_per_handoff",
+              "int8_wire_compression"):
+        assert k in out, k
+    # the base set rides along unchanged
+    assert out["disagg_ttft_p99"] == 0.80
+    assert out["disagg_shipped_bytes"] == 400000.0
+    assert out["disagg_ship_queue_depth"] == 3.0
+    assert out["disagg_wire_ms_per_handoff"] == pytest.approx(8.0)
+    assert out["overlap_wire_ms_per_handoff"] == pytest.approx(2.0)
+    assert out["overlap_wire_speedup"] == pytest.approx(4.0)
+    assert out["fp_bytes_per_handoff"] == pytest.approx(40000.0)
+    assert out["int8_bytes_per_handoff"] == pytest.approx(10100.0)
+    assert out["int8_wire_compression"] == pytest.approx(3.96, abs=0.01)
 
 
 def test_multichip_key_contract(bench):

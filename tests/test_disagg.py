@@ -245,7 +245,8 @@ def test_wire_zero_full_page_export_is_well_formed_nothing():
         donor.step(now=1e18)
     assert donor.export_request_pages(7) is None
     res = ship_shipment(None, donor.engine_id, recv)
-    assert res == {"status": "nothing", "pages": 0, "bytes": 0}
+    assert res == {"status": "nothing", "pages": 0, "bytes": 0,
+                   "adopt_ms": 0.0}
     _assert_fleet_ledger(router)
 
 
@@ -272,7 +273,8 @@ def test_wire_redelivery_skips_cached_hashes():
     free0 = len(recv.pool.free)
     # redelivery: all hashes cached -> no staging, no allocation
     again = ship_shipment(ship, donor.engine_id, recv)
-    assert again == {"status": "ok", "pages": 0, "bytes": 0}
+    assert again == {"status": "ok", "pages": 0, "bytes": 0,
+                     "adopt_ms": 0.0}
     assert recv.begin_adopt(ship) is None
     assert recv.page_accounting()["in_flight"] == 0
     assert len(recv.pool.free) == free0

@@ -7,6 +7,11 @@ fleet must degrade to colocated mode and complete every request
 every surviving engine's page ledger must settle to free + cache_idle
 only: zero leak across all ledger classes, nothing stuck in_flight.
 
+The scenario runs TWICE: once with fp KV, once under
+``serving_kv_quant`` where shipments carry native int8 bytes + scale
+planes — the int8 pass must ship strictly fewer wire bytes than the fp
+pass while holding the same bit-identity and zero-leak bars.
+
 Usage:  JAX_PLATFORMS=cpu python -m tools.disagg_smoke
 """
 
@@ -17,13 +22,19 @@ import sys
 import numpy as np
 
 
-def main() -> int:
+def run_scenario(label: str) -> int:
+    """One full pool-kill pass. Returns the fleet's total shipped wire
+    bytes on success, or -1 on failure (details on stderr)."""
     import jax.numpy as jnp
 
     from paddle_tpu.inference.fleet import FleetRouter
     from paddle_tpu.inference.serving import Request, ServingEngine
     from paddle_tpu.models.llama import LlamaConfig
     from paddle_tpu.testing import chaos
+
+    def fail(msg: str) -> int:
+        print(f"disagg_smoke[{label}]: FAIL — {msg}", file=sys.stderr)
+        return -1
 
     cfg = LlamaConfig(vocab_size=256, hidden=64, n_layers=2, n_heads=4,
                       n_kv_heads=2, ffn_hidden=128, max_seq_len=256,
@@ -57,9 +68,7 @@ def main() -> int:
     while router.step(now=1e18):
         steps += 1
         if steps > 3000:
-            print("disagg_smoke: FAIL — fleet did not drain",
-                  file=sys.stderr)
-            return 1
+            return fail("fleet did not drain")
         if not armed and router.stats["disagg_shipped_pages"] >= 1:
             pre_busy = any(
                 rep.alive and rep.role == "prefill"
@@ -74,26 +83,22 @@ def main() -> int:
     chaos.disarm()
 
     if not armed:
-        print("disagg_smoke: FAIL — never reached the mid-shipment "
-              "window (a page adopted while prefill work remained)",
-              file=sys.stderr)
-        return 1
+        return fail("never reached the mid-shipment window (a page "
+                    "adopted while prefill work remained)")
     st = router.fleet_stats()
     if st["fleet_n_prefill"] != 0 or st["n_killed"] != 2:
-        print(f"disagg_smoke: FAIL — prefill pool not fully dead: {st}",
-              file=sys.stderr)
-        return 1
+        return fail(f"prefill pool not fully dead: {st}")
     if not router.degraded or st["degraded_steps"] < 1:
-        print(f"disagg_smoke: FAIL — pool death did not enter degraded "
-              f"colocated mode: {st}", file=sys.stderr)
-        return 1
+        return fail(f"pool death did not enter degraded colocated "
+                    f"mode: {st}")
+    if st["shipped_bytes"] <= 0:
+        return fail(f"no bytes crossed the wire: {st}")
 
     bad = [r.rid for r in reqs if r.aborted or r.t_done is None
            or len(r.out_tokens) != r.max_new_tokens]
     if bad:
-        print(f"disagg_smoke: FAIL — incomplete/aborted requests {bad} "
-              f"after the pool kill", file=sys.stderr)
-        return 1
+        return fail(f"incomplete/aborted requests {bad} after the "
+                    f"pool kill")
 
     # bit-identity: every stream equals an uninterrupted solo run on a
     # fresh engine sharing the same params
@@ -105,10 +110,9 @@ def main() -> int:
                        seed=r.seed)
         solo_eng.run([solo])
         if solo.out_tokens != r.out_tokens:
-            print(f"disagg_smoke: FAIL — rid {r.rid} stream differs "
-                  f"from its uninterrupted run: {r.out_tokens} vs "
-                  f"{solo.out_tokens}", file=sys.stderr)
-            return 1
+            return fail(f"rid {r.rid} stream differs from its "
+                        f"uninterrupted run: {r.out_tokens} vs "
+                        f"{solo.out_tokens}")
 
     # every surviving engine settles to free + cache_idle only; dead
     # prefill engines' frozen pools still sum
@@ -120,23 +124,43 @@ def main() -> int:
             e.pool.commit_evictable()
         acc = e.page_accounting()
         if acc["total"] != e.n_pages - 1:
-            print(f"disagg_smoke: FAIL — engine {e.engine_id} ledger "
-                  f"does not sum: {acc}", file=sys.stderr)
-            return 1
+            return fail(f"engine {e.engine_id} ledger does not sum: "
+                        f"{acc}")
         if rep.alive and any(acc[k] for k in
                              ("slot_owned", "slot_shared",
                               "deferred_free", "adapter", "in_flight")):
-            print(f"disagg_smoke: FAIL — survivor {e.engine_id} leaked "
-                  f"pages: {acc}", file=sys.stderr)
-            return 1
+            return fail(f"survivor {e.engine_id} leaked pages: {acc}")
 
-    print(f"disagg_smoke: OK — {st['disagg_shipped_pages']} page(s) "
-          f"adopted over the prefill->decode wire "
-          f"({st['disagg_ship_bytes']} bytes), whole prefill pool "
+    print(f"disagg_smoke[{label}]: OK — {st['disagg_shipped_pages']} "
+          f"page(s) adopted over the prefill->decode wire "
+          f"({st['shipped_bytes']} bytes), whole prefill pool "
           f"chaos-killed mid-shipment, fleet degraded to colocated for "
           f"{st['degraded_steps']} tick(s), all 6 streams (incl. "
           f"sampled) bit-identical to uninterrupted runs, surviving "
           f"ledgers close with no leak")
+    return int(st["shipped_bytes"])
+
+
+def main() -> int:
+    from paddle_tpu.core.flags import GLOBAL_FLAGS
+
+    fp_bytes = run_scenario("fp")
+    if fp_bytes < 0:
+        return 1
+    GLOBAL_FLAGS.set("serving_kv_quant", True)
+    try:
+        q_bytes = run_scenario("int8")
+    finally:
+        GLOBAL_FLAGS.set("serving_kv_quant", False)
+    if q_bytes < 0:
+        return 1
+    if q_bytes >= fp_bytes:
+        print(f"disagg_smoke: FAIL — int8 wire not smaller than fp "
+              f"({q_bytes} vs {fp_bytes} bytes)", file=sys.stderr)
+        return 1
+    print(f"disagg_smoke: OK — int8 pass shipped {q_bytes} bytes vs fp "
+          f"{fp_bytes} ({fp_bytes / max(1, q_bytes):.2f}x smaller "
+          f"wire), both passes leak-free and bit-identical")
     return 0
 
 
