@@ -169,6 +169,7 @@ def _run_secondary_benches() -> dict:
                              ("_bench_13b", "gpt3_1p3b_error"),
                              ("_bench_long_ctx", "long_ctx_error"),
                              ("_bench_multichip", "multichip_error"),
+                             ("_bench_fusion", "fusion_error"),
                              ("_bench_phases", "phases_error")):
         try:
             extra.update(globals()[fn_name]())
@@ -835,6 +836,66 @@ def _bench_multichip():
                            f"{proc.stderr[-300:]}")
     lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
     return _multichip_keys(json.loads(lines[-1]))
+
+
+def _fusion_keys(rep: dict, step_ms: float, n_tokens: int) -> dict:
+    """Pure FusionReport-summary -> bench-keys mapping (ISSUE 15
+    satellite; unit-pinned in tests/test_bench_contract.py).  ``rep``
+    carries n_sites/n_applied/program_cache_hit from the compiler's
+    report; ``step_ms``/``n_tokens`` time the auto-fused train step."""
+    out = {
+        "fusion_n_sites": int(rep.get("n_sites", 0)),
+        "fusion_n_applied": int(rep.get("n_applied", 0)),
+        "fusion_step_ms": round(float(step_ms), 3),
+        "fusion_tok_s": (round(n_tokens / (step_ms / 1000.0), 1)
+                         if step_ms > 0 else 0.0),
+        "autotune_program_cache_hit": bool(rep.get("program_cache_hit",
+                                                   False)),
+    }
+    return out
+
+
+def _bench_fusion():
+    """Auto-fused train step on the fusable llama shapes (ISSUE 15): the
+    fusion pass rediscovers the norm/rope/activation sites from the
+    step's jaxpr, and the step is timed with the plan applied.  The
+    n_sites key pins discovery (a matcher regression drops it to 0 even
+    when throughput noise hides the slowdown); the program-cache-hit key
+    shows whether this session replayed a committed v2 plan."""
+    from jax.sharding import Mesh
+
+    from paddle_tpu.compiler import discover, last_report
+    from paddle_tpu.models import gpt as G
+    from paddle_tpu.parallel.train_step import make_sharded_train_step
+
+    cfg = G.GPTConfig(vocab_size=2048, hidden=256, n_layers=2, n_heads=2,
+                      seq_len=256, dtype=jnp.bfloat16)
+    B, T = 8, cfg.seq_len
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(B, T)),
+                       jnp.int32)
+    labs = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(B, T)),
+                       jnp.int32)
+
+    params0 = G.init_params(cfg, jax.random.PRNGKey(0))
+    rep = discover(functools.partial(G._model_apply_unfused, cfg=cfg),
+                   params0, toks)
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    step, params, opt_state = make_sharded_train_step(cfg, mesh, zero1=False)
+    loss, params, opt_state = step(params, opt_state, toks, labs)
+    jax.block_until_ready(loss)
+    wrap_rep = last_report()  # the step's own auto_fuse trace, if wrapped
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        loss, params, opt_state = step(params, opt_state, toks, labs)
+        float(loss)  # fetch = the only reliable sync over the tunnel
+        best = min(best, time.perf_counter() - t0)
+    hit = bool(wrap_rep.program_cache_hit) if wrap_rep is not None else False
+    return _fusion_keys({"n_sites": rep.n_sites, "n_applied": rep.n_applied,
+                         "program_cache_hit": hit},
+                        best * 1000.0, B * T)
 
 
 def _bench_phases():

@@ -165,6 +165,18 @@ define_flag("use_fused_norm_epilogue", True,
             "Fuse residual-add + bias + RMSNorm/LayerNorm (+ optional "
             "activation) into one VMEM-resident Pallas kernel for the "
             "attention/FFN epilogues; 0 restores the unfused XLA ops.")
+define_flag("use_fused_bias_act", True,
+            "Let the fusion pass discover FFN activation chains — "
+            "bias+gelu (gpt) and swiglu (llama) — and rewrite them to "
+            "ops/pallas/fused_bias_act.py; 0 disables discovery of the "
+            "two activation templates only.")
+define_flag("use_auto_fusion", True,
+            "Run the jaxpr-level fusion pass (paddle_tpu/compiler/) over "
+            "jitted model steps: discover catalog template matches "
+            "(norm epilogues, RoPE+attention, bias+gelu, swiglu) and "
+            "rewrite them to the fused Pallas kernels. 0 skips the pass "
+            "entirely — the traced jaxpr is bit-identical to the unfused "
+            "composition.")
 
 # -- Pallas autotune registry (ops/pallas/autotune.py) --------------------
 define_flag("pallas_autotune", True,

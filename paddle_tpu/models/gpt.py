@@ -222,27 +222,16 @@ def block_apply(bp: dict, x, cfg: GPTConfig, sp_constraint=None):
         v = v.reshape(B, T, cfg.n_heads, cfg.head_dim)
         o = _attention(q, k, v, cfg).reshape(B, T, H)
     o = jnp.einsum("bth,hk->btk", o, bp["proj_w"].astype(cfg.dtype))
-    use_fused_norm = sp_constraint is None
-    if use_fused_norm:
-        from ..core.flags import GLOBAL_FLAGS
-
-        use_fused_norm = (GLOBAL_FLAGS.get("use_fused_norm_epilogue")
-                          if GLOBAL_FLAGS.has("use_fused_norm_epilogue")
-                          else True)
-    if use_fused_norm:
-        from ..ops.pallas.fused_norm_epilogue import fused_norm_epilogue
-
-        # residual + proj bias + ln2 in one VMEM pass; when the SP
-        # constraint reshards between the add and the norm the fusion
-        # cannot apply, so that path keeps the unfused composition
-        x, h = fused_norm_epilogue(x, sub=o, bias=bp["proj_b"],
-                                   gain=bp["ln2_g"], beta=bp["ln2_b"],
-                                   norm="layer", eps=cfg.eps)
-    else:
-        x = x + o + bp["proj_b"].astype(cfg.dtype)
-        if sp_constraint is not None:
-            x = sp_constraint(x)
-        h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"], cfg.eps)
+    # Unfused residual + proj bias + ln2: the compiler pass
+    # (paddle_tpu/compiler/, layer_epilogue template) rediscovers this
+    # chain in the traced jaxpr and rewrites it to fused_norm_epilogue —
+    # and its matcher refuses to fuse across the SP resharding point, so
+    # the sp_constraint path stays unfused exactly as the old hand-wired
+    # gate kept it.
+    x = x + o + bp["proj_b"].astype(cfg.dtype)
+    if sp_constraint is not None:
+        x = sp_constraint(x)
+    h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"], cfg.eps)
     h = jnp.einsum("bth,hf->btf", h, bp["fc_w"].astype(cfg.dtype))
     h = jax.nn.gelu(h + bp["fc_b"].astype(cfg.dtype), approximate=True)
     h = jnp.einsum("btf,fh->bth", h, bp["fc2_w"].astype(cfg.dtype))
@@ -300,6 +289,28 @@ def moe_block_apply(mp: dict, x, cfg: GPTConfig):
 def model_apply(params: dict, tokens, cfg: GPTConfig, sp_constraint=None,
                 blocks_fn=None, return_hidden: bool = False,
                 emb_constraint=None):
+    """Forward to logits, routed through the fusion compiler when no
+    resharding callables are injected (the condition under which kernel
+    fusion used to be hand-wired).  Constrained/pipelined paths run the
+    unfused composition here and get their fusion at the train-step
+    level (parallel/train_step.py wraps the whole step)."""
+    if sp_constraint is None and blocks_fn is None and emb_constraint is None:
+        from ..compiler import fused_call
+
+        return fused_call(("gpt_apply", cfg, bool(return_hidden)),
+                          functools.partial(_model_apply_unfused, cfg=cfg,
+                                            return_hidden=return_hidden),
+                          params, tokens)
+    return _model_apply_unfused(params, tokens, cfg,
+                                sp_constraint=sp_constraint,
+                                blocks_fn=blocks_fn,
+                                return_hidden=return_hidden,
+                                emb_constraint=emb_constraint)
+
+
+def _model_apply_unfused(params: dict, tokens, cfg: GPTConfig,
+                         sp_constraint=None, blocks_fn=None,
+                         return_hidden: bool = False, emb_constraint=None):
     """Forward to logits (or the final hidden states with
     ``return_hidden`` — the chunked-loss path projects to vocab itself).
     ``blocks_fn(params_blocks, x)`` overrides the dense-stack execution
@@ -442,7 +453,7 @@ def loss_fn(params, tokens, labels, cfg: GPTConfig, sp_constraint=None,
                      and (GLOBAL_FLAGS.get("use_fused_ce")
                           if GLOBAL_FLAGS.has("use_fused_ce") else True))
         if use_fused:
-            nll_tok = fused_softmax_ce(
+            nll_tok = fused_softmax_ce(  # tpu-lint: disable=TPL009 -- TPU-only loss-head kernel; the CE chain streams vocab tiles and has no jaxpr-level template
                 hidden.reshape(B * T, cfg.hidden), head.astype(cfg.dtype),
                 labels.reshape(B * T))
             return nll_tok.mean() + 0.01 * aux

@@ -183,88 +183,41 @@ def _decode_weight_quant_flag() -> bool:
             if GLOBAL_FLAGS.has("decode_weight_quant") else False)
 
 
-def _use_fused_norm_epilogue() -> bool:
-    """Trace-time read of the epilogue routing flag (default on). The jit
-    cache does not key on flags, so this only steers tracing."""
-    from ..core.flags import GLOBAL_FLAGS
-
-    return (bool(GLOBAL_FLAGS.get("use_fused_norm_epilogue"))
-            if GLOBAL_FLAGS.has("use_fused_norm_epilogue") else True)
-
-
-def _use_fused_rope_attention() -> bool:
-    """Trace-time read of the fused rope+flash routing flag (default on)."""
-    from ..core.flags import GLOBAL_FLAGS
-
-    return (bool(GLOBAL_FLAGS.get("use_fused_rope_attention"))
-            if GLOBAL_FLAGS.has("use_fused_rope_attention") else True)
-
-
 def block_apply(bp, x, cfg: LlamaConfig, cos, sin, use_flash=True,
                 return_kv: bool = False):
-    """Training/prefill block: full-sequence causal attention.
-    ``return_kv=True`` additionally returns the (pre-repeat) rotated k/v —
-    the prefill path uses this to fill the decode cache with the SAME block
-    computation (no duplicated transformer math)."""
+    """Training/prefill block: full-sequence causal attention, written as
+    the plain UNFUSED composition.  Kernel fusion is no longer wired by
+    hand here: the compiler pass (paddle_tpu/compiler/) rediscovers the
+    rms-epilogue and rope+flash chains in this function's jaxpr — plus
+    the swiglu chain nobody ever hand-wired — and rewrites them to the
+    fused Pallas entries when the enclosing apply goes through
+    ``auto_fuse``.  ``return_kv=True`` additionally returns the
+    (pre-repeat) rotated k/v — the prefill path uses this to fill the
+    decode cache with the SAME block computation; the escaping rotated k
+    is exactly what makes the compiler pick the q-only rope fusion
+    there, reproducing the old rope_k=False hand-wiring."""
     B, T, H = x.shape
     nH, nKV, dH = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    use_fused_norm = _use_fused_norm_epilogue()
-    if use_fused_norm:
-        from ..ops.pallas.fused_norm_epilogue import fused_norm_epilogue
-
-        # norm-only site (no residual add precedes it inside the block);
-        # the passthrough r is bitwise x
-        x, h = fused_norm_epilogue(x, gain=bp["attn_norm"], norm="rms",
-                                   eps=cfg.rms_eps)
-    else:
-        h = rms_norm(x, bp["attn_norm"], cfg.rms_eps)
+    h = rms_norm(x, bp["attn_norm"], cfg.rms_eps)
     q = _mm(h, bp["wq"], cfg).reshape(B, T, nH, dH)
     k = _mm(h, bp["wk"], cfg).reshape(B, T, nKV, dH)
     v = _mm(h, bp["wv"], cfg).reshape(B, T, nKV, dH)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    kf = _repeat_kv(k, nH // nKV)
+    vf = _repeat_kv(v, nH // nKV)
     o = None
-    if use_flash and _use_fused_rope_attention():
-        from ..ops.pallas.fused_rope_attention import (
-            fused_rope_flash_attention, fused_rope_supported)
+    if use_flash:
+        from ..ops.pallas.flash_attention import (flash_attention_raw,
+                                                  supported)
 
-        if fused_rope_supported((B, T, nH, dH), q.dtype):
-            if return_kv:
-                # the decode cache stores the ROTATED pre-repeat k, so
-                # rotate it once XLA-side and fuse only the q rotation
-                k = apply_rope(k, cos, sin)
-                o = fused_rope_flash_attention(
-                    q, _repeat_kv(k, nH // nKV), _repeat_kv(v, nH // nKV),
-                    cos, sin, causal=True, rope_k=False)
-            else:
-                # rope(repeat(k)) == repeat(rope(k)): the tables depend
-                # only on position, so rotating the repeated heads
-                # in-kernel is bitwise the pre-repeat rotation
-                o = fused_rope_flash_attention(
-                    q, _repeat_kv(k, nH // nKV), _repeat_kv(v, nH // nKV),
-                    cos, sin, causal=True)
+        if supported(q.shape, q.dtype):
+            o = flash_attention_raw(q, kf, vf, causal=True)
     if o is None:
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        kf = _repeat_kv(k, nH // nKV)
-        vf = _repeat_kv(v, nH // nKV)
-        if use_flash:
-            from ..ops.pallas.flash_attention import (flash_attention_raw,
-                                                      supported)
-
-            if supported(q.shape, q.dtype):
-                o = flash_attention_raw(q, kf, vf, causal=True)
-        if o is None:
-            o = _sdpa(q, kf, vf)
+        o = _sdpa(q, kf, vf)
     attn_out = _mm(o.reshape(B, T, nH * dH), bp["wo"], cfg)
-    if use_fused_norm:
-        from ..ops.pallas.fused_norm_epilogue import fused_norm_epilogue
-
-        # the true epilogue fusion: attention residual add + ffn norm in
-        # one VMEM pass
-        x, h = fused_norm_epilogue(x, sub=attn_out, gain=bp["ffn_norm"],
-                                   norm="rms", eps=cfg.rms_eps)
-    else:
-        x = x + attn_out
-        h = rms_norm(x, bp["ffn_norm"], cfg.rms_eps)
+    x = x + attn_out
+    h = rms_norm(x, bp["ffn_norm"], cfg.rms_eps)
     gate = _mm(h, bp["w_gate"], cfg)
     up = _mm(h, bp["w_up"], cfg)
     x = x + _mm(jax.nn.silu(gate.astype(jnp.float32)).astype(cfg.dtype) * up,
@@ -285,7 +238,8 @@ def _sdpa(q, k, v):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def llama_apply(params, tokens, cfg: LlamaConfig, remat: bool = True):
+def _llama_apply_unfused(params, tokens, cfg: LlamaConfig,
+                         remat: bool = True):
     B, T = tokens.shape
     x = params["wte"][tokens].astype(cfg.dtype)
     cos, sin = rope_angles(cfg, jnp.arange(T))
@@ -303,6 +257,19 @@ def llama_apply(params, tokens, cfg: LlamaConfig, remat: bool = True):
     return _mm(x, params["head"], cfg).astype(jnp.float32)
 
 
+def llama_apply(params, tokens, cfg: LlamaConfig, remat: bool = True):
+    """Forward to logits, routed through the fusion compiler: the pass
+    plans over the unfused trace and emits fused Pallas calls where the
+    catalog matches (use_auto_fusion=0 runs the unfused composition
+    verbatim)."""
+    from ..compiler import fused_call
+
+    return fused_call(("llama_apply", cfg, bool(remat)),
+                      functools.partial(_llama_apply_unfused, cfg=cfg,
+                                        remat=remat),
+                      params, tokens)
+
+
 def llama_loss(params, tokens, labels, cfg: LlamaConfig):
     logits = llama_apply(params, tokens, cfg)
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
@@ -313,6 +280,31 @@ def llama_loss(params, tokens, labels, cfg: LlamaConfig):
 # ---------------------------------------------------------------------------
 # inference engine
 # ---------------------------------------------------------------------------
+
+def _prefill_unfused(params, tokens, cache, cfg: LlamaConfig):
+    """Prefill trace body (unfused; the compiler pass fuses it — see
+    LlamaForCausalLM._prefill_impl)."""
+    B, T = tokens.shape
+    x = params["wte"][tokens].astype(cfg.dtype)
+    cos, sin = rope_angles(cfg, jnp.arange(T))
+    cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+
+    def body(carry, inp):
+        x = carry
+        bp, ck, cv = inp
+        x, k, v = block_apply(bp, x, cfg, cos, sin, return_kv=True)
+        ck = lax.dynamic_update_slice(
+            ck, jnp.swapaxes(k, 1, 2).astype(ck.dtype), (0, 0, 0, 0))
+        cv = lax.dynamic_update_slice(
+            cv, jnp.swapaxes(v, 1, 2).astype(cv.dtype), (0, 0, 0, 0))
+        return x, (ck, cv)
+
+    x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"],
+                                     cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = _mm(x[:, -1:], params["head"], cfg).astype(jnp.float32)
+    return logits[:, 0], {"k": ks, "v": vs}
+
 
 def _decode_block(bp, x, cache_k, cache_v, pos, cfg: LlamaConfig, cos, sin):
     """One decode step for one block: x [B, 1, H]; cache [B, nKV, S, dH]
@@ -397,28 +389,14 @@ class LlamaForCausalLM:
 
     def _prefill_impl(self, params, tokens, cache):
         """Full-sequence forward (the shared block_apply, flash path
-        included) that also fills the decode cache."""
-        cfg = self.cfg
-        B, T = tokens.shape
-        x = params["wte"][tokens].astype(cfg.dtype)
-        cos, sin = rope_angles(cfg, jnp.arange(T))
-        cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+        included) that also fills the decode cache.  Routed through the
+        fusion compiler: the rotated k escaping into the cache makes the
+        rope template pick its q-only arm automatically."""
+        from ..compiler import fused_call
 
-        def body(carry, inp):
-            x = carry
-            bp, ck, cv = inp
-            x, k, v = block_apply(bp, x, cfg, cos, sin, return_kv=True)
-            ck = lax.dynamic_update_slice(
-                ck, jnp.swapaxes(k, 1, 2).astype(ck.dtype), (0, 0, 0, 0))
-            cv = lax.dynamic_update_slice(
-                cv, jnp.swapaxes(v, 1, 2).astype(cv.dtype), (0, 0, 0, 0))
-            return x, (ck, cv)
-
-        x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"],
-                                         cache["v"]))
-        x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-        logits = _mm(x[:, -1:], params["head"], cfg).astype(jnp.float32)
-        return logits[:, 0], {"k": ks, "v": vs}
+        return fused_call(("llama_prefill", self.cfg),
+                          functools.partial(_prefill_unfused, cfg=self.cfg),
+                          params, tokens, cache)
 
     def _decode_impl(self, params, cache, token, pos):
         cfg = self.cfg

@@ -26,6 +26,22 @@ Contract (every adopter follows it):
   only): CPU test runs never sweep, never write the cache, and always
   see the defaults.
 
+v2 adds a **program level** on top of the per-kernel entries: the fusion
+pass (paddle_tpu/compiler/) keys a whole jitted step by a stable jaxpr
+hash and commits the step's fusion decisions plus every per-kernel entry
+its trace resolved.  A restarted session that traces the same program
+adopts the committed entries up front, so every ``tuned()`` call inside
+the trace hits without sweeping — the compiled plan replays.  The file
+schema is additive: a version-1 file still loads (entries only, no
+programs), and v2 files keep the same ``entries`` table v1 readers
+wrote.
+
+All file writes take an ``fcntl`` lock on a ``<cache>.lock`` sidecar
+around the read-merge-rename, so concurrent fleet engines sharing one
+``artifacts/`` can't interleave their merges and drop each other's
+winners (two writers each read-before-either-writes used to keep only
+the last one's key).
+
 Caveat (same as the flash-flag note in flash_attention.py): configs are
 resolved at trace time, and the jit cache does not key on flags or on
 this registry — flipping flags or deleting the cache mid-process does
@@ -34,6 +50,7 @@ not retrace already-compiled programs.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import inspect
 import json
@@ -45,7 +62,7 @@ from typing import Any, Callable, Sequence
 __all__ = ["AutotuneRegistry", "GLOBAL_AUTOTUNE", "tuned", "stats",
            "reset_stats", "source_hash", "cache_path"]
 
-_CACHE_VERSION = 1
+_CACHE_VERSION = 2
 
 
 def cache_path() -> str:
@@ -85,6 +102,55 @@ def _device_kind() -> str:
         return "unknown"
 
 
+@contextlib.contextmanager
+def _file_lock(path: str):
+    """Exclusive advisory lock on a ``<path>.lock`` sidecar (the cache
+    file itself is replaced atomically, so it can't carry the lock).
+    Degrades to unlocked on platforms without fcntl or unwritable
+    directories — no worse than the pre-lock behavior."""
+    try:
+        import fcntl
+    except ImportError:  # non-posix: single-writer assumption stands
+        yield
+        return
+    lf = None
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        lf = open(path + ".lock", "a+")
+        fcntl.flock(lf.fileno(), fcntl.LOCK_EX)
+    except OSError:
+        if lf is not None:
+            lf.close()
+            lf = None
+    try:
+        yield
+    finally:
+        if lf is not None:
+            try:
+                import fcntl
+
+                fcntl.flock(lf.fileno(), fcntl.LOCK_UN)
+            except OSError:
+                pass
+            lf.close()
+
+
+def _read_cache_file(path: str) -> tuple[dict, dict]:
+    """(entries, programs) from a v1 or v2 cache file; missing/corrupt
+    reads as empty.  v1 files carry entries only."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}, {}
+    if not isinstance(data, dict) or data.get("version") not in (1, 2):
+        return {}, {}
+    entries = dict(data.get("entries", {}))
+    programs = dict(data.get("programs", {})) if data.get("version") == 2 \
+        else {}
+    return entries, programs
+
+
 class AutotuneRegistry:
     """Process-wide sweep-and-cache store behind :func:`tuned`."""
 
@@ -92,11 +158,15 @@ class AutotuneRegistry:
         self._path_override = path
         self._lock = threading.RLock()
         self._entries: dict[str, dict] | None = None   # lazy file load
+        self._programs: dict[str, dict] = {}
+        self._adopted: dict[str, dict] = {}   # program-injected entries
+        self._capture: dict[str, dict] | None = None
         self._loaded_from: str | None = None
         self.hits = 0
         self.misses = 0
         self.sweeps = 0
         self.sweep_time_s = 0.0
+        self.program_hits = 0
 
     # -- persistence --------------------------------------------------------
 
@@ -107,40 +177,31 @@ class AutotuneRegistry:
         path = self._path()
         if self._entries is not None and self._loaded_from == path:
             return self._entries
-        entries: dict[str, dict] = {}
-        try:
-            with open(path) as f:
-                data = json.load(f)
-            if isinstance(data, dict) and data.get("version") == _CACHE_VERSION:
-                entries = dict(data.get("entries", {}))
-        except (OSError, ValueError):
-            pass  # missing/corrupt cache == empty cache
-        self._entries, self._loaded_from = entries, path
-        return entries
+        self._entries, self._programs = _read_cache_file(path)
+        self._loaded_from = path
+        return self._entries
 
-    def _persist(self, key: str, entry: dict) -> None:
-        """Atomic read-merge-write so concurrent processes sweeping
-        different kernels don't clobber each other's winners."""
+    def _persist(self, mutate: Callable[[dict, dict], None]) -> None:
+        """Locked read-merge-write: re-read the file under the sidecar
+        lock, apply ``mutate(entries, programs)`` to the merged view,
+        and atomically replace — concurrent processes sweeping different
+        kernels (or committing different programs) keep each other's
+        work."""
         path = self._path()
-        merged: dict[str, dict] = {}
-        try:
-            with open(path) as f:
-                data = json.load(f)
-            if isinstance(data, dict) and data.get("version") == _CACHE_VERSION:
-                merged = dict(data.get("entries", {}))
-        except (OSError, ValueError):
-            pass
-        merged[key] = entry
-        try:
-            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "w") as f:
-                json.dump({"version": _CACHE_VERSION, "entries": merged}, f,
-                          indent=1, sort_keys=True)
-            os.replace(tmp, path)
-        except OSError:
-            pass  # read-only checkout: keep the in-memory entry only
-        self._entries = merged
+        with _file_lock(path):
+            entries, programs = _read_cache_file(path)
+            mutate(entries, programs)
+            try:
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                tmp = f"{path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump({"version": _CACHE_VERSION, "entries": entries,
+                               "programs": programs}, f,
+                              indent=1, sort_keys=True)
+                os.replace(tmp, path)
+            except OSError:
+                pass  # read-only checkout: keep the in-memory view only
+        self._entries, self._programs = entries, programs
         self._loaded_from = path
 
     # -- policy -------------------------------------------------------------
@@ -188,14 +249,16 @@ class AutotuneRegistry:
         key = f"{kernel}|{_device_kind()}|{bucket}|{dtype}"
         with self._lock:
             entries = self._load()
-            entry = entries.get(key)
+            entry = self._adopted.get(key) or entries.get(key)
             if entry is not None and entry.get("source") == source:
                 self.hits += 1
+                self._record(key, entry)
                 return entry["config"]
             # stale-source entries fall through: re-sweep or default
             self.misses += 1
             if (measure is None or len(candidates) < 2
                     or not self._sweep_enabled()):
+                self._record(key, {"config": default, "source": source})
                 return default
             t0 = time.perf_counter()
             timings = []
@@ -217,25 +280,98 @@ class AutotuneRegistry:
             entry = {"config": candidates[best], "ms": round(timings[best], 4),
                      "source": source, "sweep_s": round(elapsed, 3),
                      "candidates": len(candidates)}
-            self._persist(key, entry)
+            self._persist(lambda e, p: e.__setitem__(key, entry))
+            self._record(key, entry)
             return candidates[best]
+
+    # -- per-program layer (v2; driven by paddle_tpu/compiler) --------------
+
+    def _record(self, key: str, entry: dict) -> None:
+        if self._capture is not None:
+            self._capture[key] = dict(entry)
+
+    def begin_capture(self) -> bool:
+        """Start recording every entry :meth:`tuned` resolves (hit,
+        sweep winner, or default) until :meth:`end_capture` — the fusion
+        pass brackets one program trace with this pair.  Returns False
+        when a capture is already active (a fused model apply nested
+        inside a fused train step records into the outer program)."""
+        with self._lock:
+            if self._capture is not None:
+                return False
+            self._capture = {}
+            return True
+
+    def end_capture(self) -> dict[str, dict]:
+        with self._lock:
+            cap, self._capture = self._capture, None
+            return cap or {}
+
+    def program_lookup(self, phash: str) -> dict | None:
+        with self._lock:
+            self._load()
+            return self._programs.get(phash)
+
+    def adopt_program(self, phash: str, source: str) -> bool:
+        """Inject a committed program's per-kernel entries into the
+        in-memory view so the upcoming trace's ``tuned()`` calls hit
+        without sweeping.  Refused (False) when the record is missing,
+        was committed by different compiler/kernel sources, or belongs
+        to a different device kind — stale plans re-sweep instead of
+        replaying wrong configs."""
+        with self._lock:
+            self._load()
+            rec = self._programs.get(phash)
+            if (not isinstance(rec, dict) or rec.get("source") != source
+                    or rec.get("device") != _device_kind()):
+                return False
+            self._adopted.update(rec.get("entries", {}))
+            self.program_hits += 1
+            return True
+
+    def program_commit(self, phash: str, fusion: list, entries: dict,
+                       source: str) -> None:
+        """Persist one program record: the fusion decisions the pass
+        made plus every per-kernel entry the trace resolved.  The
+        entries also merge into the flat v1 table — program records and
+        kernel entries share one key space, so a restarted process hits
+        them through the ordinary :meth:`tuned` path even for calls
+        that fire before the program hash is known (during the plan
+        trace itself)."""
+        rec = {"device": _device_kind(), "source": source,
+               "fusion": list(fusion), "entries": dict(entries)}
+
+        def mutate(e, p):
+            for k, v in rec["entries"].items():
+                e.setdefault(k, v)
+            p[phash] = rec
+
+        with self._lock:
+            self._persist(mutate)
+
+    # -- stats --------------------------------------------------------------
 
     def stats(self) -> dict:
         with self._lock:
             return {"autotune_cache_hits": self.hits,
                     "autotune_cache_misses": self.misses,
                     "autotune_sweeps": self.sweeps,
-                    "autotune_sweep_time_s": round(self.sweep_time_s, 3)}
+                    "autotune_sweep_time_s": round(self.sweep_time_s, 3),
+                    "autotune_program_hits": self.program_hits}
 
     def reset_stats(self) -> None:
         with self._lock:
             self.hits = self.misses = self.sweeps = 0
             self.sweep_time_s = 0.0
+            self.program_hits = 0
 
     def invalidate(self) -> None:
-        """Drop the in-memory view (next lookup re-reads the file)."""
+        """Drop the in-memory view, including program-adopted entries
+        (next lookup re-reads the file)."""
         with self._lock:
             self._entries = None
+            self._programs = {}
+            self._adopted = {}
             self._loaded_from = None
 
 

@@ -532,6 +532,18 @@ def make_sharded_train_step(cfg: GPTConfig, mesh: Mesh, lr: float = 1e-4,
     def _run_blocks(fn, bp, x):
         return fn(bp, x)
 
+    # Route the WHOLE step (forward + backward + AdamW) through the
+    # fusion compiler: one program hash covers the step, so the v2
+    # autotune cache replays every kernel config and fusion decision on
+    # restart without re-sweeping.  The pp and quant-sync paths carry
+    # shard_map regions the re-trace must not rebuild, and Megatron-SP
+    # resharding disables every catalog site anyway (PR 6 never fused
+    # under sp either) — those run the step unwrapped.
+    if not use_pp and not use_quant_sync and not use_sp:
+        from ..compiler import auto_fuse
+
+        step = auto_fuse(step)
+
     jitted = jax.jit(step, donate_argnums=(0, 1))
 
     def put_batch(arr):

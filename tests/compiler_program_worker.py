@@ -1,0 +1,58 @@
+"""Subprocess worker for the per-program autotune round-trip test.
+
+Run as ``python tests/compiler_program_worker.py`` with
+``FLAGS_pallas_autotune_cache`` pointing at a temp file (and usually
+``FLAGS_pallas_autotune_sweep=1`` + ``JAX_PLATFORMS=cpu``): wraps a
+small fusable llama apply in ``auto_fuse``, evaluates it twice, and
+prints one JSON line with the fusion report and registry stats.  The
+test launches it twice — the first process plans, sweeps and commits
+the program record; the second must adopt it (``program_cache_hit``)
+and resolve every ``tuned()`` call without sweeping.
+"""
+
+import functools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from paddle_tpu.compiler import fused_call, last_report  # noqa: E402
+from paddle_tpu.models import llama as L  # noqa: E402
+from paddle_tpu.ops.pallas import autotune  # noqa: E402
+
+
+def main():
+    cfg = L.LlamaConfig(vocab_size=128, hidden=256, n_layers=1, n_heads=2,
+                        n_kv_heads=2, ffn_hidden=512, max_seq_len=256,
+                        dtype=jnp.bfloat16)
+    params = L.init_llama_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 256), 0,
+                                cfg.vocab_size)
+    out = fused_call(("worker_apply", cfg),
+                     functools.partial(L._llama_apply_unfused, cfg=cfg,
+                                       remat=False),
+                     params, tokens)
+    rep = last_report()
+    # second call replays the cached plan in-process
+    out2 = fused_call(("worker_apply", cfg),
+                      functools.partial(L._llama_apply_unfused, cfg=cfg,
+                                        remat=False),
+                      params, tokens)
+    row = dict(autotune.stats())
+    row["program_hash"] = rep.program_hash
+    row["n_sites"] = rep.n_sites
+    row["n_applied"] = rep.n_applied
+    row["program_cache_hit"] = rep.program_cache_hit
+    row["out_sum"] = float(jnp.asarray(out, jnp.float32).sum())
+    row["outputs_stable"] = bool(np.array_equal(np.asarray(out, np.float32),
+                                                np.asarray(out2, np.float32)))
+    print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""TPL009 fixture: hand-wired fusion bypass in model code.
+
+Seeded violations: a model forward calling a Pallas megakernel imported
+from ops/pallas/fused_* directly (by name and through a module alias),
+plus a kernel import nothing calls. Clean cases: the compiler-routed
+fused_call path, a *_supported capability probe, and a suppressed
+deliberate call with a rationale.
+"""
+
+from paddle_tpu.compiler import fused_call
+from paddle_tpu.ops.pallas import fused_bias_act
+from paddle_tpu.ops.pallas.fused_ce import fused_softmax_ce  # seeded violation: imported, never called
+from paddle_tpu.ops.pallas.fused_norm_epilogue import (
+    fused_norm_epilogue,
+    fused_norm_epilogue_supported,
+)
+
+
+def fx_hand_wired_block(x, residual, gain):
+    return fused_norm_epilogue(x, sub=residual, gain=gain,  # seeded violation
+                               norm="rms", eps=1e-5, act=None)
+
+
+def fx_alias_call(gate, up):
+    return fused_bias_act.fused_swiglu(gate, up)  # seeded violation
+
+
+def fx_compiler_routed(apply_fn, cfg, params, tokens):
+    # clean: the fusion pass discovers and rewrites the sites itself
+    return fused_call(("model_apply", cfg), apply_fn, params, tokens)
+
+
+def fx_capability_gate(n, h, dtype):
+    # clean: a *_supported probe only gates, it never computes
+    return fused_norm_epilogue_supported(n, h, dtype)
+
+
+def fx_deliberate_decode_path(x, gain):
+    # the decode hot loop keeps its hand-wired call: pinned by its own
+    # parity test and outside any auto_fuse-wrapped step
+    return fused_norm_epilogue(  # tpu-lint: disable=TPL009 -- decode loop is not auto_fuse-wrapped; parity-pinned in test_fused_norm_epilogue.py
+        x, gain=gain, norm="rms", eps=1e-5, act=None)

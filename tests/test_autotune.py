@@ -7,6 +7,8 @@ import json
 import os
 import subprocess
 import sys
+import threading
+import time
 
 import pytest
 
@@ -165,3 +167,138 @@ def test_fresh_subprocess_round_trip(tmp_path):
     assert second["autotune_sweeps"] == 0   # no re-sweep: read from disk
     assert second["autotune_cache_misses"] == 0
     assert second["autotune_cache_hits"] == 1
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15 satellites: locked persistence + the per-program (v2) layer
+# ---------------------------------------------------------------------------
+
+
+def test_two_writers_keep_both_keys(tmp_path):
+    """Regression for the read-merge-rename race: two registries persist
+    different keys concurrently, with the read->write window widened by
+    a sleep INSIDE the merge.  Without the fcntl sidecar lock both read
+    the empty file and the second rename drops the first one's key."""
+    path = str(tmp_path / "cache.json")
+    rega, regb = AutotuneRegistry(path), AutotuneRegistry(path)
+    barrier = threading.Barrier(2)
+    errs = []
+
+    def writer(reg, key):
+        def mutate(entries, programs):
+            entries[key] = {"config": 1, "source": "s"}
+            time.sleep(0.25)
+
+        try:
+            barrier.wait(timeout=10)
+            reg._persist(mutate)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=writer, args=(rega, "ka|cpu|b|f32")),
+          threading.Thread(target=writer, args=(regb, "kb|cpu|b|f32"))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not errs
+    data = json.load(open(path))
+    assert set(data["entries"]) == {"ka|cpu|b|f32", "kb|cpu|b|f32"}
+
+
+def test_v1_cache_file_still_loads(tmp_path, sweep_on):
+    """Additive schema: a version-1 file (entries only) keeps hitting,
+    and the first write upgrades it to v2 without dropping v1 entries."""
+    path = str(tmp_path / "cache.json")
+    key = f"k|{jax.devices()[0].device_kind}|b1|bf16"
+    with open(path, "w") as f:
+        json.dump({"version": 1,
+                   "entries": {key: {"config": 512, "source": "s1"}}}, f)
+    reg = AutotuneRegistry(path)
+    cfg = reg.tuned("k", "b1", "bf16", [256, 512],
+                    measure=_measure({256: 1.0, 512: 2.0}), source="s1")
+    assert cfg == 512  # the v1 entry, not a fresh sweep's winner
+    assert reg.hits == 1 and reg.sweeps == 0
+    assert reg.program_lookup("nope") is None  # v1: empty program table
+    # a new sweep upgrades the file in place, preserving the v1 entry
+    reg.tuned("k2", "b1", "bf16", [256, 512],
+              measure=_measure({256: 2.0, 512: 1.0}), source="s2")
+    data = json.load(open(path))
+    assert data["version"] == 2
+    assert data["entries"][key]["config"] == 512
+    assert data["programs"] == {}
+
+
+def test_program_commit_adopt_and_refusals(tmp_path):
+    path = str(tmp_path / "cache.json")
+    kind = jax.devices()[0].device_kind
+    key = f"k|{kind}|b1|bf16"
+    phash = "ab" * 8
+    reg = AutotuneRegistry(path)
+    reg.program_commit(phash, [{"template": "rms_epilogue", "applied": True}],
+                       {key: {"config": 512, "source": "ks"}}, source="src1")
+
+    # wrong source / unknown hash: refused, nothing adopted
+    reg2 = AutotuneRegistry(path)
+    assert reg2.adopt_program(phash, "other-src") is False
+    assert reg2.adopt_program("ff" * 8, "src1") is False
+    assert reg2.program_hits == 0
+
+    # the real adoption: tuned() resolves from the record with no sweep
+    assert reg2.adopt_program(phash, "src1") is True
+    assert reg2.program_hits == 1
+    cfg = reg2.tuned("k", "b1", "bf16", [256, 512], source="ks")
+    assert cfg == 512 and reg2.hits == 1 and reg2.sweeps == 0
+    rec = reg2.program_lookup(phash)
+    assert rec["fusion"] == [{"template": "rms_epilogue", "applied": True}]
+
+    # commit also merged the entry into the flat table: a registry that
+    # never adopts still hits through the ordinary tuned() path
+    reg3 = AutotuneRegistry(path)
+    assert reg3.tuned("k", "b1", "bf16", [256, 512], source="ks") == 512
+    assert reg3.hits == 1
+
+    # a record committed on another chip kind is refused
+    data = json.load(open(path))
+    data["programs"][phash]["device"] = "alien-chip"
+    with open(path, "w") as f:
+        json.dump(data, f)
+    reg4 = AutotuneRegistry(path)
+    assert reg4.adopt_program(phash, "src1") is False
+
+
+def test_program_round_trip_fresh_subprocess(tmp_path):
+    """The tentpole pin: a restarted process tracing the same program
+    adopts the committed v2 record — program_cache_hit, zero sweeps,
+    the same program hash, and bit-identical outputs."""
+    cache = str(tmp_path / "cache.json")
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               FLAGS_pallas_autotune_sweep="1",
+               FLAGS_pallas_autotune_cache=cache)
+    env.pop("XLA_FLAGS", None)  # single device, like production restart
+    worker = os.path.join(REPO, "tests", "compiler_program_worker.py")
+
+    def run():
+        proc = subprocess.run([sys.executable, worker], env=env,
+                              capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, proc.stderr[-4000:]
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    first = run()
+    assert first["program_cache_hit"] is False
+    assert first["n_sites"] >= 3 and first["n_applied"] == first["n_sites"]
+    assert first["outputs_stable"] is True
+
+    second = run()
+    assert second["program_cache_hit"] is True
+    assert second["autotune_program_hits"] >= 1
+    assert second["autotune_sweeps"] == 0        # warm cache: zero sweeps
+    assert second["program_hash"] == first["program_hash"]
+    assert second["n_applied"] == first["n_applied"]
+    assert second["out_sum"] == first["out_sum"]  # replay is bit-stable
+    # the committed record carries the fusion decisions
+    data = json.load(open(cache))
+    rec = data["programs"][first["program_hash"]]
+    assert len(rec["fusion"]) == first["n_sites"]
+    assert rec["entries"]

@@ -29,7 +29,7 @@ def bench(monkeypatch):
     for name in ("_bench_chip_probe", "_bench_decode", "_bench_serving",
                  "_bench_multitenant", "_bench_fleet", "_bench_disagg",
                  "_bench_loss_curve", "_bench_13b", "_bench_long_ctx",
-                 "_bench_multichip", "_bench_phases"):
+                 "_bench_multichip", "_bench_fusion", "_bench_phases"):
         monkeypatch.setattr(b, name, lambda: {})
     return b
 
@@ -278,6 +278,25 @@ def test_multichip_key_contract(bench):
     # comm_frac is a ratio: a microbench slower than the step clamps to 1
     assert bench._multichip_keys(dict(m, comm_ms=500.0))[
         "multichip_comm_frac"] == 1.0
+
+
+def test_fusion_key_contract(bench):
+    """_fusion_keys is the pure fusion-report -> bench-keys mapping for
+    the auto-fused step (ISSUE 15): discovered/applied site counts, the
+    fused step timing, and whether this session replayed a committed
+    per-program autotune record."""
+    rep = {"n_sites": 5, "n_applied": 5, "program_cache_hit": True}
+    out = bench._fusion_keys(rep, step_ms=125.0, n_tokens=2048)
+    assert out == {"fusion_n_sites": 5,
+                   "fusion_n_applied": 5,
+                   "fusion_step_ms": 125.0,
+                   "fusion_tok_s": pytest.approx(16384.0),
+                   "autotune_program_cache_hit": True}
+    # a matcher regression is visible as a count, not throughput noise
+    cold = bench._fusion_keys({"n_sites": 0}, step_ms=0.0, n_tokens=2048)
+    assert cold["fusion_n_sites"] == 0
+    assert cold["fusion_tok_s"] == 0.0
+    assert cold["autotune_program_cache_hit"] is False
 
 
 from conftest import requires_native_partial_manual

@@ -193,6 +193,47 @@ def test_tpl008_gather_constraint_fires_and_suppresses():
         assert silent not in msgs, silent
 
 
+def test_tpl009_fusion_bypass_fires_and_suppresses():
+    src = open(fx("fx_fusion_bypass.py")).read()
+    f = lint(["fx_fusion_bypass.py"], "TPL009")
+    assert len(f) == 3, [(x.line, x.message) for x in f]
+    for x in f:
+        assert "seeded violation" in src.splitlines()[x.line - 1], \
+            (x.line, x.message)
+        assert x.severity == "warning"
+    msgs = " | ".join(x.message for x in f)
+    # both call spellings and the dead kernel import fire ...
+    assert "'fused_norm_epilogue'" in msgs
+    assert "'fused_bias_act.fused_swiglu'" in msgs
+    assert "'fused_softmax_ce'" in msgs
+    # ... while the compiler route, the capability probe, and the
+    # suppressed decode-path call stay silent (their lines never fire;
+    # every reported line is a seeded one, asserted above)
+    assert "_supported'" not in msgs
+    lines = {x.line for x in f}
+    deliberate = next(i + 1 for i, ln in enumerate(src.splitlines())
+                      if "fx_deliberate_decode_path" in ln)
+    assert all(ln < deliberate for ln in lines)
+
+
+def test_tpl009_exempts_kernel_homes_and_parity_tests(tmp_path):
+    body = ("from paddle_tpu.ops.pallas.fused_ce import fused_softmax_ce\n"
+            "def f(h, w, y):\n"
+            "    return fused_softmax_ce(h, w, y)\n")
+    for rel in ("paddle_tpu/ops/pallas/wrapper.py",
+                "paddle_tpu/compiler/builders.py",
+                "tests/test_fused_ce_extra.py"):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(body)
+        assert run_lint([str(p)], select={"TPL009"}, excludes=()) == [], rel
+    model = tmp_path / "paddle_tpu/models/mymodel.py"
+    model.parent.mkdir(parents=True, exist_ok=True)
+    model.write_text(body)
+    f = run_lint([str(model)], select={"TPL009"}, excludes=())
+    assert len(f) == 1 and f[0].rule == "TPL009"
+
+
 def test_tpl008_silent_without_sharding_marks(tmp_path):
     # the same gather in a file that never touches sharding machinery is
     # out of the rule's jurisdiction (GSPMD cannot repartition it)
@@ -252,7 +293,7 @@ def test_reporters_shape():
 
 def test_rule_table_unique_and_documented():
     rules = [c.rule for c in ALL_CHECKERS]
-    assert len(rules) == len(set(rules)) == 11  # 8 per-file + 3 interproc
+    assert len(rules) == len(set(rules)) == 12  # 9 per-file + 3 interproc
     assert all(c.description for c in ALL_CHECKERS)
     assert all(c.severity in ("error", "warning") for c in ALL_CHECKERS)
 

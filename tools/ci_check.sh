@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # One-command CI gate: static analysis -> op-contract baseline -> chaos
 # suite -> serving smoke -> kernel parity -> loadgen smoke -> multichip
-# smoke -> multitenant smoke -> fleet smoke -> disagg smoke -> tier-1.
+# smoke -> multitenant smoke -> fleet smoke -> disagg smoke -> fusion
+# smoke -> tier-1.
 #
 #   bash tools/ci_check.sh
 #
@@ -22,12 +23,15 @@
 #  110  disagg smoke failed (prefill-pool loss: no pages adopted over
 #       the prefill->decode wire, degraded-mode completion dropped or
 #       diverged a stream, or a surviving ledger leaked)
+#  120  fusion smoke failed (the jaxpr pass found <3 sites on the seeded
+#       config, eager fused loss drifted from the unfused composition,
+#       or the per-program autotune cache failed to replay on restart)
 #   30  tier-1 tests failed (ROADMAP.md command)
 #    0  all gates green
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== gate 1/11: tpu-lint (per-file + interprocedural rules) =="
+echo "== gate 1/12: tpu-lint (per-file + interprocedural rules) =="
 python -m tools.lint paddle_tpu tests --format=json > /tmp/tpu_lint.json
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -37,7 +41,7 @@ if [ "$rc" -ne 0 ]; then
 fi
 echo "tpu-lint: clean"
 
-echo "== gate 2/11: tpu-verify (abstract op-contract baseline) =="
+echo "== gate 2/12: tpu-verify (abstract op-contract baseline) =="
 JAX_PLATFORMS=cpu python -m tools.lint --contracts \
     --baseline artifacts/op_contracts.json
 rc=$?
@@ -47,7 +51,7 @@ if [ "$rc" -ne 0 ]; then
     exit 20
 fi
 
-echo "== gate 3/11: chaos suite (fault injection -> self-healing) =="
+echo "== gate 3/12: chaos suite (fault injection -> self-healing) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 rc=$?
@@ -57,7 +61,7 @@ if [ "$rc" -ne 0 ]; then
     exit 40
 fi
 
-echo "== gate 4/11: serving smoke (scheduler completion + zero page leak) =="
+echo "== gate 4/12: serving smoke (scheduler completion + zero page leak) =="
 JAX_PLATFORMS=cpu python -m tools.serving_smoke
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -66,7 +70,7 @@ if [ "$rc" -ne 0 ]; then
     exit 50
 fi
 
-echo "== gate 5/11: kernel parity (fused megakernels, CPU fallback arms) =="
+echo "== gate 5/12: kernel parity (fused megakernels, CPU fallback arms) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_fused_norm_epilogue.py \
     tests/test_fused_rope_attention.py tests/test_autotune.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
@@ -77,7 +81,7 @@ if [ "$rc" -ne 0 ]; then
     exit 60
 fi
 
-echo "== gate 6/11: loadgen smoke (open-loop saturation, >=200 arrivals) =="
+echo "== gate 6/12: loadgen smoke (open-loop saturation, >=200 arrivals) =="
 JAX_PLATFORMS=cpu python -m tools.loadgen_smoke
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -87,7 +91,7 @@ if [ "$rc" -ne 0 ]; then
     exit 70
 fi
 
-echo "== gate 7/11: multichip smoke (dp x mp mesh: remat-free compile," \
+echo "== gate 7/12: multichip smoke (dp x mp mesh: remat-free compile," \
      "serial parity, quantized all-reduce) =="
 python tools/multichip_smoke.py
 rc=$?
@@ -98,7 +102,7 @@ if [ "$rc" -ne 0 ]; then
     exit 80
 fi
 
-echo "== gate 8/11: multitenant smoke (LoRA isolation, preemption," \
+echo "== gate 8/12: multitenant smoke (LoRA isolation, preemption," \
      "constrained legality, 7-class ledger) =="
 JAX_PLATFORMS=cpu python -m tools.multitenant_smoke
 rc=$?
@@ -110,7 +114,7 @@ if [ "$rc" -ne 0 ]; then
     exit 90
 fi
 
-echo "== gate 9/11: fleet smoke (engine loss -> bit-identical resume," \
+echo "== gate 9/12: fleet smoke (engine loss -> bit-identical resume," \
      "page migration, survivor ledger) =="
 JAX_PLATFORMS=cpu python -m tools.fleet_smoke
 rc=$?
@@ -121,7 +125,7 @@ if [ "$rc" -ne 0 ]; then
     exit 100
 fi
 
-echo "== gate 10/11: disagg smoke (prefill-pool loss -> degraded" \
+echo "== gate 10/12: disagg smoke (prefill-pool loss -> degraded" \
      "colocated completion, shipped pages, surviving ledgers) =="
 JAX_PLATFORMS=cpu python -m tools.disagg_smoke
 rc=$?
@@ -132,7 +136,19 @@ if [ "$rc" -ne 0 ]; then
     exit 110
 fi
 
-echo "== gate 11/11: tier-1 tests (ROADMAP.md) =="
+echo "== gate 11/12: fusion smoke (jaxpr fusion discovery, eager" \
+     "parity, per-program autotune replay) =="
+JAX_PLATFORMS=cpu python -m tools.fusion_smoke
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "ci_check: fusion smoke gate failed (rc=$rc) — the fusion pass" \
+         "lost a discovered site, broke eager bit-parity against the" \
+         "unfused composition, or the v2 program cache no longer" \
+         "replays without sweeping" >&2
+    exit 120
+fi
+
+echo "== gate 12/12: tier-1 tests (ROADMAP.md) =="
 
 set -o pipefail
 rm -f /tmp/_t1.log

@@ -1,0 +1,172 @@
+"""Fusion smoke — ci_check.sh gate "fusion" (exit 120).
+
+Three contracts on the jaxpr-level fusion pass (paddle_tpu/compiler/,
+ISSUE 15 tentpole), single-device CPU (kernels run in Pallas interpret
+mode):
+
+1. **discovery**: the pass finds >=3 fusion sites on the seeded fusable
+   llama config from the jaxpr alone — no hand-wired call sites left in
+   models/llama.py to lean on — and every site on this config applies
+   (supported shapes, single device).
+2. **parity**: fused vs unfused loss on a truly-eager (unrolled, no
+   scan) composition is BIT-identical; the scanned train loss stays
+   within the PR 6 allclose bound (the unfused baseline itself shifts
+   bits when XLA compiles the scan body).
+3. **program cache**: a fresh subprocess tracing the same program
+   (tests/compiler_program_worker.py) adopts the committed v2 record —
+   ``program_cache_hit``, zero sweeps, bit-identical outputs.
+
+Usage: ``python -m tools.fusion_smoke``.  Nonzero exit on any failure.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+MIN_SITES = 3
+
+
+def _seeded_cfg():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import llama as L
+
+    cfg = L.LlamaConfig(vocab_size=512, hidden=256, n_layers=2, n_heads=2,
+                        n_kv_heads=2, ffn_hidden=512, max_seq_len=256,
+                        dtype=jnp.bfloat16)
+    params = L.init_llama_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 256), 0,
+                                cfg.vocab_size)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (1, 256), 0,
+                                cfg.vocab_size)
+    return L, cfg, params, tokens, labels
+
+
+def _part_discovery() -> None:
+    from paddle_tpu.compiler import discover
+
+    L, cfg, params, tokens, _ = _seeded_cfg()
+    rep = discover(functools.partial(L._llama_apply_unfused, cfg=cfg,
+                                     remat=True), params, tokens)
+    print(f"fusion_smoke: discovery n_sites={rep.n_sites} "
+          f"n_applied={rep.n_applied} program={rep.program_hash}",
+          flush=True)
+    for row in rep.sites:
+        print(f"  site template={row['template']} applied={row['applied']} "
+              f"eqns={row['eqns']} note={row['note']!r}", flush=True)
+    assert rep.n_sites >= MIN_SITES, \
+        f"expected >={MIN_SITES} fusion sites, found {rep.n_sites}"
+    assert rep.n_applied == rep.n_sites, \
+        f"unapplied sites on the seeded config: {rep.sites}"
+    assert not rep.errors, rep.errors
+
+
+def _part_parity() -> None:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.compiler import auto_fuse, last_report
+    from paddle_tpu.core.flags import GLOBAL_FLAGS
+
+    L, cfg, params, tokens, labels = _seeded_cfg()
+
+    def unrolled_loss(params, tokens, labels):
+        # the eager op-by-op composition: python loop, no scan, so every
+        # op dispatches individually and XLA cannot re-fuse the baseline
+        T = tokens.shape[1]
+        x = params["wte"][tokens].astype(cfg.dtype)
+        cos, sin = L.rope_angles(cfg, jnp.arange(T))
+        cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+        for i in range(cfg.n_layers):
+            bp = jax.tree.map(lambda a: a[i], params["blocks"])
+            x = L.block_apply(bp, x, cfg, cos, sin)
+        x = L.rms_norm(x, params["final_norm"], cfg.rms_eps)
+        logits = L._mm(x, params["head"], cfg).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, labels[..., None],
+                                   axis=-1)[..., 0]
+        return (lse - gold).mean()
+
+    fused = unrolled_loss(params, tokens, labels)
+    fused_wrapped = auto_fuse(unrolled_loss)(params, tokens, labels)
+    rep = last_report()
+    assert rep.n_applied >= MIN_SITES, rep.sites
+    a = np.asarray(fused_wrapped, np.float32)
+    b = np.asarray(fused, np.float32)
+    print(f"fusion_smoke: eager loss fused={a!r} unfused={b!r} "
+          f"(sites applied: {rep.n_applied})", flush=True)
+    assert np.array_equal(a, b), \
+        f"eager fused loss {a!r} != unfused {b!r} (must be bit-identical)"
+
+    # scanned train loss: the PR 6 standard (allclose)
+    lf = L.llama_loss(params, tokens, labels, cfg)
+    old = GLOBAL_FLAGS.get("use_auto_fusion") \
+        if GLOBAL_FLAGS.has("use_auto_fusion") else True
+    GLOBAL_FLAGS.set("use_auto_fusion", False)
+    try:
+        lu = L.llama_loss(params, tokens, labels, cfg)
+    finally:
+        GLOBAL_FLAGS.set("use_auto_fusion", old)
+    print(f"fusion_smoke: scanned loss fused={float(lf):.6f} "
+          f"unfused={float(lu):.6f}", flush=True)
+    np.testing.assert_allclose(np.asarray(lf, np.float32),
+                               np.asarray(lu, np.float32),
+                               rtol=5e-3, atol=5e-3)
+
+
+def _part_program_cache() -> None:
+    worker = os.path.join(_REPO, "tests", "compiler_program_worker.py")
+    with tempfile.TemporaryDirectory(prefix="fusion_smoke_") as td:
+        cache = os.path.join(td, "cache.json")
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   FLAGS_pallas_autotune_sweep="1",
+                   FLAGS_pallas_autotune_cache=cache)
+        env.pop("XLA_FLAGS", None)
+
+        def run():
+            proc = subprocess.run([sys.executable, worker], env=env,
+                                  capture_output=True, text=True,
+                                  timeout=600)
+            assert proc.returncode == 0, proc.stderr[-4000:]
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+
+        first = run()
+        second = run()
+        print(f"fusion_smoke: program cache first_hit="
+              f"{first['program_cache_hit']} second_hit="
+              f"{second['program_cache_hit']} second_sweeps="
+              f"{second['autotune_sweeps']}", flush=True)
+        assert first["program_cache_hit"] is False
+        assert second["program_cache_hit"] is True, second
+        assert second["autotune_program_hits"] >= 1, second
+        assert second["autotune_sweeps"] == 0, second
+        assert second["program_hash"] == first["program_hash"]
+        assert second["out_sum"] == first["out_sum"], (first, second)
+
+
+def main() -> int:
+    for name, part in (("discovery", _part_discovery),
+                       ("parity", _part_parity),
+                       ("program-cache", _part_program_cache)):
+        print(f"== fusion_smoke: {name} ==", flush=True)
+        part()
+    print("fusion_smoke: OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

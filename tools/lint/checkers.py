@@ -10,6 +10,7 @@ TPL005 collective-safety     lax.p* axis names unbound by any shard_map
 TPL006 flag-hygiene          define_flag() names that are never read
 TPL007 pallas-autotune-bypass pallas_call sites no tuned() entry reaches
 TPL008 gather-sharding-constraint  traced gathers never pinned by a constraint
+TPL009 fusion-bypass         model code hand-wiring ops/pallas/fused_* calls
 
 The analyses are deliberately first-order (per-function taint, per-file
 axis sets, project-wide name sets) — precise enough to catch the shipped
@@ -983,6 +984,98 @@ class GatherShardingConstraint(Checker):
                             "*_constraint hook) the moment it exists")
 
 
+# -- TPL009: hand-wired fusion bypass ----------------------------------------
+
+class HandWiredFusionBypass(Checker):
+    """Model/runtime code that imports a Pallas megakernel from
+    ``ops/pallas/fused_*`` and calls it directly has hand-wired a fusion
+    the jaxpr-level pass (paddle_tpu/compiler/) discovers on its own.
+    Hand-wired sites sit outside the per-program autotune record and the
+    catalog's parity pins, and they keep firing even when
+    ``use_auto_fusion=0`` asks for the exact unfused baseline — the bug
+    class PR 6 shipped and ISSUE 15 retired.  Route the call through
+    ``compiler.fused_call``/``auto_fuse`` (or keep the op-by-op
+    composition and let the pass rewrite it).
+
+    Exempt: the kernel homes themselves (``paddle_tpu/ops/``), the
+    compiler that is allowed to build the calls (``paddle_tpu/compiler/``),
+    and kernel parity tests (``test_*.py`` — pinning the kernel against
+    its composition REQUIRES calling it directly).  ``*_supported()``
+    capability probes only gate, never compute, and are not flagged.
+    """
+
+    rule = "TPL009"
+    name = "fusion-bypass"
+    severity = "warning"
+    description = ("direct ops/pallas/fused_* kernel call outside the "
+                   "fusion pass — hand-wired fusion the compiler should "
+                   "discover")
+
+    _FUSED_HOME = "ops.pallas.fused"
+    _EXEMPT_DIRS = ("paddle_tpu/ops/", "paddle_tpu/compiler/")
+
+    def check(self, ctx):
+        path = ctx.path.replace("\\", "/")
+        if any(d in path for d in self._EXEMPT_DIRS):
+            return
+        if path.rsplit("/", 1)[-1].startswith("test_"):
+            return
+        self.ctx = ctx
+        direct: dict[str, ast.AST] = {}   # imported kernel name -> import
+        aliases: dict[str, ast.AST] = {}  # module alias -> import
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = ("." * node.level) + (node.module or "")
+                for a in node.names:
+                    bound = a.asname or a.name
+                    if self._FUSED_HOME in mod:
+                        if not a.name.endswith("_supported"):
+                            direct[bound] = node
+                    elif (mod.endswith("ops.pallas")
+                          or mod.endswith("pallas")) \
+                            and a.name.startswith("fused_"):
+                        aliases[bound] = node
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if self._FUSED_HOME in a.name:
+                        aliases[a.asname or a.name] = node
+        if not direct and not aliases:
+            self.ctx = None
+            return
+        called: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            tail = cname.rsplit(".", 1)[-1]
+            if cname in direct:
+                called.add(cname)
+                self.report(node, f"direct call of Pallas megakernel "
+                                  f"'{cname}' hand-wires a fusion the "
+                                  "compiler pass discovers from the jaxpr; "
+                                  "route through compiler.fused_call/"
+                                  "auto_fuse or suppress with a rationale")
+            elif "." in cname:
+                root = cname.rsplit(".", 1)[0]
+                if root in aliases and tail.startswith("fused_") \
+                        and not tail.endswith("_supported"):
+                    called.add(root)
+                    self.report(node, f"direct call of Pallas megakernel "
+                                      f"'{cname}' hand-wires a fusion the "
+                                      "compiler pass discovers from the "
+                                      "jaxpr; route through compiler."
+                                      "fused_call/auto_fuse or suppress "
+                                      "with a rationale")
+        for bound, node in {**direct, **aliases}.items():
+            if bound not in called:
+                self.report(node, f"import of Pallas megakernel surface "
+                                  f"'{bound}' from ops/pallas/fused_* in "
+                                  "non-kernel code: the fusion pass "
+                                  "(paddle_tpu/compiler/) should be the "
+                                  "only caller")
+        self.ctx = None
+
+
 ALL_CHECKERS = [
     HostSyncInTrace,
     AsyncAliasing,
@@ -992,4 +1085,5 @@ ALL_CHECKERS = [
     FlagHygiene,
     PallasAutotuneBypass,
     GatherShardingConstraint,
+    HandWiredFusionBypass,
 ]
