@@ -186,6 +186,39 @@ class Plan:
             key=lambda d: (d["template"], -d["applied"], d["eqns"]))
 
 
+def site_vmem_bytes(site: Site, block_rows: int = 256) -> int:
+    """Static VMEM roofline for one fused site: the double-buffered
+    working set of a ``block_rows``-row tile over every input plus the
+    rebound outputs. This is the estimate tools/lint/shardcheck.py's
+    TPL204 compares against the ~16 MiB per-core budget (and the seed of
+    the cost-model scheduler): a site whose tile cannot stay resident
+    will thrash HBM no matter how the kernel is scheduled."""
+    import numpy as np
+
+    def tile_bytes(aval) -> int:
+        shape = tuple(getattr(aval, "shape", ()) or ())
+        dt = np.dtype(getattr(aval, "dtype", np.float32))
+        if not shape:
+            return dt.itemsize
+        rows = min(int(shape[0]), block_rows)
+        rest = 1
+        for d in shape[1:]:
+            rest *= int(d)
+        return rows * rest * dt.itemsize
+
+    total = 0
+    for a in site.inputs:
+        aval = getattr(a, "aval", None)
+        if aval is not None:
+            total += tile_bytes(aval)
+    for v, _ in site.out_binds:
+        aval = getattr(v, "aval", None)
+        if aval is not None:
+            total += tile_bytes(aval)
+    return 2 * total  # double buffering: next tile streams in while
+    #                   the current one computes
+
+
 def _validate(g: Graph, site: Site) -> bool:
     """Prove the rewrite safe: replaced equations' outputs must be
     re-bound by the fused call or internal to the site, and re-bound

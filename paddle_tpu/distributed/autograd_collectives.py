@@ -21,7 +21,13 @@ from ..core.dispatch import op
 from ..core.tensor import Tensor
 
 __all__ = ["reshard_op", "scatter_axis", "gather_axis",
-           "dist_allreduce_quant"]
+           "dist_allreduce_quant", "QUANT_SYNC_PP_REFUSAL"]
+
+# Single source of truth for the pp>1 refusal: train_step raises it and
+# tools/lint/shardcheck.py proves the same property statically (TPL202 on
+# the quant_allreduce_dp2pp2 entry) — the message must stay in sync.
+QUANT_SYNC_PP_REFUSAL = ("dist_allreduce_quant does not support pp>1 "
+                         "meshes; use a dp(*mp) mesh or disable the flag")
 
 
 import functools

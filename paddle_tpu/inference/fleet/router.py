@@ -57,6 +57,7 @@ prefill engines are swept back out through their outboxes).
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Optional
 
@@ -648,7 +649,14 @@ class FleetRouter:
                     shipment = rep.engine.finalize_shipment(shipment)
                 job = {"req": req, "shipment": shipment,
                        "donor": rep.engine.engine_id, "pool": rep.role,
-                       "t0": time.monotonic()}
+                       "t0": time.monotonic(),
+                       # the wire closure: everything about the delivery
+                       # is pre-bound at sweep time except the target,
+                       # chosen per attempt (the decode pool may change
+                       # between retries)
+                       "wire": functools.partial(
+                           ship_shipment, shipment, rep.engine.engine_id,
+                           donor_pool=rep.role)}
                 self._attempt_ship(job, 0, now)
         depth = n_tick + sum(1 for e in self._retry if e[3] is not None)
         if depth > self.stats["ship_queue_depth"]:
@@ -673,8 +681,7 @@ class FleetRouter:
             return
         res = {"status": "ok", "pages": 0, "bytes": 0, "adopt_ms": 0.0}
         if job["shipment"] is not None and self.migration:
-            res = ship_shipment(job["shipment"], job["donor"],
-                                target.engine, donor_pool=job["pool"])
+            res = job["wire"](target.engine)
         self.stats["wire_adopt_ms"] += res.get("adopt_ms", 0.0)
         late = (self.ship_deadline > 0
                 and time.monotonic() - job["t0"] > self.ship_deadline)

@@ -145,6 +145,23 @@ def _pick_tokens(logits, temps, topps, seeds, positions):
     return lax.cond(jnp.any(temps > 0), sampled, greedy, operand=None)
 
 
+def wire_gather_pages(pages, pg):
+    """Donor-side wire STAGE kernel: snapshot the per-layer pages at
+    indices ``pg`` into shipment layout ``[n, L, ...]``. Pure so the
+    prefill->decode wire's device half is a traceable program —
+    tools/lint/shardcheck.py registers it as the ``wire_stage`` entry
+    (TPL203 collective-order group with the unified step)."""
+    return jnp.moveaxis(pages[:, pg], 1, 0)
+
+
+def wire_scatter_pages(pages, pg, payload):
+    """Adopter-side wire COMMIT kernel: scatter a shipment payload
+    (already in page layout ``[L, n, ...]``) into the page arrays at
+    indices ``pg``. The pure half of commit_adopt/_flush_commits;
+    shardcheck's ``wire_commit`` entry."""
+    return pages.at[:, pg].set(payload)
+
+
 class _PagePool:
     """Refcounted free-list page allocator with a content-addressed
     prefix cache. Page 0 is reserved as the idle-slot write sink and
@@ -588,6 +605,35 @@ class ServingEngine:
             out = _pick_tokens(logits, temps, topps, seeds,
                                pos0 + n_valid - 1)[:, None]
         return out, ks, vs
+
+    def trace_unified(self):
+        """Trace the (non-quant) unified step to a closed jaxpr with
+        shape-only arguments — no device executes anything. This is the
+        ``serving_unified`` entry program tools/lint/shardcheck.py
+        propagates partition specs through; argument shapes mirror the
+        live ``self._unified(...)`` dispatch exactly."""
+        if self._kv_quant or self._lora_on or self._constr_on:
+            raise NotImplementedError(
+                "trace_unified covers the base non-quant, non-multitenant "
+                "program; register a dedicated entry for variant engines")
+        C, qb, B = self.n_rows, self.qb, self.B
+
+        def sds(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        params = jax.tree.map(sds, self.params)
+        kp, vp = sds(self.k_pages), sds(self.v_pages)
+        i32, f32 = jnp.int32, jnp.float32
+        tokens = jax.ShapeDtypeStruct((C, qb), i32)
+        prev = jax.ShapeDtypeStruct((C, qb if self.spec_k else 1), i32)
+        cmask = jax.ShapeDtypeStruct((C,), jnp.bool_)
+        crow = jax.ShapeDtypeStruct((C,), i32)
+        ptab = jax.ShapeDtypeStruct((B + 1, self.max_blocks), i32)
+        col_i = jax.ShapeDtypeStruct((C,), i32)
+        col_f = jax.ShapeDtypeStruct((C,), f32)
+        return jax.make_jaxpr(self._unified_step_impl)(
+            params, kp, vp, tokens, prev, cmask, crow, ptab,
+            col_i, col_i, col_i, col_f, col_f, col_i)
 
     def _unified_step_impl_q(self, params, k_pages, v_pages, k_scales,
                              v_scales, tokens, prev_out, chain_mask,
@@ -1586,12 +1632,12 @@ class ServingEngine:
             return None
         _slot, tokens, salt, hashes, pg = meta
         pgd = jnp.asarray(pg, jnp.int32)
-        k = jnp.moveaxis(self.k_pages[:, pgd], 1, 0)
-        v = jnp.moveaxis(self.v_pages[:, pgd], 1, 0)
+        k = wire_gather_pages(self.k_pages, pgd)
+        v = wire_gather_pages(self.v_pages, pgd)
         ks = vs = None
         if self._kv_quant:
-            ks = jnp.moveaxis(self.k_scales[:, pgd], 1, 0)
-            vs = jnp.moveaxis(self.v_scales[:, pgd], 1, 0)
+            ks = wire_gather_pages(self.k_scales, pgd)
+            vs = wire_gather_pages(self.v_scales, pgd)
         for a in (k, v, ks, vs):
             # start the device->host transfer now, without blocking:
             # by finalize time the bytes are (usually) already resident
@@ -1852,15 +1898,19 @@ class ServingEngine:
         else:
             pg = jnp.asarray(pages, jnp.int32)
             dt = self.k_pages.dtype
-            self.k_pages = self.k_pages.at[:, pg].set(
+            self.k_pages = wire_scatter_pages(
+                self.k_pages, pg,
                 jnp.asarray(np.moveaxis(shipment["k"][idx], 0, 1), dt))
-            self.v_pages = self.v_pages.at[:, pg].set(
+            self.v_pages = wire_scatter_pages(
+                self.v_pages, pg,
                 jnp.asarray(np.moveaxis(shipment["v"][idx], 0, 1), dt))
             if self._kv_quant:
-                self.k_scales = self.k_scales.at[:, pg].set(
+                self.k_scales = wire_scatter_pages(
+                    self.k_scales, pg,
                     jnp.asarray(np.moveaxis(shipment["k_scales"][idx],
                                             0, 1), jnp.float32))
-                self.v_scales = self.v_scales.at[:, pg].set(
+                self.v_scales = wire_scatter_pages(
+                    self.v_scales, pg,
                     jnp.asarray(np.moveaxis(shipment["v_scales"][idx],
                                             0, 1), jnp.float32))
         for (j, p) in staged:
@@ -1899,14 +1949,16 @@ class ServingEngine:
             return
         pg = jnp.asarray(pages, jnp.int32)
         dt = self.k_pages.dtype
-        self.k_pages = self.k_pages.at[:, pg].set(
-            jnp.asarray(np.concatenate(karrs, axis=1), dt))
-        self.v_pages = self.v_pages.at[:, pg].set(
-            jnp.asarray(np.concatenate(varrs, axis=1), dt))
+        self.k_pages = wire_scatter_pages(
+            self.k_pages, pg, jnp.asarray(np.concatenate(karrs, axis=1), dt))
+        self.v_pages = wire_scatter_pages(
+            self.v_pages, pg, jnp.asarray(np.concatenate(varrs, axis=1), dt))
         if self._kv_quant:
-            self.k_scales = self.k_scales.at[:, pg].set(
+            self.k_scales = wire_scatter_pages(
+                self.k_scales, pg,
                 jnp.asarray(np.concatenate(ksarrs, axis=1), jnp.float32))
-            self.v_scales = self.v_scales.at[:, pg].set(
+            self.v_scales = wire_scatter_pages(
+                self.v_scales, pg,
                 jnp.asarray(np.concatenate(vsarrs, axis=1), jnp.float32))
 
     def abort_adopt(self, handle: dict) -> None:

@@ -329,7 +329,8 @@ def make_sharded_train_step(cfg: GPTConfig, mesh: Mesh, lr: float = 1e-4,
                             n_microbatches: int = 1, zero1: bool = True,
                             seed: int = 0, m_dtype: str | None = None,
                             v_dtype: str | None = None,
-                            weights: str = "auto", abstract: bool = False):
+                            weights: str = "auto", abstract: bool = False,
+                            _emb_pin: bool = True):
     """Build (step_fn, params, opt_state): a donated, fully-sharded
     train step. ``step_fn(params, opt_state, tokens, labels) ->
     (loss, params, opt_state)``.
@@ -370,8 +371,8 @@ def make_sharded_train_step(cfg: GPTConfig, mesh: Mesh, lr: float = 1e-4,
         # the pipeline is its own pp-manual shard_map; nesting it inside a
         # dp-manual region is not a supported lowering — quantized grad
         # sync targets dp(×mp) meshes
-        raise ValueError("dist_allreduce_quant does not support pp>1 "
-                         "meshes; use a dp(*mp) mesh or disable the flag")
+        from ..distributed.autograd_collectives import QUANT_SYNC_PP_REFUSAL
+        raise ValueError(QUANT_SYNC_PP_REFUSAL)
     # Master-weight mode when params would be cast per-use anyway: keep the
     # fp32 master in the optimizer state and the live MATMUL weights in the
     # compute dtype (matmuls consumed them bf16 either way; the update
@@ -435,7 +436,11 @@ def make_sharded_train_step(cfg: GPTConfig, mesh: Mesh, lr: float = 1e-4,
         return _constrain(x, P("dp"))
 
     sp = sp_constraint if use_sp else None
-    emb = emb_constraint if multichip else None
+    # _emb_pin=False rebuilds the pre-fix MULTICHIP_r05 program (gather
+    # output unpinned) so shardcheck's TPL201 regression can trace the
+    # hazard it proves absent on the default path; never disable in
+    # production code.
+    emb = emb_constraint if (multichip and _emb_pin) else None
     grad_specs = gpt_param_specs(cfg)
 
     # -- quantized gradient sync (EQuARX-style, flag-gated) ----------------

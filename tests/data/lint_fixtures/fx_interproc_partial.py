@@ -1,0 +1,29 @@
+"""functools.partial call edges: partial-wrapped helpers stay on the
+call graph (the router wires ``ship_shipment`` this way), so a sync
+buried behind a partial is still reachable from a trace root."""
+
+import functools
+
+import jax
+
+
+def _send(tag, x):
+    return float(x.sum())  # the host sync at the end of the chain
+
+
+send_metric = functools.partial(_send, "loss")
+
+
+@jax.jit
+def traced_partial_root(x):
+    return send_metric(x)  # seeded violation TPL101 (partial edge)
+
+
+@jax.jit
+def traced_partial_suppressed(x):
+    return send_metric(x)  # tpu-lint: disable=TPL101 -- suppressed instance for the fixture contract
+
+
+def eager_partial_driver(x):
+    # not a trace root: the partial edge alone is not a finding
+    return send_metric(x)

@@ -1,0 +1,114 @@
+"""Typestate fixtures: the disagg wire protocol driven wrong on purpose.
+
+TPL211 adopt-without-resolve, TPL212 staged-flush-barrier, TPL213
+release-before-guard — each with a seeded violation, a clean shape that
+must NOT fire, and a suppressed instance (the fixture contract
+tests/test_lint.py::test_fixture_seeding_is_exhaustive enforces).
+"""
+
+
+# -- TPL211: begin_adopt handle must resolve on every path -------------------
+
+def adopt_leak_on_else(eng, shipment):
+    h = eng.begin_adopt(shipment)  # seeded violation TPL211 (no-commit path)
+    if shipment.ok:
+        eng.commit_adopt(h)
+    return None
+
+
+def adopt_discarded(eng, shipment):
+    eng.begin_adopt(shipment)  # seeded violation TPL211 (result discarded)
+
+
+def adopt_leak_suppressed(eng, shipment):
+    h = eng.begin_adopt(shipment)  # tpu-lint: disable=TPL211 -- suppressed instance for the fixture contract
+    if shipment.ok:
+        eng.commit_adopt(h)
+    return None
+
+
+def adopt_ok_try_commit_except_abort(eng, shipment):
+    h = eng.begin_adopt(shipment)
+    try:
+        eng.commit_adopt(h)
+    except RuntimeError:
+        eng.abort_adopt(h)
+        raise
+
+
+def adopt_ok_both_branches(eng, shipment):
+    h = eng.begin_adopt(shipment)
+    if shipment.ok:
+        eng.commit_adopt(h)
+    else:
+        eng.abort_adopt(h)
+
+
+def adopt_ok_none_narrowing(eng, shipment):
+    h = eng.begin_adopt(shipment)
+    if h is None:
+        return False        # staging refused: nothing to resolve
+    eng.commit_adopt(h)
+    return True
+
+
+def adopt_ok_escapes_to_caller(eng, shipment):
+    h = eng.begin_adopt(shipment)
+    return h                # the caller owns the handle now
+
+
+def _finish(eng, handle):
+    eng.commit_adopt(handle)
+
+
+def adopt_ok_resolver_helper(eng, shipment):
+    h = eng.begin_adopt(shipment)
+    _finish(eng, h)         # resolves through the helper's parameter
+
+
+# -- TPL212: no staged-page read before the flush barrier --------------------
+
+class DeferredEngine:
+    def __init__(self):
+        self.k_pages = None
+        self.v_pages = None
+        self._commit_pending = []
+
+    def _flush_commits(self):
+        self._commit_pending.clear()
+
+    def commit_adopt(self, handle):
+        self._commit_pending.append(handle)
+
+    def dispatch_unflushed(self, args):
+        return self._unified(self.k_pages, args)  # seeded violation TPL212
+
+    def export_unflushed(self, pg):
+        return self.k_pages[:, pg]  # tpu-lint: disable=TPL212 -- suppressed instance for the fixture contract
+
+    def dispatch_flushed(self, args):
+        self._flush_commits()
+        return self._unified(self.k_pages, args)  # barrier above: clean
+
+    def _unified(self, pages, args):
+        return pages
+
+
+# -- TPL213: scheduler-owned release only after the in-flight guard ----------
+
+def release_unguarded(pool, owned):
+    pool.release(owned)  # seeded violation TPL213
+
+
+def release_suppressed(pool, owned):
+    pool.release(owned)  # tpu-lint: disable=TPL213 -- suppressed instance for the fixture contract
+
+
+def release_guarded(sched, pool, owned):
+    if sched._inflight is not None:
+        sched.harvest()
+    pool.release(owned)     # guard above: clean
+
+
+def release_unowned(pool, scratch):
+    pool.release(scratch)   # not scheduler-owned: out of scope

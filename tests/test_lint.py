@@ -23,6 +23,7 @@ from tools.lint import (  # noqa: E402
     Finding,
     Suppressions,
     render_json,
+    render_sarif,
     render_text,
     run_lint,
 )
@@ -291,9 +292,73 @@ def test_reporters_shape():
     assert json.loads(render_json([]))["findings"] == []
 
 
+def test_sarif_reporter_shape():
+    f = [Finding("TPL001", "host-sync-in-trace", "error", "a.py", 3, 1,
+                 "msg"),
+         Finding("TPL001", "host-sync-in-trace", "error", "a.py", 7, 0,
+                 "msg2"),
+         Finding("TPL006", "flag-hygiene", "warning", "b.py", 9, 0, "w")]
+    doc = json.loads(render_sarif(f))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    # one rule entry per distinct id, sorted; one result per finding
+    assert [r["id"] for r in run["tool"]["driver"]["rules"]] == \
+        ["TPL001", "TPL006"]
+    assert len(run["results"]) == 3
+    r0 = run["results"][0]
+    assert r0["ruleId"] == "TPL001" and r0["level"] == "error"
+    loc = r0["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "a.py"
+    # SARIF regions are 1-based where Finding.col is 0-based
+    assert loc["region"] == {"startLine": 3, "startColumn": 2}
+    assert run["results"][2]["level"] == "warning"
+    empty = json.loads(render_sarif([]))
+    assert empty["runs"][0]["results"] == []
+
+
+def test_run_lint_ignore_drops_rules():
+    # --ignore drops rules after --select: the fixture's TPL001 findings
+    # vanish while everything else in the file is unaffected
+    base = run_lint([fx("fx_host_sync.py")], excludes=())
+    assert any(x.rule == "TPL001" for x in base)
+    dropped = run_lint([fx("fx_host_sync.py")], excludes=(),
+                       ignore={"TPL001"})
+    assert not any(x.rule == "TPL001" for x in dropped)
+    # by slug too
+    dropped2 = run_lint([fx("fx_host_sync.py")], excludes=(),
+                        ignore={"host-sync-in-trace"})
+    assert not any(x.rule == "TPL001" for x in dropped2)
+    # select + ignore compose: select TPL001 then ignore it -> nothing
+    assert run_lint([fx("fx_host_sync.py")], excludes=(),
+                    select={"TPL001"}, ignore={"TPL001"}) == []
+
+
+def test_cli_ignore_and_sarif(capsys):
+    rc = main(["--format=sarif", "--select=TPL005",
+               fx("fx_collective.py"), "--no-default-excludes"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["runs"][0]["results"][0]["ruleId"] == "TPL005"
+    rc = main(["--select=TPL005", "--ignore=TPL005",
+               fx("fx_collective.py"), "--no-default-excludes"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "clean" in out
+
+
+def test_cli_parse_error_bypasses_ignore(tmp_path, capsys):
+    # TPL000 parse errors are not silenceable via --ignore filtering of
+    # checkers: the file simply cannot be analyzed
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    rc = main(["--ignore=TPL000", str(bad)])
+    assert rc == 1
+    assert "TPL000" in capsys.readouterr().out
+
+
 def test_rule_table_unique_and_documented():
     rules = [c.rule for c in ALL_CHECKERS]
-    assert len(rules) == len(set(rules)) == 12  # 9 per-file + 3 interproc
+    # 9 per-file + 3 interproc + 3 typestate
+    assert len(rules) == len(set(rules)) == 15
     assert all(c.description for c in ALL_CHECKERS)
     assert all(c.severity in ("error", "warning") for c in ALL_CHECKERS)
 

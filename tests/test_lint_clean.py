@@ -17,8 +17,18 @@ until the baseline is deliberately regenerated with
     python -m tools.lint --contracts --baseline \
         artifacts/op_contracts.json --write-baseline
 
-The lint sweep is marked smoke (pure AST, ~10s); the contract sweep
-traces every op abstractly (~15s) and runs in the normal tier.
+The shardcheck-snapshot gate does the same for the static sharding
+verifier (tools/lint/shardcheck.py): every registered entry program is
+re-traced and its spec digest, collective schedule, and finding counts
+are diffed against artifacts/shardcheck.json — regenerate deliberately
+with
+
+    python -m tools.lint --shardcheck --baseline \
+        artifacts/shardcheck.json --write-baseline
+
+The lint sweep is marked smoke (pure AST, ~10s); the contract and
+shardcheck sweeps trace programs abstractly (~15s each) and run in the
+normal tier.
 """
 
 from __future__ import annotations
@@ -36,6 +46,7 @@ from tools.lint import run_lint  # noqa: E402
 from tools.lint.reporters import render_text  # noqa: E402
 
 BASELINE = os.path.join(REPO, "artifacts", "op_contracts.json")
+SHARD_BASELINE = os.path.join(REPO, "artifacts", "shardcheck.json")
 
 
 @pytest.mark.smoke
@@ -67,3 +78,25 @@ def test_contract_baseline_current():
         "op contracts drifted from artifacts/op_contracts.json (or "
         "unexplained violations) — if intended, regenerate with "
         f"--write-baseline:\n{proc.stdout}\n{proc.stderr}")
+
+
+def test_shardcheck_baseline_current():
+    """Fresh subprocess for the same reasons as the contract gate — and
+    because the entry traces need a virgin backend the CLI provisions
+    with an 8-device virtual CPU platform before jax first imports."""
+    import subprocess
+
+    assert os.path.exists(SHARD_BASELINE), (
+        "no shardcheck baseline; generate with: python -m tools.lint "
+        "--shardcheck --baseline artifacts/shardcheck.json "
+        "--write-baseline")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)          # the CLI provisions its own mesh
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--shardcheck",
+         "--baseline", SHARD_BASELINE],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (
+        "shardcheck drifted from artifacts/shardcheck.json (unexplained "
+        "findings, stale explanations, or spec drift) — if intended, "
+        f"regenerate with --write-baseline:\n{proc.stdout}\n{proc.stderr}")

@@ -432,3 +432,239 @@ def test_interproc_rules_inactive_when_not_selected():
     f = lint(["fx_interproc_sync.py", "fx_interproc_helpers.py"],
              "TPL001")
     assert f == []
+
+
+# -- functools.partial call edges --------------------------------------------
+
+def test_tpl101_fires_through_module_level_partial():
+    src = open(fx("fx_interproc_partial.py")).read()
+    f = lint(["fx_interproc_partial.py"], "TPL101")
+    assert len(f) == 1, [x.message for x in f]
+    assert "seeded violation" in src.splitlines()[f[0].line - 1]
+    assert "traced_partial_root -> _send" in f[0].message
+
+
+def test_tpl101_partial_suppression_and_eager_driver():
+    live = lint(["fx_interproc_partial.py"], "TPL101")
+    kept = lint(["fx_interproc_partial.py"], "TPL101",
+                keep_suppressed=True)
+    assert len(kept) == len(live) + 1
+    assert all("eager_partial_driver" not in x.message for x in live)
+
+
+def test_partial_local_resolution_and_arg_offset(tmp_path):
+    idx = index_of("""
+        import functools
+
+        def g(tag, p, q):
+            return p
+
+        def caller(buf):
+            send = functools.partial(g, "x")
+            return send(buf, 1)
+    """, tmp_path=tmp_path)
+    idx.link()
+    caller = func(idx, "caller")
+    # the partial creation is a wrap edge binding the leading args ...
+    wrap = next(s for s in caller.calls
+                if s.is_wrap and s.wrap_kind == "partial")
+    assert wrap.resolved is func(idx, "g")
+    # ... and the call through the local maps the REMAINING params:
+    # partial(g, "x") bound 'tag', so send(buf, 1) maps p/q, not tag/p
+    call = next(s for s in caller.calls if s.target == "send")
+    assert call.resolved is func(idx, "g")
+    assert call.arg_offset == 1
+    mapping = {prm: getattr(e, "id", None)
+               for prm, e in call.args_to_params()}
+    assert mapping["p"] == "buf" and "tag" not in mapping
+
+
+def test_partial_self_rebinding_does_not_recurse(tmp_path):
+    # f = functools.partial(f, x) — the cycle guard must resolve this to
+    # nothing instead of hopping forever (the RecursionError regression)
+    idx = index_of("""
+        import functools
+
+        def cyclic(buf, h):
+            h = functools.partial(h, buf)
+            return h(buf)
+    """, tmp_path=tmp_path)
+    idx.link()
+    f = func(idx, "cyclic")
+    call = next(s for s in f.calls if s.target == "h" and not s.is_wrap)
+    assert call.resolved is None
+
+
+def test_partial_stored_in_dict_keeps_creation_edge(tmp_path):
+    # the router idiom: the partial lands in a job dict and is invoked
+    # far away through job["wire"](...) — unresolvable at the call site,
+    # so the CREATION site must carry the edge into the wrapped callee
+    p = tmp_path / "r.py"
+    p.write_text(textwrap.dedent("""
+        import functools
+        import jax
+
+        def _ship(shipment, x):
+            return float(x.sum())
+
+        @jax.jit
+        def drain(shipment, x):
+            job = {"wire": functools.partial(_ship, shipment)}
+            return job["wire"](x)
+    """))
+    f = run_lint([str(p)], select={"TPL101"}, excludes=())
+    assert len(f) == 1, [x.message for x in f]
+    assert "_ship" in f[0].message
+
+
+# -- TPL211: adopt-without-resolve -------------------------------------------
+
+def test_tpl211_fixture_contract():
+    src = open(fx("fx_typestate.py")).read()
+    f = lint(["fx_typestate.py"], "TPL211")
+    assert len(f) == 2, [(x.line, x.message) for x in f]
+    for x in f:
+        assert "seeded violation" in src.splitlines()[x.line - 1], \
+            (x.line, x.message)
+    msgs = " | ".join(x.message for x in f)
+    assert "escape" in msgs and "discarded" in msgs
+    # every clean shape stays silent: both-branches, try/except/abort,
+    # None-narrowing, escape-to-caller, resolver helper
+    kept = lint(["fx_typestate.py"], "TPL211", keep_suppressed=True)
+    assert len(kept) == len(f) + 1
+
+
+def test_tpl211_double_resolve_fires(tmp_path):
+    p = tmp_path / "paddle_tpu" / "d.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(textwrap.dedent("""
+        def twice(eng, shipment):
+            h = eng.begin_adopt(shipment)
+            eng.commit_adopt(h)
+            eng.abort_adopt(h)
+    """))
+    f = run_lint([str(p)], select={"TPL211"}, excludes=())
+    assert len(f) == 1 and "resolved twice" in f[0].message
+
+
+def test_tpl211_loop_resolve_is_clean(tmp_path):
+    # resolving inside the loop that created the handle: each iteration
+    # begins and resolves its own handle
+    p = tmp_path / "paddle_tpu" / "l.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(textwrap.dedent("""
+        def drain(eng, shipments):
+            for s in shipments:
+                h = eng.begin_adopt(s)
+                eng.commit_adopt(h)
+    """))
+    assert run_lint([str(p)], select={"TPL211"}, excludes=()) == []
+
+
+def test_tpl211_break_before_resolve_fires(tmp_path):
+    p = tmp_path / "paddle_tpu" / "b.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(textwrap.dedent("""
+        def drain(eng, shipments):
+            for s in shipments:
+                h = eng.begin_adopt(s)
+                if s.bad:
+                    break
+                eng.commit_adopt(h)
+    """))
+    f = run_lint([str(p)], select={"TPL211"}, excludes=())
+    assert len(f) == 1, [x.message for x in f]
+
+
+def test_tpl211_interprocedural_resolver_chain(tmp_path):
+    # h flows two hops: outer -> relay(param) -> closer(param) -> commit;
+    # the resolver fixpoint must mark relay's param transitively
+    p = tmp_path / "paddle_tpu" / "c.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(textwrap.dedent("""
+        def _closer(eng, handle):
+            eng.commit_adopt(handle)
+
+        def _relay(eng, handle):
+            _closer(eng, handle)
+
+        def outer(eng, shipment):
+            h = eng.begin_adopt(shipment)
+            _relay(eng, h)
+    """))
+    assert run_lint([str(p)], select={"TPL211"}, excludes=()) == []
+
+
+def test_tpl211_tests_modules_exempt(tmp_path):
+    p = tmp_path / "tests" / "test_probe.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(textwrap.dedent("""
+        def test_leak_recovery(eng, shipment):
+            h = eng.begin_adopt(shipment)
+            assert h is not None
+    """))
+    assert run_lint([str(p)], select={"TPL211"}, excludes=()) == []
+
+
+# -- TPL212: staged-flush-barrier --------------------------------------------
+
+def test_tpl212_fixture_contract():
+    src = open(fx("fx_typestate.py")).read()
+    f = lint(["fx_typestate.py"], "TPL212")
+    assert len(f) == 1, [(x.line, x.message) for x in f]
+    assert "seeded violation" in src.splitlines()[f[0].line - 1]
+    assert "dispatch_unflushed" in f[0].message
+    kept = lint(["fx_typestate.py"], "TPL212", keep_suppressed=True)
+    assert len(kept) == 2
+    # the flushed method and the flush machinery itself stay silent
+    msgs = " | ".join(x.message for x in kept)
+    assert "dispatch_flushed" not in msgs
+    assert "_flush_commits reads" not in msgs
+
+
+def test_tpl212_only_deferred_commit_classes(tmp_path):
+    # no _flush_commits method -> commits are synchronous -> any read
+    # order is fine
+    p = tmp_path / "paddle_tpu" / "s.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(textwrap.dedent("""
+        class SyncEngine:
+            def step(self, args):
+                return self._unified(self.k_pages, args)
+
+            def _unified(self, pages, args):
+                return pages
+    """))
+    assert run_lint([str(p)], select={"TPL212"}, excludes=()) == []
+
+
+# -- TPL213: release-before-guard --------------------------------------------
+
+def test_tpl213_fixture_contract():
+    src = open(fx("fx_typestate.py")).read()
+    f = lint(["fx_typestate.py"], "TPL213")
+    assert len(f) == 1, [(x.line, x.message) for x in f]
+    assert "seeded violation" in src.splitlines()[f[0].line - 1]
+    assert "owned" in f[0].message
+    kept = lint(["fx_typestate.py"], "TPL213", keep_suppressed=True)
+    assert len(kept) == 2
+    msgs = " | ".join(x.message for x in kept)
+    # guarded and non-owned releases stay out
+    assert "release_guarded" not in msgs and "scratch" not in msgs
+
+
+def test_tpl213_deferred_free_and_guard_attr(tmp_path):
+    p = tmp_path / "paddle_tpu" / "q.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(textwrap.dedent("""
+        def bad(self):
+            self.pool.release(self._deferred_free)
+
+        def good(self):
+            if self._inflight is not None:
+                self.harvest()
+            self.pool.release(self._deferred_free)
+    """))
+    f = run_lint([str(p)], select={"TPL213"}, excludes=())
+    assert len(f) == 1, [x.message for x in f]
+    assert "_deferred_free" in f[0].message

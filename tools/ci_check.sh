@@ -2,7 +2,7 @@
 # One-command CI gate: static analysis -> op-contract baseline -> chaos
 # suite -> serving smoke -> kernel parity -> loadgen smoke -> multichip
 # smoke -> multitenant smoke -> fleet smoke -> disagg smoke -> fusion
-# smoke -> tier-1.
+# smoke -> shardcheck smoke -> tier-1.
 #
 #   bash tools/ci_check.sh
 #
@@ -26,13 +26,16 @@
 #  120  fusion smoke failed (the jaxpr pass found <3 sites on the seeded
 #       config, eager fused loss drifted from the unfused composition,
 #       or the per-program autotune cache failed to replay on restart)
+#  130  shardcheck smoke failed (unexplained static sharding/collective
+#       finding on a registered entry program, stale explanation, or
+#       drift against artifacts/shardcheck.json)
 #   30  tier-1 tests failed (ROADMAP.md command)
 #    0  all gates green
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== gate 1/12: tpu-lint (per-file + interprocedural rules) =="
-python -m tools.lint paddle_tpu tests --format=json > /tmp/tpu_lint.json
+echo "== gate 1/13: tpu-lint (per-file + interprocedural + typestate rules) =="
+python -m tools.lint paddle_tpu tests tools --format=json > /tmp/tpu_lint.json
 rc=$?
 if [ "$rc" -ne 0 ]; then
     cat /tmp/tpu_lint.json
@@ -41,7 +44,7 @@ if [ "$rc" -ne 0 ]; then
 fi
 echo "tpu-lint: clean"
 
-echo "== gate 2/12: tpu-verify (abstract op-contract baseline) =="
+echo "== gate 2/13: tpu-verify (abstract op-contract baseline) =="
 JAX_PLATFORMS=cpu python -m tools.lint --contracts \
     --baseline artifacts/op_contracts.json
 rc=$?
@@ -51,7 +54,7 @@ if [ "$rc" -ne 0 ]; then
     exit 20
 fi
 
-echo "== gate 3/12: chaos suite (fault injection -> self-healing) =="
+echo "== gate 3/13: chaos suite (fault injection -> self-healing) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 rc=$?
@@ -61,7 +64,7 @@ if [ "$rc" -ne 0 ]; then
     exit 40
 fi
 
-echo "== gate 4/12: serving smoke (scheduler completion + zero page leak) =="
+echo "== gate 4/13: serving smoke (scheduler completion + zero page leak) =="
 JAX_PLATFORMS=cpu python -m tools.serving_smoke
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -70,7 +73,7 @@ if [ "$rc" -ne 0 ]; then
     exit 50
 fi
 
-echo "== gate 5/12: kernel parity (fused megakernels, CPU fallback arms) =="
+echo "== gate 5/13: kernel parity (fused megakernels, CPU fallback arms) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_fused_norm_epilogue.py \
     tests/test_fused_rope_attention.py tests/test_autotune.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
@@ -81,7 +84,7 @@ if [ "$rc" -ne 0 ]; then
     exit 60
 fi
 
-echo "== gate 6/12: loadgen smoke (open-loop saturation, >=200 arrivals) =="
+echo "== gate 6/13: loadgen smoke (open-loop saturation, >=200 arrivals) =="
 JAX_PLATFORMS=cpu python -m tools.loadgen_smoke
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -91,7 +94,7 @@ if [ "$rc" -ne 0 ]; then
     exit 70
 fi
 
-echo "== gate 7/12: multichip smoke (dp x mp mesh: remat-free compile," \
+echo "== gate 7/13: multichip smoke (dp x mp mesh: remat-free compile," \
      "serial parity, quantized all-reduce) =="
 python tools/multichip_smoke.py
 rc=$?
@@ -102,7 +105,7 @@ if [ "$rc" -ne 0 ]; then
     exit 80
 fi
 
-echo "== gate 8/12: multitenant smoke (LoRA isolation, preemption," \
+echo "== gate 8/13: multitenant smoke (LoRA isolation, preemption," \
      "constrained legality, 7-class ledger) =="
 JAX_PLATFORMS=cpu python -m tools.multitenant_smoke
 rc=$?
@@ -114,7 +117,7 @@ if [ "$rc" -ne 0 ]; then
     exit 90
 fi
 
-echo "== gate 9/12: fleet smoke (engine loss -> bit-identical resume," \
+echo "== gate 9/13: fleet smoke (engine loss -> bit-identical resume," \
      "page migration, survivor ledger) =="
 JAX_PLATFORMS=cpu python -m tools.fleet_smoke
 rc=$?
@@ -125,7 +128,7 @@ if [ "$rc" -ne 0 ]; then
     exit 100
 fi
 
-echo "== gate 10/12: disagg smoke (prefill-pool loss -> degraded" \
+echo "== gate 10/13: disagg smoke (prefill-pool loss -> degraded" \
      "colocated completion, shipped pages, surviving ledgers) =="
 JAX_PLATFORMS=cpu python -m tools.disagg_smoke
 rc=$?
@@ -136,7 +139,7 @@ if [ "$rc" -ne 0 ]; then
     exit 110
 fi
 
-echo "== gate 11/12: fusion smoke (jaxpr fusion discovery, eager" \
+echo "== gate 11/13: fusion smoke (jaxpr fusion discovery, eager" \
      "parity, per-program autotune replay) =="
 JAX_PLATFORMS=cpu python -m tools.fusion_smoke
 rc=$?
@@ -148,7 +151,21 @@ if [ "$rc" -ne 0 ]; then
     exit 120
 fi
 
-echo "== gate 12/12: tier-1 tests (ROADMAP.md) =="
+echo "== gate 12/13: shardcheck smoke (static sharding/collective" \
+     "verification over the registered entry programs) =="
+JAX_PLATFORMS=cpu python -m tools.lint --shardcheck \
+    --baseline artifacts/shardcheck.json
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "ci_check: shardcheck gate failed (rc=$rc) — an entry program" \
+         "has an unexplained involuntary-reshard/collective finding, an" \
+         "explanation went stale, or the spec environment drifted from" \
+         "artifacts/shardcheck.json (regenerate deliberately with" \
+         "--write-baseline)" >&2
+    exit 130
+fi
+
+echo "== gate 13/13: tier-1 tests (ROADMAP.md) =="
 
 set -o pipefail
 rm -f /tmp/_t1.log

@@ -119,7 +119,7 @@ def run_scenario(label: str) -> int:
     for rep in router.replicas:
         e = rep.engine
         if rep.alive and (e._deferred_free or e.pool.pending_evict):
-            e.pool.release(e._deferred_free)
+            e.pool.release(e._deferred_free)  # tpu-lint: disable=TPL213 -- post-run settlement: run() returned, no program in flight
             e._deferred_free = []
             e.pool.commit_evictable()
         acc = e.page_accounting()

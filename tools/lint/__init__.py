@@ -4,12 +4,16 @@ Checks trace-safety (host syncs under capture), async aliasing of numpy
 buffers, op-registry consistency against the grad-coverage inventory,
 recompile hazards, collective axis binding, flag hygiene — plus the
 whole-program interprocedural rules (TPL101-TPL103, call-chain taint
-over the project import/call graph; tools/lint/interproc.py) and
+over the project import/call graph; tools/lint/interproc.py), the wire
+protocol typestate rules (TPL211-TPL213; tools/lint/typestate.py),
 abstract op-contract verification (``--contracts``;
-tools/lint/contracts.py).
+tools/lint/contracts.py), and static sharding/collective verification
+over traced entry-program jaxprs (``--shardcheck``, rules
+TPL201-TPL204; tools/lint/shardcheck.py).
 
-    python -m tools.lint paddle_tpu tests [--format=json]
+    python -m tools.lint paddle_tpu tests [--format=json|sarif]
     python -m tools.lint --contracts --baseline artifacts/op_contracts.json
+    python -m tools.lint --shardcheck --baseline artifacts/shardcheck.json
 
 See ``tools/lint/checkers.py`` + ``tools/lint/interproc.py`` for the
 rule table, ``tools/lint/ARCHITECTURE.md`` for the call-graph/fixpoint
@@ -20,7 +24,8 @@ suppression syntax and how to add a checker.
 from .cli import ALL_CHECKERS, DEFAULT_EXCLUDES, iter_python_files, main, run_lint
 from .core import Checker, FileContext, Finding, Suppressions
 from .interproc import INTERPROC_CHECKERS, ProjectIndex
-from .reporters import render_json, render_text
+from .reporters import render_json, render_sarif, render_text
+from .typestate import TYPESTATE_CHECKERS
 
 __all__ = [
     "ALL_CHECKERS",
@@ -31,9 +36,11 @@ __all__ = [
     "INTERPROC_CHECKERS",
     "ProjectIndex",
     "Suppressions",
+    "TYPESTATE_CHECKERS",
     "iter_python_files",
     "main",
     "render_json",
+    "render_sarif",
     "render_text",
     "run_lint",
 ]

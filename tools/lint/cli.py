@@ -3,6 +3,7 @@
     python -m tools.lint paddle_tpu tests [--format=json] [--select=TPL001]
     python -m tools.lint --contracts --baseline artifacts/op_contracts.json
     python -m tools.lint --contracts --baseline ... --write-baseline
+    python -m tools.lint --shardcheck --baseline artifacts/shardcheck.json
 
 Exit codes (stable; tools/ci_check.sh relies on them):
   0  clean / baseline matches
@@ -20,11 +21,13 @@ import sys
 from .checkers import ALL_CHECKERS as FILE_CHECKERS
 from .core import Finding, parse_file
 from .interproc import INTERPROC_CHECKERS, ProjectIndex
-from .reporters import render_json, render_text
+from .reporters import render_json, render_sarif, render_text
+from .typestate import TYPESTATE_CHECKERS
 
 __all__ = ["ALL_CHECKERS", "run_lint", "main", "iter_python_files"]
 
-ALL_CHECKERS = list(FILE_CHECKERS) + list(INTERPROC_CHECKERS)
+ALL_CHECKERS = (list(FILE_CHECKERS) + list(INTERPROC_CHECKERS)
+                + list(TYPESTATE_CHECKERS))
 
 # Fixture files contain *seeded* violations for the checker unit tests —
 # never part of a clean-tree run.
@@ -64,9 +67,13 @@ def iter_python_files(paths: list[str],
 
 def run_lint(paths: list[str], select: set[str] | None = None,
              excludes: tuple = DEFAULT_EXCLUDES,
-             keep_suppressed: bool = False) -> list[Finding]:
+             keep_suppressed: bool = False,
+             ignore: set[str] | None = None) -> list[Finding]:
     """Run every (selected) checker over the python files under ``paths``
     and return unsuppressed findings, sorted by location.
+
+    ``select`` keeps only the named rules; ``ignore`` then drops rules
+    from that set (ids or slugs, like suppressions).
 
     Checkers with ``needs_project = True`` (tools/lint/interproc.py) get
     a shared :class:`ProjectIndex` bound as ``checker.project``, fed one
@@ -75,6 +82,9 @@ def run_lint(paths: list[str], select: set[str] | None = None,
     checkers = [cls() for cls in ALL_CHECKERS
                 if select is None
                 or cls.rule in select or cls.name in select]
+    if ignore:
+        checkers = [c for c in checkers
+                    if c.rule not in ignore and c.name not in ignore]
     project = ProjectIndex()
     bound = [c for c in checkers if getattr(c, "needs_project", False)]
     for checker in bound:
@@ -141,6 +151,58 @@ def run_contracts(baseline: str | None, write: bool,
     return 1 if bad or drift else 0
 
 
+def run_shardcheck(baseline: str | None, write: bool,
+                   fmt: str = "text") -> int:
+    """Static sharding & collective verification over the registered
+    entry programs (tools/lint/shardcheck.py). Same exit-code contract
+    as run_contracts: 0 clean/matching, 1 unexplained findings or
+    drift, 3 missing baseline."""
+    from . import shardcheck as S
+
+    if baseline and not write and not os.path.exists(baseline):
+        print(f"shardcheck: baseline {baseline} missing "
+              "(run with --write-baseline)", file=sys.stderr)
+        return 3
+    report = S.build_report()
+    findings = report["findings"]
+    bad = S.unexplained_findings(findings)
+    stale = S.stale_explanations(findings)
+    drift: list[str] = []
+    if baseline:
+        if write:
+            S.write_baseline(report["baseline"], baseline)
+        else:
+            drift = S.diff_baselines(report["baseline"],
+                                     S.load_baseline(baseline))
+    entries = report["baseline"]["entries"]
+    if fmt == "json":
+        import json
+
+        print(json.dumps({
+            "entries": entries,
+            "findings": [f.as_dict() for f in findings],
+            "unexplained": [f.as_dict() for f in bad],
+            "stale_explanations": stale,
+            "drift": drift,
+        }, indent=2))
+    elif fmt == "sarif":
+        print(render_sarif(bad, tool_name="tpu-shardcheck"))
+    else:
+        if bad:
+            print(render_text(bad))
+        for line in stale:
+            print(line)
+        for line in drift:
+            print(line)
+        n_explained = len(findings) - len(bad)
+        print(f"shardcheck: {len(entries)} entry program(s), "
+              f"{len(bad)} unexplained finding(s), {n_explained} "
+              f"explained, {len(stale)} stale explanation(s), "
+              f"{len(drift)} baseline drift line(s)"
+              + (f" -> wrote {baseline}" if write and baseline else ""))
+    return 1 if bad or drift or stale else 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="tools.lint",
@@ -151,11 +213,14 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("paths", nargs="*", default=["paddle_tpu", "tests"],
                         help="files or directories to lint "
                              "(default: paddle_tpu tests)")
-    parser.add_argument("--format", choices=("text", "json"),
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
                         default="text", help="output format")
     parser.add_argument("--select", default=None,
                         help="comma-separated rule ids/names to run "
                              "(default: all)")
+    parser.add_argument("--ignore", default=None,
+                        help="comma-separated rule ids/names to skip "
+                             "(applied after --select)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule table and exit")
     parser.add_argument("--no-default-excludes", action="store_true",
@@ -163,13 +228,18 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--contracts", action="store_true",
                         help="run abstract op-contract verification over "
                              "the dispatch registry instead of lint")
+    parser.add_argument("--shardcheck", action="store_true",
+                        help="run static sharding/collective verification "
+                             "over the registered entry programs instead "
+                             "of lint")
     parser.add_argument("--baseline", default=None, metavar="PATH",
-                        help="with --contracts: compare against (or, with "
-                             "--write-baseline, regenerate) this JSON "
-                             "baseline")
+                        help="with --contracts/--shardcheck: compare "
+                             "against (or, with --write-baseline, "
+                             "regenerate) this JSON baseline")
     parser.add_argument("--write-baseline", action="store_true",
-                        help="with --contracts --baseline: write the "
-                             "baseline instead of diffing")
+                        help="with --contracts/--shardcheck and "
+                             "--baseline: write the baseline instead of "
+                             "diffing")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -178,9 +248,14 @@ def main(argv: list[str] | None = None) -> int:
                   f"{cls.description}")
         return 0
 
-    if args.write_baseline and not (args.contracts and args.baseline):
-        print("tpu-lint: --write-baseline requires --contracts and "
-              "--baseline PATH", file=sys.stderr)
+    if args.contracts and args.shardcheck:
+        print("tpu-lint: --contracts and --shardcheck are exclusive",
+              file=sys.stderr)
+        return 2
+    if args.write_baseline and not (
+            (args.contracts or args.shardcheck) and args.baseline):
+        print("tpu-lint: --write-baseline requires --contracts or "
+              "--shardcheck, and --baseline PATH", file=sys.stderr)
         return 2
     if args.contracts:
         try:
@@ -189,6 +264,13 @@ def main(argv: list[str] | None = None) -> int:
         except ImportError as e:
             print(f"tpu-verify: registry import failed: {e}",
                   file=sys.stderr)
+            return 2
+    if args.shardcheck:
+        try:
+            return run_shardcheck(args.baseline, args.write_baseline,
+                                  args.format)
+        except (ImportError, RuntimeError) as e:
+            print(f"shardcheck: setup failed: {e}", file=sys.stderr)
             return 2
 
     paths = args.paths or ["paddle_tpu", "tests"]
@@ -200,8 +282,12 @@ def main(argv: list[str] | None = None) -> int:
 
     select = ({s.strip() for s in args.select.split(",") if s.strip()}
               if args.select else None)
+    ignore = ({s.strip() for s in args.ignore.split(",") if s.strip()}
+              if args.ignore else None)
     excludes = () if args.no_default_excludes else DEFAULT_EXCLUDES
-    findings = run_lint(paths, select=select, excludes=excludes)
-    render = render_json if args.format == "json" else render_text
+    findings = run_lint(paths, select=select, excludes=excludes,
+                        ignore=ignore)
+    render = {"json": render_json, "sarif": render_sarif}.get(
+        args.format, render_text)
     print(render(findings))
     return 1 if findings else 0

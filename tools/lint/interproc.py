@@ -90,9 +90,10 @@ class CallSite:
     target: str                      # dotted name as written at the site
     caller: "FuncInfo"
     is_wrap: bool = False            # shard_map(f, ...)-style wrapping
-    wrap_kind: str | None = None     # 'jit' | 'mesh' for wraps
+    wrap_kind: str | None = None     # 'jit' | 'mesh' | 'partial' for wraps
     wrap_axes: set = field(default_factory=set)
     resolved: "FuncInfo | None" = None
+    arg_offset: int = 0              # params consumed by partial pre-binding
 
     def args_to_params(self) -> list:
         """[(callee_param_name, caller_arg_expr)] for positional +
@@ -105,9 +106,18 @@ class CallSite:
                 kw.arg is None for kw in self.node.keywords):
             return []
         params = g.params
+        pos_args = self.node.args
+        if self.is_wrap and self.wrap_kind == "partial":
+            # functools.partial(f, a, b): args after the callable map to
+            # f's leading parameters
+            pos_args = self.node.args[1:]
+        elif self.arg_offset:
+            # call THROUGH a stored partial (h = partial(f, a); h(b)):
+            # the pre-bound leading params are already consumed
+            params = params[self.arg_offset:]
         # bound-method call (x.m(a)): the receiver consumes 'self',
         # which FuncInfo.params already strips — indices line up.
-        out = list(zip(params, self.node.args))
+        out = list(zip(params, pos_args))
         by_name = {p: None for p in params}
         for kw in self.node.keywords:
             if kw.arg in by_name:
@@ -135,6 +145,7 @@ class FuncInfo:
     collectives: list = field(default_factory=list)  # [(axis, node, opname)]
     asarray_params: dict = field(default_factory=dict)  # param -> sink pointer
     np_locals: set = field(default_factory=set)      # numpy-buffer locals
+    partial_locals: dict = field(default_factory=dict)  # name -> (target, n_bound)
 
     def __hash__(self):
         return id(self)
@@ -232,6 +243,7 @@ class ProjectIndex:
         self.class_methods: dict[tuple, dict[str, FuncInfo]] = {}
         self.np_attrs: dict[str, set] = {}             # module -> numpy attrs
         self.file_axes: dict[str, set] = {}            # module -> axes bound anywhere in file
+        self.module_scope: dict[str, FuncInfo] = {}    # module -> <module> pseudo-fn
         self.jit_wrapped: set[FuncInfo] = set()
         self._linked = False
 
@@ -255,6 +267,7 @@ class ProjectIndex:
                        module=module, path=ctx.path, node=ctx.tree)
         self._summarize(top)
         self.functions.append(top)
+        self.module_scope[module] = top
         self._walk_defs(ctx.tree, module, ctx.path, cls=None, parent=None)
         self._sup = None
         self._linked = False
@@ -501,16 +514,39 @@ class ProjectIndex:
                         wrap_axes=(str_constants(node)
                                    if tail in _MESH_WRAPPERS else set()),
                     ))
+            # functools.partial(g, ...) pre-binds arguments; the
+            # CREATION site is the call edge, because the value may be
+            # stored (a dict slot, a work queue — the disagg router's
+            # job["wire"]) and invoked where no static target is
+            # visible. Reachability for TPL101-103 and the typestate
+            # rules must not depend on seeing the eventual invocation.
+            if tail == "partial" and node.args:
+                wrapped = dotted_name(node.args[0])
+                if wrapped:
+                    f.calls.append(CallSite(
+                        node=node, target=wrapped, caller=f, is_wrap=True,
+                        wrap_kind="partial"))
             if cname:
                 f.calls.append(CallSite(node=node, target=cname, caller=f))
             # numpy buffer locals (for TPL102 caller-side detection)
         for node in _iter_scope(f.node):
-            if isinstance(node, ast.Assign) and isinstance(node.value,
-                                                           ast.Call) \
-                    and _np_rooted(call_name(node.value)):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            if _np_rooted(call_name(node.value)):
                 for t in node.targets:
                     if isinstance(t, ast.Name):
                         f.np_locals.add(t.id)
+            # h = functools.partial(g, a): direct calls through 'h'
+            # resolve to g with the pre-bound params consumed
+            vtail = call_name(node.value).rsplit(".", 1)[-1]
+            if vtail == "partial" and node.value.args:
+                wrapped = dotted_name(node.value.args[0])
+                if wrapped:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            f.partial_locals[t.id] = (
+                                wrapped, len(node.value.args) - 1)
 
     def _collect_asarray_flow(self, f: FuncInfo) -> None:
         """Parameters that flow directly into jnp.asarray in this body."""
@@ -544,7 +580,8 @@ class ProjectIndex:
                     self.jit_wrapped.add(site.resolved)
         self._linked = True
 
-    def _resolve(self, site: CallSite) -> FuncInfo | None:
+    def _resolve(self, site: CallSite,
+                 _hops: frozenset = frozenset()) -> FuncInfo | None:
         parts = site.target.split(".")
         caller = site.caller
         # self.m() / cls.m() within a class body
@@ -554,11 +591,32 @@ class ProjectIndex:
         pkg = caller.module.rpartition(".")[0]
         if len(parts) == 1:
             name = parts[0]
+            scopes = []
             scope = caller
             while scope is not None:            # nested defs, innermost out
+                scopes.append(scope)
+                scope = scope.parent
+            # module-level partials (send = functools.partial(f, tag))
+            # live on the <module> pseudo-function, which is nobody's
+            # parent — append it as the outermost scope
+            mod_top = self.module_scope.get(caller.module)
+            if mod_top is not None and mod_top not in scopes:
+                scopes.append(mod_top)
+            for scope in scopes:
                 if name in scope.local_defs:
                     return scope.local_defs[name]
-                scope = scope.parent
+                if name in scope.partial_locals:
+                    # cycle guard: re-binding idioms (f = partial(f, x))
+                    # would otherwise hop forever
+                    hop = (id(scope), name)
+                    if hop in _hops:
+                        return None
+                    target, n_bound = scope.partial_locals[name]
+                    site.arg_offset = n_bound
+                    return self._resolve(
+                        CallSite(node=site.node, target=target,
+                                 caller=scope),
+                        _hops | {hop})
             local = self.module_funcs.get(caller.module, {}).get(name)
             if local is not None:
                 return local
@@ -719,7 +777,11 @@ class TransitiveAsarrayAlias(InterprocChecker):
             for f in p.functions:
                 for site in f.calls:
                     g = site.resolved
-                    if g is None or g not in flow or site.is_wrap:
+                    # partial wraps DO hand arguments over (the buffer is
+                    # captured at creation time) — only jit/mesh wraps
+                    # pass a callable, not data
+                    if g is None or g not in flow or (
+                            site.is_wrap and site.wrap_kind != "partial"):
                         continue
                     for g_param, expr in site.args_to_params():
                         if g_param not in flow[g]:
@@ -733,7 +795,8 @@ class TransitiveAsarrayAlias(InterprocChecker):
             strict = any(s in f.path for s in AsyncAliasing.STRICT_PATHS)
             for site in f.calls:
                 g = site.resolved
-                if g is None or g not in flow or site.is_wrap:
+                if g is None or g not in flow or (
+                        site.is_wrap and site.wrap_kind != "partial"):
                     continue
                 for g_param, expr in site.args_to_params():
                     if g_param not in flow[g]:

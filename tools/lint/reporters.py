@@ -1,4 +1,5 @@
-"""Finding reporters: human text and machine JSON."""
+"""Finding reporters: human text, machine JSON, and SARIF for CI
+per-rule annotation."""
 
 from __future__ import annotations
 
@@ -6,7 +7,7 @@ import json
 
 from .core import Finding
 
-__all__ = ["render_text", "render_json"]
+__all__ = ["render_text", "render_json", "render_sarif"]
 
 
 def render_text(findings: list[Finding]) -> str:
@@ -37,3 +38,48 @@ def render_json(findings: list[Finding]) -> str:
         },
         indent=2,
     )
+
+
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                 "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def render_sarif(findings: list[Finding], tool_name: str = "tpu-lint",
+                 tool_version: str = "1.0") -> str:
+    """SARIF 2.1.0 — the format CI annotation surfaces consume. One run,
+    one rule entry per distinct rule id, one result per finding; SARIF
+    columns are 1-based where Finding.col is 0-based."""
+    ordered = sorted(findings, key=Finding.sort_key)
+    rules: dict[str, dict] = {}
+    results = []
+    for f in ordered:
+        rules.setdefault(f.rule, {
+            "id": f.rule,
+            "name": f.name,
+            "shortDescription": {"text": f.name},
+        })
+        results.append({
+            "ruleId": f.rule,
+            "level": "error" if f.severity == "error" else "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": f.col + 1},
+                },
+            }],
+        })
+    doc = {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": tool_name,
+                "version": tool_version,
+                "rules": [rules[k] for k in sorted(rules)],
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)

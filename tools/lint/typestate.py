@@ -1,0 +1,551 @@
+"""tpu-lint typestate rules: the disagg wire protocol, verified statically.
+
+The prefill->decode wire (inference/fleet/, inference/serving.py) is a
+three-phase protocol over the page ledger's ``in_flight`` class:
+
+  ``begin_adopt``  allocates pages and stages a shipment (ledger class
+                   in_flight) — the handle it returns OWNS those pages;
+  ``commit_adopt`` publishes them into the prefix cache (or defers the
+                   device scatter to ``_flush_commits`` under
+                   ``wire_overlap``);
+  ``abort_adopt``  rolls the staging back to the free list.
+
+Every dynamic smoke (disagg, fleet, chaos) exercises one interleaving;
+these rules verify the protocol on **all paths**, interprocedurally, on
+the :class:`~tools.lint.interproc.ProjectIndex`:
+
+TPL211  adopt-without-resolve     every ``begin_adopt`` handle reaches
+        exactly one of ``commit_adopt``/``abort_adopt`` (or escapes to
+        the caller / a resolving helper) on every path — a path that
+        drops a staged handle leaks in_flight pages forever; resolving
+        twice double-releases.
+TPL212  staged-flush-barrier      in a class with deferred commits
+        (defines ``_flush_commits``), no method may dispatch a program
+        over the page arrays or snapshot them for export without the
+        flush barrier first — a staged page read before its flush sees
+        stale bytes (exactly the ordering ``_dispatch_unified`` /
+        ``stage_request_pages`` / ``export_request_pages`` maintain).
+TPL213  release-before-guard      releasing scheduler-owned pages
+        (``owned`` / ``_deferred_free``) is only safe after the
+        in-flight-program guard — an unguarded release hands pages back
+        while a dispatched program may still write them.
+
+Like the TPL10x family, resolution is first-order and best-effort:
+unresolvable dynamic dispatch contributes no edge, so imprecision costs
+recall, never phantom findings.  Functions in ``tests.*`` modules are
+exempt (tests intentionally drive partial protocols to probe recovery) —
+except the seeded-violation fixtures under ``lint_fixtures``, which are
+exactly the files that must fire.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import names_in
+from .interproc import FuncInfo, InterprocChecker
+
+__all__ = ["TYPESTATE_CHECKERS", "AdoptProtocol", "StagedFlushBarrier",
+           "ReleaseBeforeGuard"]
+
+_BEGIN = "begin_adopt"
+_RESOLVE_TAILS = {"commit_adopt", "abort_adopt"}
+_PAGE_ATTRS = {"k_pages", "v_pages", "k_scales", "v_scales"}
+_GUARD_IDS = {"_inflight", "defer"}
+_OWNED_ARGS = {"owned", "_deferred_free"}
+
+# handle states
+_STAGED = "staged"
+_DONE = "done"
+
+
+def _in_tests(f: FuncInfo) -> bool:
+    # seeded-violation fixtures anchor under tests/ too — they must fire
+    if "lint_fixtures" in f.module:
+        return False
+    return f.module == "tests" or f.module.startswith("tests.")
+
+
+def _idents(node: ast.AST) -> set:
+    """Name ids AND attribute names in an expression (names_in sees only
+    bare Names — ``self._deferred_free`` must count as a mention too)."""
+    out = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+def _call_tail(node: ast.Call) -> str:
+    from .core import call_name
+
+    cname = call_name(node)
+    return cname.rsplit(".", 1)[-1] if cname else ""
+
+
+# ---------------------------------------------------------------------------
+# TPL211: begin_adopt handles resolve exactly once on every path
+# ---------------------------------------------------------------------------
+
+class AdoptProtocol(InterprocChecker):
+    """Path-sensitive handle tracking per function body, with an
+    interprocedural resolver fixpoint: a helper that commits/aborts a
+    parameter (directly or transitively) resolves any handle passed into
+    that parameter."""
+
+    rule = "TPL211"
+    name = "adopt-without-resolve"
+    severity = "error"
+    description = ("begin_adopt handle must reach exactly one of "
+                   "commit_adopt/abort_adopt on every path")
+
+    def finalize(self):
+        p = self.project
+        if p is None:
+            return
+        p.link()
+        resolvers = self._resolver_params(p)
+        for f in p.functions:
+            if _in_tests(f) or f.is_module:
+                continue
+            if f.name == _BEGIN:
+                continue          # the protocol's own implementation
+            self._check_function(f, resolvers)
+
+    # -- interprocedural half ------------------------------------------------
+
+    @staticmethod
+    def _resolver_params(p) -> dict:
+        """FuncInfo -> set of parameter names whose value the function
+        resolves (commits/aborts/hands to another resolver)."""
+        res: dict = {}
+        changed = True
+        while changed:
+            changed = False
+            for f in p.functions:
+                for site in f.calls:
+                    tail = site.target.rsplit(".", 1)[-1]
+                    if tail in _RESOLVE_TAILS and site.node.args:
+                        for nm in names_in(site.node.args[0]):
+                            if nm in f.params and nm not in res.setdefault(
+                                    f, set()):
+                                res[f].add(nm)
+                                changed = True
+                    g = site.resolved
+                    if g is None or g not in res or site.is_wrap:
+                        continue
+                    for g_param, expr in site.args_to_params():
+                        if g_param not in res[g]:
+                            continue
+                        for nm in names_in(expr):
+                            if nm in f.params and nm not in res.setdefault(
+                                    f, set()):
+                                res[f].add(nm)
+                                changed = True
+        return res
+
+    # -- intraprocedural half ------------------------------------------------
+
+    def _check_function(self, f: FuncInfo, resolvers: dict):
+        body = getattr(f.node, "body", None)
+        if not isinstance(body, list):
+            return
+        self._f = f
+        self._resolvers = resolvers
+        self._pending_exits = []
+        # falls off the end of the function body with a staged handle =
+        # leak; Return paths check themselves, Raise paths hand cleanup
+        # to the caller (the adopt_pages except/abort shape) and are
+        # deliberately exempt
+        for _, state in self._block(body, {}):
+            self._check_leaks(state)
+
+    def _report_leak(self, begin_node):
+        if getattr(begin_node, "_tpl211_reported", False):
+            return
+        begin_node._tpl211_reported = True
+        self.report(
+            begin_node,
+            "begin_adopt handle may escape without commit_adopt/"
+            "abort_adopt on some path — staged pages stay in the "
+            "in_flight ledger class forever; resolve the handle on "
+            "every path (the adopt_pages try/commit/except/abort shape)",
+            path=self._f.path)
+
+    def _check_leaks(self, state: dict):
+        for var, (st, node) in state.items():
+            if st == _STAGED:
+                self._report_leak(node)
+
+    def _resolving_call(self, call: ast.Call) -> bool:
+        tail = _call_tail(call)
+        if tail in _RESOLVE_TAILS:
+            return True
+        # a resolved callee that resolves the corresponding parameter
+        for site in self._f.calls:
+            if site.node is call and site.resolved is not None:
+                res = self._resolvers.get(site.resolved, set())
+                if res:
+                    return True
+        return False
+
+    def _resolved_vars(self, call: ast.Call, state: dict) -> list:
+        """Handle vars this call resolves."""
+        tail = _call_tail(call)
+        out = []
+        if tail in _RESOLVE_TAILS and call.args:
+            out += [nm for nm in names_in(call.args[0]) if nm in state]
+        for site in self._f.calls:
+            if site.node is not call or site.resolved is None:
+                continue
+            res = self._resolvers.get(site.resolved, set())
+            for g_param, expr in site.args_to_params():
+                if g_param in res:
+                    out += [nm for nm in names_in(expr) if nm in state]
+        return out
+
+    def _scan_calls(self, node: ast.AST, state: dict):
+        """Process begin/resolve calls inside one simple statement or
+        expression, in source order."""
+        calls = [n for n in ast.walk(node) if isinstance(n, ast.Call)]
+        calls.sort(key=lambda n: (n.lineno, n.col_offset))
+        for call in calls:
+            for var in self._resolved_vars(call, state):
+                st, bn = state[var]
+                if st == _DONE:
+                    self.report(
+                        call,
+                        f"handle '{var}' resolved twice (second "
+                        "commit_adopt/abort_adopt here) — the staged "
+                        "pages double-release; every path must resolve "
+                        "exactly once",
+                        path=self._f.path)
+                state[var] = (_DONE, bn)
+
+    def _begin_target(self, stmt: ast.stmt):
+        """(var, call) when the statement binds a begin_adopt result to
+        a simple name; (None, call) when a begin result is discarded."""
+        calls = [n for n in ast.walk(stmt) if isinstance(n, ast.Call)
+                 and _call_tail(n) == _BEGIN]
+        if not calls:
+            return None, None
+        call = calls[0]
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.value is call):
+            return stmt.targets[0].id, call
+        if isinstance(stmt, (ast.Return,)):
+            return "<returned>", call      # handed to the caller
+        return None, call
+
+    @staticmethod
+    def _none_test(test: ast.AST, state: dict):
+        """('is_none'|'not_none', var) for ``h is None`` narrowing."""
+        if (isinstance(test, ast.Compare) and len(test.ops) == 1
+                and isinstance(test.left, ast.Name)
+                and test.left.id in state
+                and len(test.comparators) == 1
+                and isinstance(test.comparators[0], ast.Constant)
+                and test.comparators[0].value is None):
+            if isinstance(test.ops[0], ast.Is):
+                return "is_none", test.left.id
+            if isinstance(test.ops[0], ast.IsNot):
+                return "not_none", test.left.id
+        return None, None
+
+    @staticmethod
+    def _state_key(state: dict):
+        return frozenset((v, st, id(n)) for v, (st, n) in state.items())
+
+    @classmethod
+    def _dedupe(cls, outs: list) -> list:
+        """Collapse (kind, state) pairs with identical abstract states —
+        without this, every branch statement doubles the path list and
+        handle-free functions explode exponentially."""
+        seen = set()
+        uniq = []
+        for kind, s in outs:
+            key = (kind, cls._state_key(s))
+            if key not in seen:
+                seen.add(key)
+                uniq.append((kind, s))
+        return uniq
+
+    def _block(self, stmts: list, state: dict) -> list:
+        """Abstractly execute a statement list. ``state`` maps handle
+        var -> (state, begin node). Returns [(exit_kind, state)] where
+        exit_kind is 'fall' | 'return' | 'break' | 'continue' | 'raise';
+        'fall' means execution reaches the end of the list."""
+        states = [dict(state)]
+        for stmt in stmts:
+            new_states = []
+            exited = []
+            for st in states:
+                outs = self._stmt(stmt, st)
+                for kind, s in outs:
+                    if kind == "fall":
+                        new_states.append(s)
+                    else:
+                        exited.append((kind, s))
+            # non-fall exits leave the block immediately
+            self._pending_exits.extend(exited)
+            states = [s for _, s in self._dedupe(
+                ("fall", s) for s in new_states)]
+            if not states:
+                return []
+        return [("fall", s) for s in states]
+
+    def _run_block(self, stmts: list, state: dict) -> list:
+        """_block plus collection of inner exits."""
+        saved, self._pending_exits = getattr(self, "_pending_exits", []), []
+        falls = self._block(stmts, state)
+        exits = self._dedupe(self._pending_exits + falls)
+        self._pending_exits = saved
+        return exits
+
+    def _stmt(self, stmt: ast.stmt, state: dict) -> list:
+        state = dict(state)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return [("fall", state)]
+        if isinstance(stmt, ast.If):
+            kind, var = self._none_test(stmt.test, state)
+            self._scan_calls(stmt.test, state)
+            then_state, else_state = dict(state), dict(state)
+            if kind == "is_none":
+                then_state.pop(var, None)     # no handle on the None path
+            elif kind == "not_none":
+                else_state.pop(var, None)
+            outs = self._run_block(stmt.body, then_state)
+            outs += self._run_block(stmt.orelse, else_state)
+            return self._split(outs)
+        if isinstance(stmt, ast.Try):
+            outs = self._run_block(stmt.body, state)
+            # a handler can run with the state from ANY point in the try
+            # body — entry state is the most-staged approximation
+            for h in stmt.handlers:
+                outs += self._run_block(h.body, dict(state))
+            outs2 = []
+            for kind, s in outs:
+                if stmt.finalbody:
+                    for k2, s2 in self._run_block(stmt.finalbody, s):
+                        outs2.append((kind if k2 == "fall" else k2, s2))
+                else:
+                    outs2.append((kind, s))
+            if stmt.orelse:
+                extra = []
+                for kind, s in outs2:
+                    if kind == "fall":
+                        extra += self._run_block(stmt.orelse, s)
+                    else:
+                        extra.append((kind, s))
+                outs2 = extra
+            return self._split(outs2)
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            test = getattr(stmt, "test", None) or getattr(stmt, "iter",
+                                                          None)
+            if test is not None:
+                self._scan_calls(test, state)
+            outs = self._run_block(stmt.body, dict(state))
+            results = [("fall", dict(state))]       # zero iterations
+            for kind, s in outs:
+                if kind in ("break", "continue", "fall"):
+                    results.append(("fall", s))
+                else:
+                    results.append((kind, s))
+            results += self._run_block(stmt.orelse, dict(state)) \
+                if stmt.orelse else []
+            return self._split(results)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._scan_calls(item.context_expr, state)
+            return self._split(self._run_block(stmt.body, state))
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._scan_calls(stmt.value, state)
+                for nm in names_in(stmt.value):
+                    if nm in state:               # handle escapes upward:
+                        st, bn = state[nm]        # caller owns it now
+                        state[nm] = (_DONE, bn)
+            self._check_leaks(state)
+            return [("return", state)]
+        if isinstance(stmt, ast.Raise):
+            # exception paths hand cleanup to the caller's except/abort
+            return [("raise", state)]
+        if isinstance(stmt, ast.Break):
+            return [("break", state)]
+        if isinstance(stmt, ast.Continue):
+            return [("continue", state)]
+        # simple statement: begin-binding, then resolves, in order
+        var, begin = self._begin_target(stmt)
+        self._scan_calls(stmt, state)
+        if begin is not None:
+            if var is None:
+                self.report(
+                    begin,
+                    "begin_adopt result discarded — the handle owns the "
+                    "staged pages; bind it and resolve it with "
+                    "commit_adopt/abort_adopt",
+                    path=self._f.path)
+            elif var == "<returned>":
+                pass                               # escapes to the caller
+            else:
+                state[var] = (_STAGED, begin)
+        return [("fall", state)]
+
+    def _split(self, outs: list) -> list:
+        """Route non-fall exits to _pending_exits, keep falls local."""
+        falls = []
+        for kind, s in outs:
+            if kind == "fall":
+                falls.append(("fall", s))
+            elif kind in ("return", "raise"):
+                self._pending_exits.append((kind, s))
+            else:
+                falls.append((kind, s))    # break/continue bubble up one
+        return falls
+
+
+# ---------------------------------------------------------------------------
+# TPL212: no staged-page read before the flush barrier
+# ---------------------------------------------------------------------------
+
+class StagedFlushBarrier(InterprocChecker):
+    """In classes with deferred adoption commits (they define
+    ``_flush_commits``), a method that dispatches the unified program or
+    gathers pages for export must flush first — otherwise it reads pages
+    whose committed bytes are still host-side in ``_commit_pending``."""
+
+    rule = "TPL212"
+    name = "staged-flush-barrier"
+    severity = "error"
+    description = ("page-array read (dispatch/export) without a prior "
+                   "_flush_commits barrier in a deferred-commit class")
+
+    # methods that ARE the commit/flush machinery (they write, not read)
+    _EXEMPT = {"_flush_commits", "commit_adopt", "__init__"}
+    _READ_CALL_TAILS = {"_unified", "wire_gather_pages"}
+
+    def finalize(self):
+        p = self.project
+        if p is None:
+            return
+        p.link()
+        for (module, cls), methods in sorted(p.class_methods.items()):
+            if ((module == "tests" or module.startswith("tests."))
+                    and "lint_fixtures" not in module):
+                continue
+            if "_flush_commits" not in methods:
+                continue
+            for name, m in sorted(methods.items()):
+                if name in self._EXEMPT:
+                    continue
+                read = self._first_read(m)
+                if read is None:
+                    continue
+                node, what = read
+                if self._flushes_before(m, node.lineno):
+                    continue
+                self.report(
+                    node,
+                    f"{cls}.{name} reads staged pages ({what}) with no "
+                    "_flush_commits barrier earlier in the method — a "
+                    "deferred adoption commit may still be pending, so "
+                    "the program/export sees stale page bytes; flush "
+                    "first (the _dispatch_unified preamble)",
+                    path=m.path)
+
+    def _first_read(self, m: FuncInfo):
+        """Earliest staged-state read: a ``self._unified(...)`` dispatch,
+        a ``wire_gather_pages(self.k_pages, ...)`` export gather, or a
+        direct subscript load of a page array."""
+        best = None
+        for n in ast.walk(m.node):
+            hit = None
+            if isinstance(n, ast.Call):
+                tail = _call_tail(n)
+                if tail in self._READ_CALL_TAILS:
+                    hit = (n, f"{tail}(...)")
+            elif (isinstance(n, ast.Subscript)
+                    and isinstance(n.value, ast.Attribute)
+                    and n.value.attr in _PAGE_ATTRS
+                    and isinstance(n.ctx, ast.Load)):
+                hit = (n, f"{n.value.attr}[...]")
+            if hit is None:
+                continue
+            if best is None or hit[0].lineno < best[0].lineno:
+                best = hit
+        return best
+
+    @staticmethod
+    def _flushes_before(m: FuncInfo, line: int) -> bool:
+        for n in ast.walk(m.node):
+            if (isinstance(n, ast.Call)
+                    and _call_tail(n) == "_flush_commits"
+                    and n.lineno < line):
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# TPL213: page release only after the in-flight guard
+# ---------------------------------------------------------------------------
+
+class ReleaseBeforeGuard(InterprocChecker):
+    """Scheduler-owned pages (``owned`` buffers, the ``_deferred_free``
+    list) may only return to the pool after the in-flight-program guard:
+    a dispatched program may still write the pages, so an unguarded
+    release lets the allocator hand them to a new request mid-write."""
+
+    rule = "TPL213"
+    name = "release-before-guard"
+    severity = "error"
+    description = ("pool.release of scheduler-owned pages with no "
+                   "in-flight-program guard earlier in the function")
+
+    def finalize(self):
+        p = self.project
+        if p is None:
+            return
+        p.link()
+        for f in p.functions:
+            if _in_tests(f) or f.is_module:
+                continue
+            for site in f.calls:
+                parts = site.target.split(".")
+                if parts[-1] != "release" or len(parts) < 2:
+                    continue
+                if not any("pool" in part for part in parts[:-1]):
+                    continue
+                owned = set()
+                for a in site.node.args:
+                    owned |= _idents(a) & _OWNED_ARGS
+                if not owned:
+                    continue
+                if self._guarded_before(f, site.node.lineno):
+                    continue
+                self.report(
+                    site.node,
+                    f"release of scheduler-owned pages "
+                    f"({', '.join(sorted(owned))}) with no in-flight "
+                    "guard (_inflight / defer test) earlier in "
+                    f"'{f.name}' — an in-flight program may still write "
+                    "these pages; gate the release on the in-flight "
+                    "handle being harvested",
+                    path=f.path)
+
+    @staticmethod
+    def _guarded_before(f: FuncInfo, line: int) -> bool:
+        for n in ast.walk(f.node):
+            if getattr(n, "lineno", line) >= line:
+                continue
+            if isinstance(n, ast.Name) and n.id in _GUARD_IDS:
+                return True
+            if isinstance(n, ast.Attribute) and n.attr in _GUARD_IDS:
+                return True
+        return False
+
+
+TYPESTATE_CHECKERS = [AdoptProtocol, StagedFlushBarrier, ReleaseBeforeGuard]
