@@ -147,6 +147,14 @@ class Site:
     build: Callable[[list], Sequence]
     applied: bool = True          # kernel-supported gate at plan time
     note: str = ""
+    # per-site accumulation dtype: what the fused kernel's dots/reduces
+    # accumulate in. Every catalog template today is fp32-accumulating
+    # (the kernels pin preferred_element_type / fp32 scratch), so the
+    # default is the only value in use — tools/lint/quantcheck.py's
+    # TPL301 checks it per applied site with sub-fp32 inputs, so a
+    # future template that accumulates narrower must say so here and
+    # will be flagged.
+    accum_dtype: str = "float32"
 
 
 @dataclasses.dataclass

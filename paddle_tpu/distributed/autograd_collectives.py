@@ -26,6 +26,11 @@ __all__ = ["reshard_op", "scatter_axis", "gather_axis",
 # Single source of truth for the pp>1 refusal: train_step raises it and
 # tools/lint/shardcheck.py proves the same property statically (TPL202 on
 # the quant_allreduce_dp2pp2 entry) — the message must stay in sync.
+# tools/lint/quantcheck.py traces the same entry over its precision
+# lattice: both quantize phases divide by SCALE_EPS-clamped scales
+# (TPL304), the fp32 dequant-accumulate keeps int8 off the reduction
+# (TPL301/TPL305), and each chunk's bytes dequantize against the scale
+# from their own absmax event (TPL303).
 QUANT_SYNC_PP_REFUSAL = ("dist_allreduce_quant does not support pp>1 "
                          "meshes; use a dp(*mp) mesh or disable the flag")
 

@@ -61,7 +61,8 @@ from ..models.llama import (LlamaConfig, apply_rope, init_llama_params,
                             _mm)
 from ..testing import chaos as _chaos
 
-__all__ = ["Request", "ServingEngine"]
+__all__ = ["Request", "ServingEngine", "kv_admit_first_write",
+           "kv_scale_reset", "wire_gather_pages", "wire_scatter_pages"]
 
 
 @dataclasses.dataclass
@@ -160,6 +161,46 @@ def wire_scatter_pages(pages, pg, payload):
     indices ``pg``. The pure half of commit_adopt/_flush_commits;
     shardcheck's ``wire_commit`` entry."""
     return pages.at[:, pg].set(payload)
+
+
+def kv_scale_reset(scales, page_ids, axis: int = 0):
+    """Zero the scale-plane entries of freshly allocated pages — the
+    PR 8 fix: a reused page's stale running-absmax would quantize the
+    new tenant's tokens against a garbage (possibly inflated) scale, so
+    the allocator resets the plane and the first write sets a fresh
+    scale. ``axis`` is the page dimension: single-layer ``[P, nKV]``
+    planes use 0, the engine's stacked ``[L, P, nKV]`` planes use 1.
+    tools/lint/quantcheck.py recognizes this scatter-set-of-zero as the
+    scale-provenance *reset* event that clears TPL303 foreignness."""
+    idx = (slice(None),) * axis + (page_ids,)
+    return scales.at[idx].set(0.0)
+
+
+def kv_admit_first_write(pages, scales, page_ids, tokens,
+                         _zero_scale_on_alloc: bool = True):
+    """A new tenant's FIRST write into freshly allocated (reused) pages,
+    as one traceable program: reset -> scatter-max -> quantize ->
+    scatter. One layer, v-layout ``pages`` [P, nKV, bs, d] int8,
+    ``scales`` [P, nKV] fp32 (the plane as the allocator left it — the
+    *previous* tenant's running absmaxes), ``page_ids`` [N] int32,
+    ``tokens`` [N, nKV, bs, d] fp32.
+
+    ``_zero_scale_on_alloc`` mirrors the engine attribute of the same
+    name: True is the shipped path (kv_scale_reset before the first
+    kv_scale_update); False rebuilds the pre-PR 8 program where the
+    prior tenant's absmax survives into the new tenant's quantize —
+    tools/lint/quantcheck.py traces both and proves TPL303
+    (scale-provenance-mismatch) fires exactly on the False variant."""
+    from ..ops.quant import kv_scale_update, quantize_to_scale
+
+    if _zero_scale_on_alloc:
+        scales = kv_scale_reset(scales, page_ids)
+    absmax = jnp.max(jnp.abs(tokens.astype(jnp.float32)),
+                     axis=(-2, -1)) / 127.0                  # [N, nKV]
+    scales = kv_scale_update(scales, page_ids, absmax)
+    s = jnp.take(scales, page_ids, axis=0)[:, :, None, None]
+    q = quantize_to_scale(tokens, s)
+    return pages.at[page_ids].set(q), scales
 
 
 class _PagePool:
@@ -339,6 +380,12 @@ class ServingEngine:
         if kv_quant is None:
             kv_quant = GLOBAL_FLAGS.get("serving_kv_quant")
         self._kv_quant = bool(kv_quant)
+        # the PR 8 scale-leak fix as a named hook: _alloc_pages zeroes a
+        # reused page's scale-plane entries before the new tenant's first
+        # write (kv_scale_reset). tools/lint/quantcheck.py flips this off
+        # to rebuild the pre-fix program and prove TPL303 fires on it —
+        # production engines never disable it.
+        self._zero_scale_on_alloc = True
         # overlapped migration wire (serving_wire_overlap): export stages
         # an async device->host copy chained after the in-flight program
         # instead of a blocking chain sync, and adoption commits fold
@@ -635,6 +682,40 @@ class ServingEngine:
             params, kp, vp, tokens, prev, cmask, crow, ptab,
             col_i, col_i, col_i, col_f, col_f, col_i)
 
+    def trace_unified_quant(self):
+        """``trace_unified`` for the ``serving_kv_quant`` engine: the
+        int8 step with its two scale-plane operands, traced shape-only.
+        This is the ``serving_unified_int8kv`` entry program
+        tools/lint/quantcheck.py interprets over the precision lattice
+        (the scale planes are the TPL303 provenance roots)."""
+        if not self._kv_quant:
+            raise NotImplementedError(
+                "trace_unified_quant covers the serving_kv_quant "
+                "program; use trace_unified for the base engine")
+        if self._lora_on or self._constr_on:
+            raise NotImplementedError(
+                "trace_unified_quant covers the non-multitenant quant "
+                "program; register a dedicated entry for variant engines")
+        C, qb, B = self.n_rows, self.qb, self.B
+
+        def sds(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        params = jax.tree.map(sds, self.params)
+        kp, vp = sds(self.k_pages), sds(self.v_pages)
+        ksc, vsc = sds(self.k_scales), sds(self.v_scales)
+        i32, f32 = jnp.int32, jnp.float32
+        tokens = jax.ShapeDtypeStruct((C, qb), i32)
+        prev = jax.ShapeDtypeStruct((C, qb if self.spec_k else 1), i32)
+        cmask = jax.ShapeDtypeStruct((C,), jnp.bool_)
+        crow = jax.ShapeDtypeStruct((C,), i32)
+        ptab = jax.ShapeDtypeStruct((B + 1, self.max_blocks), i32)
+        col_i = jax.ShapeDtypeStruct((C,), i32)
+        col_f = jax.ShapeDtypeStruct((C,), f32)
+        return jax.make_jaxpr(self._unified_step_impl_q)(
+            params, kp, vp, ksc, vsc, tokens, prev, cmask, crow, ptab,
+            col_i, col_i, col_i, col_f, col_f, col_i)
+
     def _unified_step_impl_q(self, params, k_pages, v_pages, k_scales,
                              v_scales, tokens, prev_out, chain_mask,
                              chain_row, ptable, row_slot, pos0, n_valid,
@@ -908,15 +989,15 @@ class ServingEngine:
                and self.adapters._evict_idle()):
             pass
         pages = self.pool.alloc(n)
-        if self._kv_quant and pages:
+        if self._kv_quant and pages and self._zero_scale_on_alloc:
             # a reused page's stale running-absmax would quantize the
             # new tenant's tokens against a garbage (possibly inflated)
             # scale; zeroing at allocation makes the first write set a
             # fresh scale. Chained after any in-flight step's donated
             # output, so programs already dispatched are unaffected.
             pg = jnp.asarray(pages, jnp.int32)
-            self.k_scales = self.k_scales.at[:, pg].set(0.0)
-            self.v_scales = self.v_scales.at[:, pg].set(0.0)
+            self.k_scales = kv_scale_reset(self.k_scales, pg, axis=1)
+            self.v_scales = kv_scale_reset(self.v_scales, pg, axis=1)
         return pages
 
     def _admit(self, now: float) -> None:

@@ -27,6 +27,10 @@ import jax.numpy as jnp
 
 from .flash_attention import _interpret_mode
 
+# Accumulation-dtype declaration for tools/lint/quantcheck.py (TPL301):
+# score and value dots accumulate in fp32 in every arm.
+ACCUM_DTYPE = "float32"
+
 BLOCK_S = 512
 
 

@@ -33,6 +33,10 @@ import jax.numpy as jnp
 
 from ...core.dispatch import op
 
+# Accumulation-dtype declaration for tools/lint/quantcheck.py (TPL301):
+# fwd and bwd kernels accumulate every dot in fp32.
+ACCUM_DTYPE = "float32"
+
 _INTERPRET = None  # resolved lazily: True on CPU backend (tests), False on TPU
 
 

@@ -29,6 +29,10 @@ import jax.numpy as jnp
 
 from .flash_attention import _interpret_mode, _tpu_params
 
+# Accumulation-dtype declaration for tools/lint/quantcheck.py (TPL301):
+# logits and the bwd dx/dh accumulators are fp32 in every kernel arm.
+ACCUM_DTYPE = "float32"
+
 # Tile sizes: head tile [H, bv] bf16 is the VMEM resident; token block
 # [BT, H] streams. The final vocab tile may be a partial block (Pallas
 # pads reads; the kernels mask col >= V). v5e VMEM is ~16 MB/core, so bv

@@ -38,6 +38,11 @@ from .flash_attention import _interpret_mode
 
 __all__ = ["lora_matmul", "lora_matmul_supported"]
 
+# Accumulation-dtype declaration for tools/lint/quantcheck.py (TPL301):
+# both BGMV dots accumulate in fp32 (preferred_element_type) in the
+# kernel and the XLA fallback alike.
+ACCUM_DTYPE = "float32"
+
 
 def lora_matmul_supported(qb: int, H: int, r: int, N: int) -> bool:
     """MXU-kernel gate: sublane-tileable row blocks, full-lane H/N, and

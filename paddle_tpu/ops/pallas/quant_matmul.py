@@ -35,6 +35,13 @@ from .flash_attention import _interpret_mode
 
 __all__ = ["quant_matmul", "quant_matmul_supported"]
 
+# Accumulation-dtype declaration for tools/lint/quantcheck.py (TPL301):
+# the MXU kernel accumulates in an fp32 VMEM scratch (every lax.dot
+# carries preferred_element_type=jnp.float32) and the XLA fallback's
+# einsum pins the same — the verifier checks this declaration against
+# the traced fallback so the two arms cannot silently drift.
+ACCUM_DTYPE = "float32"
+
 
 def quant_matmul_supported(M: int, K: int, N: int) -> bool:
     """MXU-kernel gate: sublane-tileable rows and int8-tileable weight

@@ -55,6 +55,13 @@ from .flash_attention import _interpret_mode
 
 __all__ = ["ragged_paged_attention", "ragged_paged_supported"]
 
+# Accumulation-dtype declaration for tools/lint/quantcheck.py (TPL301):
+# both arms accumulate scores and values in fp32 (kernel: fp32 scratch
+# + preferred_element_type on every dot; XLA arm: the same pin on its
+# einsums) — the verifier checks the declaration against the traced
+# XLA arm so the arms cannot drift apart.
+ACCUM_DTYPE = "float32"
+
 
 def ragged_paged_supported(kt_pages_shape, n_q_heads: int, qb: int,
                            itemsize: int = 2) -> bool:

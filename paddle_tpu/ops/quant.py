@@ -29,12 +29,24 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 __all__ = ["absmax_quantize_int8", "dequantize_int8", "kv_scale_update",
-           "quantize_to_scale", "rescale_int8", "SCALE_EPS"]
+           "quantize_to_scale", "rescale_int8", "SCALE_EPS",
+           "SCALE_EVENT_FNS"]
 
 # Far below any real activation/weight scale but large enough that
 # value / SCALE_EPS cannot overflow fp32 for values that passed the
 # absmax reduction (|v| <= 127 * scale by construction).
 SCALE_EPS = 1e-30
+
+# Static-verifier contract (tools/lint/quantcheck.py): every divide by
+# a scale in this module is dominated by a ``maximum(., SCALE_EPS)``
+# clamp (rule TPL304), and each of these callables is a *scale event*
+# for TPL303 provenance — a quantize/rescale/scatter-max whose lineage
+# the verifier threads through the traced programs. Adding a scale-
+# producing function here without listing it makes quantcheck's
+# provenance bottom out in an anonymous event (still checked, but the
+# finding loses its name).
+SCALE_EVENT_FNS = ("absmax_quantize_int8", "quantize_to_scale",
+                   "rescale_int8", "kv_scale_update")
 
 
 def absmax_quantize_int8(arr, axis: int = -2, scale_dtype=jnp.float32):

@@ -26,9 +26,21 @@ with
     python -m tools.lint --shardcheck --baseline \
         artifacts/shardcheck.json --write-baseline
 
-The lint sweep is marked smoke (pure AST, ~10s); the contract and
-shardcheck sweeps trace programs abstractly (~15s each) and run in the
-normal tier.
+The quantcheck-snapshot gate does the same for the static precision &
+scale-provenance verifier (tools/lint/quantcheck.py): every registered
+entry is re-traced, the precision lattice re-derived, and the format
+digests, finding counts, kernel accumulation declarations, and
+explained set diffed against artifacts/quantcheck.json — regenerate
+deliberately with
+
+    python -m tools.lint --quantcheck --baseline \
+        artifacts/quantcheck.json --write-baseline
+
+The lint sweep is marked smoke (pure AST, ~10s); the contract,
+shardcheck, and quantcheck sweeps trace programs abstractly and run in
+the normal tier, and the budget test pins the WHOLE static-analysis
+stack (lint + contracts + shardcheck + quantcheck) under a 60s
+wall-clock ceiling so the pre-commit loop stays interactive.
 """
 
 from __future__ import annotations
@@ -47,6 +59,13 @@ from tools.lint.reporters import render_text  # noqa: E402
 
 BASELINE = os.path.join(REPO, "artifacts", "op_contracts.json")
 SHARD_BASELINE = os.path.join(REPO, "artifacts", "shardcheck.json")
+QUANT_BASELINE = os.path.join(REPO, "artifacts", "quantcheck.json")
+
+
+def _fresh_env():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)          # the CLI provisions its own mesh
+    return env
 
 
 @pytest.mark.smoke
@@ -90,13 +109,71 @@ def test_shardcheck_baseline_current():
         "no shardcheck baseline; generate with: python -m tools.lint "
         "--shardcheck --baseline artifacts/shardcheck.json "
         "--write-baseline")
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    env.pop("XLA_FLAGS", None)          # the CLI provisions its own mesh
     proc = subprocess.run(
         [sys.executable, "-m", "tools.lint", "--shardcheck",
          "--baseline", SHARD_BASELINE],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+        cwd=REPO, env=_fresh_env(), capture_output=True, text=True,
+        timeout=300)
     assert proc.returncode == 0, (
         "shardcheck drifted from artifacts/shardcheck.json (unexplained "
         "findings, stale explanations, or spec drift) — if intended, "
         f"regenerate with --write-baseline:\n{proc.stdout}\n{proc.stderr}")
+
+
+def test_quantcheck_baseline_current():
+    """Fresh subprocess for the same reasons as the shardcheck gate:
+    the precision-lattice sweep re-traces the full entry set against a
+    virgin 8-device virtual backend."""
+    import subprocess
+
+    assert os.path.exists(QUANT_BASELINE), (
+        "no quantcheck baseline; generate with: python -m tools.lint "
+        "--quantcheck --baseline artifacts/quantcheck.json "
+        "--write-baseline")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--quantcheck",
+         "--baseline", QUANT_BASELINE],
+        cwd=REPO, env=_fresh_env(), capture_output=True, text=True,
+        timeout=300)
+    assert proc.returncode == 0, (
+        "quantcheck drifted from artifacts/quantcheck.json (unexplained "
+        "findings, stale explanations, or format drift) — if intended, "
+        f"regenerate with --write-baseline:\n{proc.stdout}\n{proc.stderr}")
+
+
+def test_static_analysis_stack_fits_wall_clock_budget():
+    """The whole pre-commit static-analysis stack — AST lint over the
+    tree plus the three traced snapshot gates (contracts, shardcheck,
+    quantcheck) — must finish under 60s wall-clock, or the gate stops
+    being something people run before every commit. Measured ~35s on
+    the CI container; the 60s ceiling leaves headroom without letting
+    an accidentally quadratic checker or a traced entry that grew an
+    unrolled loop slip in unnoticed."""
+    import subprocess
+    import time
+
+    stages = [
+        ("lint", [sys.executable, "-m", "tools.lint", "paddle_tpu",
+                  "tests", "tools"]),
+        ("contracts", [sys.executable, "-m", "tools.lint", "--contracts",
+                       "--baseline", BASELINE]),
+        ("shardcheck", [sys.executable, "-m", "tools.lint",
+                        "--shardcheck", "--baseline", SHARD_BASELINE]),
+        ("quantcheck", [sys.executable, "-m", "tools.lint",
+                        "--quantcheck", "--baseline", QUANT_BASELINE]),
+    ]
+    t0 = time.monotonic()
+    took = {}
+    for name, cmd in stages:
+        s0 = time.monotonic()
+        proc = subprocess.run(cmd, cwd=REPO, env=_fresh_env(),
+                              capture_output=True, text=True, timeout=120)
+        took[name] = time.monotonic() - s0
+        assert proc.returncode == 0, (
+            f"{name} failed inside the budget run:\n"
+            f"{proc.stdout}\n{proc.stderr}")
+    total = time.monotonic() - t0
+    breakdown = ", ".join(f"{k} {v:.1f}s" for k, v in took.items())
+    assert total < 60.0, (
+        f"static-analysis stack blew the 60s budget: {total:.1f}s "
+        f"({breakdown})")

@@ -68,6 +68,45 @@ def test_rescale_identity_when_scale_unchanged():
     assert np.all(np.abs(np.asarray(out2, np.int32)) <= 64)
 
 
+def test_rescale_shrinking_scale_exact_or_saturates_never_wraps():
+    """Shrinking the scale grows the stored magnitudes: values still
+    representable after the shrink must round-trip EXACTLY (the ratio is
+    an integer multiply), and values pushed past the int8 range must
+    saturate to ±127 — int8 overflow wrap (e.g. 100*2 -> -56) would be
+    silent KV corruption."""
+    s_old = jnp.full((1, 1), 2.0, jnp.float32)
+    s_new = jnp.full((1, 1), 1.0, jnp.float32)      # shrink: ratio 2.0
+    q = jnp.asarray([[-100, -64, -3, 0, 3, 50, 63, 100, 127]],
+                    jnp.int8).T
+    out = np.asarray(rescale_int8(q, s_old, s_new), np.int32).ravel()
+    want = np.asarray([-127, -127, -6, 0, 6, 100, 126, 127, 127])
+    np.testing.assert_array_equal(out, want)
+    # representable entries are exact: dequant at the new scale equals
+    # the original dequantized value bit-for-bit
+    rep = np.abs(np.asarray(q, np.int32).ravel()) <= 63
+    orig = np.asarray(dequantize_int8(q, s_old)).ravel()
+    new = np.asarray(dequantize_int8(
+        rescale_int8(q, s_old, s_new), s_new)).ravel()
+    np.testing.assert_array_equal(new[rep], orig[rep])
+    # saturated entries clamp toward the representable edge, keep sign
+    assert np.all(np.sign(out) == np.sign(np.asarray(q, np.int32).ravel()))
+
+
+def test_dequantize_int8_dtype_argument():
+    """Both attention arms dequantize via fp32 multiply then cast to the
+    compute dtype — the ``dtype=`` argument must control the output
+    dtype without changing the fp32-multiply numerics."""
+    q = jnp.asarray([[-127, -1, 0, 1, 127]], jnp.int8)
+    s = jnp.full((1, 1), 0.5, jnp.float32)
+    out = dequantize_int8(q, s, dtype=jnp.bfloat16)
+    assert out.dtype == jnp.bfloat16
+    # 0.5-step values are exactly representable in bf16: no extra error
+    np.testing.assert_array_equal(
+        np.asarray(out, np.float32),
+        np.asarray([[-63.5, -0.5, 0.0, 0.5, 63.5]], np.float32))
+    assert dequantize_int8(q, s).dtype == jnp.float32  # default intact
+
+
 def test_rescale_then_dequant_preserves_value():
     rng = np.random.RandomState(3)
     x = rng.randn(32, 4).astype(np.float32)

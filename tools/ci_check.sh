@@ -2,7 +2,7 @@
 # One-command CI gate: static analysis -> op-contract baseline -> chaos
 # suite -> serving smoke -> kernel parity -> loadgen smoke -> multichip
 # smoke -> multitenant smoke -> fleet smoke -> disagg smoke -> fusion
-# smoke -> shardcheck smoke -> tier-1.
+# smoke -> shardcheck smoke -> quantcheck smoke -> tier-1.
 #
 #   bash tools/ci_check.sh
 #
@@ -29,12 +29,17 @@
 #  130  shardcheck smoke failed (unexplained static sharding/collective
 #       finding on a registered entry program, stale explanation, or
 #       drift against artifacts/shardcheck.json)
+#  140  quantcheck smoke failed (unexplained precision/scale-provenance
+#       finding on a registered entry program, format-environment drift
+#       against artifacts/quantcheck.json, or the TPL303 scale-leak
+#       regression harness no longer fires exactly once on the pre-fix
+#       admission program while staying silent on the shipped one)
 #   30  tier-1 tests failed (ROADMAP.md command)
 #    0  all gates green
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== gate 1/13: tpu-lint (per-file + interprocedural + typestate rules) =="
+echo "== gate 1/14: tpu-lint (per-file + interprocedural + typestate rules) =="
 python -m tools.lint paddle_tpu tests tools --format=json > /tmp/tpu_lint.json
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -44,7 +49,7 @@ if [ "$rc" -ne 0 ]; then
 fi
 echo "tpu-lint: clean"
 
-echo "== gate 2/13: tpu-verify (abstract op-contract baseline) =="
+echo "== gate 2/14: tpu-verify (abstract op-contract baseline) =="
 JAX_PLATFORMS=cpu python -m tools.lint --contracts \
     --baseline artifacts/op_contracts.json
 rc=$?
@@ -54,7 +59,7 @@ if [ "$rc" -ne 0 ]; then
     exit 20
 fi
 
-echo "== gate 3/13: chaos suite (fault injection -> self-healing) =="
+echo "== gate 3/14: chaos suite (fault injection -> self-healing) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 rc=$?
@@ -64,7 +69,7 @@ if [ "$rc" -ne 0 ]; then
     exit 40
 fi
 
-echo "== gate 4/13: serving smoke (scheduler completion + zero page leak) =="
+echo "== gate 4/14: serving smoke (scheduler completion + zero page leak) =="
 JAX_PLATFORMS=cpu python -m tools.serving_smoke
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -73,7 +78,7 @@ if [ "$rc" -ne 0 ]; then
     exit 50
 fi
 
-echo "== gate 5/13: kernel parity (fused megakernels, CPU fallback arms) =="
+echo "== gate 5/14: kernel parity (fused megakernels, CPU fallback arms) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_fused_norm_epilogue.py \
     tests/test_fused_rope_attention.py tests/test_autotune.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
@@ -84,7 +89,7 @@ if [ "$rc" -ne 0 ]; then
     exit 60
 fi
 
-echo "== gate 6/13: loadgen smoke (open-loop saturation, >=200 arrivals) =="
+echo "== gate 6/14: loadgen smoke (open-loop saturation, >=200 arrivals) =="
 JAX_PLATFORMS=cpu python -m tools.loadgen_smoke
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -94,7 +99,7 @@ if [ "$rc" -ne 0 ]; then
     exit 70
 fi
 
-echo "== gate 7/13: multichip smoke (dp x mp mesh: remat-free compile," \
+echo "== gate 7/14: multichip smoke (dp x mp mesh: remat-free compile," \
      "serial parity, quantized all-reduce) =="
 python tools/multichip_smoke.py
 rc=$?
@@ -105,7 +110,7 @@ if [ "$rc" -ne 0 ]; then
     exit 80
 fi
 
-echo "== gate 8/13: multitenant smoke (LoRA isolation, preemption," \
+echo "== gate 8/14: multitenant smoke (LoRA isolation, preemption," \
      "constrained legality, 7-class ledger) =="
 JAX_PLATFORMS=cpu python -m tools.multitenant_smoke
 rc=$?
@@ -117,7 +122,7 @@ if [ "$rc" -ne 0 ]; then
     exit 90
 fi
 
-echo "== gate 9/13: fleet smoke (engine loss -> bit-identical resume," \
+echo "== gate 9/14: fleet smoke (engine loss -> bit-identical resume," \
      "page migration, survivor ledger) =="
 JAX_PLATFORMS=cpu python -m tools.fleet_smoke
 rc=$?
@@ -128,7 +133,7 @@ if [ "$rc" -ne 0 ]; then
     exit 100
 fi
 
-echo "== gate 10/13: disagg smoke (prefill-pool loss -> degraded" \
+echo "== gate 10/14: disagg smoke (prefill-pool loss -> degraded" \
      "colocated completion, shipped pages, surviving ledgers) =="
 JAX_PLATFORMS=cpu python -m tools.disagg_smoke
 rc=$?
@@ -139,7 +144,7 @@ if [ "$rc" -ne 0 ]; then
     exit 110
 fi
 
-echo "== gate 11/13: fusion smoke (jaxpr fusion discovery, eager" \
+echo "== gate 11/14: fusion smoke (jaxpr fusion discovery, eager" \
      "parity, per-program autotune replay) =="
 JAX_PLATFORMS=cpu python -m tools.fusion_smoke
 rc=$?
@@ -151,7 +156,7 @@ if [ "$rc" -ne 0 ]; then
     exit 120
 fi
 
-echo "== gate 12/13: shardcheck smoke (static sharding/collective" \
+echo "== gate 12/14: shardcheck smoke (static sharding/collective" \
      "verification over the registered entry programs) =="
 JAX_PLATFORMS=cpu python -m tools.lint --shardcheck \
     --baseline artifacts/shardcheck.json
@@ -165,7 +170,26 @@ if [ "$rc" -ne 0 ]; then
     exit 130
 fi
 
-echo "== gate 13/13: tier-1 tests (ROADMAP.md) =="
+echo "== gate 13/14: quantcheck smoke (static precision & scale-provenance" \
+     "verification + TPL303 scale-leak regression harness) =="
+JAX_PLATFORMS=cpu python -m tools.lint --quantcheck \
+    --baseline artifacts/quantcheck.json
+rc=$?
+if [ "$rc" -eq 0 ]; then
+    JAX_PLATFORMS=cpu python -m tools.lint --quantcheck-regression
+    rc=$?
+fi
+if [ "$rc" -ne 0 ]; then
+    echo "ci_check: quantcheck gate failed (rc=$rc) — an entry program" \
+         "has an unexplained precision/scale-provenance finding" \
+         "(TPL300-TPL305), an explanation went stale, the format" \
+         "environment drifted from artifacts/quantcheck.json (regenerate" \
+         "deliberately with --write-baseline), or the scale-leak" \
+         "regression harness lost its exactly-once TPL303 signal" >&2
+    exit 140
+fi
+
+echo "== gate 14/14: tier-1 tests (ROADMAP.md) =="
 
 set -o pipefail
 rm -f /tmp/_t1.log

@@ -7,13 +7,17 @@ whole-program interprocedural rules (TPL101-TPL103, call-chain taint
 over the project import/call graph; tools/lint/interproc.py), the wire
 protocol typestate rules (TPL211-TPL213; tools/lint/typestate.py),
 abstract op-contract verification (``--contracts``;
-tools/lint/contracts.py), and static sharding/collective verification
+tools/lint/contracts.py), static sharding/collective verification
 over traced entry-program jaxprs (``--shardcheck``, rules
-TPL201-TPL204; tools/lint/shardcheck.py).
+TPL201-TPL204; tools/lint/shardcheck.py), and static precision &
+scale-provenance verification over the same entry set
+(``--quantcheck``, rules TPL300-TPL305, plus the
+``--quantcheck-regression`` scale-leak gate; tools/lint/quantcheck.py).
 
     python -m tools.lint paddle_tpu tests [--format=json|sarif]
     python -m tools.lint --contracts --baseline artifacts/op_contracts.json
     python -m tools.lint --shardcheck --baseline artifacts/shardcheck.json
+    python -m tools.lint --quantcheck --baseline artifacts/quantcheck.json
 
 See ``tools/lint/checkers.py`` + ``tools/lint/interproc.py`` for the
 rule table, ``tools/lint/ARCHITECTURE.md`` for the call-graph/fixpoint

@@ -4,6 +4,8 @@
     python -m tools.lint --contracts --baseline artifacts/op_contracts.json
     python -m tools.lint --contracts --baseline ... --write-baseline
     python -m tools.lint --shardcheck --baseline artifacts/shardcheck.json
+    python -m tools.lint --quantcheck --baseline artifacts/quantcheck.json
+    python -m tools.lint --quantcheck-regression
 
 Exit codes (stable; tools/ci_check.sh relies on them):
   0  clean / baseline matches
@@ -203,6 +205,93 @@ def run_shardcheck(baseline: str | None, write: bool,
     return 1 if bad or drift or stale else 0
 
 
+def run_quantcheck(baseline: str | None, write: bool, fmt: str = "text",
+                   select: set[str] | None = None,
+                   ignore: set[str] | None = None) -> int:
+    """Static precision & scale-provenance verification over the
+    registered entry programs (tools/lint/quantcheck.py).  Same exit-
+    code contract as run_shardcheck; ``select``/``ignore`` filter the
+    *reported* unexplained findings by rule id or slug (the baseline
+    payload always covers every rule, so a filtered run cannot write a
+    narrowed baseline)."""
+    from . import quantcheck as Q
+
+    if baseline and not write and not os.path.exists(baseline):
+        print(f"quantcheck: baseline {baseline} missing "
+              "(run with --write-baseline)", file=sys.stderr)
+        return 3
+    report = Q.build_report()
+    findings = report["findings"]
+    bad = Q.unexplained_findings(findings)
+    if select:
+        bad = [f for f in bad if f.rule in select or f.name in select]
+    if ignore:
+        bad = [f for f in bad
+               if f.rule not in ignore and f.name not in ignore]
+    stale = Q.stale_explanations(findings)
+    drift: list[str] = []
+    if baseline:
+        if write:
+            Q.write_baseline(report["baseline"], baseline)
+        else:
+            drift = Q.diff_baselines(report["baseline"],
+                                     Q.load_baseline(baseline))
+    entries = report["baseline"]["entries"]
+    if fmt == "json":
+        import json
+
+        print(json.dumps({
+            "entries": entries,
+            "kernel_accum": report["baseline"]["kernel_accum"],
+            "findings": [f.as_dict() for f in findings],
+            "unexplained": [f.as_dict() for f in bad],
+            "stale_explanations": stale,
+            "drift": drift,
+        }, indent=2))
+    elif fmt == "sarif":
+        print(render_sarif(bad, tool_name="tpu-quantcheck"))
+    else:
+        if bad:
+            print(render_text(bad))
+        for line in stale:
+            print(line)
+        for line in drift:
+            print(line)
+        n_explained = len(findings) - len(Q.unexplained_findings(findings))
+        print(f"quantcheck: {len(entries)} entry program(s), "
+              f"{len(bad)} unexplained finding(s), {n_explained} "
+              f"explained, {len(stale)} stale explanation(s), "
+              f"{len(drift)} baseline drift line(s)"
+              + (f" -> wrote {baseline}" if write and baseline else ""))
+    return 1 if bad or drift or stale else 0
+
+
+def run_quantcheck_regression(fmt: str = "text") -> int:
+    """The TPL303 regression gate: rebuild the PR 8 pre-fix admit
+    program (scale plane not reset on page alloc) and require exactly
+    one scale-provenance finding on it and zero on the shipped one."""
+    from . import quantcheck as Q
+
+    rep = Q.regression_report()
+    if fmt == "json":
+        import json
+
+        print(json.dumps(rep, indent=2))
+    else:
+        for label in ("regression", "shipped"):
+            r = rep[label]
+            print(f"quantcheck-regression: {r['entry']}: "
+                  f"{r['tpl303']} TPL303 finding(s)")
+            for m in r["messages"]:
+                print(f"  {m}")
+        print("quantcheck-regression: "
+              + ("OK (pre-fix program fires exactly once, shipped "
+                 "program is clean)" if rep["ok"] else
+                 "FAIL (expected exactly 1 TPL303 on the pre-fix "
+                 "program and 0 on the shipped one)"))
+    return 0 if rep["ok"] else 1
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="tools.lint",
@@ -232,14 +321,22 @@ def main(argv: list[str] | None = None) -> int:
                         help="run static sharding/collective verification "
                              "over the registered entry programs instead "
                              "of lint")
+    parser.add_argument("--quantcheck", action="store_true",
+                        help="run static precision/scale-provenance "
+                             "verification over the registered entry "
+                             "programs instead of lint")
+    parser.add_argument("--quantcheck-regression", action="store_true",
+                        help="run the quantcheck TPL303 regression gate "
+                             "(the pre-fix scale-leak program must fire "
+                             "exactly once; the shipped one not at all)")
     parser.add_argument("--baseline", default=None, metavar="PATH",
-                        help="with --contracts/--shardcheck: compare "
-                             "against (or, with --write-baseline, "
+                        help="with --contracts/--shardcheck/--quantcheck: "
+                             "compare against (or, with --write-baseline, "
                              "regenerate) this JSON baseline")
     parser.add_argument("--write-baseline", action="store_true",
-                        help="with --contracts/--shardcheck and "
-                             "--baseline: write the baseline instead of "
-                             "diffing")
+                        help="with --contracts/--shardcheck/--quantcheck "
+                             "and --baseline: write the baseline instead "
+                             "of diffing")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -248,15 +345,45 @@ def main(argv: list[str] | None = None) -> int:
                   f"{cls.description}")
         return 0
 
-    if args.contracts and args.shardcheck:
-        print("tpu-lint: --contracts and --shardcheck are exclusive",
+    modes = [m for m, on in (("--contracts", args.contracts),
+                             ("--shardcheck", args.shardcheck),
+                             ("--quantcheck", args.quantcheck),
+                             ("--quantcheck-regression",
+                              args.quantcheck_regression)) if on]
+    if len(modes) > 1:
+        print(f"tpu-lint: {' and '.join(modes)} are exclusive",
               file=sys.stderr)
         return 2
     if args.write_baseline and not (
-            (args.contracts or args.shardcheck) and args.baseline):
-        print("tpu-lint: --write-baseline requires --contracts or "
-              "--shardcheck, and --baseline PATH", file=sys.stderr)
+            (args.contracts or args.shardcheck or args.quantcheck)
+            and args.baseline):
+        print("tpu-lint: --write-baseline requires --contracts, "
+              "--shardcheck, or --quantcheck, and --baseline PATH",
+              file=sys.stderr)
         return 2
+    if args.quantcheck_regression and args.baseline:
+        print("tpu-lint: --quantcheck-regression takes no --baseline "
+              "(the regression entries are never baselined)",
+              file=sys.stderr)
+        return 2
+    select = ({s.strip() for s in args.select.split(",") if s.strip()}
+              if args.select else None)
+    ignore = ({s.strip() for s in args.ignore.split(",") if s.strip()}
+              if args.ignore else None)
+    if args.quantcheck:
+        try:
+            return run_quantcheck(args.baseline, args.write_baseline,
+                                  args.format, select=select,
+                                  ignore=ignore)
+        except (ImportError, RuntimeError) as e:
+            print(f"quantcheck: setup failed: {e}", file=sys.stderr)
+            return 2
+    if args.quantcheck_regression:
+        try:
+            return run_quantcheck_regression(args.format)
+        except (ImportError, RuntimeError) as e:
+            print(f"quantcheck: setup failed: {e}", file=sys.stderr)
+            return 2
     if args.contracts:
         try:
             return run_contracts(args.baseline, args.write_baseline,
@@ -280,10 +407,6 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         return 2
 
-    select = ({s.strip() for s in args.select.split(",") if s.strip()}
-              if args.select else None)
-    ignore = ({s.strip() for s in args.ignore.split(",") if s.strip()}
-              if args.ignore else None)
     excludes = () if args.no_default_excludes else DEFAULT_EXCLUDES
     findings = run_lint(paths, select=select, excludes=excludes,
                         ignore=ignore)
