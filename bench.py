@@ -478,10 +478,12 @@ def _bench_multitenant():
     return _multitenant_keys(lora_m, prio_m, con_m, n_adapters)
 
 
-def _fleet_keys(m):
+def _fleet_keys(m, ops=None):
     """Pure mapping: FleetDriver metrics dict -> bench fleet_* keys
-    (tests/test_bench_contract.py pins the key set)."""
-    return {
+    (tests/test_bench_contract.py pins the key set). ``ops`` is the
+    zero-downtime-operations arm (mid-run weight rollout + autoscale +
+    SLO shed); None = base arm only."""
+    out = {
         "fleet_n_engines": float(m["fleet_n_engines"]),
         "fleet_goodput": m["goodput_tok_s"],
         "fleet_ttft_p99": m["ttft_p99_s"],
@@ -489,6 +491,17 @@ def _fleet_keys(m):
         "fleet_recovery_ms": m["recovery_ms_max"],
         "fleet_deadline_miss_rate": m["deadline_miss_rate"],
     }
+    if ops is not None:
+        out["fleet_rollout_goodput"] = ops["goodput_tok_s"]
+        out["fleet_rollout_stall_ms"] = ops["rollout_stall_ms"]
+        out["fleet_autoscale_n_engines_min"] = float(
+            ops["autoscale_n_engines_min"])
+        out["fleet_autoscale_n_engines_max"] = float(
+            ops["autoscale_n_engines_max"])
+        out["fleet_shed_rate"] = round(
+            (ops["n_shed"] + ops["n_slo_shed"])
+            / max(1, ops["n_submitted"]), 3)
+    return out
 
 
 def _bench_fleet():
@@ -528,7 +541,35 @@ def _bench_fleet():
     # survivor absorbs migrated pages plus the remaining arrivals
     kill_at = float(np.percentile([r.arrival for r in wl], 33))
     m = FleetDriver(router, clock="wall").run(wl, kills={kill_at: 1})
-    return _fleet_keys(m)
+
+    # zero-downtime-operations arm: same traffic shape, no kill — a
+    # live weight rollout lands a third of the way in (goodput/TTFT
+    # measured THROUGH the deploy), autoscale may retire idle capacity
+    # at the tail, SLO shed drops requests that cannot make TTFT
+    router2 = FleetRouter(cfg, n_engines=2, seed=0,
+                          engine_kwargs=dict(max_batch=8, page_size=128,
+                                             max_seq=1536,
+                                             prefill_budget=512),
+                          autoscale=True, min_engines=1, max_engines=3,
+                          slo_shed=True)
+    for i, rep in enumerate(router2.replicas):
+        rep.engine.run([Request(rid=-1 - i,
+                                prompt=np.ones(640, np.int32),
+                                max_new_tokens=2, arrival=0.0)])
+    wl2 = synthesize(WorkloadSpec(
+        n_requests=48, seed=11, vocab_size=cfg.vocab_size,
+        process="poisson", rate=30.0, prefix_len=512, n_prefixes=1,
+        shared_frac=0.9, tail_log_mean=5.3, tail_log_sigma=0.6,
+        tail_min=32, tail_max=512, new_min=64, new_max=128,
+        max_seq=1536, n_tenants=8, tenant_skew=1.2, n_sessions=6,
+        deadline_ttft=30.0, deadline_e2e=120.0))
+    v2 = jax.tree_util.tree_map(
+        lambda w: (w * 1.001).astype(w.dtype),
+        router2.replicas[0].engine.params)
+    deploy_at = float(np.percentile([r.arrival for r in wl2], 33))
+    m2 = FleetDriver(router2, clock="wall").run(wl2,
+                                                deploys={deploy_at: v2})
+    return _fleet_keys(m, ops=m2)
 
 
 def _wire_ms_per_handoff(m):

@@ -346,6 +346,60 @@ define_flag("serving_disagg_hysteresis", 0.2,
             "this fraction before a replica changes role (prevents "
             "role flapping at phase boundaries).")
 
+# -- zero-downtime fleet operations (inference/fleet/rollout.py +
+#    FleetRouter hooks — rolling weight upgrades, demand autoscale, and
+#    SLO-aware shedding. All off by default; with every flag off the
+#    router/engine behavior is pinned bit-identical to the PR 17 fleet
+#    in tests/test_rollout.py) ---------------------------------------------
+define_flag("serving_fleet_rollout_canary", 4,
+            "Canary decode length (new tokens) for the post-swap health "
+            "check during FleetRouter.rollout: the freshly swapped "
+            "engine must complete a solo greedy decode of this many "
+            "tokens before it rejoins placement. 0 = skip the canary "
+            "(swapped engines rejoin unchecked). Only consulted while a "
+            "rollout is in flight, so the default is inert otherwise.")
+define_flag("serving_fleet_autoscale", False,
+            "Demand-driven engine count: the router reuses the dynamic-"
+            "split demand census (queued prefill tokens + remaining "
+            "decode tokens) as a fleet-wide utilization EWMA against "
+            "aggregate page capacity, adds an engine above the high "
+            "watermark and retires one (drain-then-remove, requests "
+            "are never dropped) below the low watermark, bounded by "
+            "serving_fleet_{min,max}_engines with a cooldown between "
+            "actions. Off (default) = fixed fleet, bit-identical.")
+define_flag("serving_fleet_min_engines", 1,
+            "Autoscale floor: retire never shrinks the fleet below "
+            "this many live engines.")
+define_flag("serving_fleet_max_engines", 4,
+            "Autoscale ceiling: scale-up never grows the fleet above "
+            "this many live engines.")
+define_flag("serving_fleet_scale_high", 0.85,
+            "Utilization EWMA (demand tokens / aggregate token "
+            "capacity) above which the autoscaler adds an engine.")
+define_flag("serving_fleet_scale_low", 0.2,
+            "Utilization EWMA below which the autoscaler drains and "
+            "retires the least-loaded engine (subject to the floor).")
+define_flag("serving_fleet_scale_ewma", 0.3,
+            "EWMA smoothing factor (0 < alpha <= 1) for the autoscale "
+            "utilization estimate; higher = faster reaction.")
+define_flag("serving_fleet_scale_cooldown", 1.0,
+            "Minimum seconds between autoscale actions (hysteresis in "
+            "time: prevents add/retire flapping at a watermark).")
+define_flag("serving_fleet_slo_shed", False,
+            "SLO-aware admission control: on each router tick the "
+            "predicted queue wait for every never-yet-accepted request "
+            "(tokens ahead of it / measured or prior service rate) is "
+            "compared against its remaining TTFT budget, and requests "
+            "that cannot make their deadline are shed lowest-priority "
+            "first BEFORE the deadline blows (stat n_slo_shed), instead "
+            "of counting misses after. Accepted streams are never shed. "
+            "Off (default) = deadline misses are only counted.")
+define_flag("serving_fleet_slo_rate", 0.0,
+            "Service-rate prior (tokens/sec per live engine) for the "
+            "SLO shed predictor. 0 (default) = use the measured "
+            "per-tick throughput EWMA; a positive value pins the "
+            "predictor (deterministic in rush-clock tests).")
+
 define_flag("dist_allreduce_quant", False,
             "EQuARX-style int8 gradient all-reduce for the dp gradient "
             "sync: per-rank-chunk symmetric int8 with fp32 scales on the "

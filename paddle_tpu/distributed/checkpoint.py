@@ -399,6 +399,8 @@ def load_state_dict(state_dict, path, process_group=None,
 
 def _step_dirs(root) -> list[tuple[int, str]]:
     out = []
+    if not root:        # unset root (None/"") = no checkpoints, not a crash
+        return out
     for name in os.listdir(root) if os.path.isdir(root) else []:
         if name.startswith(_STEP_PREFIX):
             try:
@@ -414,7 +416,10 @@ def step_dir(root, step: int) -> str:
 
 
 def latest_step(root) -> Optional[int]:
-    """The step the ``LATEST`` pointer names, or None."""
+    """The step the ``LATEST`` pointer names, or None (an unset
+    root — None/"" — reads as "no checkpoints", same as an empty dir)."""
+    if not root:
+        return None
     try:
         with open(os.path.join(root, _LATEST)) as f:
             return int(f.read().strip())

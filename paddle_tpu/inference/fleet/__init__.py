@@ -18,6 +18,15 @@ exhausting retries) degrades the fleet to colocated serving — every
 survivor serves both phases, streams complete bit-identically — and a
 recovered role re-splits automatically.
 
+Zero-downtime operations (``rollout.py``): live weight rollout —
+content-hashed :class:`WeightCatalog` versions, every stream pinned to
+its admission-time version, engines upgraded one at a time through
+drain -> swap -> canary -> rejoin with automatic rollback — plus
+demand-driven autoscale (``serving_fleet_autoscale``: add/retire
+engines on the census, retire = drain-then-remove, requests never
+dropped) and SLO-aware admission shed (``serving_fleet_slo_shed``:
+predicted wait vs remaining TTFT budget, never-accepted work only).
+
 The whole layer is host-side policy over unchanged engines: a lone
 ``ServingEngine`` never touches this package, so ``serving_fleet_*`` /
 ``serving_disagg_*`` flags off is bit-identical single-engine behavior
@@ -25,6 +34,8 @@ by construction.
 """
 
 from .migration import ship_pages, ship_shipment
+from .rollout import WeightCatalog, run_canary
 from .router import FleetRouter
 
-__all__ = ["FleetRouter", "ship_pages", "ship_shipment"]
+__all__ = ["FleetRouter", "WeightCatalog", "run_canary",
+           "ship_pages", "ship_shipment"]

@@ -1,0 +1,126 @@
+"""Zero-downtime weight rollout primitives (fleet operations).
+
+A rolling upgrade is three small pieces riding planes the fleet
+already has:
+
+- :class:`WeightCatalog` — content-hashed (sha1, the ``AdapterStore``
+  recipe) parameter versions. Publishing the same bytes twice dedupes
+  to one version id, so "is engine X on version V" is a string compare
+  and A/B versions coexist as plain dict entries. The router stamps
+  every request's ``param_version`` at placement: a stream admitted
+  under version A only ever resumes on a version-A engine, which is
+  what keeps streams bit-reproducible *through* a deploy (KV pages are
+  a pure function of (params, prefix), so cross-version pages must
+  never mix in one stream).
+- :class:`RolloutState` — the router's in-flight rollout cursor: which
+  version we are moving to, which version to fall back to, and which
+  engine is currently mid-episode (drain -> swap -> canary -> rejoin).
+  A rollback is just a rollout whose target is the prior version with
+  canary failures ignored, so it always converges to ONE version.
+- :func:`run_canary` — the post-swap health check: a solo greedy
+  decode on the freshly swapped (and fully drained) engine. A canary
+  that cannot produce its tokens means the new weights are unservable
+  and the router rolls the fleet back.
+
+The state machine itself lives in ``FleetRouter._rollout_tick`` (it
+needs placement, migration, and death/recovery — all router state);
+this module holds the pieces with no router dependency.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..serving import Request
+
+__all__ = ["WeightCatalog", "RolloutState", "run_canary"]
+
+
+def _hash_leaves(h, tree) -> None:
+    """Feed every leaf of a params tree into ``h`` deterministically:
+    dict keys sorted, tuple/list position-tagged, each leaf tagged with
+    dtype + shape before its bytes (quantized params carry (int8,
+    scales) tuples — both legs join the digest)."""
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            h.update(repr(k).encode())
+            _hash_leaves(h, tree[k])
+    elif isinstance(tree, (tuple, list)):
+        for i, v in enumerate(tree):
+            h.update(b"[%d]" % i)
+            _hash_leaves(h, v)
+    else:
+        w = np.asarray(tree)
+        h.update(str(w.dtype).encode() + repr(w.shape).encode())
+        h.update(np.ascontiguousarray(w).tobytes())
+
+
+class WeightCatalog:
+    """Content-hashed parameter versions (the ``AdapterStore`` recipe
+    applied to full model weights): ``put`` digests every leaf and
+    returns the version id, identical bytes dedupe to one entry, and
+    A/B versions coexist until nothing references the old one."""
+
+    def __init__(self):
+        self._params: dict[str, object] = {}
+
+    def put(self, params) -> str:
+        """Publish a params tree; returns its content-hash version id
+        (idempotent — re-publishing the same bytes is a no-op)."""
+        h = hashlib.sha1(b"pt-weights")
+        _hash_leaves(h, params)
+        version = h.hexdigest()[:12]
+        self._params.setdefault(version, params)
+        return version
+
+    def get(self, version: str):
+        return self._params[version]
+
+    def __contains__(self, version) -> bool:
+        return version in self._params
+
+    def versions(self) -> list[str]:
+        return sorted(self._params)
+
+
+@dataclass
+class RolloutState:
+    """The router's in-flight rollout cursor (one engine at a time)."""
+
+    target: str                        # version every engine should reach
+    prior: str                         # rollback destination
+    is_rollback: bool = False          # canary failures ignored: converge
+    t0: float = 0.0                    # monotonic at rollout start
+    current_eid: Optional[int] = None  # engine mid-episode, None = pick next
+    episode_t0: float = 0.0            # monotonic at current drain start
+
+
+def run_canary(engine, n_tokens: int, now: float = 0.0) -> bool:
+    """Post-swap health check: a solo greedy decode of ``n_tokens`` on
+    the (drained) engine. Runs through the normal submit/step plane so
+    it exercises exactly the program a real request would; the prompt
+    spans less than one page, so nothing lands in the prefix cache.
+    True iff the decode produced every token without aborting."""
+    if n_tokens <= 0:
+        return True
+    vocab = int(engine.cfg.vocab_size)
+    prompt = np.arange(1, 1 + min(8, max(1, vocab - 1)),
+                       dtype=np.int32) % vocab
+    req = Request(rid=-(1 << 30) - engine.engine_id, prompt=prompt,
+                  max_new_tokens=int(n_tokens))
+    was_prefill_only = engine.prefill_only
+    engine.prefill_only = False        # a canary must DECODE, not export
+    try:
+        engine.submit(req)
+        for _ in range(64 + 16 * int(n_tokens)):
+            if not engine.step(now=now):
+                break
+    finally:
+        engine.prefill_only = was_prefill_only
+        if len(req.out_tokens) < n_tokens and not req.aborted:
+            engine.abort(req.rid)      # never leave a stuck canary resident
+    return not req.aborted and len(req.out_tokens) >= n_tokens

@@ -98,6 +98,11 @@ class Request:
     deadline_ttft: float = 0.0
     deadline_e2e: float = 0.0
     session: Optional[object] = None
+    # weight-version pin (inference/fleet/rollout.py): stamped by the
+    # router at first placement so a stream admitted under version A is
+    # only ever resumed on a version-A engine during a rolling upgrade
+    # (bit-reproducible streams through a deploy). None = unpinned.
+    param_version: Optional[str] = None
     # filled by the engine:
     out_tokens: list = dataclasses.field(default_factory=list)
     t_first: Optional[float] = None    # first-token wall time
@@ -346,6 +351,11 @@ class ServingEngine:
         self.prefill_only = bool(prefill_only)
         self.pool_role: Optional[str] = None
         self.outbox: list = []  # (request, shipment | None), router-drained
+        # weight-version tag (inference/fleet/rollout.py): the catalog
+        # version of ``params`` currently loaded. Router-assigned (via
+        # set_params or attribute write) like the fleet fields above; a
+        # lone engine keeps None and never consults it.
+        self.param_version: Optional[str] = None
         self.cfg = cfg
         self.params = params if params is not None else init_llama_params(
             cfg, jax.random.PRNGKey(seed))
@@ -359,6 +369,9 @@ class ServingEngine:
             # compiled path needs no changes. The tuple check skips
             # params that arrive already quantized.
             self.params = quantize_weights_int8(self.params)
+        # remembered for set_params (a live weight swap must land in the
+        # same quantized format the ctor established)
+        self._weight_only_int8 = bool(weight_only_int8)
         self.B = max_batch
         self.bs = page_size
         self.max_seq = max_seq or cfg.max_seq_len
@@ -848,6 +861,19 @@ class ServingEngine:
         return out, ks, vs, kss, vss
 
     # -- scheduler ----------------------------------------------------------
+
+    def set_params(self, params, version=None) -> None:
+        """Swap the model weights in place (rolling-upgrade path). The
+        params dict is the first operand of every jitted dispatch, so a
+        same-shape swap takes effect on the next step with no recompile;
+        resident KV pages stay valid (they hold attention state, not
+        weights). Mirrors the ctor's weight-quant guard so a quantized
+        engine receives quantized weights either way."""
+        self.params = params
+        if ((self._weight_only_int8 or self.cfg.weight_only_int8)
+                and not isinstance(self.params["blocks"]["wq"], tuple)):
+            self.params = quantize_weights_int8(self.params)
+        self.param_version = version
 
     def register_adapter(self, adapter_id, weights: dict) -> None:
         """Add a LoRA adapter (multitenant.lora.make_lora layout) to the

@@ -67,6 +67,20 @@ point                 kinds
                       back leak-free through abort_adopt and the wire
                       reports a rejection). Pool-scoped: the adopter
                       tags its probe with its pool role
+``rollout.swap``      ``raise`` (ChaosInjected out of the per-engine
+                      parameter swap during FleetRouter.rollout — the
+                      drained engine dies mid-swap and the router
+                      replaces it on the rollout's target version),
+                      ``hang`` (sleep ``seconds`` inside the swap; with
+                      a step budget armed the router treats the stalled
+                      swap as a mid-swap death). Ctx-targeted like
+                      ``engine.step``: ``engine=``/``pool=`` pick one
+                      replica's swap
+``rollout.canary``    ``fail`` (the post-swap canary health check
+                      reports failure even though the decode succeeded
+                      — the router rolls the whole fleet back to the
+                      prior weight version). Same ``engine=``/``pool=``
+                      ctx targeting
 ====================  ======================================================
 
 Multi-host targeting: a spec with ``rank=<r>`` in its args fires only in

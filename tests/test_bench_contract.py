@@ -168,6 +168,20 @@ def test_fleet_key_contract(bench):
     assert out["fleet_migrated_pages"] == 9.0
     assert out["fleet_recovery_ms"] == 220.5
     assert out["fleet_deadline_miss_rate"] == 0.021
+    # base arm only: no zero-downtime-operations keys
+    assert "fleet_rollout_goodput" not in out
+    # ops arm (ISSUE 18): goodput measured THROUGH a live weight
+    # rollout, the longest drain->swap->canary stall, the autoscaler's
+    # live engine-count envelope, and the total shed fraction
+    ops = {"goodput_tok_s": 295.0, "rollout_stall_ms": 84.2,
+           "autoscale_n_engines_min": 1, "autoscale_n_engines_max": 3,
+           "n_shed": 1, "n_slo_shed": 2, "n_submitted": 48}
+    out = bench._fleet_keys(m, ops=ops)
+    assert out["fleet_rollout_goodput"] == 295.0
+    assert out["fleet_rollout_stall_ms"] == 84.2
+    assert out["fleet_autoscale_n_engines_min"] == 1.0
+    assert out["fleet_autoscale_n_engines_max"] == 3.0
+    assert out["fleet_shed_rate"] == round(3 / 48, 3)
     # error marker name is wired in the secondary list
     import inspect
 

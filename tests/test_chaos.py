@@ -251,6 +251,19 @@ def test_load_latest_valid_none_when_empty(tmp_path):
     assert load_latest_valid({"w": t}, str(tmp_path / "nope")) is None
 
 
+def test_checkpoint_helpers_tolerate_unset_root():
+    """An unset checkpoint root (None or "") means "no checkpoints" —
+    auto-resume helpers must answer None, not TypeError out of
+    os.path.join(None, ...)."""
+    from paddle_tpu.distributed.checkpoint import (latest_step,
+                                                   load_latest_valid)
+
+    t = pt.to_tensor(np.zeros((2,), np.float32))
+    for root in (None, ""):
+        assert latest_step(root) is None
+        assert load_latest_valid({"w": t}, root) is None
+
+
 def test_legacy_v1_checkpoint_still_loads(tmp_path):
     """Format additivity: a pre-crc/manifest checkpoint verifies OK (with
     a warning) and loads."""

@@ -1,0 +1,369 @@
+"""Zero-downtime fleet operations (PR 18): live weight rollout under
+chaos, version-pinned stream bit-identity, canary rollback, demand-
+driven autoscale, and SLO-aware admission shed.
+
+The headline property: start a rolling weight upgrade mid-decode and
+chaos-kill the swap (raise AND hang) — every in-flight stream (greedy
+and sampled) still completes bit-identically to an uninterrupted solo
+run on the weight version it was PINNED to at admission, the fleet
+converges to exactly one version, and the 7-class page ledger sums on
+every tick. A canary failure instead rolls the whole fleet back to the
+prior version through the same machinery."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.flags import GLOBAL_FLAGS
+from paddle_tpu.inference.fleet import FleetRouter, WeightCatalog
+from paddle_tpu.inference.serving import Request, ServingEngine
+from paddle_tpu.models.llama import LlamaConfig
+from paddle_tpu.testing import chaos
+
+CFG = LlamaConfig(vocab_size=256, hidden=64, n_layers=2, n_heads=4,
+                  n_kv_heads=2, ffn_hidden=128, max_seq_len=256,
+                  dtype=jnp.float32, param_dtype=jnp.float32)
+EKW = dict(max_batch=2, page_size=16, max_seq=128, n_pages=1 + 24,
+           prefill_budget=32)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    chaos.disarm()
+
+
+def _mk_router(**kw):
+    ekw = dict(EKW, **kw.pop("engine_kwargs", {}))
+    return FleetRouter(CFG, n_engines=2, seed=0, engine_kwargs=ekw, **kw)
+
+
+def _mk_reqs(rng, n=4, max_new=10, sampled=()):
+    reqs = []
+    for i in range(n):
+        prompt = rng.randint(1, CFG.vocab_size,
+                             size=rng.randint(24, 48)).astype(np.int32)
+        kw = (dict(temperature=0.8, top_p=0.9, seed=100 + i)
+              if i in sampled else {})
+        reqs.append(Request(rid=i, prompt=prompt, max_new_tokens=max_new,
+                            arrival=0.0, **kw))
+    return reqs
+
+
+def _solo_run(params, req):
+    """Uninterrupted single-engine reference for one request."""
+    eng = ServingEngine(CFG, params=params, seed=0, **EKW)
+    ref = Request(rid=1000 + req.rid, prompt=req.prompt.copy(),
+                  max_new_tokens=req.max_new_tokens,
+                  temperature=req.temperature, top_p=req.top_p,
+                  seed=req.seed)
+    eng.run([ref])
+    return ref.out_tokens
+
+
+def _assert_fleet_ledger(router):
+    acc = router.page_accounting()
+    for eid, a in acc["engines"].items():
+        eng = next(r.engine for r in router.replicas
+                   if r.engine.engine_id == eid)
+        assert a["total"] == eng.n_pages - 1, (eid, a)
+    assert acc["fleet"]["total"] == acc["expected"], acc
+
+
+def _perturb(params):
+    """A distinct-but-servable v2: every leaf nudged, dtypes kept."""
+    return jax.tree_util.tree_map(
+        lambda w: (np.asarray(w) * 1.001).astype(np.asarray(w).dtype),
+        params)
+
+
+def _run_until_mid_decode(router, reqs, limit=200):
+    for _ in range(limit):
+        router.step(now=1e18)
+        if any(r is not None and 0 < len(r.out_tokens)
+               < r.max_new_tokens
+               for rep in router.replicas for r in rep.engine.slots):
+            return
+    raise AssertionError("no mid-decode stream appeared")
+
+
+def _drain_checked(router, limit=4000):
+    """Drain the fleet asserting the 7-class ledger sums every tick."""
+    steps = 0
+    while router.step(now=1e18):
+        _assert_fleet_ledger(router)
+        steps += 1
+        assert steps < limit, "fleet did not drain"
+    return steps
+
+
+def _assert_pinned_bit_identity(router, reqs):
+    for r in reqs:
+        assert not r.aborted and len(r.out_tokens) == r.max_new_tokens, \
+            (r.rid, r.aborted, len(r.out_tokens))
+        assert r.param_version is not None, r.rid
+        ref = _solo_run(router.catalog.get(r.param_version), r)
+        assert r.out_tokens == ref, r.rid
+
+
+# -- weight catalog ---------------------------------------------------------
+
+def test_weight_catalog_content_hash_dedup():
+    """Publishing the same bytes twice dedupes to one version id;
+    different bytes get a different id; both stay retrievable (A/B
+    coexistence)."""
+    cat = WeightCatalog()
+    p1 = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+          "b": (np.ones(3, np.float32), np.zeros(3, np.int8))}
+    v1 = cat.put(p1)
+    assert cat.put({k: p1[k] for k in p1}) == v1    # same bytes, new dict
+    p2 = {"w": p1["w"] * 2, "b": p1["b"]}
+    v2 = cat.put(p2)
+    assert v2 != v1
+    assert cat.versions() == sorted([v1, v2])
+    assert cat.get(v1) is p1 and v1 in cat
+
+
+# -- rolling upgrade --------------------------------------------------------
+
+def test_clean_rollout_mid_decode_converges_and_streams_bit_identical():
+    """A clean deploy started mid-decode: every stream completes
+    bit-identically on its pinned version, the fleet ends with every
+    live engine on the target, and the ledger sums every tick."""
+    router = _mk_router()
+    params = router.replicas[0].engine.params
+    reqs = _mk_reqs(np.random.RandomState(0), n=5, sampled=(2,))
+    for r in reqs:
+        router.submit(r, now=1e18)
+    _run_until_mid_decode(router, reqs)
+    v2 = router.rollout(params=_perturb(params))
+    _drain_checked(router)
+    st = router.fleet_stats()
+    assert st["fleet_versions"] == [v2]
+    assert st["n_rollouts"] == 1 and st["n_rollback"] == 0
+    assert st["rollout_stall_ms"] > 0.0
+    _assert_pinned_bit_identity(router, reqs)
+
+
+def test_midswap_chaos_raise_replaced_on_target_bit_identical():
+    """Chaos kills the swap itself (``rollout.swap`` raise): the
+    mid-swap corpse is declared dead and replaced by a fresh engine
+    already ON the target version, the rollout still converges to
+    exactly the target, and every in-flight stream (greedy + sampled)
+    completes bit-identically on its pinned version."""
+    chaos.arm(chaos.FaultPlan(seed=0)
+              .add("rollout.swap", "raise", at=0, engine=0))
+    router = _mk_router()
+    params = router.replicas[0].engine.params
+    reqs = _mk_reqs(np.random.RandomState(1), n=5, sampled=(1, 3))
+    for r in reqs:
+        router.submit(r, now=1e18)
+    _run_until_mid_decode(router, reqs)
+    v2 = router.rollout(params=_perturb(params))
+    _drain_checked(router)
+    st = router.fleet_stats()
+    assert st["n_swap_deaths"] == 1 and st["n_killed"] == 1
+    assert st["fleet_versions"] == [v2]
+    assert st["n_rollback"] == 0
+    _assert_pinned_bit_identity(router, reqs)
+    # the corpse's frozen pool still sums; live ledgers close
+    _assert_fleet_ledger(router)
+
+
+def test_midswap_chaos_hang_past_step_budget_is_a_death():
+    """A hung swap (``rollout.swap`` hang) past the step budget gets
+    the same verdict as a hung step: mid-swap death, replaced on the
+    target version, streams bit-identical."""
+    router = _mk_router(step_budget=0.5)
+    params = router.replicas[0].engine.params
+    # compile OUTSIDE the watched window (first step pays jit)
+    for i, rep in enumerate(router.replicas):
+        rep.engine.run([Request(rid=-1 - i,
+                                prompt=np.ones(40, np.int32),
+                                max_new_tokens=2, arrival=0.0)])
+    chaos.arm(chaos.FaultPlan(seed=0)
+              .add("rollout.swap", "hang", at=0, engine=0, seconds=1.0))
+    reqs = _mk_reqs(np.random.RandomState(2), n=4, sampled=(2,))
+    for r in reqs:
+        router.submit(r, now=1e18)
+    _run_until_mid_decode(router, reqs)
+    v2 = router.rollout(params=_perturb(params))
+    _drain_checked(router)
+    st = router.fleet_stats()
+    assert st["n_swap_deaths"] == 1
+    assert "budget" in next(r for r in router.replicas
+                            if not r.alive).last_error
+    assert st["fleet_versions"] == [v2]
+    _assert_pinned_bit_identity(router, reqs)
+
+
+def test_canary_failure_rolls_the_fleet_back():
+    """A failing canary (``rollout.canary`` fail) swaps the engine
+    straight back and retargets the fleet at the prior version; the
+    rollback ignores canary failures, so the fleet converges to the
+    ORIGINAL version and every stream still completes bit-identically."""
+    chaos.arm(chaos.FaultPlan(seed=0)
+              .add("rollout.canary", "fail", at=0, engine=0))
+    router = _mk_router()
+    params = router.replicas[0].engine.params
+    v1 = router.catalog.put(params)     # idempotent: the baseline id
+    reqs = _mk_reqs(np.random.RandomState(3), n=4, sampled=(0,))
+    for r in reqs:
+        router.submit(r, now=1e18)
+    _run_until_mid_decode(router, reqs)
+    v2 = router.rollout(params=_perturb(params))
+    assert v2 != v1
+    _drain_checked(router)
+    st = router.fleet_stats()
+    assert st["fleet_versions"] == [v1]
+    assert st["n_canary_fail"] == 1 and st["n_rollback"] == 1
+    assert all(rep.alive for rep in router.replicas)    # nobody died
+    _assert_pinned_bit_identity(router, reqs)
+
+
+def test_rollout_argument_validation():
+    router = _mk_router()
+    with pytest.raises(ValueError):
+        router.rollout()                       # needs params or version
+    with pytest.raises(ValueError):
+        router.rollout(version="no-such-hash")
+    router.rollout(params=_perturb(router.replicas[0].engine.params))
+    with pytest.raises(RuntimeError):          # one rollout at a time
+        router.rollout(version=router._rollout.target)
+
+
+# -- add_engine lands on a chosen side of an in-flight rollout --------------
+
+def test_add_engine_explicit_params_version_both_sides():
+    """During an in-flight rollout a joiner can land on EITHER side via
+    explicit ``params=``/``version=``; a joiner with neither inherits
+    replica 0's version. The v1 joiner is then upgraded by the same
+    rollout, so the fleet still converges to the target."""
+    router = _mk_router()
+    params = router.replicas[0].engine.params
+    v2p = _perturb(params)
+    v2 = router.rollout(params=v2p)
+    v1 = router._rollout.prior
+    eid_old = router.add_engine(params=router.catalog.get(v1),
+                                version=v1)
+    eid_new = router.add_engine(params=v2p, version=v2)
+    by_eid = {r.engine.engine_id: r.engine for r in router.replicas}
+    assert by_eid[eid_old].param_version == v1
+    assert by_eid[eid_new].param_version == v2
+    assert by_eid[eid_new].params is v2p
+    # default joiner inherits replica 0's side
+    eid_def = router.add_engine()
+    by_eid = {r.engine.engine_id: r.engine for r in router.replicas}
+    assert (by_eid[eid_def].param_version
+            == router.replicas[0].engine.param_version)
+    _drain_checked(router)
+    assert router.fleet_stats()["fleet_versions"] == [v2]
+
+
+# -- demand-driven autoscale ------------------------------------------------
+
+def test_autoscale_up_then_retire_never_drops_requests():
+    """Census utilization above the high watermark adds an engine on
+    the fleet's current version; once the burst drains, utilization
+    below the low watermark retires engines by drain-then-remove down
+    to ``min_engines`` — and no request is ever dropped either way."""
+    router = _mk_router(autoscale=True, min_engines=1, max_engines=3,
+                        scale_high=0.5, scale_low=0.1, scale_ewma=1.0,
+                        scale_cooldown=0.0)
+    reqs = _mk_reqs(np.random.RandomState(4), n=12, max_new=8)
+    for r in reqs:
+        router.submit(r, now=1e18)
+    _drain_checked(router)
+    # retire down to min_engines: utilization is 0 after the drain
+    for _ in range(12):
+        router.step(now=1e18)
+    st = router.fleet_stats()
+    assert st["n_scale_up"] >= 1 and st["autoscale_n_engines_max"] == 3
+    assert st["n_scale_down"] >= 1
+    assert sum(1 for rep in router.replicas if rep.alive) == 1
+    assert all(not r.aborted and len(r.out_tokens) == r.max_new_tokens
+               for r in reqs)
+    _assert_fleet_ledger(router)
+
+
+def test_autoscale_bounds_respected_when_idle():
+    """An idle fleet never scales below min_engines (and an autoscale
+    router with no traffic does nothing at all above it)."""
+    router = _mk_router(autoscale=True, min_engines=2, max_engines=3,
+                        scale_low=0.9, scale_ewma=1.0, scale_cooldown=0.0)
+    r = Request(rid=0, prompt=np.arange(1, 30, dtype=np.int32),
+                max_new_tokens=4, arrival=0.0)
+    router.submit(r, now=1e18)
+    _drain_checked(router)
+    for _ in range(8):
+        router.step(now=1e18)
+    st = router.fleet_stats()
+    assert st["n_scale_down"] == 0
+    assert sum(1 for rep in router.replicas if rep.alive) == 2
+
+
+# -- SLO-aware admission shed -----------------------------------------------
+
+def test_slo_shed_drops_only_never_accepted_predicted_misses():
+    """With a pinned service-rate prior, queued never-accepted requests
+    whose predicted wait exceeds their remaining TTFT budget shed
+    immediately (``n_slo_shed``); requests without a TTFT deadline —
+    and anything already accepted — are never shed."""
+    router = _mk_router(slo_shed=True, slo_rate=1.0)
+    safe = _mk_reqs(np.random.RandomState(5), n=4, max_new=8)
+    for r in safe:
+        router.submit(r, now=0.0)
+    # queued behind ~everything with a 1 tok/s rate prior: hopeless
+    doomed = []
+    for i in range(3):
+        d = Request(rid=100 + i,
+                    prompt=np.arange(1, 25, dtype=np.int32),
+                    max_new_tokens=8, arrival=0.0, deadline_ttft=0.5)
+        doomed.append(d)
+        router.submit(d, now=0.0)
+    steps = 0
+    while router.step(now=0.0):
+        steps += 1
+        assert steps < 4000, "fleet did not drain"
+    st = router.fleet_stats()
+    assert st["n_slo_shed"] == 3
+    assert all(d.aborted and not d.out_tokens for d in doomed)
+    assert all(not r.aborted and len(r.out_tokens) == r.max_new_tokens
+               for r in safe)
+    _assert_fleet_ledger(router)
+
+
+# -- flags off = pinned single-version fleet --------------------------------
+
+def test_flags_off_rollout_machinery_fully_dormant():
+    """Every ``serving_fleet_*`` operations flag defaults off: a plain
+    router never pins a version, never touches the rollout/autoscale/
+    shed paths, and streams are bit-identical to solo runs."""
+    assert GLOBAL_FLAGS.get("serving_fleet_autoscale") is False
+    assert GLOBAL_FLAGS.get("serving_fleet_slo_shed") is False
+    assert float(GLOBAL_FLAGS.get("serving_fleet_slo_rate")) == 0.0
+    # the knob defaults are part of the pinned surface too
+    assert int(GLOBAL_FLAGS.get("serving_fleet_rollout_canary")) == 4
+    assert int(GLOBAL_FLAGS.get("serving_fleet_min_engines")) == 1
+    assert int(GLOBAL_FLAGS.get("serving_fleet_max_engines")) == 4
+    assert float(GLOBAL_FLAGS.get("serving_fleet_scale_high")) == 0.85
+    assert float(GLOBAL_FLAGS.get("serving_fleet_scale_low")) == 0.2
+    assert float(GLOBAL_FLAGS.get("serving_fleet_scale_ewma")) == 0.3
+    assert float(GLOBAL_FLAGS.get("serving_fleet_scale_cooldown")) == 1.0
+    router = _mk_router()
+    assert not router.autoscale and not router.slo_shed
+    assert not router.rollout_active
+    params = router.replicas[0].engine.params
+    reqs = _mk_reqs(np.random.RandomState(6), n=4, sampled=(3,))
+    for r in reqs:
+        router.submit(r, now=1e18)
+    _drain_checked(router)
+    st = router.fleet_stats()
+    assert st["n_rollouts"] == 0 and st["n_slo_shed"] == 0
+    assert st["n_scale_up"] == 0 and st["n_scale_down"] == 0
+    assert st["fleet_versions"] == []
+    for r in reqs:
+        assert r.param_version is None
+        assert r.out_tokens == _solo_run(params, r), r.rid

@@ -2,7 +2,8 @@
 # One-command CI gate: static analysis -> op-contract baseline -> chaos
 # suite -> serving smoke -> kernel parity -> loadgen smoke -> multichip
 # smoke -> multitenant smoke -> fleet smoke -> disagg smoke -> fusion
-# smoke -> shardcheck smoke -> quantcheck smoke -> tier-1.
+# smoke -> shardcheck smoke -> quantcheck smoke -> rollout smoke ->
+# tier-1.
 #
 #   bash tools/ci_check.sh
 #
@@ -34,12 +35,16 @@
 #       against artifacts/quantcheck.json, or the TPL303 scale-leak
 #       regression harness no longer fires exactly once on the pre-fix
 #       admission program while staying silent on the shipped one)
+#  150  rollout smoke failed (live weight rollout under a mid-swap chaos
+#       kill: a stream was dropped, diverged from its pinned version,
+#       the fleet did not converge to the target version, or a ledger
+#       leaked)
 #   30  tier-1 tests failed (ROADMAP.md command)
 #    0  all gates green
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== gate 1/14: tpu-lint (per-file + interprocedural + typestate rules) =="
+echo "== gate 1/15: tpu-lint (per-file + interprocedural + typestate rules) =="
 python -m tools.lint paddle_tpu tests tools --format=json > /tmp/tpu_lint.json
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -49,7 +54,7 @@ if [ "$rc" -ne 0 ]; then
 fi
 echo "tpu-lint: clean"
 
-echo "== gate 2/14: tpu-verify (abstract op-contract baseline) =="
+echo "== gate 2/15: tpu-verify (abstract op-contract baseline) =="
 JAX_PLATFORMS=cpu python -m tools.lint --contracts \
     --baseline artifacts/op_contracts.json
 rc=$?
@@ -59,7 +64,7 @@ if [ "$rc" -ne 0 ]; then
     exit 20
 fi
 
-echo "== gate 3/14: chaos suite (fault injection -> self-healing) =="
+echo "== gate 3/15: chaos suite (fault injection -> self-healing) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 rc=$?
@@ -69,7 +74,7 @@ if [ "$rc" -ne 0 ]; then
     exit 40
 fi
 
-echo "== gate 4/14: serving smoke (scheduler completion + zero page leak) =="
+echo "== gate 4/15: serving smoke (scheduler completion + zero page leak) =="
 JAX_PLATFORMS=cpu python -m tools.serving_smoke
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -78,7 +83,7 @@ if [ "$rc" -ne 0 ]; then
     exit 50
 fi
 
-echo "== gate 5/14: kernel parity (fused megakernels, CPU fallback arms) =="
+echo "== gate 5/15: kernel parity (fused megakernels, CPU fallback arms) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_fused_norm_epilogue.py \
     tests/test_fused_rope_attention.py tests/test_autotune.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
@@ -89,7 +94,7 @@ if [ "$rc" -ne 0 ]; then
     exit 60
 fi
 
-echo "== gate 6/14: loadgen smoke (open-loop saturation, >=200 arrivals) =="
+echo "== gate 6/15: loadgen smoke (open-loop saturation, >=200 arrivals) =="
 JAX_PLATFORMS=cpu python -m tools.loadgen_smoke
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -99,7 +104,7 @@ if [ "$rc" -ne 0 ]; then
     exit 70
 fi
 
-echo "== gate 7/14: multichip smoke (dp x mp mesh: remat-free compile," \
+echo "== gate 7/15: multichip smoke (dp x mp mesh: remat-free compile," \
      "serial parity, quantized all-reduce) =="
 python tools/multichip_smoke.py
 rc=$?
@@ -110,7 +115,7 @@ if [ "$rc" -ne 0 ]; then
     exit 80
 fi
 
-echo "== gate 8/14: multitenant smoke (LoRA isolation, preemption," \
+echo "== gate 8/15: multitenant smoke (LoRA isolation, preemption," \
      "constrained legality, 7-class ledger) =="
 JAX_PLATFORMS=cpu python -m tools.multitenant_smoke
 rc=$?
@@ -122,7 +127,7 @@ if [ "$rc" -ne 0 ]; then
     exit 90
 fi
 
-echo "== gate 9/14: fleet smoke (engine loss -> bit-identical resume," \
+echo "== gate 9/15: fleet smoke (engine loss -> bit-identical resume," \
      "page migration, survivor ledger) =="
 JAX_PLATFORMS=cpu python -m tools.fleet_smoke
 rc=$?
@@ -133,7 +138,7 @@ if [ "$rc" -ne 0 ]; then
     exit 100
 fi
 
-echo "== gate 10/14: disagg smoke (prefill-pool loss -> degraded" \
+echo "== gate 10/15: disagg smoke (prefill-pool loss -> degraded" \
      "colocated completion, shipped pages, surviving ledgers) =="
 JAX_PLATFORMS=cpu python -m tools.disagg_smoke
 rc=$?
@@ -144,7 +149,7 @@ if [ "$rc" -ne 0 ]; then
     exit 110
 fi
 
-echo "== gate 11/14: fusion smoke (jaxpr fusion discovery, eager" \
+echo "== gate 11/15: fusion smoke (jaxpr fusion discovery, eager" \
      "parity, per-program autotune replay) =="
 JAX_PLATFORMS=cpu python -m tools.fusion_smoke
 rc=$?
@@ -156,7 +161,7 @@ if [ "$rc" -ne 0 ]; then
     exit 120
 fi
 
-echo "== gate 12/14: shardcheck smoke (static sharding/collective" \
+echo "== gate 12/15: shardcheck smoke (static sharding/collective" \
      "verification over the registered entry programs) =="
 JAX_PLATFORMS=cpu python -m tools.lint --shardcheck \
     --baseline artifacts/shardcheck.json
@@ -170,7 +175,7 @@ if [ "$rc" -ne 0 ]; then
     exit 130
 fi
 
-echo "== gate 13/14: quantcheck smoke (static precision & scale-provenance" \
+echo "== gate 13/15: quantcheck smoke (static precision & scale-provenance" \
      "verification + TPL303 scale-leak regression harness) =="
 JAX_PLATFORMS=cpu python -m tools.lint --quantcheck \
     --baseline artifacts/quantcheck.json
@@ -189,7 +194,19 @@ if [ "$rc" -ne 0 ]; then
     exit 140
 fi
 
-echo "== gate 14/14: tier-1 tests (ROADMAP.md) =="
+echo "== gate 14/15: rollout smoke (live weight deploy under a mid-swap" \
+     "chaos kill -> pinned-version bit-identity, single-version" \
+     "convergence, zero leak) =="
+JAX_PLATFORMS=cpu python -m tools.rollout_smoke
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "ci_check: rollout smoke gate failed (rc=$rc) — a mid-swap" \
+         "death dropped or diverged a stream, the fleet ended on a" \
+         "mixed/wrong weight version, or a page ledger leaked" >&2
+    exit 150
+fi
+
+echo "== gate 15/15: tier-1 tests (ROADMAP.md) =="
 
 set -o pipefail
 rm -f /tmp/_t1.log
