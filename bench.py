@@ -170,7 +170,8 @@ def _run_secondary_benches() -> dict:
                              ("_bench_long_ctx", "long_ctx_error"),
                              ("_bench_multichip", "multichip_error"),
                              ("_bench_fusion", "fusion_error"),
-                             ("_bench_phases", "phases_error")):
+                             ("_bench_phases", "phases_error"),
+                             ("_bench_obs", "obs_error")):
         try:
             extra.update(globals()[fn_name]())
         except Exception as e:  # noqa: BLE001
@@ -1015,6 +1016,59 @@ def _bench_phases():
 
     out.update(autotune.stats())
     return out
+
+
+def _obs_keys(n_emitted: int, steps: int, plain_s: float,
+              armed_s: float) -> dict:
+    """Pure obs-measurement -> bench-keys mapping (ISSUE 19 satellite;
+    unit-pinned in tests/test_bench_contract.py): the armed-vs-disarmed
+    wall overhead of the tracing plane and its event volume per engine
+    step."""
+    return {
+        "obs_trace_overhead_frac": (round((armed_s - plain_s) / plain_s, 4)
+                                    if plain_s > 0 else 0.0),
+        "obs_events_per_step": (round(n_emitted / steps, 2)
+                                if steps > 0 else 0.0),
+    }
+
+
+def _bench_obs():
+    """Observability-plane overhead (ISSUE 19): the same serving run
+    with tracing disarmed then armed, identical engine/params/requests.
+    The disarmed fast path is one module-global load per probe, so the
+    frac should sit in measurement noise; events_per_step sizes the
+    armed ring against FLAGS_obs_buffer_events."""
+    from paddle_tpu import obs
+    from paddle_tpu.inference.serving import Request, ServingEngine
+    from paddle_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig(vocab_size=256, hidden=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_hidden=128, max_seq_len=256,
+                      dtype=jnp.float32, param_dtype=jnp.float32)
+    ekw = dict(max_batch=2, page_size=16, max_seq=128, n_pages=1 + 24,
+               prefill_budget=32)
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, cfg.vocab_size, size=40).astype(np.int32)
+               for _ in range(8)]
+
+    def run(armed, params=None):
+        eng = ServingEngine(cfg, params=params, seed=0, **ekw)
+        reqs = [Request(rid=i, prompt=p.copy(), max_new_tokens=16,
+                        arrival=0.0) for i, p in enumerate(prompts)]
+        eng.run([reqs[0]])              # compile outside the window
+        st = obs.arm(capacity=65536) if armed else None
+        t0 = time.perf_counter()
+        stats = eng.run(reqs[1:])
+        dt = time.perf_counter() - t0
+        if armed:
+            obs.disarm()
+        return (dt, stats["unified_steps"],
+                st.tracer.n_emitted if st else 0, eng.params)
+
+    obs.disarm()
+    plain_s, _, _, params = run(armed=False)
+    armed_s, steps, n_emitted, _ = run(armed=True, params=params)
+    return _obs_keys(n_emitted, steps, plain_s, armed_s)
 
 
 if __name__ == "__main__":

@@ -79,7 +79,9 @@ from typing import Optional
 import numpy as np
 
 from ...core.flags import GLOBAL_FLAGS
+from ...obs import clock as _clock
 from ...testing import chaos as _chaos
+from ... import obs as _obs
 from ..serving import Request, ServingEngine
 from .migration import ship_pages, ship_shipment
 from .rollout import RolloutState, WeightCatalog, run_canary
@@ -296,6 +298,9 @@ class FleetRouter:
             "n_swap_deaths": 0, "rollout_ms": 0.0, "n_slo_shed": 0,
             "n_scale_up": 0, "n_scale_down": 0,
         }
+        # FLAGS_obs_trace=1 arms the observability plane from any entry
+        # point (the engines' constructors do the same)
+        _obs.arm_from_flags()
 
     # -- registration broadcast ------------------------------------------
 
@@ -423,7 +428,7 @@ class FleetRouter:
 
     def _drop(self, req: Request, counter: str) -> None:
         req.aborted = True
-        req.t_done = time.monotonic()
+        req.t_done = _clock.now()
         self._owner.pop(req.rid, None)
         self._decode_phase.discard(req.rid)
         self.stats[counter] += 1
@@ -437,7 +442,7 @@ class FleetRouter:
             return
         delay = (0.0 if attempt == 0
                  else self.retry_base_delay * (2.0 ** (attempt - 1)))
-        self._retry.append([time.monotonic() + delay, attempt, req, None])
+        self._retry.append([_clock.now() + delay, attempt, req, None])
 
     def submit(self, req: Request, now: float = 0.0) -> None:
         self._requests[req.rid] = req
@@ -458,7 +463,7 @@ class FleetRouter:
             if req.rid == rid:
                 self._retry.pop(i)
                 req.aborted = True
-                req.t_done = time.monotonic()
+                req.t_done = _clock.now()
                 self._decode_phase.discard(rid)
                 return True
         for rep2 in self.replicas:      # swept into an engine outbox,
@@ -466,7 +471,7 @@ class FleetRouter:
                 if req.rid == rid:                       # picked up
                     rep2.engine.outbox.pop(i)
                     req.aborted = True
-                    req.t_done = time.monotonic()
+                    req.t_done = _clock.now()
                     self._decode_phase.discard(rid)
                     return True
         return False
@@ -491,7 +496,7 @@ class FleetRouter:
         if self.slo_shed:
             self._slo_tick(now)
         if self._retry:
-            t = time.monotonic()
+            t = _clock.now()
             ready = [e for e in self._retry if e[0] <= t]
             self._retry = [e for e in self._retry if e[0] > t]
             for _rdy, attempt, req, job in ready:
@@ -511,7 +516,7 @@ class FleetRouter:
         for rep in self.replicas:
             if not rep.alive:
                 continue
-            t0 = time.monotonic()
+            t0 = _clock.now()
             try:
                 more = rep.engine.step(now=now)
             except Exception as exc:          # noqa: BLE001 — a replica
@@ -522,7 +527,7 @@ class FleetRouter:
                 busy = True
                 continue
             rep.failures = 0
-            rep.last_step_s = time.monotonic() - t0
+            rep.last_step_s = _clock.now() - t0
             if self.step_budget > 0 and rep.last_step_s > self.step_budget:
                 # hang detection, single-threaded: the stall is observed
                 # as elapsed wall time once the step finally returns
@@ -539,7 +544,7 @@ class FleetRouter:
                 # exhaustion during the drain above) registers
                 self.stats["degraded_steps"] += 1
         if self._recovering:
-            t = time.monotonic()
+            t = _clock.now()
             still = []
             for entry in self._recovering:
                 req, n0, t0 = entry
@@ -658,7 +663,7 @@ class FleetRouter:
         prior = next((r.engine.param_version for r in self._alive()
                       if r.engine.param_version != version), base)
         self._rollout = RolloutState(target=version, prior=prior,
-                                     t0=time.monotonic())
+                                     t0=_clock.now())
         self.stats["n_rollouts"] += 1
         return version
 
@@ -701,38 +706,43 @@ class FleetRouter:
                     and r is not self._retiring]
             if not cand:
                 self.stats["rollout_ms"] += round(
-                    (time.monotonic() - ro.t0) * 1000.0, 3)
+                    (_clock.now() - ro.t0) * 1000.0, 3)
                 self._rollout = None
                 return
             rep = min(cand, key=lambda r: r.engine.engine_id)
             ro.current_eid = rep.engine.engine_id
-            ro.episode_t0 = time.monotonic()
+            ro.episode_t0 = _clock.now()
+            _obs.instant("rollout.drain", engine=rep.engine.engine_id,
+                         target=ro.target)
             self._begin_drain(rep, now)
             return
         if not self._drain_tick(rep, now):
             return                              # still evacuating
         e = rep.engine
         died = False
-        t0 = time.monotonic()
+        t0 = _clock.now()
         try:
-            self._swap_probe(e)
-            e.set_params(self.catalog.get(ro.target), version=ro.target)
+            with _obs.span("rollout.swap", engine=e.engine_id,
+                           target=ro.target):
+                self._swap_probe(e)
+                e.set_params(self.catalog.get(ro.target),
+                             version=ro.target)
         except Exception as exc:    # noqa: BLE001 — any swap escape is
             rep.last_error = (      # a mid-swap death
                 f"rollout.swap: {type(exc).__name__}: {exc}")
             died = True
         if (not died and self.step_budget > 0
-                and time.monotonic() - t0 > self.step_budget):
+                and _clock.now() - t0 > self.step_budget):
             # a hung swap past the step budget: same verdict as a hung
             # step — the replica's weight state is not trustworthy
             rep.last_error = (f"rollout.swap took "
-                              f"{time.monotonic() - t0:.3f}s > budget "
+                              f"{_clock.now() - t0:.3f}s > budget "
                               f"{self.step_budget:.3f}s")
             died = True
         if died:
             self.stats["n_swap_deaths"] += 1
             rep.draining = False
-            self._declare_dead(rep, now)
+            self._declare_dead(rep, now, reason="rollout-swap-death")
             self.add_engine(params=self.catalog.get(ro.target),
                             version=ro.target,
                             engine_kwargs=self._replacement_kwargs())
@@ -748,12 +758,15 @@ class FleetRouter:
                 ok = False
         if ok and self.rollout_canary > 0:
             try:
-                ok = run_canary(e, self.rollout_canary, now=now)
+                with _obs.span("rollout.canary", engine=e.engine_id,
+                               target=ro.target):
+                    ok = run_canary(e, self.rollout_canary, now=now)
             except Exception as exc:  # noqa: BLE001 — a canary that
                 rep.last_error = (    # raises is a dead engine
                     f"rollout.canary: {type(exc).__name__}: {exc}")
                 rep.draining = False
-                self._declare_dead(rep, now)
+                self._declare_dead(rep, now,
+                                   reason="rollout-canary-death")
                 self.add_engine(params=self.catalog.get(ro.target),
                                 version=ro.target,
                                 engine_kwargs=self._replacement_kwargs())
@@ -766,6 +779,10 @@ class FleetRouter:
             # same machinery
             self.stats["n_canary_fail"] += 1
             self.stats["n_rollback"] += 1
+            _obs.flight_dump("canary-rollback",
+                             detail=f"engine {e.engine_id} canary "
+                                    f"failed on {ro.target}; fleet "
+                                    f"retargets {ro.prior}")
             e.set_params(self.catalog.get(ro.prior), version=ro.prior)
             self._rejoin(rep)
             self._end_episode(ro)
@@ -893,7 +910,7 @@ class FleetRouter:
 
     def _end_episode(self, ro: RolloutState) -> None:
         if ro.current_eid is not None:
-            ms = (time.monotonic() - ro.episode_t0) * 1000.0
+            ms = (_clock.now() - ro.episode_t0) * 1000.0
             if ms > self._rollout_stall_ms:
                 self._rollout_stall_ms = ms
         ro.current_eid = None
@@ -939,7 +956,7 @@ class FleetRouter:
         a = self.scale_alpha
         self._util_ewma = (util if self._util_ewma is None
                            else a * util + (1.0 - a) * self._util_ewma)
-        t = time.monotonic()
+        t = _clock.now()
         if t - self._last_scale_t < self.scale_cooldown:
             return
         if (self._util_ewma > self.scale_high
@@ -1052,9 +1069,11 @@ class FleetRouter:
         """Pool death -> colocated: every survivor serves both phases
         (prefill_only off), placement stops filtering by role."""
         self.degraded = True
-        self._degraded_t0 = time.monotonic()
+        self._degraded_t0 = _clock.now()
         for rep in self._alive():
             rep.engine.prefill_only = False
+        _obs.flight_dump("pool-death",
+                         detail="degraded to colocated serving")
 
     def _resplit(self) -> None:
         """Both roles live again: restore the pool split. Mid-decode
@@ -1064,7 +1083,7 @@ class FleetRouter:
         handoff."""
         self.degraded = False
         self._degraded_ms.append(
-            (time.monotonic() - self._degraded_t0) * 1000.0)
+            (_clock.now() - self._degraded_t0) * 1000.0)
         self.stats["n_resplit"] += 1
         for rep in self._alive():
             if rep.role == "prefill":
@@ -1191,7 +1210,7 @@ class FleetRouter:
                     shipment = rep.engine.finalize_shipment(shipment)
                 job = {"req": req, "shipment": shipment,
                        "donor": rep.engine.engine_id, "pool": rep.role,
-                       "t0": time.monotonic(),
+                       "t0": _clock.now(),
                        # the wire closure: everything about the delivery
                        # is pre-bound at sweep time except the target,
                        # chosen per attempt (the decode pool may change
@@ -1226,10 +1245,15 @@ class FleetRouter:
             res = job["wire"](target.engine)
         self.stats["wire_adopt_ms"] += res.get("adopt_ms", 0.0)
         late = (self.ship_deadline > 0
-                and time.monotonic() - job["t0"] > self.ship_deadline)
+                and _clock.now() - job["t0"] > self.ship_deadline)
         if res["status"] in ("dropped", "rejected", "failed") or late:
             if res["status"] in ("dropped", "rejected", "failed"):
-                self.stats["migration_" + res["status"]] += 1
+                # full-literal keys for TPL010 metrics hygiene
+                self.stats["migration_dropped"
+                           if res["status"] == "dropped"
+                           else "migration_rejected"
+                           if res["status"] == "rejected"
+                           else "migration_failed"] += 1
             self.stats["n_ship_retries"] += 1
             self._queue_ship_retry(job, attempt + 1, now)
             return
@@ -1249,7 +1273,7 @@ class FleetRouter:
         signal: degrade to colocated and deliver by re-prefill."""
         req = job["req"]
         expired = (self.ship_deadline > 0
-                   and time.monotonic() - job["t0"] > self.ship_deadline)
+                   and _clock.now() - job["t0"] > self.ship_deadline)
         if attempt > self.retry_max or expired:
             if expired:
                 self.stats["n_ship_deadline"] += 1
@@ -1261,7 +1285,7 @@ class FleetRouter:
             return
         delay = (0.0 if attempt == 0
                  else self.retry_base_delay * (2.0 ** (attempt - 1)))
-        self._retry.append([time.monotonic() + delay, attempt, req, job])
+        self._retry.append([_clock.now() + delay, attempt, req, job])
 
     def _deliver(self, req: Request, target: _Replica) -> None:
         """Re-submit the request on the decode target: it re-prefills
@@ -1297,9 +1321,12 @@ class FleetRouter:
 
     # -- death + recovery -------------------------------------------------
 
-    def _declare_dead(self, rep: _Replica, now: float) -> None:
+    def _declare_dead(self, rep: _Replica, now: float,
+                      reason: str = "engine-death") -> None:
         rep.alive = False
         self.stats["n_killed"] += 1
+        _obs.instant("fleet.death", engine=rep.engine.engine_id,
+                     reason=reason, error=rep.last_error)
         e = rep.engine
         resident = [(s, r) for s, r in enumerate(e.slots)
                     if r is not None and not r.aborted
@@ -1320,10 +1347,10 @@ class FleetRouter:
         for _s, r in resident:
             if r.out_tokens:       # an accepted stream: time its resume
                 self._recovering.append([r, len(r.out_tokens),
-                                         time.monotonic()])
+                                         _clock.now()])
         for r in shipped:
             self._recovering.append([r, len(r.out_tokens),
-                                     time.monotonic()])
+                                     _clock.now()])
         for rid in ([r.rid for _s, r in resident]
                     + [r.rid for r in queued]
                     + [r.rid for r in shipped]):
@@ -1349,16 +1376,27 @@ class FleetRouter:
                 # re-admission so its re-prefill runs through the cache.
                 # Any wire/adopter failure just means re-prefill does
                 # the work — streams are identical either way.
-                res = ship_pages(e, target.engine, req.rid)
+                with _obs.span("fleet.migrate",
+                               engine=target.engine.engine_id,
+                               rid=req.rid, donor=e.engine_id):
+                    res = ship_pages(e, target.engine, req.rid)
+                _obs.lifecycle(req.rid, "migrate",
+                               engine=target.engine.engine_id,
+                               donor=e.engine_id, pages=res["pages"],
+                               status=res["status"])
                 self.stats["migrated_pages"] += res["pages"]
                 self.stats["migration_bytes"] += res["bytes"]
                 self.stats["shipped_bytes"] += res["bytes"]
                 self.stats["wire_adopt_ms"] += res.get("adopt_ms", 0.0)
                 if res["status"] in ("dropped", "rejected", "failed"):
-                    self.stats["migration_" + (
-                        "dropped" if res["status"] == "dropped"
-                        else "rejected" if res["status"] == "rejected"
-                        else "failed")] += 1
+                    # full-literal keys (TPL010 metrics hygiene: every
+                    # written stats key is statically checkable against
+                    # the declared schema)
+                    self.stats["migration_dropped"
+                               if res["status"] == "dropped"
+                               else "migration_rejected"
+                               if res["status"] == "rejected"
+                               else "migration_failed"] += 1
             try:
                 target.engine.submit(req)
             except ValueError:
@@ -1369,6 +1407,9 @@ class FleetRouter:
                 req.param_version = target.engine.param_version
             if self.affinity and req.session is not None:
                 self._sessions[req.session] = target.engine.engine_id
+        # postmortem artifact: the ring now holds the death, every
+        # migration span, and any chaos fault that caused it
+        _obs.flight_dump(reason, detail=rep.last_error)
 
     def _shed_for_pressure(self, victims: list, now: float) -> list:
         """Graceful degradation under ``serving_fleet_shed_backlog``:

@@ -38,6 +38,7 @@ from __future__ import annotations
 import time
 from typing import Optional
 
+from ...obs import clock as _clock
 from .metrics import summarize, summarize_fleet
 
 __all__ = ["OpenLoopDriver", "FleetDriver"]
@@ -101,11 +102,11 @@ class OpenLoopDriver:
             total = sum(r.max_new_tokens + len(r.prompt)
                         for r in requests)
             max_steps = 200 + 4 * total
-        t0 = time.monotonic()
+        t0 = _clock.now()
         steps = 0
         while True:
             now = (1e18 if self.clock == "rush"
-                   else time.monotonic() - t0)
+                   else _clock.now() - t0)
             gate = steps if self.clock == "rush" else now
             while pending and pending[0][0] <= gate:
                 eng.abort(pending.pop(0)[1])
@@ -122,9 +123,9 @@ class OpenLoopDriver:
                     s is not None for s in eng.slots) \
                     and eng._inflight is None and eng.queue:
                 nxt = min(r.arrival for r in eng.queue)
-                wait = max(0.0, nxt - (time.monotonic() - t0))
+                wait = max(0.0, nxt - (_clock.now() - t0))
                 time.sleep(min(max(wait, 0.001), 0.05))
-        wall = time.monotonic() - t0
+        wall = _clock.now() - t0
         if eng._deferred_free or eng.pool.pending_evict:
             eng.pool.release(eng._deferred_free)
             eng._deferred_free = []
@@ -178,13 +179,13 @@ class FleetDriver:
             total = sum(r.max_new_tokens + len(r.prompt)
                         for r in requests)
             max_steps = 200 + 4 * total
-        t0 = time.monotonic()
+        t0 = _clock.now()
         for r in sorted(requests, key=lambda r: r.arrival):
             router.submit(r, now=0.0 if self.clock == "wall" else 1e18)
         steps = 0
         while True:
             now = (1e18 if self.clock == "rush"
-                   else time.monotonic() - t0)
+                   else _clock.now() - t0)
             gate = steps if self.clock == "rush" else now
             while pending and pending[0][0] <= gate:
                 router.abort(pending.pop(0)[1])
@@ -219,9 +220,9 @@ class FleetDriver:
                         and e._inflight is None for e in live) \
                         and any(e.queue for e in live):
                     nxt = min(r.arrival for e in live for r in e.queue)
-                    wait = max(0.0, nxt - (time.monotonic() - t0))
+                    wait = max(0.0, nxt - (_clock.now() - t0))
                     time.sleep(min(max(wait, 0.001), 0.05))
-        wall = time.monotonic() - t0
+        wall = _clock.now() - t0
         for rep in router.replicas:
             e = rep.engine
             if rep.alive and (e._deferred_free or e.pool.pending_evict):

@@ -19,7 +19,11 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["percentile", "summarize", "summarize_fleet"]
+from ...obs.metrics import (FLEET_STATS_SCHEMA, Histogram,
+                            MetricsRegistry, SERVING_STATS_SCHEMA)
+
+__all__ = ["percentile", "summarize", "summarize_fleet",
+           "fleet_registry"]
 
 
 def percentile(xs, p: float) -> float:
@@ -111,4 +115,41 @@ def summarize_fleet(requests, router, wall_s: float) -> dict:
         misses += rep.engine.pool.misses
     out = _aggregate(requests, st, hits, misses, wall_s)
     out.update(router.fleet_stats())
+    # at fleet scale the raw-list percentiles above are replaced by
+    # exponential-bucket histograms: O(buckets) memory for any request
+    # count, relative error bounded by the bucket growth (obs.metrics)
+    reg = fleet_registry(requests, st)
+    done = [r for r in requests
+            if not r.aborted and r.t_done is not None
+            and len(r.out_tokens) >= r.max_new_tokens]
+    if done:
+        ttft_h = reg.histogram("ttft_seconds")
+        tpot_h = reg.histogram("tpot_seconds")
+        out["ttft_p50_s"] = round(ttft_h.percentile(50), 4)
+        out["ttft_p99_s"] = round(ttft_h.percentile(99), 4)
+        out["tpot_p50_s"] = round(tpot_h.percentile(50), 5)
+        out["tpot_p99_s"] = round(tpot_h.percentile(99), 5)
     return out
+
+
+def fleet_registry(requests, st: dict) -> MetricsRegistry:
+    """A :class:`MetricsRegistry` over a completed fleet run: the
+    summed engine counters absorbed through their declared schema, plus
+    TTFT/TPOT histograms over the request records. ``bench.py`` and the
+    smoke tools export this as JSON / Prometheus text."""
+    reg = MetricsRegistry()
+    reg.absorb(st, SERVING_STATS_SCHEMA)
+    reg.absorb(st, FLEET_STATS_SCHEMA)
+    ttft_h = reg.histogram("ttft_seconds",
+                           "arrival -> first token (fleet-wide)")
+    tpot_h = reg.histogram("tpot_seconds", "steady decode pace")
+    for r in requests:
+        if r.aborted or r.t_done is None \
+                or len(r.out_tokens) < r.max_new_tokens:
+            continue
+        if r.t_first is not None:
+            ttft_h.observe(max(0.0, r.t_first - r.arrival))
+            if len(r.out_tokens) > 1:
+                tpot_h.observe(max(0.0, (r.t_done - r.t_first)
+                                   / (len(r.out_tokens) - 1)))
+    return reg

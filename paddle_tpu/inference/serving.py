@@ -59,7 +59,9 @@ from ..core.flags import GLOBAL_FLAGS
 from ..models.llama import (LlamaConfig, apply_rope, init_llama_params,
                             quantize_weights_int8, rms_norm, rope_angles,
                             _mm)
+from ..obs import clock as _clock
 from ..testing import chaos as _chaos
+from .. import obs as _obs
 
 __all__ = ["Request", "ServingEngine", "kv_admit_first_write",
            "kv_scale_reset", "wire_gather_pages", "wire_scatter_pages"]
@@ -543,6 +545,9 @@ class ServingEngine:
             # wire cost the overlapped path shrinks to a buffer swap)
             "wire_export_ms": 0.0,
         }
+        # FLAGS_obs_trace=1 arms the observability plane from any entry
+        # point; default off = zero probes beyond one global load each
+        _obs.arm_from_flags()
 
     # -- compiled program ---------------------------------------------------
 
@@ -930,6 +935,14 @@ class ServingEngine:
                     f"{req.constraint.dfa.vocab_size} != model vocab "
                     f"{self.cfg.vocab_size}")
         self.queue.append(req)
+        # lifecycle flow: first submission opens the request's async
+        # track; a resume (preempt/migration/ship re-admission) is an
+        # instant on the same id
+        _obs.lifecycle(req.rid,
+                       "arrival" if (req.t_first is None
+                                     and not req.out_tokens)
+                       else "resubmit",
+                       engine=self.engine_id)
 
     def abort(self, rid: int) -> bool:
         """Cancel a request by rid, wherever it is: queued (removed) or
@@ -937,7 +950,7 @@ class ServingEngine:
         an in-flight program may still write them; tokens an in-flight
         program produces for it are discarded at harvest). Returns False
         if the rid is unknown/already done."""
-        now = time.monotonic()
+        now = _clock.now()
         for i, r in enumerate(self.queue):
             if r.rid == rid:
                 self.queue.pop(i)
@@ -1103,6 +1116,8 @@ class ServingEngine:
             slot = free_slots.pop(0)
             n_shared = len(shared)
             self.slots[slot] = req
+            _obs.lifecycle(req.rid, "admit", engine=self.engine_id,
+                           slot=slot)
             self._slot_shared[slot] = shared
             self._slot_owned[slot] = pages
             self._slot_hashes[slot] = hashes
@@ -1160,6 +1175,7 @@ class ServingEngine:
         req.n_preempted += 1
         req.age = 0                        # re-admission ages afresh
         self.stats["preemptions"] += 1
+        _obs.lifecycle(req.rid, "preempt", engine=self.engine_id)
         self._release_slot_pages(slot, defer=True)
         self._prefilling.pop(slot, None)
         self.table[slot] = 0
@@ -1198,7 +1214,8 @@ class ServingEngine:
     def _finish_if_done(self, slot: int, defer_free: bool = False) -> None:
         req = self.slots[slot]
         if req is not None and len(req.out_tokens) >= req.max_new_tokens:
-            req.t_done = time.monotonic()
+            req.t_done = _clock.now()
+            _obs.lifecycle(req.rid, "done", engine=self.engine_id)
             self._release_slot_pages(slot, defer=defer_free)
             self.table[slot] = 0           # sink
             self.seq_lens[slot] = 0
@@ -1254,19 +1271,42 @@ class ServingEngine:
         """
         if _chaos.active():               # disarmed: one global load,
             self._chaos_step()            # nothing else on the hot path
-        now = time.monotonic() if now is None else now
-        self._admit(now)
+        if _obs.active():                 # same pattern for the tracer
+            with _obs.span("engine.step", engine=self.engine_id):
+                return self._step_impl(now, traced=True)
+        return self._step_impl(now, traced=False)
+
+    def _step_impl(self, now: Optional[float], traced: bool) -> bool:
+        now = _clock.now() if now is None else now
+        if traced:
+            with _obs.span("engine.admit", engine=self.engine_id):
+                self._admit(now)
+        else:
+            self._admit(now)
         prev = self._inflight
-        self._dispatch_unified(now)
+        if traced:
+            with _obs.span("engine.dispatch", engine=self.engine_id):
+                self._dispatch_unified(now)
+        else:
+            self._dispatch_unified(now)
         if self.spec_k or self._constr_on:
             # synchronous modes: drafts (spec) and vocab masks
             # (constrained) are host state derived from the previous
             # step's tokens, so each step harvests before the next
             # dispatch (chaining is moot — nothing stays in flight)
             if self._inflight is not None:
-                self._harvest(self._inflight)
+                if traced:
+                    with _obs.span("engine.harvest",
+                                   engine=self.engine_id):
+                        self._harvest(self._inflight)
+                else:
+                    self._harvest(self._inflight)
         elif prev is not None:
-            self._harvest(prev)
+            if traced:
+                with _obs.span("engine.harvest", engine=self.engine_id):
+                    self._harvest(prev)
+            else:
+                self._harvest(prev)
         if self.prefill_only:
             self._export_completed()
         if self._inflight is None and (self._deferred_free
@@ -1324,12 +1364,15 @@ class ServingEngine:
             if (req is None or s in self._prefilling or s in inflight
                     or not req.out_tokens):
                 continue
-            t0 = time.perf_counter()
-            shipment = (self.stage_request_pages(req.rid)
-                        if self._wire_overlap
-                        else self.export_request_pages(req.rid))
-            self.stats["wire_export_ms"] += (time.perf_counter() - t0) * 1e3
+            t0 = _clock.now()
+            with _obs.span("wire.stage", engine=self.engine_id,
+                           rid=req.rid):
+                shipment = (self.stage_request_pages(req.rid)
+                            if self._wire_overlap
+                            else self.export_request_pages(req.rid))
+            self.stats["wire_export_ms"] += (_clock.now() - t0) * 1e3
             self.outbox.append((req, shipment))
+            _obs.lifecycle(req.rid, "ship", engine=self.engine_id)
             # immediate (non-deferred) release: the in-flight guard
             # above means no dispatched program references this slot's
             # pages (its prefill-final is harvested, and a prefill-only
@@ -1541,7 +1584,7 @@ class ServingEngine:
         self.pool.release(self._deferred_free)
         self._deferred_free = []
         self.pool.commit_evictable()
-        now = time.monotonic()
+        now = _clock.now()
         for idx, s, req, kind, m, drafts in snap:
             if kind == "mid":
                 continue
@@ -1563,6 +1606,8 @@ class ServingEngine:
                     self.stats["waste_overrun_slot_tokens"] += 1
                 if req.t_first is None:
                     req.t_first = now
+                    _obs.lifecycle(req.rid, "first-token",
+                                   engine=self.engine_id)
                 if self.slots[s] is req:
                     self.cur_tok[s] = tok
                     self._finish_if_done(s, defer_free=True)
@@ -1578,6 +1623,8 @@ class ServingEngine:
                 req.out_tokens.extend(o[:take])
                 if req.t_first is None and take:
                     req.t_first = now
+                    _obs.lifecycle(req.rid, "first-token",
+                                   engine=self.engine_id)
                 self.stats["decode_active_tokens"] += take
                 self.stats["waste_spec_rejected_slot_tokens"] += m - a
                 self.stats["waste_overrun_slot_tokens"] += a - take
@@ -1611,6 +1658,7 @@ class ServingEngine:
                 # belong to a newer request; only the completion time
                 # remains to record
                 req.t_done = now
+                _obs.lifecycle(req.rid, "done", engine=self.engine_id)
 
     # -- KV page migration (inference/fleet/) -----------------------------
     #
@@ -1766,7 +1814,7 @@ class ServingEngine:
         non-staged (sync-wire) shipments."""
         if not shipment or not shipment.get("staged"):
             return shipment
-        t0 = time.perf_counter()
+        t0 = _clock.now()
         quant = shipment["k_scales"] is not None
         k = np.ascontiguousarray(np.asarray(shipment["k"]))
         v = np.ascontiguousarray(np.asarray(shipment["v"]))
@@ -1780,7 +1828,10 @@ class ServingEngine:
                for j in range(len(shipment["hashes"]))]
         shipment.update({"k": k, "v": v, "k_scales": ks, "v_scales": vs,
                          "crc": crc, "staged": False})
-        self.stats["wire_export_ms"] += (time.perf_counter() - t0) * 1e3
+        self.stats["wire_export_ms"] += (_clock.now() - t0) * 1e3
+        _obs.instant("wire.finalize", engine=self.engine_id,
+                     rid=shipment.get("rid"),
+                     pages=len(shipment.get("hashes", [])))
         if _chaos.active():
             ctx = {"engine": self.engine_id}
             if self.pool_role is not None:
@@ -1991,6 +2042,15 @@ class ServingEngine:
         shipment, staged = handle["shipment"], handle["staged"]
         idx = [j for j, _ in staged]
         pages = [p for _, p in staged]
+        if _obs.active():
+            with _obs.span("wire.commit", engine=self.engine_id,
+                           rid=shipment.get("rid"), pages=len(pages)):
+                return self._commit_adopt_impl(shipment, staged, idx,
+                                               pages)
+        return self._commit_adopt_impl(shipment, staged, idx, pages)
+
+    def _commit_adopt_impl(self, shipment: dict, staged: list,
+                           idx: list, pages: list) -> int:
         if self._wire_overlap:
             self._commit_pending.append({
                 "pages": pages,
@@ -2026,6 +2086,9 @@ class ServingEngine:
         # lookup claims them. They settle to evictable at the next
         # harvest/idle commit like any other pending page.
         self.pool.decref(pages)
+        if shipment.get("rid") is not None:
+            _obs.lifecycle(shipment["rid"], "adopt",
+                           engine=self.engine_id, pages=len(pages))
         return len(pages)
 
     def _flush_commits(self) -> None:
@@ -2141,10 +2204,10 @@ class ServingEngine:
             self.submit(r)
         self.stats = {k: 0 for k in self.stats}   # per-run counters
         hits0, misses0 = self.pool.hits, self.pool.misses
-        t0 = time.monotonic()
+        t0 = _clock.now()
         while (any(s is not None for s in self.slots) or self.queue
                or self._inflight is not None):
-            self.step(now=time.monotonic() - t0)
+            self.step(now=_clock.now() - t0)
             if not any(s is not None for s in self.slots) \
                     and self._inflight is None and self.queue:
                 # nothing active and next arrival is in the future (or
@@ -2152,9 +2215,9 @@ class ServingEngine:
                 # busy-spin — floor keeps the pool-blocked case off 100%
                 # CPU (submit() rejects requests that can NEVER fit)
                 nxt = min(r.arrival for r in self.queue)
-                wait = max(0.0, nxt - (time.monotonic() - t0))
+                wait = max(0.0, nxt - (_clock.now() - t0))
                 time.sleep(min(max(wait, 0.001), 0.05))
-        wall = time.monotonic() - t0
+        wall = _clock.now() - t0
         if self._deferred_free or self.pool.pending_evict:
             # nothing is in flight after the drive loop: settle deferred
             # frees (e.g. a final-step abort) so page_accounting sees

@@ -180,6 +180,18 @@ def _hoist_arrays(tpl, leaves: list):
     return tpl
 
 
+# declared counter family for the lazy-segment runner (TPL010 checks
+# every stats[...] write against a *_STATS_SCHEMA; eager_tape_ops is
+# written from core/dispatch.py against this runner's dict)
+LAZY_SEGMENT_STATS_SCHEMA = {
+    "lazy_ops": ("counter", "ops recorded into pending segments"),
+    "flushes": ("counter", "pending-graph flushes"),
+    "segments_compiled": ("counter", "distinct segments compiled"),
+    "segment_calls": ("counter", "compiled segment invocations"),
+    "eager_tape_ops": ("counter", "tape ops forcing an eager flush"),
+}
+
+
 class SegmentRunner:
     """Per-StaticFunction lazy-segment state: one pending graph at a
     time, a compiled-segment cache, and counters."""
@@ -190,8 +202,7 @@ class SegmentRunner:
         self._aval_cache: dict = {}
         self.max_segments = max_segments
         self.degraded = False   # tripped the compile cap: plain eager
-        self.stats = {"lazy_ops": 0, "flushes": 0, "segments_compiled": 0,
-                      "segment_calls": 0, "eager_tape_ops": 0}
+        self.stats = {k: 0 for k in LAZY_SEGMENT_STATS_SCHEMA}
 
     # -- recording ----------------------------------------------------------
 

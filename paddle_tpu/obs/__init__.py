@@ -1,0 +1,218 @@
+"""Fleet-wide observability plane: span tracing, metrics, flight
+recorder.
+
+Armed/disarmed follows the :mod:`paddle_tpu.testing.chaos` pattern: one
+module global, one load on the disarmed fast path, and **no effect on
+any computed stream** in either state — tracing observes host control
+flow only, never touches device programs, RNG or scheduling decisions,
+so serving/fleet outputs are pinned bit-identical with tracing off AND
+on.
+
+Usage (host code)::
+
+    from paddle_tpu import obs as _obs
+
+    # hot path: guard on active() exactly like chaos probes
+    if _obs.active():
+        with _obs.span("engine.step", engine=self.engine_id):
+            ...
+
+    # cold paths may call unconditionally: every helper no-ops when
+    # disarmed
+    _obs.lifecycle(req.rid, "first-token", engine=self.engine_id)
+    _obs.flight_dump("engine-death", detail=rep.last_error)
+
+Arming: ``obs.arm()`` in tests/tools, or the ``obs_trace`` flag
+(``FLAGS_obs_trace=1``) picked up by ``arm_from_flags()`` from the
+engine/router/train-loop constructors. While armed, chaos faults that
+actually fire are annotated into the trace (instant events named
+``chaos.<point>``) and logged for the flight recorder through a chaos
+observer callback.
+
+Export: ``obs.export(path)`` writes Chrome trace-event JSON — open in
+Perfetto (https://ui.perfetto.dev) or ``chrome://tracing``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from paddle_tpu.core.flags import GLOBAL_FLAGS
+from paddle_tpu.testing import chaos as _chaos
+
+from . import clock, flight as _flight
+from .metrics import (FLEET_STATS_SCHEMA, MetricsRegistry,
+                      SERVING_STATS_SCHEMA, TRAIN_STATS_SCHEMA)
+from .trace import Tracer
+
+__all__ = ["active", "arm", "arm_from_flags", "disarm", "span",
+           "instant", "lifecycle", "flight_dump", "export", "tracer",
+           "registry", "clock", "Tracer", "MetricsRegistry",
+           "SERVING_STATS_SCHEMA", "FLEET_STATS_SCHEMA",
+           "TRAIN_STATS_SCHEMA"]
+
+
+class _NoopSpan:
+    """Shared reusable ``with`` guard for the disarmed path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _ObsState:
+    """Everything one armed session owns."""
+
+    def __init__(self, capacity: int, dump_dir: str):
+        self.tracer = Tracer(capacity)
+        self.registry = MetricsRegistry()
+        self.faults: list = []          # chaos specs that actually fired
+        self.dump_dir = dump_dir
+        self.dumps: list = []           # flightrec paths written
+
+
+_armed: Optional[_ObsState] = None
+
+
+def _tid(engine) -> int:
+    """Trace track for an engine id: track 0 is the host/fleet track,
+    engine N lives on track N+1."""
+    return 0 if engine is None else int(engine) + 1
+
+
+def _on_chaos_fire(point: str, spec, ctx, invocation: int) -> None:
+    """Chaos observer: a fault actually fired — annotate the trace and
+    remember it for the flight recorder."""
+    st = _armed
+    if st is None:
+        return
+    rec = {"point": point, "kind": spec.kind,
+           "args": {k: v for k, v in spec.args.items()},
+           "ctx": dict(ctx or {}), "invocation": invocation}
+    st.faults.append(rec)
+    st.tracer.instant("chaos." + point,
+                      tid=_tid((ctx or {}).get("engine")),
+                      attrs={"kind": spec.kind, "invocation": invocation,
+                             **{f"ctx.{k}": str(v)
+                                for k, v in (ctx or {}).items()}})
+
+
+# -- arming ------------------------------------------------------------------
+
+def active() -> bool:
+    return _armed is not None
+
+
+def arm(capacity: Optional[int] = None,
+        dump_dir: Optional[str] = None) -> _ObsState:
+    """Activate tracing process-wide (replaces any armed session)."""
+    global _armed
+    if capacity is None:
+        capacity = int(GLOBAL_FLAGS.get("obs_buffer_events"))
+    if dump_dir is None:
+        dump_dir = str(GLOBAL_FLAGS.get("obs_dir"))
+    _armed = _ObsState(capacity, dump_dir)
+    _chaos.add_observer(_on_chaos_fire)
+    return _armed
+
+
+def disarm() -> None:
+    global _armed
+    _armed = None
+    _chaos.remove_observer(_on_chaos_fire)
+
+
+def arm_from_flags() -> bool:
+    """Arm iff the ``obs_trace`` flag is set (the constructors of
+    ServingEngine / FleetRouter / ResilientTrainLoop call this, so
+    ``FLAGS_obs_trace=1`` traces any entry point without code changes).
+    Idempotent; returns whether tracing is armed afterwards."""
+    if _armed is not None:
+        return True
+    if not GLOBAL_FLAGS.get("obs_trace"):
+        return False
+    arm(capacity=int(GLOBAL_FLAGS.get("obs_buffer_events")),
+        dump_dir=str(GLOBAL_FLAGS.get("obs_dir")))
+    return True
+
+
+# -- recording ---------------------------------------------------------------
+
+def span(name: str, engine=None, **attrs):
+    """``with obs.span("engine.step", engine=0):`` — a no-op shared
+    guard when disarmed (one global load), a B/E pair on the engine's
+    track when armed."""
+    st = _armed
+    if st is None:
+        return _NOOP
+    if engine is not None:
+        attrs["engine"] = engine
+    return st.tracer.span(name, tid=_tid(engine), attrs=attrs or None)
+
+
+def instant(name: str, engine=None, **attrs) -> None:
+    st = _armed
+    if st is None:
+        return
+    if engine is not None:
+        attrs["engine"] = engine
+    st.tracer.instant(name, tid=_tid(engine), attrs=attrs or None)
+
+
+_LIFECYCLE_PH = {"arrival": "b", "done": "e"}
+
+
+def lifecycle(rid: int, event: str, engine=None, **attrs) -> None:
+    """One request-lifecycle event: ``arrival`` opens the async flow
+    (ph ``b``), ``done`` closes it (ph ``e``), everything between
+    (admit, first-token, preempt, migrate, ship, adopt, ...) is an
+    async instant (ph ``n``) — all sharing ``id=rid`` so Perfetto
+    stitches the flow across engine tracks."""
+    st = _armed
+    if st is None:
+        return
+    attrs["event"] = event
+    if engine is not None:
+        attrs["engine"] = engine
+    st.tracer.async_event("req", rid, _LIFECYCLE_PH.get(event, "n"),
+                          tid=_tid(engine), attrs=attrs)
+
+
+# -- artifacts ---------------------------------------------------------------
+
+def flight_dump(reason: str, detail: Optional[str] = None) -> Optional[str]:
+    """Dump the ring on a death path; returns the flightrec path, or
+    None when disarmed."""
+    st = _armed
+    if st is None:
+        return None
+    st.tracer.instant("flightrec.dump", attrs={"reason": reason})
+    path = _flight.dump(st.tracer, reason, detail=detail,
+                        faults=st.faults, registry=st.registry,
+                        dump_dir=st.dump_dir)
+    st.dumps.append(path)
+    return path
+
+
+def export(path: Optional[str] = None) -> Optional[dict]:
+    """Chrome trace-event JSON of the armed tracer (None when
+    disarmed)."""
+    st = _armed
+    if st is None:
+        return None
+    return st.tracer.export(path)
+
+
+def tracer() -> Optional[Tracer]:
+    return _armed.tracer if _armed is not None else None
+
+
+def registry() -> Optional[MetricsRegistry]:
+    return _armed.registry if _armed is not None else None

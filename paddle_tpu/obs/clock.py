@@ -1,0 +1,26 @@
+"""The one duration clock for the observability plane.
+
+Every duration measured anywhere in serving / fleet / loadgen / train
+code goes through :func:`now` so trace timestamps, recovery timings and
+driver walls are mutually comparable. ``time.perf_counter()`` is the
+highest-resolution monotonic clock CPython offers; the historical split
+(router on ``time.monotonic()``, wire timing on ``time.perf_counter()``)
+meant artifacts from the two sides could not be diffed on one axis.
+
+Request timestamps (``Request.t_first`` / ``t_done``) are stamped on
+this clock by the engines and rebased against a driver ``t0`` taken from
+the same clock — the epoch cancels, but only because every participant
+reads the SAME clock. Do not mix ``time.monotonic()`` back in.
+"""
+
+from __future__ import annotations
+
+import time
+
+__all__ = ["now"]
+
+
+def now() -> float:
+    """Seconds on the process-wide duration clock (monotonic,
+    arbitrary epoch)."""
+    return time.perf_counter()

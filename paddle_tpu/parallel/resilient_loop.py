@@ -39,6 +39,8 @@ import random
 import time
 from typing import Callable, Optional
 
+from .. import obs as _obs
+
 __all__ = ["with_retries", "agree_resume_step", "ResilientTrainLoop"]
 
 logger = logging.getLogger("paddle_tpu.parallel.resilient_loop")
@@ -171,6 +173,9 @@ class ResilientTrainLoop:
         self.bad_streak = 0
         self.stats = {"skipped": 0, "rollbacks": 0, "hangs": 0,
                       "io_retries": 0}
+        # FLAGS_obs_trace=1 arms the observability plane on the train
+        # side too (train.step / ckpt.save spans, death-path dumps)
+        _obs.arm_from_flags()
 
     # -- recovery ---------------------------------------------------------
     def resume(self) -> Optional[int]:
@@ -212,6 +217,9 @@ class ResilientTrainLoop:
     def _rollback(self):
         from ..distributed.checkpoint import load_latest_valid
 
+        _obs.flight_dump("nan-rollback",
+                         detail=f"step {self.step}: {self.bad_streak} "
+                                "consecutive non-finite loss(es)")
         rolled = with_retries(load_latest_valid, self.state, self.ckpt_dir,
                               retries=self.retries,
                               on_retry=self._count_retry)
@@ -231,10 +239,12 @@ class ResilientTrainLoop:
     def _save(self):
         from ..distributed.checkpoint import save_checkpoint
 
-        with_retries(save_checkpoint, self.state, self.ckpt_dir, self.step,
-                     keep_last_k=self.keep_last_k,
-                     coordinator_rank=self.coordinator_rank,
-                     retries=self.retries, on_retry=self._count_retry)
+        with _obs.span("ckpt.save", step=self.step):
+            with_retries(save_checkpoint, self.state, self.ckpt_dir,
+                         self.step, keep_last_k=self.keep_last_k,
+                         coordinator_rank=self.coordinator_rank,
+                         retries=self.retries,
+                         on_retry=self._count_retry)
 
     # -- hang escalation --------------------------------------------------
     def _escalate(self, tag: str, age: float):
@@ -244,6 +254,9 @@ class ResilientTrainLoop:
 
         self.stats["hangs"] += 1
         tasks = comm_task_manager.in_flight()
+        _obs.flight_dump("watchdog-escalation",
+                         detail=f"{tag} hung {age:.1f}s; "
+                                f"{len(tasks)} in-flight comm task(s)")
         logger.error("step %r hung for %.1fs; %d in-flight comm task(s)%s",
                      tag, age, len(tasks),
                      "".join(f"\n  - {n} ({a:.1f}s old)" for n, a in tasks))
@@ -276,10 +289,12 @@ class ResilientTrainLoop:
             # through the launcher's death watch / stale heartbeat lease
             os._exit(int(fault.args.get("code", 1)))
         with self.watchdog.guard(f"step{self.step}"):
-            if fault is not None and fault.kind == "hang":
-                time.sleep(float(fault.args.get("seconds", 1.0)))
-            loss, new_state = self.step_fn(self.state, batch)
-            loss_val = float(loss)   # the blocking fetch the guard covers
+            with _obs.span("train.step", step=self.step):
+                if fault is not None and fault.kind == "hang":
+                    time.sleep(float(fault.args.get("seconds", 1.0)))
+                loss, new_state = self.step_fn(self.state, batch)
+                # the blocking fetch the guard covers
+                loss_val = float(loss)
         if fault is not None and fault.kind == "nan":
             loss_val = float("nan")
         if not math.isfinite(loss_val):

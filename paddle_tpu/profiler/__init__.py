@@ -8,19 +8,26 @@ the CUPTI tracer + chrome-trace logger); RecordEvent maps to
 jax.profiler.TraceAnnotation so user spans appear inside the device trace;
 host-side per-op stats ride the dispatch funnel hook (the host_tracer.h
 role).
+
+When the observability plane is armed (FLAGS_obs_trace=1 or
+``obs.arm()``), RecordEvent spans also land in the shared obs tracer
+ring, so profiler user-spans and engine/fleet spans interleave in one
+Chrome trace; ``export_chrome_tracing`` then writes that trace next to
+the host summary.
 """
 
 from __future__ import annotations
 
 import contextlib
 import enum
-import time
 from collections import defaultdict
 from typing import Callable, Iterable, Optional
 
 import jax
 
+from .. import obs as _obs
 from ..core.dispatch import DISPATCH_HOOKS
+from ..obs import clock as _clock
 
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
@@ -73,18 +80,27 @@ class RecordEvent:
         self.name = name
         self._ann = None
         self._t0 = None
+        self._obs_open = False
 
     def begin(self):
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
-        self._t0 = time.perf_counter()
+        self._t0 = _clock.now()
         _HOST_EVENTS[self.name]["count"] += 1
+        if _obs.active():
+            _obs.tracer().begin(self.name, attrs={"src": "profiler"})
+            self._obs_open = True
 
     def end(self):
         if self._ann is not None:
-            _HOST_EVENTS[self.name]["total_s"] += time.perf_counter() - self._t0
+            _HOST_EVENTS[self.name]["total_s"] += _clock.now() - self._t0
             self._ann.__exit__(None, None, None)
             self._ann = None
+        if self._obs_open:
+            self._obs_open = False
+            tr = _obs.tracer()
+            if tr is not None:      # obs may have disarmed mid-span
+                tr.end(self.name)
 
     def __enter__(self):
         self.begin()
@@ -135,7 +151,7 @@ class Profiler:
             name, self._op_counts[name] + 1)
         self._hook = hook
         DISPATCH_HOOKS.append(hook)
-        self._last_step_t = time.perf_counter()
+        self._last_step_t = _clock.now()
 
     def stop(self):
         if self._hook in DISPATCH_HOOKS:
@@ -151,7 +167,7 @@ class Profiler:
             self._on_trace_ready(self)
 
     def step(self, num_samples: Optional[int] = None):
-        now = time.perf_counter()
+        now = _clock.now()
         if self._last_step_t is not None:
             self._step_times.append(now - self._last_step_t)
         self._last_step_t = now
@@ -227,6 +243,10 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
 
         os.makedirs(dir_name, exist_ok=True)
         prof.export(os.path.join(dir_name, "host_summary.txt"))
+        if _obs.active():
+            # the shared obs ring (RecordEvent spans included) as Chrome
+            # trace-event JSON, next to the host summary
+            _obs.export(os.path.join(dir_name, "obs_trace.json"))
 
     return handler
 

@@ -127,7 +127,8 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 __all__ = ["FaultSpec", "FaultPlan", "ChaosInjected", "arm", "disarm",
-           "active", "fire", "raise_fault", "arm_from_env", "PLAN_ENV"]
+           "active", "fire", "raise_fault", "arm_from_env", "PLAN_ENV",
+           "add_observer", "remove_observer"]
 
 logger = logging.getLogger("paddle_tpu.testing.chaos")
 
@@ -265,11 +266,35 @@ class _ArmedPlan:
                     logger.warning("chaos[%s]: firing %s(%s) at "
                                    "invocation %d of %s", self.plan.name,
                                    spec.kind, spec.args, n, point)
+                    for cb in list(_observers):
+                        try:
+                            cb(point, spec, ctx, n)
+                        except Exception:
+                            logger.exception("chaos observer %r failed",
+                                             cb)
                     return spec
         return None
 
 
 _armed: Optional[_ArmedPlan] = None
+
+# fault observers: called as cb(point, spec, ctx, invocation) ONLY when a
+# spec actually fires (the cold path — the disarmed probe cost is
+# untouched). The observability plane registers one to annotate injected
+# faults into the trace / flight recorder.
+_observers: list = []
+
+
+def add_observer(cb) -> None:
+    if cb not in _observers:
+        _observers.append(cb)
+
+
+def remove_observer(cb) -> None:
+    try:
+        _observers.remove(cb)
+    except ValueError:
+        pass
 
 
 def arm(plan: FaultPlan) -> None:

@@ -1,0 +1,26 @@
+"""TPL010 fixture: metrics hygiene (never imported)."""
+
+FX_M_STATS_SCHEMA = {
+    "fx_m_declared_written": ("counter", "declared and written: clean"),
+    "fx_m_cond_a": ("counter", "written via one IfExp arm: clean"),
+    "fx_m_cond_b": ("counter", "written via the other arm: clean"),
+    "fx_m_dyn_credit": ("counter", "dynamic write, call-site literal"),
+    "fx_m_ghost_series": ("counter", "flatlines forever"),  # seeded violation
+}
+
+
+class FxEngine:
+    def __init__(self):
+        self.stats = {k: 0 for k in FX_M_STATS_SCHEMA}
+
+    def tick(self, blocked: bool):
+        self.stats["fx_m_declared_written"] += 1
+        self.stats["fx_m_cond_a" if blocked else "fx_m_cond_b"] += 1
+        self.stats["fx_m_rogue_counter"] += 1   # seeded violation
+        self.stats["fx_m_reserved"] += 1  # tpu-lint: disable=TPL010 -- fixture: suppressed instance
+        self._bump("fx_m_dyn_credit")
+
+    def _bump(self, counter: str):
+        # dynamic key: extraction skips it; the call-site literal above
+        # is the mention credit keeping fx_m_dyn_credit off the report
+        self.stats[counter] += 1

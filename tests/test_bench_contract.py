@@ -29,7 +29,8 @@ def bench(monkeypatch):
     for name in ("_bench_chip_probe", "_bench_decode", "_bench_serving",
                  "_bench_multitenant", "_bench_fleet", "_bench_disagg",
                  "_bench_loss_curve", "_bench_13b", "_bench_long_ctx",
-                 "_bench_multichip", "_bench_fusion", "_bench_phases"):
+                 "_bench_multichip", "_bench_fusion", "_bench_phases",
+                 "_bench_obs"):
         monkeypatch.setattr(b, name, lambda: {})
     return b
 
@@ -311,6 +312,27 @@ def test_fusion_key_contract(bench):
     assert cold["fusion_n_sites"] == 0
     assert cold["fusion_tok_s"] == 0.0
     assert cold["autotune_program_cache_hit"] is False
+
+
+def test_obs_key_contract(bench):
+    """_obs_keys is the pure obs-measurement -> bench-keys mapping
+    (ISSUE 19): armed-vs-disarmed wall overhead fraction and trace-event
+    volume per engine step, both zero-guarded."""
+    out = bench._obs_keys(n_emitted=1200, steps=60, plain_s=2.0,
+                          armed_s=2.1)
+    assert out == {"obs_trace_overhead_frac": pytest.approx(0.05),
+                   "obs_events_per_step": pytest.approx(20.0)}
+    cold = bench._obs_keys(n_emitted=0, steps=0, plain_s=0.0,
+                           armed_s=0.0)
+    assert cold == {"obs_trace_overhead_frac": 0.0,
+                    "obs_events_per_step": 0.0}
+    # the measurement arm really drives the serving engine through the
+    # obs plane: disarmed control first, armed run second (the fixture
+    # stubs the attribute, so read the shipped source instead)
+    src = open(bench.__file__).read()
+    body = src.split("def _bench_obs():")[1]
+    assert "obs.arm" in body and "obs.disarm" in body
+    assert "_obs_keys(" in body
 
 
 from conftest import requires_native_partial_manual

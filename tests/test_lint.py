@@ -235,6 +235,38 @@ def test_tpl009_exempts_kernel_homes_and_parity_tests(tmp_path):
     assert len(f) == 1 and f[0].rule == "TPL009"
 
 
+def test_tpl010_metrics_hygiene_fires_and_suppresses():
+    src = open(fx("fx_metrics.py")).read()
+    f = lint(["fx_metrics.py"], "TPL010")
+    assert len(f) == 2, [(x.line, x.message) for x in f]
+    for x in f:
+        assert "seeded violation" in src.splitlines()[x.line - 1], \
+            (x.line, x.message)
+        assert x.severity == "warning"
+    msgs = " | ".join(x.message for x in f)
+    # the rogue write and the flatlining declaration fire ...
+    assert "fx_m_rogue_counter" in msgs and "never" not in \
+        next(x.message for x in f if "rogue" in x.message)
+    assert "fx_m_ghost_series" in msgs
+    # ... while declared+written keys, both IfExp arms, the
+    # mention-credited dynamic write, and the suppressed instance
+    # stay silent
+    for quiet in ("fx_m_declared_written", "fx_m_cond_a", "fx_m_cond_b",
+                  "fx_m_dyn_credit", "fx_m_reserved"):
+        assert quiet not in msgs, quiet
+
+
+def test_tpl010_silent_without_schema(tmp_path):
+    # a tree with stats writes but no *_STATS_SCHEMA declaration is out
+    # of the rule's jurisdiction (nothing to be in lockstep with)
+    mod = tmp_path / "plain.py"
+    mod.write_text("class E:\n"
+                   "    def tick(self):\n"
+                   "        self.stats['anything_goes'] += 1\n")
+    f = run_lint([str(mod)], select={"TPL010"}, excludes=())
+    assert f == []
+
+
 def test_tpl008_silent_without_sharding_marks(tmp_path):
     # the same gather in a file that never touches sharding machinery is
     # out of the rule's jurisdiction (GSPMD cannot repartition it)
@@ -357,8 +389,8 @@ def test_cli_parse_error_bypasses_ignore(tmp_path, capsys):
 
 def test_rule_table_unique_and_documented():
     rules = [c.rule for c in ALL_CHECKERS]
-    # 9 per-file + 3 interproc + 3 typestate
-    assert len(rules) == len(set(rules)) == 15
+    # 10 per-file + 3 interproc + 3 typestate
+    assert len(rules) == len(set(rules)) == 16
     assert all(c.description for c in ALL_CHECKERS)
     assert all(c.severity in ("error", "warning") for c in ALL_CHECKERS)
 

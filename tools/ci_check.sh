@@ -3,7 +3,7 @@
 # suite -> serving smoke -> kernel parity -> loadgen smoke -> multichip
 # smoke -> multitenant smoke -> fleet smoke -> disagg smoke -> fusion
 # smoke -> shardcheck smoke -> quantcheck smoke -> rollout smoke ->
-# tier-1.
+# obs smoke -> tier-1.
 #
 #   bash tools/ci_check.sh
 #
@@ -39,12 +39,16 @@
 #       kill: a stream was dropped, diverged from its pinned version,
 #       the fleet did not converge to the target version, or a ledger
 #       leaked)
+#  160  obs smoke failed (armed tracing through an engine kill produced
+#       an invalid Chrome trace, lost the migration span or chaos
+#       annotation, failed to flight-dump on the death path, leaked
+#       pages, or perturbed a token stream vs the disarmed control run)
 #   30  tier-1 tests failed (ROADMAP.md command)
 #    0  all gates green
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== gate 1/15: tpu-lint (per-file + interprocedural + typestate rules) =="
+echo "== gate 1/16: tpu-lint (per-file + interprocedural + typestate rules) =="
 python -m tools.lint paddle_tpu tests tools --format=json > /tmp/tpu_lint.json
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -54,7 +58,7 @@ if [ "$rc" -ne 0 ]; then
 fi
 echo "tpu-lint: clean"
 
-echo "== gate 2/15: tpu-verify (abstract op-contract baseline) =="
+echo "== gate 2/16: tpu-verify (abstract op-contract baseline) =="
 JAX_PLATFORMS=cpu python -m tools.lint --contracts \
     --baseline artifacts/op_contracts.json
 rc=$?
@@ -64,7 +68,7 @@ if [ "$rc" -ne 0 ]; then
     exit 20
 fi
 
-echo "== gate 3/15: chaos suite (fault injection -> self-healing) =="
+echo "== gate 3/16: chaos suite (fault injection -> self-healing) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
 rc=$?
@@ -74,7 +78,7 @@ if [ "$rc" -ne 0 ]; then
     exit 40
 fi
 
-echo "== gate 4/15: serving smoke (scheduler completion + zero page leak) =="
+echo "== gate 4/16: serving smoke (scheduler completion + zero page leak) =="
 JAX_PLATFORMS=cpu python -m tools.serving_smoke
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -83,7 +87,7 @@ if [ "$rc" -ne 0 ]; then
     exit 50
 fi
 
-echo "== gate 5/15: kernel parity (fused megakernels, CPU fallback arms) =="
+echo "== gate 5/16: kernel parity (fused megakernels, CPU fallback arms) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_fused_norm_epilogue.py \
     tests/test_fused_rope_attention.py tests/test_autotune.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly
@@ -94,7 +98,7 @@ if [ "$rc" -ne 0 ]; then
     exit 60
 fi
 
-echo "== gate 6/15: loadgen smoke (open-loop saturation, >=200 arrivals) =="
+echo "== gate 6/16: loadgen smoke (open-loop saturation, >=200 arrivals) =="
 JAX_PLATFORMS=cpu python -m tools.loadgen_smoke
 rc=$?
 if [ "$rc" -ne 0 ]; then
@@ -104,7 +108,7 @@ if [ "$rc" -ne 0 ]; then
     exit 70
 fi
 
-echo "== gate 7/15: multichip smoke (dp x mp mesh: remat-free compile," \
+echo "== gate 7/16: multichip smoke (dp x mp mesh: remat-free compile," \
      "serial parity, quantized all-reduce) =="
 python tools/multichip_smoke.py
 rc=$?
@@ -115,7 +119,7 @@ if [ "$rc" -ne 0 ]; then
     exit 80
 fi
 
-echo "== gate 8/15: multitenant smoke (LoRA isolation, preemption," \
+echo "== gate 8/16: multitenant smoke (LoRA isolation, preemption," \
      "constrained legality, 7-class ledger) =="
 JAX_PLATFORMS=cpu python -m tools.multitenant_smoke
 rc=$?
@@ -127,7 +131,7 @@ if [ "$rc" -ne 0 ]; then
     exit 90
 fi
 
-echo "== gate 9/15: fleet smoke (engine loss -> bit-identical resume," \
+echo "== gate 9/16: fleet smoke (engine loss -> bit-identical resume," \
      "page migration, survivor ledger) =="
 JAX_PLATFORMS=cpu python -m tools.fleet_smoke
 rc=$?
@@ -138,7 +142,7 @@ if [ "$rc" -ne 0 ]; then
     exit 100
 fi
 
-echo "== gate 10/15: disagg smoke (prefill-pool loss -> degraded" \
+echo "== gate 10/16: disagg smoke (prefill-pool loss -> degraded" \
      "colocated completion, shipped pages, surviving ledgers) =="
 JAX_PLATFORMS=cpu python -m tools.disagg_smoke
 rc=$?
@@ -149,7 +153,7 @@ if [ "$rc" -ne 0 ]; then
     exit 110
 fi
 
-echo "== gate 11/15: fusion smoke (jaxpr fusion discovery, eager" \
+echo "== gate 11/16: fusion smoke (jaxpr fusion discovery, eager" \
      "parity, per-program autotune replay) =="
 JAX_PLATFORMS=cpu python -m tools.fusion_smoke
 rc=$?
@@ -161,7 +165,7 @@ if [ "$rc" -ne 0 ]; then
     exit 120
 fi
 
-echo "== gate 12/15: shardcheck smoke (static sharding/collective" \
+echo "== gate 12/16: shardcheck smoke (static sharding/collective" \
      "verification over the registered entry programs) =="
 JAX_PLATFORMS=cpu python -m tools.lint --shardcheck \
     --baseline artifacts/shardcheck.json
@@ -175,7 +179,7 @@ if [ "$rc" -ne 0 ]; then
     exit 130
 fi
 
-echo "== gate 13/15: quantcheck smoke (static precision & scale-provenance" \
+echo "== gate 13/16: quantcheck smoke (static precision & scale-provenance" \
      "verification + TPL303 scale-leak regression harness) =="
 JAX_PLATFORMS=cpu python -m tools.lint --quantcheck \
     --baseline artifacts/quantcheck.json
@@ -194,7 +198,7 @@ if [ "$rc" -ne 0 ]; then
     exit 140
 fi
 
-echo "== gate 14/15: rollout smoke (live weight deploy under a mid-swap" \
+echo "== gate 14/16: rollout smoke (live weight deploy under a mid-swap" \
      "chaos kill -> pinned-version bit-identity, single-version" \
      "convergence, zero leak) =="
 JAX_PLATFORMS=cpu python -m tools.rollout_smoke
@@ -206,15 +210,28 @@ if [ "$rc" -ne 0 ]; then
     exit 150
 fi
 
-echo "== gate 15/15: tier-1 tests (ROADMAP.md) =="
+echo "== gate 15/16: obs smoke (armed tracing through an engine kill ->" \
+     "valid trace + migration span + fault annotation + flight dump," \
+     "disarmed control bit-identical) =="
+JAX_PLATFORMS=cpu python -m tools.obs_smoke
+rc=$?
+if [ "$rc" -ne 0 ]; then
+    echo "ci_check: obs smoke gate failed (rc=$rc) — the armed trace" \
+         "went structurally invalid, lost the migration/chaos evidence," \
+         "the death path stopped flight-dumping, a ledger leaked, or" \
+         "tracing perturbed a token stream" >&2
+    exit 160
+fi
+
+echo "== gate 16/16: tier-1 tests (ROADMAP.md) =="
 
 set -o pipefail
 rm -f /tmp/_t1.log
-# budget raised 870 -> 1200: the suite is ~1010s single-process as of
-# PR 10 (711 tests; growth is spread across rounds, top offenders are
-# the lint/contract sweeps) — keep headroom so a green suite can't
+# budget raised 870 -> 1200 -> 1800: the suite is ~1300s single-process
+# as of PR 19 (888 tests; growth is spread across rounds, top offenders
+# are the lint/contract sweeps) — keep headroom so a green suite can't
 # time out
-timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+timeout -k 10 1800 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

@@ -11,6 +11,7 @@ TPL006 flag-hygiene          define_flag() names that are never read
 TPL007 pallas-autotune-bypass pallas_call sites no tuned() entry reaches
 TPL008 gather-sharding-constraint  traced gathers never pinned by a constraint
 TPL009 fusion-bypass         model code hand-wiring ops/pallas/fused_* calls
+TPL010 metrics-hygiene       stats keys written/declared out of schema lockstep
 
 The analyses are deliberately first-order (per-function taint, per-file
 axis sets, project-wide name sets) — precise enough to catch the shipped
@@ -1076,6 +1077,93 @@ class HandWiredFusionBypass(Checker):
         self.ctx = None
 
 
+# -- TPL010: metrics hygiene -------------------------------------------------
+
+class MetricsHygiene(Checker):
+    """Runtime ``stats`` counters and their declared schema drift apart
+    silently: a key written in serving/fleet code but absent from every
+    ``*_STATS_SCHEMA`` dict (paddle_tpu/obs/metrics.py) never reaches the
+    metrics registry, the Prometheus export or the flight recorder; a
+    key declared in a schema but written nowhere is a dashboard series
+    that flatlines at zero forever. Both directions are reported.
+
+    Key extraction from ``x.stats[...]`` store sites is deliberately
+    conservative (first-order, like TPL006): a string constant is that
+    key; a conditional expression contributes the union of both arms;
+    anything else (computed keys, loop variables) is dynamic and
+    skipped. Dynamic writes instead earn their keys *mention credit* —
+    any string literal outside a schema dict that equals a declared key
+    (e.g. the ``self._drop(req, "n_shed")`` call-site literal) counts as
+    a writer, so a declared key is only reported when NOTHING in the
+    tree could name it.
+    """
+
+    rule = "TPL010"
+    name = "metrics-hygiene"
+    severity = "warning"
+    description = "stats key written but undeclared, or declared but never written"
+
+    _SCHEMA_SUFFIX = "_STATS_SCHEMA"
+
+    def __init__(self):
+        super().__init__()
+        self.declared: dict[str, tuple] = {}  # key -> (path, line, node)
+        self.writes: dict[str, list] = {}     # key -> [(path, line, node)]
+        self.mentions: set[str] = set()       # str literals outside schemas
+
+    def _literal_keys(self, expr: ast.AST) -> list[str]:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return [expr.value]
+        if isinstance(expr, ast.IfExp):
+            # both arms are possible at runtime; the test is irrelevant
+            return self._literal_keys(expr.body) + \
+                self._literal_keys(expr.orelse)
+        return []  # dynamic key — handled by mention credit
+
+    def visit_Assign(self, node: ast.Assign):
+        tgt = node.targets[0] if len(node.targets) == 1 else None
+        name = dotted_name(tgt) if tgt is not None else ""
+        if name.endswith(self._SCHEMA_SUFFIX) and \
+                isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    self.declared.setdefault(
+                        k.value, (self.ctx.path, k.lineno, k))
+            return  # don't descend: a declaration is not mention credit
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        if isinstance(node.ctx, ast.Store) and \
+                dotted_name(node.value).rsplit(".", 1)[-1] == "stats":
+            for key in self._literal_keys(node.slice):
+                self.writes.setdefault(key, []).append(
+                    (self.ctx.path, node.lineno, node))
+        self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant):
+        if isinstance(node.value, str):
+            self.mentions.add(node.value)
+
+    def finalize(self):
+        if not self.declared:
+            return  # no schema in the analyzed tree — nothing to check
+        for key, sites in sorted(self.writes.items()):
+            if key not in self.declared:
+                path, line, node = sites[0]
+                self.report(node, f"stats key '{key}' is written but not "
+                                  "declared in any *_STATS_SCHEMA dict — "
+                                  "the metrics registry cannot absorb it "
+                                  "(declare it, or rename the write)",
+                            path=path, line=line)
+        for key, (path, line, node) in sorted(self.declared.items()):
+            if key not in self.mentions:
+                self.report(node, f"stats key '{key}' is declared in a "
+                                  "*_STATS_SCHEMA but never written (or "
+                                  "even named) by any code in the analyzed "
+                                  "tree — a metric series that flatlines "
+                                  "at zero", path=path, line=line)
+
+
 ALL_CHECKERS = [
     HostSyncInTrace,
     AsyncAliasing,
@@ -1086,4 +1174,5 @@ ALL_CHECKERS = [
     PallasAutotuneBypass,
     GatherShardingConstraint,
     HandWiredFusionBypass,
+    MetricsHygiene,
 ]
